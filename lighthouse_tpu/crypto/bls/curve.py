@@ -1,0 +1,156 @@
+"""BLS12-381 elliptic-curve group operations (host golden model).
+
+Generic short-Weierstrass (a = 0) affine arithmetic parameterised over the field
+element type, so the same code serves E1(Fp), the twist E2(Fp2) and the untwisted
+E(Fp12) used by the Miller loop.  Mirrors the capability surface of the reference's
+``crypto/bls`` point types (``crypto/bls/src/generic_public_key.rs`` et al.).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from .fields import Fq, Fq2, Fq6, Fq12, GAMMA
+from .params import B1, B2, G1_X, G1_Y, G2_X_C0, G2_X_C1, G2_Y_C0, G2_Y_C1, H1, P, R, X
+
+# A point is None (infinity) or a tuple (x, y) of field elements.
+Point = Optional[Tuple[object, object]]
+
+
+def is_on_curve(pt: Point, b) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return y * y == x * x * x + b
+
+
+def add(p1: Point, p2: Point) -> Point:
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if y1 == y2:
+            return double(p1)
+        return None
+    m = (y2 - y1) * (x2 - x1).inv()
+    x3 = m * m - x1 - x2
+    return (x3, m * (x1 - x3) - y1)
+
+
+def double(p1: Point) -> Point:
+    if p1 is None:
+        return None
+    x1, y1 = p1
+    if y1.is_zero():
+        return None
+    m = (x1 * x1 + x1 * x1 + x1 * x1) * (y1 + y1).inv()
+    x3 = m * m - x1 - x1
+    return (x3, m * (x1 - x3) - y1)
+
+
+def neg(p1: Point) -> Point:
+    if p1 is None:
+        return None
+    x1, y1 = p1
+    return (x1, -y1)
+
+
+def mul(p1: Point, k: int) -> Point:
+    """Scalar multiplication [k]P (double-and-add; host reference only)."""
+    if k < 0:
+        return mul(neg(p1), -k)
+    acc: Point = None
+    addend = p1
+    while k:
+        if k & 1:
+            acc = add(acc, addend)
+        addend = double(addend)
+        k >>= 1
+    return acc
+
+
+G1 = (Fq(G1_X), Fq(G1_Y))
+G2 = (Fq2(G2_X_C0, G2_X_C1), Fq2(G2_Y_C0, G2_Y_C1))
+
+B1_FQ = Fq(B1)
+B2_FQ2 = Fq2(*B2)
+B12_FQ12 = Fq12.from_fq2(Fq2(4, 0))  # untwisted curve: y^2 = x^3 + 4 over Fp12
+
+
+def untwist(pt: Point) -> Point:
+    """Map E2(Fp2) -> E(Fp12): (x, y) -> (x / w^2, y / w^3)  (M-twist)."""
+    if pt is None:
+        return None
+    x, y = pt
+    w = Fq12.w()
+    w2_inv = (w * w).inv()
+    w3_inv = (w * w * w).inv()
+    return (Fq12.from_fq2(x) * w2_inv, Fq12.from_fq2(y) * w3_inv)
+
+
+def embed_g1(pt: Point) -> Point:
+    """Embed E1(Fp) into E(Fp12)."""
+    if pt is None:
+        return None
+    x, y = pt
+    return (Fq12.from_fq2(Fq2(x.n, 0)), Fq12.from_fq2(Fq2(y.n, 0)))
+
+
+# psi: untwist -> Frobenius -> twist endomorphism on E2(Fp2).
+# psi(x, y) = (cx * conj(x), cy * conj(y)) with cx = xi^{-(p-1)/3}, cy = xi^{-(p-1)/2}.
+_XI = Fq2(1, 1)
+PSI_CX = _XI.pow((P - 1) // 3).inv()
+PSI_CY = _XI.pow((P - 1) // 2).inv()
+
+
+def psi(pt: Point) -> Point:
+    if pt is None:
+        return None
+    x, y = pt
+    return (x.conj() * PSI_CX, y.conj() * PSI_CY)
+
+
+def psi2(pt: Point) -> Point:
+    return psi(psi(pt))
+
+
+def clear_cofactor_g2(pt: Point) -> Point:
+    """Budroni–Pintore fast cofactor clearing, as specified for BLS12-381 G2
+    (RFC 9380 / hash-to-curve draft; what blst implements):
+
+        h_eff * P = [x^2 - x - 1]P + [x - 1]psi(P) + psi^2([2]P)
+    """
+    t1 = mul(pt, X * X - X - 1)
+    t2 = mul(psi(pt), X - 1)
+    t3 = psi2(double(pt))
+    return add(add(t1, t2), t3)
+
+
+def mul_by_x(pt: Point) -> Point:
+    """[x]P with the (negative) BLS parameter."""
+    return mul(pt, X)
+
+
+def in_g1(pt: Point) -> bool:
+    """Full G1 membership: on curve and in the r-order subgroup."""
+    if pt is None:
+        return True
+    if not is_on_curve(pt, B1_FQ):
+        return False
+    return mul(pt, R) is None
+
+
+def in_g2(pt: Point) -> bool:
+    """Full G2 membership: on the twist and in the r-order subgroup.
+
+    Uses the psi-eigenvalue check psi(P) == [x]P (valid for BLS12-381; the host
+    tests cross-validate against the naive [r]P == O check).
+    """
+    if pt is None:
+        return True
+    if not is_on_curve(pt, B2_FQ2):
+        return False
+    return psi(pt) == mul_by_x(pt)
