@@ -1,0 +1,44 @@
+"""BLS execution backends (the reference's `define_mod!` seam, crypto/bls/src/lib.rs:84-139).
+
+- ``host``: pure-Python multi-pairing (the golden model; always available)
+- ``fake``: always-valid (mirrors impls/fake_crypto.rs — lets every logic test run
+  without crypto cost or TPU access)
+- ``jax``: batched TPU multi-pairing kernel (lighthouse_tpu/ops)
+
+Selected via ``set_backend()`` or env ``LIGHTHOUSE_TPU_BLS_BACKEND``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_ACTIVE = None
+_NAME = None
+
+
+def get_backend():
+    global _ACTIVE, _NAME
+    if _ACTIVE is None:
+        set_backend(os.environ.get("LIGHTHOUSE_TPU_BLS_BACKEND", "host"))
+    return _ACTIVE
+
+
+def backend_name() -> Optional[str]:
+    get_backend()
+    return _NAME
+
+
+def set_backend(name: str):
+    global _ACTIVE, _NAME
+    if name == "host":
+        from . import host as mod
+    elif name == "fake":
+        from . import fake as mod
+    elif name == "jax":
+        from . import jax_backend as mod
+    else:
+        raise ValueError(f"unknown BLS backend: {name!r}")
+    _ACTIVE = mod
+    _NAME = name
+    return mod

@@ -1,0 +1,256 @@
+"""Derive the 3-isogeny E'(Fp2) -> E2(Fp2) used by RFC 9380 SSWU hash-to-G2.
+
+The reference client gets this map for free from blst's embedded iso_map
+constants.  Offline we re-derive it from first principles:
+
+  1. roots of the 3-division polynomial of E' give the order-3 kernels;
+  2. Velu's formulas give the rational isogeny for each kernel;
+  3. the kernel whose codomain is exactly E2: y^2 = x^3 + 4(1+u) is selected.
+
+The resulting rational maps are verified (points map onto E2; the map commutes
+with doubling) and written to lighthouse_tpu/crypto/bls/_sswu_g2_iso.py as plain
+coefficient lists.
+
+Run: python scripts/derive_g2_isogeny.py
+"""
+
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from lighthouse_tpu.crypto.bls.fields import Fq2
+from lighthouse_tpu.crypto.bls.params import P, SSWU_A, SSWU_B
+
+A = Fq2(*SSWU_A)
+B = Fq2(*SSWU_B)
+B2 = Fq2(4, 4)
+rng = random.Random(2026)
+
+# ---- polynomial helpers over Fq2 (coeff lists, low->high) ----
+
+def ptrim(a):
+    while a and a[-1].is_zero():
+        a.pop()
+    return a
+
+def padd(a, b):
+    n = max(len(a), len(b))
+    out = []
+    for i in range(n):
+        x = a[i] if i < len(a) else Fq2.zero()
+        y = b[i] if i < len(b) else Fq2.zero()
+        out.append(x + y)
+    return ptrim(out)
+
+def psub(a, b):
+    return padd(a, [-x for x in b])
+
+def pmul(a, b):
+    if not a or not b:
+        return []
+    out = [Fq2.zero()] * (len(a) + len(b) - 1)
+    for i, x in enumerate(a):
+        for j, y in enumerate(b):
+            out[i + j] = out[i + j] + x * y
+    return ptrim(out)
+
+def pdivmod(a, m):
+    a = list(a)
+    q = [Fq2.zero()] * max(1, len(a) - len(m) + 1)
+    inv_lead = m[-1].inv()
+    while len(a) >= len(m) and ptrim(list(a)):
+        a = ptrim(a)
+        if len(a) < len(m):
+            break
+        c = a[-1] * inv_lead
+        d = len(a) - len(m)
+        q[d] = q[d] + c
+        for i, mc in enumerate(m):
+            a[i + d] = a[i + d] - c * mc
+        a.pop()
+    return ptrim(q), ptrim(a)
+
+def pmod(a, m):
+    return pdivmod(a, m)[1]
+
+def pgcd(a, b):
+    a, b = list(a), list(b)
+    while b:
+        a, b = b, pmod(a, b)
+    if a:
+        inv_lead = a[-1].inv()
+        a = [c * inv_lead for c in a]
+    return a
+
+def ppow_mod(base, e, m):
+    r = [Fq2.one()]
+    b = pmod(base, m)
+    while e:
+        if e & 1:
+            r = pmod(pmul(r, b), m)
+        b = pmod(pmul(b, b), m)
+        e >>= 1
+    return r
+
+def peval(a, x):
+    acc = Fq2.zero()
+    for c in reversed(a):
+        acc = acc * x + c
+    return acc
+
+
+def roots_in_fq2(f):
+    """All roots of f lying in Fp2."""
+    q = P * P
+    xq = ppow_mod([Fq2.zero(), Fq2.one()], q, f)     # x^q mod f
+    split = pgcd(psub(xq, [Fq2.zero(), Fq2.one()]), f)
+    out = []
+
+    def rec(g):
+        g = [c * g[-1].inv() for c in g]
+        if len(g) == 1:
+            return
+        if len(g) == 2:
+            out.append(-g[0] * g[1].inv())
+            return
+        while True:
+            delta = Fq2(rng.randrange(P), rng.randrange(P))
+            t = ppow_mod([delta, Fq2.one()], (q - 1) // 2, g)
+            h = pgcd(psub(t, [Fq2.one()]), g)
+            if 0 < len(h) - 1 < len(g) - 1:
+                rec(h)
+                rec(pdivmod(g, h)[0])
+                return
+
+    if len(split) > 1:
+        rec(split)
+    return out
+
+
+def velu3(x0):
+    """Velu rational maps for the order-3 kernel {O, (x0, +-y0)}.
+
+    Returns (xnum, xden, ynum, yden, A2, B2): x' = xnum/xden, y' = y*ynum/yden.
+    """
+    gx = x0 * x0 * x0 + A * x0 + B       # y0^2
+    t = x0 * x0 * Fq2(3, 0) + A          # 3x0^2 + A
+    u = gx * Fq2(4, 0)                   # (2y0)^2
+    v = t + t                            # 2(3x0^2 + A)
+    w = u + x0 * v
+    a2 = A - v * Fq2(5, 0)
+    b2 = B - w * Fq2(7, 0)
+    lin = [-x0, Fq2.one()]               # (x - x0)
+    lin2 = pmul(lin, lin)
+    lin3 = pmul(lin2, lin)
+    # x' = x + v/(x-x0) + u/(x-x0)^2 = (x*lin2 + v*lin + u) / lin2
+    xnum = padd(pmul([Fq2.zero(), Fq2.one()], lin2), padd([c * v for c in lin], [u]))
+    xden = lin2
+    # y' = y * (1 - v/(x-x0)^2 - 2u/(x-x0)^3) = y * (lin3 - v*lin - 2u)/lin3
+    ynum = psub(lin3, padd([c * v for c in lin], [u + u]))
+    yden = lin3
+    return xnum, xden, ynum, yden, a2, b2
+
+
+def eval_iso(maps, pt):
+    xnum, xden, ynum, yden = maps
+    x, y = pt
+    den = peval(xden, x)
+    if den.is_zero():
+        return None  # kernel point -> infinity
+    return (peval(xnum, x) * den.inv(), y * peval(ynum, x) * peval(yden, x).inv())
+
+
+def random_eprime_point():
+    while True:
+        x = Fq2(rng.randrange(P), rng.randrange(P))
+        y = (x * x * x + A * x + B).sqrt()
+        if y is not None:
+            return (x, y)
+
+
+def main():
+    # 3-division polynomial of E': 3x^4 + 6A x^2 + 12B x - A^2
+    psi3 = ptrim([
+        -(A * A),
+        B * Fq2(12, 0),
+        A * Fq2(6, 0),
+        Fq2.zero(),
+        Fq2(3, 0),
+    ])
+    roots = roots_in_fq2(psi3)
+    print(f"psi3 roots in Fp2: {len(roots)}")
+    assert roots, "no order-3 kernel defined over Fp2"
+
+    # The Velu codomain is y^2 = x^3 + 2916(1+u) = x^3 + 4(1+u)*3^6; the RFC map is
+    # Velu composed with the isomorphism (x, y) -> (x/9, -y/27).  The composition is
+    # pinned exactly by independently-recalled RFC 9380 E.3 fingerprints, all of
+    # which this script re-derives bit-for-bit:
+    #   k_(1,3) = 1/9 mod p           = 0x171d...aaaa5ed1
+    #   k_(1,0) = (1+I)*0x5c75...aa97d6
+    #   k_(2,0) = -72*I  (tail ...aa63),  k_(2,1) = 12 - 12*I
+    #   k_(3,3) = -1/27 mod p         = 0x124c...718b10
+    winners = []
+    for x0 in sorted(roots, key=lambda r: (r.c0, r.c1)):
+        xnum, xden, ynum, yden, a2, b2 = velu3(x0)
+        print(f"  root c0=0x{x0.c0:x} c1=0x{x0.c1:x} -> codomain A2={(a2.c0, a2.c1)}, B2={(b2.c0, b2.c1)}")
+        if a2.is_zero() and b2 == B2.mul_scalar(729):
+            inv9 = Fq2(1, 0).mul_scalar(pow(9, P - 2, P))
+            inv27 = Fq2(1, 0).mul_scalar(pow(27, P - 2, P))
+            xnum = [c * inv9 for c in xnum]
+            ynum = [-(c * inv27) for c in ynum]
+            winners.append((x0, (xnum, xden, ynum, yden)))
+
+    assert winners, "no kernel yields codomain E2: y^2 = x^3 + 4(1+u)"
+    if len(winners) > 1:
+        print(f"NOTE: {len(winners)} kernels give the exact codomain; picking lexicographically first")
+    x0, maps = winners[0]
+    # assert the recalled RFC fingerprints hold on the final normalised map
+    xnum, xden, ynum, yden = maps
+    assert xnum[3] == Fq2(pow(9, P - 2, P), 0)
+    assert xnum[0] == Fq2(0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+                          0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6)
+    assert xden[0] == Fq2(0, P - 72) and xden[1] == Fq2(12, P - 12)
+    assert ynum[3] == Fq2(P - pow(27, P - 2, P), 0)
+
+    # verify: maps land on E2 and commute with doubling (isogeny homomorphism)
+    from lighthouse_tpu.crypto.bls import curve
+    for _ in range(8):
+        pt = random_eprime_point()
+        img = eval_iso(maps, pt)
+        assert img is not None
+        xi, yi = img
+        assert yi * yi == xi * xi * xi + B2, "image not on E2"
+        img2 = eval_iso(maps, _double_eprime(pt))
+        assert img2 == curve.double(img), "iso does not commute with doubling"
+    print("verification passed: maps land on E2 and commute with doubling")
+
+    out = Path(__file__).resolve().parent.parent / "lighthouse_tpu/crypto/bls/_sswu_g2_iso.py"
+    xnum, xden, ynum, yden = maps
+    def fmt(poly):
+        return "[" + ", ".join(f"(0x{c.c0:x}, 0x{c.c1:x})" for c in poly) + "]"
+    out.write_text(
+        '"""3-isogeny E\' -> E2 for SSWU hash-to-G2 (generated by scripts/derive_g2_isogeny.py).\n'
+        "\n"
+        "Coefficient lists are (c0, c1) pairs, low-degree first:\n"
+        "    x' = XNUM(x)/XDEN(x),   y' = y * YNUM(x)/YDEN(x)\n"
+        '"""\n\n'
+        f"KERNEL_X = (0x{x0.c0:x}, 0x{x0.c1:x})\n"
+        f"XNUM = {fmt(xnum)}\n"
+        f"XDEN = {fmt(xden)}\n"
+        f"YNUM = {fmt(ynum)}\n"
+        f"YDEN = {fmt(yden)}\n"
+    )
+    print(f"wrote {out}")
+
+
+def _double_eprime(pt):
+    x, y = pt
+    m = (x * x * Fq2(3, 0) + A) * (y + y).inv()
+    x3 = m * m - x - x
+    return (x3, m * (x - x3) - y)
+
+
+if __name__ == "__main__":
+    main()

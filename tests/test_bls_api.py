@@ -1,0 +1,186 @@
+"""Signature API + hash-to-curve + serialization tests.
+
+Mirrors the EF BLS handler surface (testing/ef_tests/src/cases/bls_*.rs:
+sign/verify/aggregate/fast_aggregate_verify/batch_verify) using invariants and
+self-generated vectors, since the official tarballs need network access.
+"""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.bls import curve, serde
+from lighthouse_tpu.crypto.bls.hash_to_curve import (
+    expand_message_xmd,
+    hash_to_field_fq2,
+    hash_to_g2,
+)
+from lighthouse_tpu.crypto.bls.params import DST, P, R
+
+rng = random.Random(7)
+
+
+def sk(i: int) -> bls.SecretKey:
+    return bls.SecretKey(i)
+
+
+def test_hash_to_g2_lands_in_subgroup_and_is_deterministic():
+    h1 = hash_to_g2(b"hello", DST)
+    h2 = hash_to_g2(b"hello", DST)
+    h3 = hash_to_g2(b"hellp", DST)
+    assert h1 == h2
+    assert h1 != h3
+    assert curve.in_g2(h1)
+    assert curve.in_g2(h3)
+    # different DST separates domains
+    assert hash_to_g2(b"hello", b"OTHER_DST_") != h1
+
+
+def test_expand_message_xmd_lengths():
+    out = expand_message_xmd(b"msg", DST, 256)
+    assert len(out) == 256
+    assert expand_message_xmd(b"msg", DST, 256) == out
+    assert expand_message_xmd(b"msg", DST, 32) == out[:0] + expand_message_xmd(b"msg", DST, 32)
+    # first 32 bytes of a longer expansion differ from a len-32 expansion
+    # (len_in_bytes is domain-separating) — just check both are well-formed
+    assert len(expand_message_xmd(b"", DST, 64)) == 64
+
+
+def test_hash_to_field_range():
+    for u in hash_to_field_fq2(b"abc", 2, DST):
+        assert 0 <= u.c0 < P and 0 <= u.c1 < P
+
+
+def test_g1_serde_roundtrip():
+    for i in [1, 2, 1234567, R - 1]:
+        pt = curve.mul(curve.G1, i)
+        data = serde.g1_compress(pt)
+        assert len(data) == 48
+        assert serde.g1_decompress(data) == pt
+    assert serde.g1_compress(None) == bytes([0xC0]) + b"\x00" * 47
+    assert serde.g1_decompress(bytes([0xC0]) + b"\x00" * 47) is None
+
+
+def test_g2_serde_roundtrip():
+    for i in [1, 5, 987654321]:
+        pt = curve.mul(curve.G2, i)
+        data = serde.g2_compress(pt)
+        assert len(data) == 96
+        assert serde.g2_decompress(data) == pt
+    # hash outputs round-trip too (y-sign edge coverage from varied points)
+    for m in [b"a", b"b", b"c", b"d"]:
+        pt = hash_to_g2(m, DST)
+        assert serde.g2_decompress(serde.g2_compress(pt)) == pt
+
+
+def test_serde_rejects_malformed():
+    with pytest.raises(serde.DecodeError):
+        serde.g1_decompress(b"\x00" * 48)  # no compression flag
+    with pytest.raises(serde.DecodeError):
+        serde.g1_decompress(bytes([0xC0]) + b"\x00" * 46 + b"\x01")  # dirty infinity
+    bad_x = bytes([0x80]) + (P - 1).to_bytes(48, "big")[1:]
+    # x = p - 1 (mod-valid) but y^2 likely non-square OR fine; use x >= p instead:
+    with pytest.raises(serde.DecodeError):
+        serde.g1_decompress(bytes([0x9F]) + b"\xff" * 47)  # x >= p
+    with pytest.raises(serde.DecodeError):
+        serde.g2_decompress(b"\x11" * 96)
+
+
+def test_sign_verify_roundtrip():
+    s = sk(12345)
+    pk = s.public_key()
+    msg = b"\x42" * 32
+    sig = s.sign(msg)
+    assert sig.verify(pk, msg)
+    assert not sig.verify(pk, b"\x43" * 32)
+    assert not sig.verify(sk(54321).public_key(), msg)
+    # serde roundtrip preserves verification
+    sig2 = bls.Signature.from_bytes(sig.to_bytes())
+    assert sig2.verify(pk, msg)
+    pk2 = bls.PublicKey.from_bytes(pk.to_bytes())
+    assert sig.verify(pk2, msg)
+
+
+def test_fast_aggregate_verify():
+    msg = b"\x01" * 32
+    sks = [sk(i + 100) for i in range(4)]
+    pks = [s.public_key() for s in sks]
+    agg = bls.AggregateSignature.aggregate([s.sign(msg) for s in sks])
+    assert bls.fast_aggregate_verify(pks, msg, agg.to_signature())
+    assert not bls.fast_aggregate_verify(pks[:3], msg, agg.to_signature())
+    assert not bls.fast_aggregate_verify([], msg, agg.to_signature())
+
+
+def test_aggregate_verify_distinct_messages():
+    sks = [sk(i + 7) for i in range(3)]
+    msgs = [bytes([i]) * 32 for i in range(3)]
+    agg = bls.AggregateSignature.aggregate(
+        [s.sign(m) for s, m in zip(sks, msgs)]
+    )
+    pks = [s.public_key() for s in sks]
+    assert bls.aggregate_verify(pks, msgs, agg.to_signature())
+    msgs_bad = list(msgs)
+    msgs_bad[1] = b"\xee" * 32
+    assert not bls.aggregate_verify(pks, msgs_bad, agg.to_signature())
+
+
+def test_eth_fast_aggregate_verify_infinity_exception():
+    inf_sig = bls.Signature.from_bytes(bls.INFINITY_SIGNATURE)
+    assert bls.eth_fast_aggregate_verify([], b"\x00" * 32, inf_sig)
+    assert not bls.fast_aggregate_verify([], b"\x00" * 32, inf_sig)
+    pk = sk(3).public_key()
+    assert not bls.eth_fast_aggregate_verify([pk], b"\x00" * 32, inf_sig)
+
+
+def test_infinity_pubkey_rejected():
+    with pytest.raises(bls.BlsError):
+        bls.PublicKey.from_bytes(bls.INFINITY_PUBLIC_KEY)
+
+
+def test_verify_signature_sets_semantics():
+    msg_a, msg_b = b"\xaa" * 32, b"\xbb" * 32
+    s1, s2, s3 = sk(11), sk(22), sk(33)
+    set1 = bls.SignatureSet.single_pubkey(s1.sign(msg_a), s1.public_key(), msg_a)
+    # multi-pubkey set: s2 and s3 both sign msg_b, aggregated
+    agg = bls.AggregateSignature.aggregate([s2.sign(msg_b), s3.sign(msg_b)])
+    set2 = bls.SignatureSet.multiple_pubkeys(
+        agg, [s2.public_key(), s3.public_key()], msg_b
+    )
+    assert bls.verify_signature_sets([set1, set2], seed=b"t")
+    assert bls.verify_signature_sets([set1], seed=b"t")
+    # empty batch fails (impls/blst.rs:41)
+    assert not bls.verify_signature_sets([], seed=b"t")
+    # a bad set poisons the batch
+    bad = bls.SignatureSet.single_pubkey(s1.sign(msg_a), s1.public_key(), msg_b)
+    assert not bls.verify_signature_sets([set1, set2, bad], seed=b"t")
+    # set with no signing keys fails (impls/blst.rs:86-89)
+    empty_keys = bls.SignatureSet(s1.sign(msg_a), msg_a, [])
+    assert not bls.verify_signature_sets([set1, empty_keys], seed=b"t")
+    # infinity signature fails the whole batch (impls/blst.rs:76-81)
+    inf = bls.SignatureSet.single_pubkey(
+        bls.Signature.from_bytes(bls.INFINITY_SIGNATURE), s1.public_key(), msg_a
+    )
+    assert not bls.verify_signature_sets([set1, inf], seed=b"t")
+
+
+def test_fake_backend():
+    bls.set_backend("fake")
+    try:
+        s1 = sk(11)
+        msg = b"\xcd" * 32
+        good = bls.SignatureSet.single_pubkey(s1.sign(msg), s1.public_key(), msg)
+        wrong = bls.SignatureSet.single_pubkey(s1.sign(msg), s1.public_key(), b"\x00" * 32)
+        assert bls.verify_signature_sets([good, wrong])  # fake: anything structural passes
+        assert not bls.verify_signature_sets([])
+        assert not bls.verify_signature_sets([bls.SignatureSet(s1.sign(msg), msg, [])])
+    finally:
+        bls.set_backend("host")
+
+
+def test_key_gen_and_random():
+    k = bls.SecretKey.key_gen(b"\x01" * 32)
+    assert 0 < k.scalar < R
+    k2 = bls.SecretKey.key_gen(b"\x01" * 32)
+    assert k.scalar == k2.scalar  # deterministic
+    assert bls.SecretKey.random().scalar != bls.SecretKey.random().scalar
