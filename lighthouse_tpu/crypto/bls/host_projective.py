@@ -1,0 +1,160 @@
+"""Host-integer mirror of the *device* pairing algorithm.
+
+The golden model (``pairing.py``) uses affine arithmetic with field inversions —
+transparently correct, but inversion-per-step is unusable on TPU.  The device
+kernels (``lighthouse_tpu/ops``) instead run an inversion-free projective Miller
+loop on the twist with denominator elimination.  This module is that exact
+algorithm over Python integers, so the JAX/limb implementation can be validated
+bit-for-bit against it, while *this* module is validated against the golden model
+(tests/test_host_projective.py).
+
+Role-equivalent to the optimised pairing inside ``blst`` that backs the
+reference's ``crypto/bls/src/impls/blst.rs:112-114`` batch verification.
+
+Derivation notes (why denominator elimination is sound here)
+------------------------------------------------------------
+Untwisting the M-twist point (x', y') on E'/Fq2: y^2 = x^3 + 4(1+u) gives
+(x' * v^-1, y' * (v/xi) * w) on E/Fq12 (w^2 = v, v^3 = xi).  For a line through
+untwisted twist points evaluated at P = (xp, yp) in G1(Fp), both the doubling
+and addition slopes have the shape M * (v^2/xi) * w with M in Fq2, so the line
+value is
+
+    l = yp - w * [ y~ * v/xi  +  M * xp * v^2/xi  -  M * x~ * v/xi ]
+
+Scaling l by any element of the subfield F_{p^6} (the c1 = 0 subalgebra, which
+contains Fq2, v and v^2) multiplies the Miller value by a factor that the final
+exponentiation's (p^6 - 1) stage maps to 1.  We scale away all denominators
+(2y~, x~q - x~, Z powers, xi), leaving polynomial line coefficients:
+
+    doubling at T=(X,Y,Z):   l'' = 2*Y*Z^2*xi*yp
+                                   - w*( (2*Y^2*Z - 3*X^3)*v + 3*X^2*Z*xp*v^2 )
+    addition (T, Q=(xq,yq)): l'' = xi*F*yp
+                                   - w*( (yq*F - E*xq)*v + E*xp*v^2 )
+        with E = yq*Z - Y, F = xq*Z - X   (both Fq2)
+
+The Miller accumulator is f_{|x|,Q}(P) *without* the final inversion for the
+negative BLS parameter; ``final_exponentiation(f)`` then differs from the golden
+model's value exactly by inversion, which preserves the only predicate the
+framework uses: ``== 1``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from .fields import Fq2, Fq6, Fq12
+from .pairing import final_exponentiation
+from .params import X_ABS
+
+# Bits of |x| below the leading one, MSB first — the fixed Miller schedule.
+X_BITS = [int(b) for b in bin(X_ABS)[3:]]
+
+XI = Fq2(1, 1)
+
+
+# ---------------------------------------------------------------- G2 projective
+# Homogeneous projective coordinates (X : Y : Z) on the twist, affine = (X/Z, Y/Z).
+# Formulas verified against the affine golden model in tests.
+
+Proj2 = Tuple[Fq2, Fq2, Fq2]
+
+
+def proj_from_affine(pt) -> Proj2:
+    x, y = pt
+    return (x, y, Fq2.one())
+
+
+def proj_to_affine(p: Proj2):
+    x, y, z = p
+    if z.is_zero():
+        return None
+    zi = z.inv()
+    return (x * zi, y * zi)
+
+
+def proj_dbl(t: Proj2) -> Tuple[Proj2, Tuple[Fq2, Fq2, Fq2]]:
+    """Double T and return the (eliminated-denominator) line coefficients.
+
+    Line l'' = L00 * yp + w*( L1v + L1vv * xp ) with
+        L00 = 2*Y*Z^2*xi      (an Fq2; multiplied by the Fp scalar yp)
+        L1v = -(2*Y^2*Z - 3*X^3)
+        L1vv = -3*X^2*Z       (multiplied by the Fp scalar xp)
+    """
+    x, y, z = t
+    xx = x.square()                     # X^2
+    w3 = xx + xx + xx                   # 3X^2
+    s = y * z                           # S = Y*Z
+    b = x * y * s                       # B = X*Y*S
+    h = w3.square() - (b + b + b + b + b + b + b + b)   # W^2 - 8B
+    x3 = (h * s).mul_scalar(2)
+    y2s2 = (y * s).square()
+    y3 = w3 * (b + b + b + b - h) - y2s2.mul_scalar(8)
+    z3 = s.square() * s
+    z3 = z3.mul_scalar(8)
+
+    l00 = (y * z.square()).mul_scalar(2).mul_by_xi()    # 2YZ^2 * xi
+    l1v = -(y.square() * z.mul_scalar(2) - xx * x.mul_scalar(3))
+    l1vv = -(xx * z).mul_scalar(3)
+    return (x3, y3, z3), (l00, l1v, l1vv)
+
+
+def proj_add_mixed(t: Proj2, q) -> Tuple[Proj2, Tuple[Fq2, Fq2, Fq2]]:
+    """T + Q for affine twist point Q, plus the line through them.
+
+    Line l'' = L00 * yp + w*( L1v + L1vv * xp ) with
+        L00 = xi * F
+        L1v = -(yq*F - E*xq)
+        L1vv = -E            (times xp)
+        E = yq*Z - Y, F = xq*Z - X
+    """
+    x, y, z = t
+    xq, yq = q
+    e = yq * z - y
+    f = xq * z - x
+    ff = f.square()
+    fff = f * ff
+    t1 = e.square() * z - ff * (x + xq * z)
+    x3 = f * t1
+    y3 = e * (ff * x - t1) - fff * y
+    z3 = z * fff
+
+    l00 = f.mul_by_xi()
+    l1v = -(yq * f - e * xq)
+    l1vv = -e
+    return (x3, y3, z3), (l00, l1v, l1vv)
+
+
+def line_to_fq12(line: Tuple[Fq2, Fq2, Fq2], xp: int, yp: int) -> Fq12:
+    """Assemble the sparse line value  L00*yp + w*(L1v*v + L1vv*xp*v^2)."""
+    l00, l1v, l1vv = line
+    c0 = Fq6(l00.mul_scalar(yp), Fq2.zero(), Fq2.zero())
+    c1 = Fq6(Fq2.zero(), l1v, l1vv.mul_scalar(xp))
+    return Fq12(c0, c1)
+
+
+def miller_loop_projective(p, q) -> Fq12:
+    """f_{|x|,Q}(P) via the inversion-free schedule the device kernel runs.
+
+    p: G1 affine (Fq pair as ints via .n), q: G2 affine twist point (Fq2 pair).
+    Infinity on either side contributes the neutral value 1.
+    """
+    if p is None or q is None:
+        return Fq12.one()
+    xp, yp = p[0].n, p[1].n
+    f = Fq12.one()
+    t: Proj2 = proj_from_affine(q)
+    for bit in X_BITS:
+        t, line = proj_dbl(t)
+        f = f.square() * line_to_fq12(line, xp, yp)
+        if bit:
+            t, line = proj_add_mixed(t, q)
+            f = f * line_to_fq12(line, xp, yp)
+    return f
+
+
+def multi_pairing_is_one_projective(pairs: Sequence[Tuple]) -> bool:
+    """Device-algorithm analog of ``pairing.multi_pairing_is_one``."""
+    f = Fq12.one()
+    for p, q in pairs:
+        f = f * miller_loop_projective(p, q)
+    return final_exponentiation(f).is_one()

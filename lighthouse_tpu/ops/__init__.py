@@ -1,0 +1,12 @@
+"""TPU-native compute kernels (JAX/XLA) for the framework's crypto hot paths.
+
+This package is the execution backend that occupies the architectural slot of the
+``blst`` C/assembly library in the reference client (``crypto/bls/src/impls/blst.rs``):
+batched BLS12-381 field arithmetic, curve ops, and the optimal-ate multi-pairing,
+all expressed as fixed-shape JAX programs that vmap over a batch axis and shard
+over a `jax.sharding.Mesh`.
+
+Correctness contract: every module here mirrors a host Python-integer
+implementation (``lighthouse_tpu/crypto/bls/{fields,curve,pairing,host_projective}``)
+and is tested exact against it.
+"""

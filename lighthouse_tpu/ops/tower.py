@@ -1,0 +1,277 @@
+"""Extension-field tower Fq2/Fq6/Fq12 over limb vectors (batched, JAX).
+
+Mirrors the host golden model ``crypto/bls/fields.py`` formula-for-formula —
+tower: Fp2 = Fp[u]/(u^2+1), Fp6 = Fp2[v]/(v^3 - (1+u)), Fp12 = Fp6[w]/(w^2 - v) —
+but over the redundant limb representation of ``ops.fq``.
+
+Shapes (trailing dims; any leading batch dims broadcast/vmap):
+    Fq  : (..., 25)
+    Fq2 : (..., 2, 25)
+    Fq6 : (..., 3, 2, 25)
+    Fq12: (..., 2, 3, 2, 25)
+
+Karatsuba sub-multiplications are stacked onto one new axis before the single
+``fq_mul`` call, so each tower multiply issues exactly one conv+reduce pipeline —
+the batched shapes keep the underlying matmuls large (MXU-friendly).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..crypto.bls import fields as hf
+from ..crypto.bls.params import P
+from .fq import (
+    FQ_ONE,
+    FQ_ZERO,
+    fq_inv,
+    fq_mul,
+    fq_mul_small,
+    fq_reduce,
+    from_limbs16,
+    to_limbs16,
+)
+
+# ----------------------------------------------------------------------- Fq2
+
+
+def fq2_add(a, b):
+    return a + b
+
+
+def fq2_sub(a, b):
+    return a - b
+
+
+def fq2_neg(a):
+    return -a
+
+
+def fq2_mul(a, b):
+    """Karatsuba: 3 base muls stacked into one fq_mul call."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    lhs = jnp.stack([a0, a1, a0 + a1], axis=-2)
+    rhs = jnp.stack([b0, b1, b0 + b1], axis=-2)
+    t = fq_mul(lhs, rhs)
+    t0, t1, t2 = t[..., 0, :], t[..., 1, :], t[..., 2, :]
+    return jnp.stack([t0 - t1, t2 - t0 - t1], axis=-2)
+
+
+def fq2_square(a):
+    """(a0+a1)(a0-a1), 2*a0*a1 — 2 muls, stacked."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    t = fq_mul(
+        jnp.stack([a0 + a1, a0], axis=-2),
+        jnp.stack([a0 - a1, a1 + a1], axis=-2),
+    )
+    return jnp.stack([t[..., 0, :], t[..., 1, :]], axis=-2)
+
+
+def fq2_conj(a):
+    return jnp.stack([a[..., 0, :], -a[..., 1, :]], axis=-2)
+
+
+def fq2_mul_by_xi(a):
+    """Multiply by xi = 1 + u."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return jnp.stack([a0 - a1, a0 + a1], axis=-2)
+
+
+def fq2_mul_small(a, k: int):
+    return a * jnp.int32(k)
+
+
+def fq2_mul_fq(a, s):
+    """Fq2 * Fq (s shape (..., 25), broadcast over the pair axis)."""
+    return fq_mul(a, s[..., None, :])
+
+
+def fq2_inv(a):
+    """conj(a) / norm(a); one base-field inversion."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    t = fq_mul(jnp.stack([a0, a1], axis=-2), jnp.stack([a0, a1], axis=-2))
+    d = fq_inv(t[..., 0, :] + t[..., 1, :])
+    return fq_mul(jnp.stack([a0, -a1], axis=-2), d[..., None, :])
+
+
+def fq2_reduce(a):
+    return fq_reduce(a)
+
+
+# ----------------------------------------------------------------------- Fq6
+
+
+def _fq6_parts(a):
+    return a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+
+
+def fq6_add(a, b):
+    return a + b
+
+
+def fq6_sub(a, b):
+    return a - b
+
+
+def fq6_neg(a):
+    return -a
+
+
+def fq6_mul(a, b):
+    """Toom-style 6-mul schedule, mirroring fields.Fq6.__mul__; one fq2_mul call."""
+    a0, a1, a2 = _fq6_parts(a)
+    b0, b1, b2 = _fq6_parts(b)
+    lhs = jnp.stack([a0, a1, a2, a1 + a2, a0 + a1, a0 + a2], axis=-3)
+    rhs = jnp.stack([b0, b1, b2, b1 + b2, b0 + b1, b0 + b2], axis=-3)
+    t = fq2_mul(lhs, rhs)
+    t0, t1, t2 = t[..., 0, :, :], t[..., 1, :, :], t[..., 2, :, :]
+    s12, s01, s02 = t[..., 3, :, :], t[..., 4, :, :], t[..., 5, :, :]
+    c0 = t0 + fq2_mul_by_xi(s12 - t1 - t2)
+    c1 = s01 - t0 - t1 + fq2_mul_by_xi(t2)
+    c2 = s02 - t0 - t2 + t1
+    return jnp.stack([c0, c1, c2], axis=-3)
+
+
+def fq6_square(a):
+    return fq6_mul(a, a)
+
+
+def fq6_mul_by_v(a):
+    a0, a1, a2 = _fq6_parts(a)
+    return jnp.stack([fq2_mul_by_xi(a2), a0, a1], axis=-3)
+
+
+def fq6_mul_fq2(a, s):
+    return fq2_mul(a, s[..., None, :, :])
+
+
+def fq6_inv(a):
+    """fields.Fq6.inv formulas; one fq2 inversion."""
+    a0, a1, a2 = _fq6_parts(a)
+    t = fq2_mul(
+        jnp.stack([a0, a2, a1, a1, a0, a0], axis=-3),
+        jnp.stack([a0, a2, a1, a2, a1, a2], axis=-3),
+    )
+    sq0, sq2, sq1 = t[..., 0, :, :], t[..., 1, :, :], t[..., 2, :, :]
+    p12, p01, p02 = t[..., 3, :, :], t[..., 4, :, :], t[..., 5, :, :]
+    c0 = sq0 - fq2_mul_by_xi(p12)
+    c1 = fq2_mul_by_xi(sq2) - p01
+    c2 = sq1 - p02
+    prods = fq2_mul(jnp.stack([a0, a2, a1], axis=-3), jnp.stack([c0, c1, c2], axis=-3))
+    t = fq2_inv(
+        prods[..., 0, :, :] + fq2_mul_by_xi(prods[..., 1, :, :] + prods[..., 2, :, :])
+    )
+    return fq6_mul_fq2(jnp.stack([c0, c1, c2], axis=-3), t)
+
+
+# ----------------------------------------------------------------------- Fq12
+
+
+def fq12_parts(a):
+    return a[..., 0, :, :, :], a[..., 1, :, :, :]
+
+
+def fq12_add(a, b):
+    return a + b
+
+
+def fq12_mul(a, b):
+    a0, a1 = fq12_parts(a)
+    b0, b1 = fq12_parts(b)
+    t = fq6_mul(
+        jnp.stack([a0, a1, a0 + a1], axis=-4),
+        jnp.stack([b0, b1, b0 + b1], axis=-4),
+    )
+    t0, t1, t2 = t[..., 0, :, :, :], t[..., 1, :, :, :], t[..., 2, :, :, :]
+    return jnp.stack([t0 + fq6_mul_by_v(t1), t2 - t0 - t1], axis=-4)
+
+
+def fq12_square(a):
+    return fq12_mul(a, a)
+
+
+def fq12_conj(a):
+    a0, a1 = fq12_parts(a)
+    return jnp.stack([a0, -a1], axis=-4)
+
+
+def fq12_inv(a):
+    a0, a1 = fq12_parts(a)
+    sq = fq6_mul(jnp.stack([a0, a1], axis=-4), jnp.stack([a0, a1], axis=-4))
+    t = fq6_inv(sq[..., 0, :, :, :] - fq6_mul_by_v(sq[..., 1, :, :, :]))
+    return jnp.stack([fq6_mul(a0, t), fq6_neg(fq6_mul(a1, t))], axis=-4)
+
+
+def fq12_reduce(a):
+    return fq_reduce(a)
+
+
+# ---------------------------------------------------------------- Frobenius
+
+# gamma_i = xi^(i*(p-1)/6) as limb constants, from the host golden model.
+def _fq2_const(x: hf.Fq2) -> np.ndarray:
+    return np.stack([to_limbs16(x.c0), to_limbs16(x.c1)])
+
+
+_GAMMA = jnp.asarray(np.stack([_fq2_const(g) for g in hf.GAMMA]))  # (6, 2, 25)
+
+
+def fq12_frobenius(a):
+    """x -> x^p, mirroring fields.Fq12.frobenius."""
+    a0, a1 = fq12_parts(a)
+    a00, a01, a02 = _fq6_parts(a0)
+    a10, a11, a12 = _fq6_parts(a1)
+    lhs = jnp.stack(
+        [fq2_conj(a01), fq2_conj(a02), fq2_conj(a10), fq2_conj(a11), fq2_conj(a12)],
+        axis=-3,
+    )
+    rhs = jnp.broadcast_to(_GAMMA[jnp.asarray([2, 4, 1, 3, 5])], lhs.shape)
+    t = fq2_mul(lhs, rhs)
+    c0 = jnp.stack([fq2_conj(a00), t[..., 0, :, :], t[..., 1, :, :]], axis=-3)
+    c1 = jnp.stack([t[..., 2, :, :], t[..., 3, :, :], t[..., 4, :, :]], axis=-3)
+    return jnp.stack([c0, c1], axis=-4)
+
+
+def fq12_frobenius_n(a, n: int):
+    for _ in range(n % 12):
+        a = fq12_frobenius(a)
+    return a
+
+
+# ------------------------------------------------------------ host conversion
+
+FQ2_ZERO = jnp.asarray(np.stack([np.asarray(FQ_ZERO)] * 2))
+FQ2_ONE = jnp.asarray(np.stack([np.asarray(FQ_ONE), np.asarray(FQ_ZERO)]))
+FQ6_ZERO = jnp.asarray(np.stack([np.asarray(FQ2_ZERO)] * 3))
+FQ6_ONE = jnp.asarray(np.stack([np.asarray(FQ2_ONE), np.asarray(FQ2_ZERO), np.asarray(FQ2_ZERO)]))
+FQ12_ZERO = jnp.asarray(np.stack([np.asarray(FQ6_ZERO)] * 2))
+FQ12_ONE = jnp.asarray(np.stack([np.asarray(FQ6_ONE), np.asarray(FQ6_ZERO)]))
+
+
+def fq2_to_limbs(x: hf.Fq2) -> np.ndarray:
+    return _fq2_const(x)
+
+
+def fq2_from_limbs(arr) -> hf.Fq2:
+    a = np.asarray(arr)
+    return hf.Fq2(from_limbs16(a[..., 0, :]), from_limbs16(a[..., 1, :]))
+
+
+def fq6_to_limbs(x: hf.Fq6) -> np.ndarray:
+    return np.stack([_fq2_const(x.c0), _fq2_const(x.c1), _fq2_const(x.c2)])
+
+
+def fq6_from_limbs(arr) -> hf.Fq6:
+    a = np.asarray(arr)
+    return hf.Fq6(*(fq2_from_limbs(a[i]) for i in range(3)))
+
+
+def fq12_to_limbs(x: hf.Fq12) -> np.ndarray:
+    return np.stack([fq6_to_limbs(x.c0), fq6_to_limbs(x.c1)])
+
+
+def fq12_from_limbs(arr) -> hf.Fq12:
+    a = np.asarray(arr)
+    return hf.Fq12(fq6_from_limbs(a[0]), fq6_from_limbs(a[1]))

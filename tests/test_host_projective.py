@@ -1,0 +1,84 @@
+"""Validate the inversion-free projective pairing (device algorithm, host ints)
+against the affine golden model."""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import curve, pairing
+from lighthouse_tpu.crypto.bls.fields import Fq12
+from lighthouse_tpu.crypto.bls.host_projective import (
+    miller_loop_projective,
+    multi_pairing_is_one_projective,
+    proj_add_mixed,
+    proj_dbl,
+    proj_from_affine,
+    proj_to_affine,
+)
+from lighthouse_tpu.crypto.bls.pairing import final_exponentiation
+
+rng = random.Random(0xBEEF)
+
+
+def rand_g1():
+    return curve.mul(curve.G1, rng.randrange(1, curve.R))
+
+
+def rand_g2():
+    return curve.mul(curve.G2, rng.randrange(1, curve.R))
+
+
+def test_proj_dbl_matches_affine():
+    q = rand_g2()
+    t = proj_from_affine(q)
+    for _ in range(5):
+        t, _ = proj_dbl(t)
+        q = curve.double(q)
+        assert proj_to_affine(t) == q
+
+
+def test_proj_add_mixed_matches_affine():
+    q = rand_g2()
+    p2 = rand_g2()
+    t = proj_from_affine(p2)
+    acc = p2
+    for _ in range(5):
+        t, _ = proj_add_mixed(t, q)
+        acc = curve.add(acc, q)
+        assert proj_to_affine(t) == acc
+
+
+def test_miller_consistent_with_golden():
+    """FE(f_proj * f_golden) == 1 since f_proj = f_golden^-1 * (subfield junk)."""
+    p, q = rand_g1(), rand_g2()
+    f_proj = miller_loop_projective(p, q)
+    f_gold = pairing.miller_loop(curve.embed_g1(p), curve.untwist(q))  # = f^-1
+    assert final_exponentiation(f_proj * f_gold).is_one()
+    # And on its own it is NOT trivially one.
+    assert not final_exponentiation(f_proj).is_one()
+
+
+def test_bilinearity_via_projective():
+    p, q = rand_g1(), rand_g2()
+    a = rng.randrange(2, 2**32)
+    # e(aP, Q) * e(-P, aQ) == 1
+    assert multi_pairing_is_one_projective(
+        [(curve.mul(p, a), q), (curve.neg(p), curve.mul(q, a))]
+    )
+    # e(aP, Q) * e(-P, (a+1)Q) != 1
+    assert not multi_pairing_is_one_projective(
+        [(curve.mul(p, a), q), (curve.neg(p), curve.mul(q, a + 1))]
+    )
+
+
+def test_infinity_pairs():
+    p, q = rand_g1(), rand_g2()
+    assert miller_loop_projective(None, q) == Fq12.one()
+    assert miller_loop_projective(p, None) == Fq12.one()
+    assert multi_pairing_is_one_projective([(None, q), (p, None)])
+
+
+def test_agrees_with_golden_multi_pairing():
+    for _ in range(3):
+        pairs = [(rand_g1(), rand_g2()) for _ in range(2)]
+        assert pairing.multi_pairing_is_one(pairs) == multi_pairing_is_one_projective(pairs)

@@ -1,0 +1,99 @@
+"""Exact validation of the JAX limb arithmetic against Python integers."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto.bls.params import P
+from lighthouse_tpu.ops import fq
+
+rng = random.Random(0xF00D)
+
+
+def rand_elt():
+    return rng.randrange(P)
+
+
+def test_roundtrip():
+    for _ in range(10):
+        v = rand_elt()
+        assert fq.from_limbs16(fq.to_limbs16(v)) == v
+
+
+def test_mul_exact():
+    mul = jax.jit(fq.fq_mul)
+    for _ in range(20):
+        a, b = rand_elt(), rand_elt()
+        r = mul(jnp.asarray(fq.to_limbs16(a)), jnp.asarray(fq.to_limbs16(b)))
+        assert fq.from_limbs16(np.asarray(r)) == a * b % P
+        assert int(np.abs(np.asarray(r)).max()) < 1 << 17
+
+
+def test_mul_batched():
+    n = 64
+    av = [rand_elt() for _ in range(n)]
+    bv = [rand_elt() for _ in range(n)]
+    a = jnp.asarray(np.stack([fq.to_limbs16(x) for x in av]))
+    b = jnp.asarray(np.stack([fq.to_limbs16(x) for x in bv]))
+    r = np.asarray(jax.jit(fq.fq_mul)(a, b))
+    for i in range(n):
+        assert fq.from_limbs16(r[i]) == av[i] * bv[i] % P
+
+
+def test_deep_expression_chains():
+    """Adversarial chains of add/sub/mul keep exactness and limb bounds."""
+
+    @jax.jit
+    def chain(a, b, c):
+        t = fq.fq_mul(a, b)
+        acc = t
+        for _ in range(100):          # long additive chain between muls
+            acc = fq.fq_add(acc, t)
+        u = fq.fq_sub(acc, fq.fq_mul_small(c, 37))
+        v = fq.fq_mul(u, fq.fq_neg(acc))
+        return fq.fq_mul(v, v)
+
+    a, b, c = rand_elt(), rand_elt(), rand_elt()
+    r = chain(*(jnp.asarray(fq.to_limbs16(x)) for x in (a, b, c)))
+    t = a * b % P
+    acc = t * 101 % P
+    u = (acc - 37 * c) % P
+    v = u * (-acc) % P
+    assert fq.from_limbs16(np.asarray(r)) == v * v % P
+
+
+def test_zero_and_edge_values():
+    mul = jax.jit(fq.fq_mul)
+    for a, b in [(0, 0), (0, 1), (1, 1), (P - 1, P - 1), (P - 1, 1), (2**380, P - 2)]:
+        r = mul(jnp.asarray(fq.to_limbs16(a)), jnp.asarray(fq.to_limbs16(b)))
+        assert fq.from_limbs16(np.asarray(r)) == a * b % P
+
+
+def test_negative_redundant_inputs():
+    """Subtraction results (negative values / signed limbs) multiply correctly."""
+
+    @jax.jit
+    def f(a, b):
+        d = fq.fq_sub(a, b)          # negative value when a < b
+        return fq.fq_mul(d, d)
+
+    a, b = 5, P - 3
+    r = f(jnp.asarray(fq.to_limbs16(a)), jnp.asarray(fq.to_limbs16(b)))
+    assert fq.from_limbs16(np.asarray(r)) == (a - b) ** 2 % P
+
+
+def test_pow_and_inv():
+    x = rand_elt()
+    xi = np.asarray(jax.jit(fq.fq_inv)(jnp.asarray(fq.to_limbs16(x))))
+    assert fq.from_limbs16(xi) == pow(x, P - 2, P)
+    assert fq.from_limbs16(xi) * x % P == 1
+
+
+def test_reduce_tightens():
+    x = jnp.asarray(fq.to_limbs16(rand_elt())) * jnp.int32(400)  # limbs ~2^24.6
+    r = np.asarray(jax.jit(fq.fq_reduce)(x))
+    assert fq.from_limbs16(r) == fq.from_limbs16(np.asarray(x))
+    assert int(np.abs(r).max()) < 1 << 17

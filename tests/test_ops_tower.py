@@ -1,0 +1,76 @@
+"""JAX tower arithmetic vs the host golden model (exact)."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lighthouse_tpu.crypto.bls import fields as hf
+from lighthouse_tpu.crypto.bls.params import P
+from lighthouse_tpu.ops import tower as tw
+
+rng = random.Random(0xA11CE)
+
+
+def rand_fq2():
+    return hf.Fq2(rng.randrange(P), rng.randrange(P))
+
+
+def rand_fq6():
+    return hf.Fq6(rand_fq2(), rand_fq2(), rand_fq2())
+
+
+def rand_fq12():
+    return hf.Fq12(rand_fq6(), rand_fq6())
+
+
+def j2(x):
+    return jnp.asarray(tw.fq2_to_limbs(x))
+
+
+def j12(x):
+    return jnp.asarray(tw.fq12_to_limbs(x))
+
+
+def test_fq2_ops():
+    a, b = rand_fq2(), rand_fq2()
+    assert tw.fq2_from_limbs(jax.jit(tw.fq2_mul)(j2(a), j2(b))) == a * b
+    assert tw.fq2_from_limbs(jax.jit(tw.fq2_square)(j2(a))) == a.square()
+    assert tw.fq2_from_limbs(jax.jit(tw.fq2_mul_by_xi)(j2(a))) == a.mul_by_xi()
+    assert tw.fq2_from_limbs(jax.jit(tw.fq2_inv)(j2(a))) == a.inv()
+    assert tw.fq2_from_limbs(j2(a) - j2(b)) == a - b
+
+
+def test_fq6_ops():
+    a, b = rand_fq6(), rand_fq6()
+    ja = jnp.asarray(tw.fq6_to_limbs(a))
+    jb = jnp.asarray(tw.fq6_to_limbs(b))
+    assert tw.fq6_from_limbs(jax.jit(tw.fq6_mul)(ja, jb)) == a * b
+    assert tw.fq6_from_limbs(jax.jit(tw.fq6_mul_by_v)(ja)) == a.mul_by_v()
+    assert tw.fq6_from_limbs(jax.jit(tw.fq6_inv)(ja)) == a.inv()
+
+
+def test_fq12_ops():
+    a, b = rand_fq12(), rand_fq12()
+    assert tw.fq12_from_limbs(jax.jit(tw.fq12_mul)(j12(a), j12(b))) == a * b
+    assert tw.fq12_from_limbs(jax.jit(tw.fq12_square)(j12(a))) == a.square()
+    assert tw.fq12_from_limbs(jax.jit(tw.fq12_conj)(j12(a))) == a.conj()
+    assert tw.fq12_from_limbs(jax.jit(tw.fq12_inv)(j12(a))) == a.inv()
+
+
+def test_fq12_frobenius():
+    a = rand_fq12()
+    fr = jax.jit(tw.fq12_frobenius)
+    assert tw.fq12_from_limbs(fr(j12(a))) == a.frobenius()
+    assert tw.fq12_from_limbs(fr(fr(j12(a)))) == a.frobenius_n(2)
+
+
+def test_batched_mul():
+    avs = [rand_fq12() for _ in range(4)]
+    bvs = [rand_fq12() for _ in range(4)]
+    a = jnp.stack([j12(x) for x in avs])
+    b = jnp.stack([j12(x) for x in bvs])
+    r = np.asarray(jax.jit(tw.fq12_mul)(a, b))
+    for i in range(4):
+        assert tw.fq12_from_limbs(r[i]) == avs[i] * bvs[i]
