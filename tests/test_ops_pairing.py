@@ -1,0 +1,85 @@
+"""JAX multi-pairing vs host oracles (golden model + projective mirror)."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto.bls import curve, pairing as hp
+from lighthouse_tpu.crypto.bls import host_projective as hpp
+from lighthouse_tpu.ops import ec, pairing as jp, tower as tw
+
+rng = random.Random(0x9A1)
+
+
+def rand_g1():
+    return curve.mul(curve.G1, rng.randrange(1, curve.R))
+
+
+def rand_g2():
+    return curve.mul(curve.G2, rng.randrange(1, curve.R))
+
+
+def stack_g1(pts):
+    return tuple(
+        jnp.stack([jnp.asarray(ec.g1_to_limbs(pt)[i]) for pt in pts]) for i in range(3)
+    )
+
+
+def stack_g2_affine(pts):
+    xs = jnp.stack([jnp.asarray(tw.fq2_to_limbs(pt[0])) for pt in pts])
+    ys = jnp.stack([jnp.asarray(tw.fq2_to_limbs(pt[1])) for pt in pts])
+    return (xs, ys)
+
+
+def test_miller_matches_host_mirror():
+    p, q = rand_g1(), rand_g2()
+    f = jax.jit(jp.miller_loop)(
+        tuple(jnp.asarray(c) for c in ec.g1_to_limbs(p)),
+        (jnp.asarray(tw.fq2_to_limbs(q[0])), jnp.asarray(tw.fq2_to_limbs(q[1]))),
+    )
+    assert tw.fq12_from_limbs(f) == hpp.miller_loop_projective(p, q)
+
+
+def test_final_exponentiation_matches_golden():
+    p, q = rand_g1(), rand_g2()
+    f_host = hpp.miller_loop_projective(p, q)
+    fe = jax.jit(jp.final_exponentiation)(jnp.asarray(tw.fq12_to_limbs(f_host)))
+    assert tw.fq12_from_limbs(fe) == hp.final_exponentiation(f_host)
+
+
+def test_multi_pairing_valid_and_invalid():
+    p, q = rand_g1(), rand_g2()
+    a = rng.randrange(2, 2**40)
+    pairs_good = [(curve.mul(p, a), q), (curve.neg(p), curve.mul(q, a))]
+    pairs_bad = [(curve.mul(p, a), q), (curve.neg(p), curve.mul(q, a + 1))]
+    fn = jax.jit(jp.multi_pairing_fe)
+    for pairs, expect in [(pairs_good, True), (pairs_bad, False)]:
+        p1 = stack_g1([pr[0] for pr in pairs])
+        q2 = stack_g2_affine([pr[1] for pr in pairs])
+        fe = fn(p1, q2, jnp.asarray([True, True]))
+        assert jp.fe_is_one(fe) == expect
+
+
+def test_g1_infinity_auto_killed():
+    """A (projective-infinity G1, Q) pair contributes subfield junk only."""
+    q = rand_g2()
+    p1 = stack_g1([None, rand_g1()])
+    g = curve.mul(curve.G2, 7)
+    q2 = stack_g2_affine([q, g])
+    # pair 2 = (P, 7*G2') chosen invalid alone; combined with masked-in inf pair
+    fe = jax.jit(jp.multi_pairing_fe)(p1, q2, jnp.asarray([True, False]))
+    assert jp.fe_is_one(fe)  # inf pair -> 1, other masked -> 1
+
+
+def test_mask_and_padding():
+    p, q = rand_g1(), rand_g2()
+    a = rng.randrange(2, 2**40)
+    # 3 pairs (non-power-of-two): the valid two + one garbage pair masked out.
+    pairs = [(curve.mul(p, a), q), (curve.neg(p), curve.mul(q, a)), (rand_g1(), rand_g2())]
+    p1 = stack_g1([pr[0] for pr in pairs])
+    q2 = stack_g2_affine([pr[1] for pr in pairs])
+    fe = jax.jit(jp.multi_pairing_fe)(p1, q2, jnp.asarray([True, True, False]))
+    assert jp.fe_is_one(fe)
