@@ -1,0 +1,16 @@
+"""JAX/TPU batch-verification backend.
+
+The slot of ``crypto/bls/src/impls/blst.rs`` in the reference: all signature
+sets in the node funnel through here, and the multi-pairing runs as a fused,
+shape-bucketed device program (``lighthouse_tpu/ops/verify.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def verify_signature_sets(sets, seed: Optional[bytes] = None) -> bool:
+    from ....ops.verify import verify_signature_sets_device
+
+    return verify_signature_sets_device(sets, seed=seed)
