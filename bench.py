@@ -1,0 +1,66 @@
+"""North-star benchmark: batched BLS signature-set verification throughput.
+
+Measures the fused device program (scalar muls + aggregation + multi-pairing +
+final exponentiation) on the reference's headline config — 128 aggregate
+signature sets, 32-validator committees (BASELINE.md "north-star targets") —
+and prints ONE JSON line.
+
+``vs_baseline`` compares against a documented estimate of the reference's
+blst-on-64-CPU-threads throughput for the same semantics (one 64-bit-weighted
+multi-pairing per batch).  Lighthouse publishes no absolute numbers
+(BASELINE.json.published == {}); the figure below is derived from blst's
+well-known ~0.4-0.5 ms/thread per aggregate-verify pairing cost:
+    64 threads / 0.45 ms  ->  ~142k sets/s.  We use 142_000 sets/s.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BLST_64T_SETS_PER_SEC = 142_000.0
+
+N_SETS = 128
+N_KEYS = 32
+REPS = 5
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_ENABLE_X64", "0")
+    import jax
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from __graft_entry__ import _build_example
+    from lighthouse_tpu.ops.pairing import fe_is_one
+    from lighthouse_tpu.ops.verify import _device_verify
+
+    batch = _build_example(n_sets=N_SETS, n_keys=N_KEYS, seed=3)
+
+    # Warmup / compile.
+    fe, w_z = _device_verify(*batch)
+    jax.block_until_ready((fe, w_z))
+    assert fe_is_one(fe), "benchmark batch failed to verify"
+
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        fe, w_z = _device_verify(*batch)
+    jax.block_until_ready((fe, w_z))
+    dt = (time.perf_counter() - t0) / REPS
+
+    sets_per_sec = N_SETS / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"verify_signature_sets throughput ({N_SETS} sets x {N_KEYS}-key committees)",
+                "value": round(sets_per_sec, 1),
+                "unit": "sets/sec",
+                "vs_baseline": round(sets_per_sec / BLST_64T_SETS_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Data-model layer: SSZ, chain specs, and consensus containers.
+
+Occupies the slot of the reference's ``consensus/types`` crate (20.5k LoC —
+``EthSpec`` presets, ``ChainSpec`` runtime constants, SSZ containers across all
+forks).  Design departure for TPU: ``BeaconState`` keeps per-validator data as
+dense columnar numpy arrays (balances, participation, validator fields) rather
+than a persistent tree — epoch processing then maps onto fused XLA array ops
+(the reference's ``single_pass.rs`` fused epoch loop, but SPMD).
+"""
+
+from .ssz import (  # noqa: F401
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    Vector,
+    boolean,
+    bytes4,
+    bytes32,
+    bytes48,
+    bytes96,
+    hash_tree_root,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    uint128,
+    uint256,
+)

@@ -1,0 +1,479 @@
+"""Consensus containers across forks (phase0 → deneb), preset-parameterized.
+
+The reference expresses fork-variant containers with the ``superstruct`` macro
+over compile-time ``EthSpec`` sizes (``consensus/types/src/beacon_state.rs:34``,
+``beacon_block_body.rs``).  Here, ``build_types(preset)`` constructs the full
+set of SSZ container classes for a preset (Mainnet/Minimal/Gnosis) and returns
+a registry; per-fork variants are distinct classes related by explicit
+``fork_name`` attributes and upgrade functions (``state_transition/upgrades``).
+
+Field order follows the consensus specs exactly (SSZ stability is
+consensus-critical); cross-checked against spec test vectors in tests.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from types import SimpleNamespace
+
+from .spec import Preset
+from .ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    Vector,
+    boolean,
+    bytes4,
+    bytes32,
+    bytes48,
+    bytes96,
+    uint64,
+    uint8,
+    uint256,
+)
+
+bytes20 = ByteVector(20)
+
+
+@lru_cache(maxsize=None)
+def build_types(preset: Preset) -> SimpleNamespace:
+    P = preset
+    JUSTIFICATION_BITS_LENGTH = 4
+    DEPOSIT_CONTRACT_TREE_DEPTH = 32
+
+    ns = SimpleNamespace(preset=P)
+
+    # ---------------------------------------------------------- basic misc
+
+    class Fork(Container):
+        fields = {"previous_version": bytes4, "current_version": bytes4, "epoch": uint64}
+
+    class ForkData(Container):
+        fields = {"current_version": bytes4, "genesis_validators_root": bytes32}
+
+    class Checkpoint(Container):
+        fields = {"epoch": uint64, "root": bytes32}
+
+    class Validator(Container):
+        fields = {
+            "pubkey": bytes48,
+            "withdrawal_credentials": bytes32,
+            "effective_balance": uint64,
+            "slashed": boolean,
+            "activation_eligibility_epoch": uint64,
+            "activation_epoch": uint64,
+            "exit_epoch": uint64,
+            "withdrawable_epoch": uint64,
+        }
+
+    class AttestationData(Container):
+        fields = {
+            "slot": uint64,
+            "index": uint64,
+            "beacon_block_root": bytes32,
+            "source": Checkpoint.ssz_type,
+            "target": Checkpoint.ssz_type,
+        }
+
+    class IndexedAttestation(Container):
+        fields = {
+            "attesting_indices": List(uint64, P.max_validators_per_committee),
+            "data": AttestationData.ssz_type,
+            "signature": bytes96,
+        }
+
+    class PendingAttestation(Container):
+        fields = {
+            "aggregation_bits": Bitlist(P.max_validators_per_committee),
+            "data": AttestationData.ssz_type,
+            "inclusion_delay": uint64,
+            "proposer_index": uint64,
+        }
+
+    class Eth1Data(Container):
+        fields = {"deposit_root": bytes32, "deposit_count": uint64, "block_hash": bytes32}
+
+    class HistoricalBatch(Container):
+        fields = {
+            "block_roots": Vector(bytes32, P.slots_per_historical_root),
+            "state_roots": Vector(bytes32, P.slots_per_historical_root),
+        }
+
+    class DepositMessage(Container):
+        fields = {"pubkey": bytes48, "withdrawal_credentials": bytes32, "amount": uint64}
+
+    class DepositData(Container):
+        fields = {
+            "pubkey": bytes48,
+            "withdrawal_credentials": bytes32,
+            "amount": uint64,
+            "signature": bytes96,
+        }
+
+    class BeaconBlockHeader(Container):
+        fields = {
+            "slot": uint64,
+            "proposer_index": uint64,
+            "parent_root": bytes32,
+            "state_root": bytes32,
+            "body_root": bytes32,
+        }
+
+    class SignedBeaconBlockHeader(Container):
+        fields = {"message": BeaconBlockHeader.ssz_type, "signature": bytes96}
+
+    class SigningData(Container):
+        fields = {"object_root": bytes32, "domain": bytes32}
+
+    # ----------------------------------------------------------- operations
+
+    class ProposerSlashing(Container):
+        fields = {
+            "signed_header_1": SignedBeaconBlockHeader.ssz_type,
+            "signed_header_2": SignedBeaconBlockHeader.ssz_type,
+        }
+
+    class AttesterSlashing(Container):
+        fields = {
+            "attestation_1": IndexedAttestation.ssz_type,
+            "attestation_2": IndexedAttestation.ssz_type,
+        }
+
+    class Attestation(Container):
+        fields = {
+            "aggregation_bits": Bitlist(P.max_validators_per_committee),
+            "data": AttestationData.ssz_type,
+            "signature": bytes96,
+        }
+
+    class Deposit(Container):
+        fields = {
+            "proof": Vector(bytes32, DEPOSIT_CONTRACT_TREE_DEPTH + 1),
+            "data": DepositData.ssz_type,
+        }
+
+    class VoluntaryExit(Container):
+        fields = {"epoch": uint64, "validator_index": uint64}
+
+    class SignedVoluntaryExit(Container):
+        fields = {"message": VoluntaryExit.ssz_type, "signature": bytes96}
+
+    class SyncAggregate(Container):
+        fields = {
+            "sync_committee_bits": Bitvector(P.sync_committee_size),
+            "sync_committee_signature": bytes96,
+        }
+
+    class SyncCommittee(Container):
+        fields = {
+            "pubkeys": Vector(bytes48, P.sync_committee_size),
+            "aggregate_pubkey": bytes48,
+        }
+
+    class Withdrawal(Container):
+        fields = {
+            "index": uint64,
+            "validator_index": uint64,
+            "address": bytes20,
+            "amount": uint64,
+        }
+
+    class BLSToExecutionChange(Container):
+        fields = {
+            "validator_index": uint64,
+            "from_bls_pubkey": bytes48,
+            "to_execution_address": bytes20,
+        }
+
+    class SignedBLSToExecutionChange(Container):
+        fields = {"message": BLSToExecutionChange.ssz_type, "signature": bytes96}
+
+    class HistoricalSummary(Container):
+        fields = {"block_summary_root": bytes32, "state_summary_root": bytes32}
+
+    # ---------------------------------------------------- execution payloads
+
+    _payload_base = {
+        "parent_hash": bytes32,
+        "fee_recipient": bytes20,
+        "state_root": bytes32,
+        "receipts_root": bytes32,
+        "logs_bloom": ByteVector(P.bytes_per_logs_bloom),
+        "prev_randao": bytes32,
+        "block_number": uint64,
+        "gas_limit": uint64,
+        "gas_used": uint64,
+        "timestamp": uint64,
+        "extra_data": ByteList(P.max_extra_data_bytes),
+        "base_fee_per_gas": uint256,
+        "block_hash": bytes32,
+    }
+    _txs = {"transactions": List(ByteList(P.max_bytes_per_transaction), P.max_transactions_per_payload)}
+    _wds = {"withdrawals": List(Withdrawal.ssz_type, P.max_withdrawals_per_payload)}
+    _blobgas = {"blob_gas_used": uint64, "excess_blob_gas": uint64}
+
+    class ExecutionPayloadBellatrix(Container):
+        fields = {**_payload_base, **_txs}
+
+    class ExecutionPayloadCapella(Container):
+        fields = {**_payload_base, **_txs, **_wds}
+
+    class ExecutionPayloadDeneb(Container):
+        fields = {**_payload_base, **_txs, **_wds, **_blobgas}
+
+    _hdr_base = dict(_payload_base)
+    _hdr_base["transactions_root"] = bytes32
+
+    class ExecutionPayloadHeaderBellatrix(Container):
+        fields = dict(_hdr_base)
+
+    class ExecutionPayloadHeaderCapella(Container):
+        fields = {**_hdr_base, "withdrawals_root": bytes32}
+
+    class ExecutionPayloadHeaderDeneb(Container):
+        fields = {**_hdr_base, "withdrawals_root": bytes32, **_blobgas}
+
+    # -------------------------------------------------------- block bodies
+
+    _body_base = {
+        "randao_reveal": bytes96,
+        "eth1_data": Eth1Data.ssz_type,
+        "graffiti": bytes32,
+        "proposer_slashings": List(ProposerSlashing.ssz_type, P.max_proposer_slashings),
+        "attester_slashings": List(AttesterSlashing.ssz_type, P.max_attester_slashings),
+        "attestations": List(Attestation.ssz_type, P.max_attestations),
+        "deposits": List(Deposit.ssz_type, P.max_deposits),
+        "voluntary_exits": List(SignedVoluntaryExit.ssz_type, P.max_voluntary_exits),
+    }
+    _sync_agg = {"sync_aggregate": SyncAggregate.ssz_type}
+    _blschanges = {
+        "bls_to_execution_changes": List(
+            SignedBLSToExecutionChange.ssz_type, P.max_bls_to_execution_changes
+        )
+    }
+    _blobkzg = {
+        "blob_kzg_commitments": List(bytes48, P.max_blob_commitments_per_block)
+    }
+
+    class BeaconBlockBodyPhase0(Container):
+        fork_name = "phase0"
+        fields = dict(_body_base)
+
+    class BeaconBlockBodyAltair(Container):
+        fork_name = "altair"
+        fields = {**_body_base, **_sync_agg}
+
+    class BeaconBlockBodyBellatrix(Container):
+        fork_name = "bellatrix"
+        fields = {**_body_base, **_sync_agg, "execution_payload": ExecutionPayloadBellatrix.ssz_type}
+
+    class BeaconBlockBodyCapella(Container):
+        fork_name = "capella"
+        fields = {
+            **_body_base,
+            **_sync_agg,
+            "execution_payload": ExecutionPayloadCapella.ssz_type,
+            **_blschanges,
+        }
+
+    class BeaconBlockBodyDeneb(Container):
+        fork_name = "deneb"
+        fields = {
+            **_body_base,
+            **_sync_agg,
+            "execution_payload": ExecutionPayloadDeneb.ssz_type,
+            **_blschanges,
+            **_blobkzg,
+        }
+
+    _bodies = {
+        "phase0": BeaconBlockBodyPhase0,
+        "altair": BeaconBlockBodyAltair,
+        "bellatrix": BeaconBlockBodyBellatrix,
+        "capella": BeaconBlockBodyCapella,
+        "deneb": BeaconBlockBodyDeneb,
+    }
+
+    _blocks = {}
+    _signed_blocks = {}
+    for _fork, _body in _bodies.items():
+        _blk = type(
+            f"BeaconBlock{_fork.capitalize()}",
+            (Container,),
+            {
+                "fork_name": _fork,
+                "fields": {
+                    "slot": uint64,
+                    "proposer_index": uint64,
+                    "parent_root": bytes32,
+                    "state_root": bytes32,
+                    "body": _body.ssz_type,
+                },
+            },
+        )
+        _sblk = type(
+            f"SignedBeaconBlock{_fork.capitalize()}",
+            (Container,),
+            {
+                "fork_name": _fork,
+                "fields": {"message": _blk.ssz_type, "signature": bytes96},
+            },
+        )
+        _blocks[_fork] = _blk
+        _signed_blocks[_fork] = _sblk
+
+    # -------------------------------------------------------------- states
+
+    _state_pre = {
+        "genesis_time": uint64,
+        "genesis_validators_root": bytes32,
+        "slot": uint64,
+        "fork": Fork.ssz_type,
+        "latest_block_header": BeaconBlockHeader.ssz_type,
+        "block_roots": Vector(bytes32, P.slots_per_historical_root),
+        "state_roots": Vector(bytes32, P.slots_per_historical_root),
+        "historical_roots": List(bytes32, P.historical_roots_limit),
+        "eth1_data": Eth1Data.ssz_type,
+        "eth1_data_votes": List(
+            Eth1Data.ssz_type, P.epochs_per_eth1_voting_period * P.slots_per_epoch
+        ),
+        "eth1_deposit_index": uint64,
+        "validators": List(Validator.ssz_type, P.validator_registry_limit),
+        "balances": List(uint64, P.validator_registry_limit),
+        "randao_mixes": Vector(bytes32, P.epochs_per_historical_vector),
+        "slashings": Vector(uint64, P.epochs_per_slashings_vector),
+    }
+    _state_justification = {
+        "justification_bits": Bitvector(JUSTIFICATION_BITS_LENGTH),
+        "previous_justified_checkpoint": Checkpoint.ssz_type,
+        "current_justified_checkpoint": Checkpoint.ssz_type,
+        "finalized_checkpoint": Checkpoint.ssz_type,
+    }
+    _participation = {
+        "previous_epoch_participation": List(uint8, P.validator_registry_limit),
+        "current_epoch_participation": List(uint8, P.validator_registry_limit),
+    }
+    _altair_tail = {
+        "inactivity_scores": List(uint64, P.validator_registry_limit),
+        "current_sync_committee": SyncCommittee.ssz_type,
+        "next_sync_committee": SyncCommittee.ssz_type,
+    }
+    _capella_tail = {
+        "next_withdrawal_index": uint64,
+        "next_withdrawal_validator_index": uint64,
+        "historical_summaries": List(HistoricalSummary.ssz_type, P.historical_roots_limit),
+    }
+
+    class BeaconStatePhase0(Container):
+        fork_name = "phase0"
+        fields = {
+            **_state_pre,
+            "previous_epoch_attestations": List(
+                PendingAttestation.ssz_type, P.max_attestations * P.slots_per_epoch
+            ),
+            "current_epoch_attestations": List(
+                PendingAttestation.ssz_type, P.max_attestations * P.slots_per_epoch
+            ),
+            **_state_justification,
+        }
+
+    class BeaconStateAltair(Container):
+        fork_name = "altair"
+        fields = {**_state_pre, **_participation, **_state_justification, **_altair_tail}
+
+    class BeaconStateBellatrix(Container):
+        fork_name = "bellatrix"
+        fields = {
+            **_state_pre,
+            **_participation,
+            **_state_justification,
+            **_altair_tail,
+            "latest_execution_payload_header": ExecutionPayloadHeaderBellatrix.ssz_type,
+        }
+
+    class BeaconStateCapella(Container):
+        fork_name = "capella"
+        fields = {
+            **_state_pre,
+            **_participation,
+            **_state_justification,
+            **_altair_tail,
+            "latest_execution_payload_header": ExecutionPayloadHeaderCapella.ssz_type,
+            **_capella_tail,
+        }
+
+    class BeaconStateDeneb(Container):
+        fork_name = "deneb"
+        fields = {
+            **_state_pre,
+            **_participation,
+            **_state_justification,
+            **_altair_tail,
+            "latest_execution_payload_header": ExecutionPayloadHeaderDeneb.ssz_type,
+            **_capella_tail,
+        }
+
+    _states = {
+        "phase0": BeaconStatePhase0,
+        "altair": BeaconStateAltair,
+        "bellatrix": BeaconStateBellatrix,
+        "capella": BeaconStateCapella,
+        "deneb": BeaconStateDeneb,
+    }
+
+    # ------------------------------------------------- aggregation / duties
+
+    class AggregateAndProof(Container):
+        fields = {
+            "aggregator_index": uint64,
+            "aggregate": Attestation.ssz_type,
+            "selection_proof": bytes96,
+        }
+
+    class SignedAggregateAndProof(Container):
+        fields = {"message": AggregateAndProof.ssz_type, "signature": bytes96}
+
+    class SyncCommitteeMessage(Container):
+        fields = {
+            "slot": uint64,
+            "beacon_block_root": bytes32,
+            "validator_index": uint64,
+            "signature": bytes96,
+        }
+
+    _sync_subcommittee_size = max(1, P.sync_committee_size // 4)
+
+    class SyncCommitteeContribution(Container):
+        fields = {
+            "slot": uint64,
+            "beacon_block_root": bytes32,
+            "subcommittee_index": uint64,
+            "aggregation_bits": Bitvector(_sync_subcommittee_size),
+            "signature": bytes96,
+        }
+
+    class ContributionAndProof(Container):
+        fields = {
+            "aggregator_index": uint64,
+            "contribution": SyncCommitteeContribution.ssz_type,
+            "selection_proof": bytes96,
+        }
+
+    class SignedContributionAndProof(Container):
+        fields = {"message": ContributionAndProof.ssz_type, "signature": bytes96}
+
+    # ------------------------------------------------------------- exports
+
+    for k, v in dict(locals()).items():
+        if isinstance(v, type) and issubclass(v, Container) and v is not Container:
+            setattr(ns, v.__name__, v)
+
+    ns.Fork = Fork
+    ns.block_body = _bodies
+    ns.block = _blocks
+    ns.signed_block = _signed_blocks
+    ns.state = _states
+    return ns

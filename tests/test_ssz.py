@@ -1,0 +1,134 @@
+"""SSZ round-trip + hash-tree-root tests, including spec-derived known answers."""
+
+import hashlib
+
+import pytest
+
+from lighthouse_tpu.types import ssz
+from lighthouse_tpu.types.ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    Container,
+    List,
+    Vector,
+    boolean,
+    bytes32,
+    uint8,
+    uint16,
+    uint64,
+)
+
+
+def test_uint_roundtrip():
+    assert uint64.serialize(0x0102030405060708) == bytes.fromhex("0807060504030201")
+    assert uint64.deserialize(uint64.serialize(12345)) == 12345
+    assert uint16.serialize(0xABCD) == b"\xcd\xab"
+
+
+def test_uint_htr_is_padded_le():
+    assert uint64.hash_tree_root(5) == (5).to_bytes(8, "little") + b"\x00" * 24
+
+
+def test_vector_uint():
+    v = Vector(uint64, 4)
+    vals = [1, 2, 3, 4]
+    assert v.deserialize(v.serialize(vals)) == vals
+    # 4 uint64 = 32 bytes = 1 chunk, root == packed chunk
+    assert v.hash_tree_root(vals) == b"".join(x.to_bytes(8, "little") for x in vals)
+
+
+def test_list_uint_htr():
+    l = List(uint64, 8)  # limit 8 -> 2 chunks -> depth 1
+    root_empty = l.hash_tree_root([])
+    expect = hashlib.sha256(
+        hashlib.sha256(b"\x00" * 64).digest() + (0).to_bytes(32, "little")
+    ).digest()
+    assert root_empty == expect
+    vals = [1, 2, 3]
+    packed = b"".join(x.to_bytes(8, "little") for x in vals) + b"\x00" * 8
+    body = hashlib.sha256(packed + b"\x00" * 32).digest()
+    assert l.hash_tree_root(vals) == hashlib.sha256(body + (3).to_bytes(32, "little")).digest()
+
+
+def test_bitvector():
+    bv = Bitvector(10)
+    bits = [True, False] * 5
+    data = bv.serialize(bits)
+    assert len(data) == 2
+    assert bv.deserialize(data) == bits
+    with pytest.raises(ValueError):
+        bv.deserialize(b"\xff\xff")  # high bits set
+
+
+def test_bitlist():
+    bl = Bitlist(16)
+    for bits in ([], [True], [False] * 8, [True] * 16, [True, False, True]):
+        assert bl.deserialize(bl.serialize(bits)) == bits
+    assert bl.serialize([]) == b"\x01"
+    with pytest.raises(ValueError):
+        bl.deserialize(b"\x00")
+
+
+class Inner(Container):
+    fields = {"a": uint64, "b": bytes32}
+
+
+class Outer(Container):
+    fields = {
+        "x": uint8,
+        "items": List(uint64, 32),
+        "inner": Inner.ssz_type,
+        "flag": boolean,
+        "blob": ByteList(64),
+    }
+
+
+def test_container_roundtrip():
+    o = Outer(x=7, items=[1, 2, 3], inner=Inner(a=9, b=b"\x11" * 32), flag=True, blob=b"hi")
+    data = o.as_ssz_bytes()
+    o2 = Outer.from_ssz_bytes(data)
+    assert o == o2
+    assert o2.items == [1, 2, 3]
+    assert o2.inner.a == 9
+
+
+def test_container_defaults():
+    o = Outer()
+    assert o.x == 0 and o.items == [] and o.flag is False
+    assert o.inner == Inner(a=0, b=b"\x00" * 32)
+
+
+def test_container_htr_manual():
+    i = Inner(a=1, b=b"\x22" * 32)
+    expect = hashlib.sha256(
+        ((1).to_bytes(8, "little") + b"\x00" * 24) + b"\x22" * 32
+    ).digest()
+    assert i.hash_tree_root() == expect
+
+
+def test_fixed_size_flags():
+    assert Inner.ssz_type.is_fixed_size and Inner.ssz_type.fixed_size == 40
+    assert not Outer.ssz_type.is_fixed_size
+
+
+def test_variable_container_offsets():
+    o = Outer(x=255, items=[7] * 5, blob=b"\xaa" * 10)
+    data = o.as_ssz_bytes()
+    # fixed part: 1 (x) + 4 (offset items) + 40 (inner) + 1 (flag) + 4 (offset blob)
+    assert int.from_bytes(data[1:5], "little") == 50
+    assert Outer.from_ssz_bytes(data) == o
+
+
+def test_nested_variable_list():
+    t = List(List(uint64, 4), 4)
+    v = [[1], [2, 3], []]
+    assert t.deserialize(t.serialize(v)) == v
+
+
+def test_merkleize_limit_padding():
+    # one chunk with limit 4 -> depth 2 tree with zero siblings
+    c = b"\x01" * 32
+    h01 = hashlib.sha256(c + ssz.ZERO_CHUNK).digest()
+    expect = hashlib.sha256(h01 + ssz.ZERO_HASHES[1]).digest()
+    assert ssz.merkleize([c], 4) == expect
