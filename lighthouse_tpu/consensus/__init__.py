@@ -1,0 +1,30 @@
+"""Pure consensus layer: the state-transition function and its helpers.
+
+Mirrors the reference's ``consensus/`` workspace (state_processing,
+swap_or_not_shuffle, fork_choice, safe_arith) re-designed array-first:
+validator registries, balances and participation live as dense numpy/jax
+arrays during epoch processing (the reference's
+``per_epoch_processing/single_pass.rs`` fused loop becomes fused vector ops),
+while block-level processing stays host-side Python driving the batched
+device BLS backend for signatures (``per_block_processing.rs:54-63``
+signature strategies).
+"""
+
+from .per_block import BlockSignatureStrategy, BlockSignatureVerifier, per_block_processing
+from .per_epoch import process_epoch
+from .per_slot import process_slot, process_slots
+from .shuffling import compute_shuffled_index, shuffle_list
+from .state_transition import StateRootMismatch, state_transition
+
+__all__ = [
+    "BlockSignatureStrategy",
+    "BlockSignatureVerifier",
+    "StateRootMismatch",
+    "compute_shuffled_index",
+    "per_block_processing",
+    "process_epoch",
+    "process_slot",
+    "process_slots",
+    "shuffle_list",
+    "state_transition",
+]

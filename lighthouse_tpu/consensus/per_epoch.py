@@ -1,0 +1,508 @@
+"""Epoch processing (reference: ``consensus/state_processing/src/per_epoch_processing``).
+
+The altair+ path is array-first — the analog of the reference's fused
+``single_pass.rs`` epoch loop: validator registry fields, balances,
+participation flags and inactivity scores are pulled into dense int64 numpy
+arrays once, every per-validator rule becomes fused vector arithmetic, and
+results are written back in one pass.  (On-device variants of the same math
+live behind the same array contract; numpy keeps host tests hermetic.)
+
+The phase0 path replays pending attestations (matching source/target/head) as
+the spec requires; it shares the justification engine with altair+.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ..types.spec import (
+    GENESIS_EPOCH,
+    PARTICIPATION_FLAG_WEIGHTS,
+    PROPOSER_WEIGHT,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_SOURCE_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+    ChainSpec,
+    FAR_FUTURE_EPOCH,
+)
+from . import helpers as h
+
+BASE_REWARDS_PER_EPOCH = 4  # phase0
+
+
+# ----------------------------------------------------------- array extract
+
+
+class EpochArrays:
+    """Dense snapshot of the registry for one epoch-processing run."""
+
+    def __init__(self, state, spec: ChainSpec):
+        vs = state.validators
+        n = len(vs)
+        self.n = n
+        self.effective_balance = np.fromiter(
+            (v.effective_balance for v in vs), dtype=np.int64, count=n
+        )
+        self.activation_epoch = np.fromiter(
+            (min(v.activation_epoch, 2**63 - 1) for v in vs), dtype=np.int64, count=n
+        )
+        self.exit_epoch = np.fromiter(
+            (min(v.exit_epoch, 2**63 - 1) for v in vs), dtype=np.int64, count=n
+        )
+        self.withdrawable_epoch = np.fromiter(
+            (min(v.withdrawable_epoch, 2**63 - 1) for v in vs), dtype=np.int64, count=n
+        )
+        self.slashed = np.fromiter((v.slashed for v in vs), dtype=bool, count=n)
+
+    def active_mask(self, epoch: int) -> np.ndarray:
+        return (self.activation_epoch <= epoch) & (epoch < self.exit_epoch)
+
+    def eligible_mask(self, prev_epoch: int) -> np.ndarray:
+        """Spec ``get_eligible_validator_indices``."""
+        return self.active_mask(prev_epoch) | (
+            self.slashed & (prev_epoch + 1 < self.withdrawable_epoch)
+        )
+
+
+def _participation_array(lst, n: int) -> np.ndarray:
+    return np.fromiter(lst, dtype=np.int64, count=n)
+
+
+# ------------------------------------------------- justification (shared)
+
+
+def weigh_justification_and_finalization(
+    state, total_active_balance: int, previous_target_balance: int, current_target_balance: int,
+    spec: ChainSpec,
+) -> None:
+    previous_epoch = h.get_previous_epoch(state, spec)
+    current_epoch = h.get_current_epoch(state, spec)
+    old_previous_justified = state.previous_justified_checkpoint
+    old_current_justified = state.current_justified_checkpoint
+    types_cp = type(old_current_justified)
+
+    state.previous_justified_checkpoint = state.current_justified_checkpoint
+    bits = list(state.justification_bits)
+    bits = [False] + bits[:-1]
+    if previous_target_balance * 3 >= total_active_balance * 2:
+        state.current_justified_checkpoint = types_cp(
+            epoch=previous_epoch, root=h.get_block_root(state, previous_epoch, spec)
+        )
+        bits[1] = True
+    if current_target_balance * 3 >= total_active_balance * 2:
+        state.current_justified_checkpoint = types_cp(
+            epoch=current_epoch, root=h.get_block_root(state, current_epoch, spec)
+        )
+        bits[0] = True
+    state.justification_bits = bits
+
+    # Finalization: 2nd/3rd/4th most recent epochs justified as source.
+    if all(bits[1:4]) and old_previous_justified.epoch + 3 == current_epoch:
+        state.finalized_checkpoint = old_previous_justified
+    if all(bits[1:3]) and old_previous_justified.epoch + 2 == current_epoch:
+        state.finalized_checkpoint = old_previous_justified
+    if all(bits[0:3]) and old_current_justified.epoch + 2 == current_epoch:
+        state.finalized_checkpoint = old_current_justified
+    if all(bits[0:2]) and old_current_justified.epoch + 1 == current_epoch:
+        state.finalized_checkpoint = old_current_justified
+
+
+def is_in_inactivity_leak(state, spec: ChainSpec) -> bool:
+    return (
+        h.get_previous_epoch(state, spec) - state.finalized_checkpoint.epoch
+        > spec.min_epochs_to_inactivity_penalty
+    )
+
+
+# ------------------------------------------------------------ altair path
+
+
+def _unslashed_participating_mask(
+    arrays: EpochArrays, participation: np.ndarray, flag_index: int, epoch: int
+) -> np.ndarray:
+    return (
+        arrays.active_mask(epoch)
+        & ((participation >> flag_index) & 1).astype(bool)
+        & ~arrays.slashed
+    )
+
+
+def process_epoch_altair(state, types, spec: ChainSpec) -> None:
+    arrays = EpochArrays(state, spec)
+    n = arrays.n
+    current_epoch = h.get_current_epoch(state, spec)
+    previous_epoch = h.get_previous_epoch(state, spec)
+    prev_part = _participation_array(state.previous_epoch_participation, n)
+    curr_part = _participation_array(state.current_epoch_participation, n)
+    balances = np.fromiter(state.balances, dtype=np.int64, count=n)
+
+    increment = spec.effective_balance_increment
+    total_active_balance = max(
+        increment, int(arrays.effective_balance[arrays.active_mask(current_epoch)].sum())
+    )
+
+    # --- justification & finalization
+    if current_epoch > GENESIS_EPOCH + 1:
+        prev_target = _unslashed_participating_mask(
+            arrays, prev_part, TIMELY_TARGET_FLAG_INDEX, previous_epoch
+        )
+        curr_target = _unslashed_participating_mask(
+            arrays, curr_part, TIMELY_TARGET_FLAG_INDEX, current_epoch
+        )
+        weigh_justification_and_finalization(
+            state,
+            total_active_balance,
+            max(increment, int(arrays.effective_balance[prev_target].sum())),
+            max(increment, int(arrays.effective_balance[curr_target].sum())),
+            spec,
+        )
+
+    in_leak = is_in_inactivity_leak(state, spec)
+    eligible = arrays.eligible_mask(previous_epoch)
+
+    # --- inactivity updates
+    inactivity = np.fromiter(state.inactivity_scores, dtype=np.int64, count=n)
+    if current_epoch > GENESIS_EPOCH:
+        prev_target = _unslashed_participating_mask(
+            arrays, prev_part, TIMELY_TARGET_FLAG_INDEX, previous_epoch
+        )
+        delta = np.where(prev_target, -np.minimum(1, inactivity), spec.inactivity_score_bias)
+        inactivity = inactivity + np.where(eligible, delta, 0)
+        if not in_leak:
+            inactivity = inactivity - np.where(
+                eligible, np.minimum(spec.inactivity_score_recovery_rate, inactivity), 0
+            )
+        state.inactivity_scores = [int(x) for x in inactivity]
+
+    # --- rewards and penalties
+    if current_epoch > GENESIS_EPOCH:
+        base_reward_per_increment = (
+            increment * spec.base_reward_factor // spec.integer_squareroot(total_active_balance)
+        )
+        base_reward = (arrays.effective_balance // increment) * base_reward_per_increment
+        active_increments = total_active_balance // increment
+        rewards = np.zeros(n, dtype=np.int64)
+        penalties = np.zeros(n, dtype=np.int64)
+        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+            participating = _unslashed_participating_mask(
+                arrays, prev_part, flag_index, previous_epoch
+            )
+            participating_increments = int(
+                arrays.effective_balance[participating].sum()
+            ) // increment
+            flag_rewards = np.zeros(n, dtype=np.int64)
+            if not in_leak:
+                flag_rewards = (
+                    base_reward * weight * participating_increments
+                    // (active_increments * WEIGHT_DENOMINATOR)
+                )
+            rewards += np.where(eligible & participating, flag_rewards, 0)
+            if flag_index != TIMELY_HEAD_FLAG_INDEX:
+                penalties += np.where(
+                    eligible & ~participating, base_reward * weight // WEIGHT_DENOMINATOR, 0
+                )
+        # inactivity penalties (EIP-7045-era quotient per fork)
+        fork = type(state).fork_name
+        quotient = (
+            spec.inactivity_penalty_quotient_altair
+            if fork == "altair"
+            else spec.inactivity_penalty_quotient_bellatrix
+        )
+        prev_target = _unslashed_participating_mask(
+            arrays, prev_part, TIMELY_TARGET_FLAG_INDEX, previous_epoch
+        )
+        inactivity_penalty = (
+            arrays.effective_balance * inactivity
+            // (spec.inactivity_score_bias * quotient)
+        )
+        penalties += np.where(eligible & ~prev_target, inactivity_penalty, 0)
+        balances = np.maximum(0, balances + rewards - penalties)
+        state.balances = [int(x) for x in balances]
+
+    # --- registry updates, slashings, resets (shared with phase0)
+    _process_registry_updates(state, arrays, spec)
+    _process_slashings(state, arrays, balances, total_active_balance, spec)
+    _process_eth1_data_reset(state, spec)
+    _process_effective_balance_updates(state, arrays, spec)
+    _process_slashings_reset(state, spec)
+    _process_randao_mixes_reset(state, spec)
+    _process_historical_update(state, types, spec)
+
+    # --- participation flag rotation
+    state.previous_epoch_participation = list(state.current_epoch_participation)
+    state.current_epoch_participation = [0] * n
+
+    # --- sync committee rotation
+    next_epoch = current_epoch + 1
+    if next_epoch % spec.preset.epochs_per_sync_committee_period == 0:
+        state.current_sync_committee = state.next_sync_committee
+        state.next_sync_committee = h.get_next_sync_committee(state, types, spec)
+
+    h.invalidate_caches(state)
+
+
+# ------------------------------------------------------------ phase0 path
+
+
+def _matching_attestation_sets(state, spec: ChainSpec):
+    """(matching_source, matching_target, matching_head) pending attestations
+    for the previous epoch, plus per-validator earliest inclusion info."""
+    previous_epoch = h.get_previous_epoch(state, spec)
+    source_atts = list(state.previous_epoch_attestations)
+    target_root = h.get_block_root(state, previous_epoch, spec)
+    target_atts = [a for a in source_atts if bytes(a.data.target.root) == bytes(target_root)]
+    head_atts = [
+        a
+        for a in target_atts
+        if bytes(a.data.beacon_block_root) == bytes(h.get_block_root_at_slot(state, a.data.slot, spec))
+    ]
+    return source_atts, target_atts, head_atts
+
+
+def _attesting_indices_set(state, attestations, spec: ChainSpec) -> set:
+    out = set()
+    for a in attestations:
+        out.update(h.get_attesting_indices(state, a.data, a.aggregation_bits, spec))
+    return out
+
+
+def _unslashed(state, indices: set) -> set:
+    return {i for i in indices if not state.validators[i].slashed}
+
+
+def process_epoch_phase0(state, types, spec: ChainSpec) -> None:
+    arrays = EpochArrays(state, spec)
+    n = arrays.n
+    current_epoch = h.get_current_epoch(state, spec)
+    previous_epoch = h.get_previous_epoch(state, spec)
+    increment = spec.effective_balance_increment
+    total_active_balance = max(
+        increment, int(arrays.effective_balance[arrays.active_mask(current_epoch)].sum())
+    )
+
+    # --- justification & finalization from pending attestations
+    if current_epoch > GENESIS_EPOCH + 1:
+        source_atts, target_atts, _ = _matching_attestation_sets(state, spec)
+        prev_target_idx = _unslashed(state, _attesting_indices_set(state, target_atts, spec))
+        # current-epoch matching target
+        cur_target_root = h.get_block_root(state, current_epoch, spec)
+        cur_target_atts = [
+            a
+            for a in state.current_epoch_attestations
+            if bytes(a.data.target.root) == bytes(cur_target_root)
+        ]
+        cur_target_idx = _unslashed(state, _attesting_indices_set(state, cur_target_atts, spec))
+        weigh_justification_and_finalization(
+            state,
+            total_active_balance,
+            h.get_total_balance(state, prev_target_idx, spec),
+            h.get_total_balance(state, cur_target_idx, spec),
+            spec,
+        )
+
+    # --- rewards and penalties
+    if current_epoch > GENESIS_EPOCH:
+        rewards, penalties = _phase0_attestation_deltas(
+            state, arrays, total_active_balance, spec
+        )
+        balances = np.fromiter(state.balances, dtype=np.int64, count=n)
+        balances = np.maximum(0, balances + rewards - penalties)
+        state.balances = [int(x) for x in balances]
+    else:
+        balances = np.fromiter(state.balances, dtype=np.int64, count=n)
+
+    _process_registry_updates(state, arrays, spec)
+    _process_slashings(state, arrays, balances, total_active_balance, spec)
+    _process_eth1_data_reset(state, spec)
+    _process_effective_balance_updates(state, arrays, spec)
+    _process_slashings_reset(state, spec)
+    _process_randao_mixes_reset(state, spec)
+    _process_historical_update(state, types, spec)
+
+    # --- participation record rotation
+    state.previous_epoch_attestations = list(state.current_epoch_attestations)
+    state.current_epoch_attestations = []
+
+    h.invalidate_caches(state)
+
+
+def _phase0_attestation_deltas(state, arrays: EpochArrays, total_active_balance: int, spec):
+    n = arrays.n
+    previous_epoch = h.get_previous_epoch(state, spec)
+    increment = spec.effective_balance_increment
+    eligible = arrays.eligible_mask(previous_epoch)
+    base_reward = (
+        arrays.effective_balance
+        * spec.base_reward_factor
+        // spec.integer_squareroot(total_active_balance)
+        // BASE_REWARDS_PER_EPOCH
+    )
+    rewards = np.zeros(n, dtype=np.int64)
+    penalties = np.zeros(n, dtype=np.int64)
+    in_leak = is_in_inactivity_leak(state, spec)
+
+    source_atts, target_atts, head_atts = _matching_attestation_sets(state, spec)
+    source_idx = _unslashed(state, _attesting_indices_set(state, source_atts, spec))
+    target_idx = _unslashed(state, _attesting_indices_set(state, target_atts, spec))
+    head_idx = _unslashed(state, _attesting_indices_set(state, head_atts, spec))
+
+    for idx_set in (source_idx, target_idx, head_idx):
+        mask = np.zeros(n, dtype=bool)
+        if idx_set:
+            mask[list(idx_set)] = True
+        attesting_balance = max(increment, int(arrays.effective_balance[mask].sum()))
+        if in_leak:
+            component_reward = base_reward
+        else:
+            component_reward = (
+                base_reward * (attesting_balance // increment)
+                // (total_active_balance // increment)
+            )
+        rewards += np.where(eligible & mask, component_reward, 0)
+        penalties += np.where(eligible & ~mask, base_reward, 0)
+
+    # inclusion-delay rewards: earliest inclusion per source-attesting validator
+    proposer_reward = base_reward // spec.proposer_reward_quotient
+    earliest: Dict[int, Tuple[int, int]] = {}  # index -> (delay, proposer)
+    for a in source_atts:
+        for i in h.get_attesting_indices(state, a.data, a.aggregation_bits, spec):
+            if i in source_idx:
+                d = int(a.inclusion_delay)
+                if i not in earliest or d < earliest[i][0]:
+                    earliest[i] = (d, int(a.proposer_index))
+    for i, (delay, proposer) in earliest.items():
+        rewards[proposer] += int(proposer_reward[i])
+        max_attester_reward = int(base_reward[i]) - int(proposer_reward[i])
+        rewards[i] += max_attester_reward // delay
+
+    # inactivity leak penalties
+    if in_leak:
+        finality_delay = previous_epoch - state.finalized_checkpoint.epoch
+        target_mask = np.zeros(n, dtype=bool)
+        if target_idx:
+            target_mask[list(target_idx)] = True
+        penalties += np.where(
+            eligible, BASE_REWARDS_PER_EPOCH * base_reward - proposer_reward, 0
+        )
+        penalties += np.where(
+            eligible & ~target_mask,
+            arrays.effective_balance * finality_delay // spec.inactivity_penalty_quotient,
+            0,
+        )
+    return rewards, penalties
+
+
+# ------------------------------------------------------- shared sub-steps
+
+
+def _process_registry_updates(state, arrays: EpochArrays, spec: ChainSpec) -> None:
+    current_epoch = h.get_current_epoch(state, spec)
+    # eligibility + ejections
+    for index, v in enumerate(state.validators):
+        if h.is_eligible_for_activation_queue(v, spec):
+            v.activation_eligibility_epoch = current_epoch + 1
+        if (
+            h.is_active_validator(v, current_epoch)
+            and v.effective_balance <= spec.ejection_balance
+        ):
+            h.initiate_validator_exit(state, index, spec)
+    # dequeue activations up to churn
+    queue = sorted(
+        (
+            index
+            for index, v in enumerate(state.validators)
+            if h.is_eligible_for_activation(state, v)
+        ),
+        key=lambda i: (state.validators[i].activation_eligibility_epoch, i),
+    )
+    churn = h.get_validator_activation_churn_limit(state, spec)
+    for index in queue[:churn]:
+        state.validators[index].activation_epoch = h.compute_activation_exit_epoch(
+            current_epoch, spec
+        )
+
+
+def _process_slashings(
+    state, arrays: EpochArrays, balances: np.ndarray, total_balance: int, spec: ChainSpec
+) -> None:
+    fork = type(state).fork_name
+    epoch = h.get_current_epoch(state, spec)
+    if fork == "phase0":
+        multiplier = spec.proportional_slashing_multiplier
+    elif fork == "altair":
+        multiplier = spec.proportional_slashing_multiplier_altair
+    else:
+        multiplier = spec.proportional_slashing_multiplier_bellatrix
+    adjusted_total = min(sum(int(x) for x in state.slashings) * multiplier, total_balance)
+    increment = spec.effective_balance_increment
+    target_epoch = epoch + spec.preset.epochs_per_slashings_vector // 2
+    mask = arrays.slashed & (arrays.withdrawable_epoch == target_epoch)
+    if not mask.any():
+        return
+    penalty_numerator = (arrays.effective_balance // increment) * adjusted_total
+    penalty = penalty_numerator // total_balance * increment
+    for index in np.nonzero(mask)[0]:
+        h.decrease_balance(state, int(index), int(penalty[index]))
+
+
+def _process_eth1_data_reset(state, spec: ChainSpec) -> None:
+    next_epoch = h.get_current_epoch(state, spec) + 1
+    if next_epoch % spec.preset.epochs_per_eth1_voting_period == 0:
+        state.eth1_data_votes = []
+
+
+def _process_effective_balance_updates(state, arrays: EpochArrays, spec: ChainSpec) -> None:
+    increment = spec.effective_balance_increment
+    hysteresis_increment = increment // spec.preset.hysteresis_quotient
+    downward = hysteresis_increment * spec.preset.hysteresis_downward_multiplier
+    upward = hysteresis_increment * spec.preset.hysteresis_upward_multiplier
+    for index, v in enumerate(state.validators):
+        balance = state.balances[index]
+        if balance + downward < v.effective_balance or v.effective_balance + upward < balance:
+            v.effective_balance = min(
+                balance - balance % increment, spec.max_effective_balance
+            )
+
+
+def _process_slashings_reset(state, spec: ChainSpec) -> None:
+    next_epoch = h.get_current_epoch(state, spec) + 1
+    state.slashings[next_epoch % spec.preset.epochs_per_slashings_vector] = 0
+
+
+def _process_randao_mixes_reset(state, spec: ChainSpec) -> None:
+    current_epoch = h.get_current_epoch(state, spec)
+    next_epoch = current_epoch + 1
+    state.randao_mixes[next_epoch % spec.preset.epochs_per_historical_vector] = h.get_randao_mix(
+        state, current_epoch, spec
+    )
+
+
+def _process_historical_update(state, types, spec: ChainSpec) -> None:
+    next_epoch = h.get_current_epoch(state, spec) + 1
+    if next_epoch % (spec.preset.slots_per_historical_root // spec.slots_per_epoch) != 0:
+        return
+    fork = type(state).fork_name
+    if fork in ("phase0", "altair", "bellatrix"):
+        batch = types.HistoricalBatch(
+            block_roots=list(state.block_roots), state_roots=list(state.state_roots)
+        )
+        state.historical_roots = list(state.historical_roots) + [batch.hash_tree_root()]
+    else:
+        summary = types.HistoricalSummary(
+            block_summary_root=state.fields["block_roots"].hash_tree_root(state.block_roots),
+            state_summary_root=state.fields["state_roots"].hash_tree_root(state.state_roots),
+        )
+        state.historical_summaries = list(state.historical_summaries) + [summary]
+
+
+# ---------------------------------------------------------------- dispatch
+
+
+def process_epoch(state, types, spec: ChainSpec) -> None:
+    if type(state).fork_name == "phase0":
+        process_epoch_phase0(state, types, spec)
+    else:
+        process_epoch_altair(state, types, spec)

@@ -1,0 +1,143 @@
+"""Fork upgrade functions (reference: ``consensus/state_processing/src/upgrade/``:
+altair.rs, merge.rs, capella.rs, deneb.rs).
+
+Each takes a pre-fork state and returns the post-fork state container,
+copying shared fields and initializing the new ones per spec.
+"""
+
+from __future__ import annotations
+
+from ..types.spec import ChainSpec
+from . import helpers as h
+
+
+def _copy_shared(pre, new_cls, **overrides):
+    kwargs = {}
+    for name in new_cls.fields:
+        if name in overrides:
+            kwargs[name] = overrides[name]
+        elif name in pre.fields:
+            kwargs[name] = getattr(pre, name)
+    out = new_cls(**kwargs)
+    return out
+
+
+def _convert_payload_header(pre_hdr, new_cls):
+    kwargs = {name: getattr(pre_hdr, name) for name in new_cls.fields if name in pre_hdr.fields}
+    return new_cls(**kwargs)
+
+
+def translate_participation(post, pending_attestations, spec: ChainSpec) -> None:
+    """Altair upgrade: replay phase0 pending attestations into participation
+    flags (spec ``translate_participation``)."""
+    for att in pending_attestations:
+        data = att.data
+        inclusion_delay = att.inclusion_delay
+        flags = h.get_attestation_participation_flag_indices(post, data, inclusion_delay, spec)
+        committee = h.get_beacon_committee(post, data.slot, data.index, spec)
+        for i, bit in enumerate(att.aggregation_bits):
+            if not bit:
+                continue
+            index = int(committee[i])
+            ep = post.previous_epoch_participation[index]
+            for flag in flags:
+                ep = h.add_flag(ep, flag)
+            post.previous_epoch_participation[index] = ep
+
+
+def upgrade_to_altair(pre, types, spec: ChainSpec):
+    epoch = h.get_current_epoch(pre, spec)
+    n = len(pre.validators)
+    post = _copy_shared(
+        pre,
+        types.state["altair"],
+        fork=types.Fork(
+            previous_version=pre.fork.current_version,
+            current_version=spec.altair_fork_version,
+            epoch=epoch,
+        ),
+        previous_epoch_participation=[0] * n,
+        current_epoch_participation=[0] * n,
+        inactivity_scores=[0] * n,
+    )
+    translate_participation(post, pre.previous_epoch_attestations, spec)
+    sync_committee = h.get_next_sync_committee(post, types, spec)
+    post.current_sync_committee = sync_committee
+    post.next_sync_committee = h.get_next_sync_committee(post, types, spec)
+    return post
+
+
+def upgrade_to_bellatrix(pre, types, spec: ChainSpec):
+    epoch = h.get_current_epoch(pre, spec)
+    return _copy_shared(
+        pre,
+        types.state["bellatrix"],
+        fork=types.Fork(
+            previous_version=pre.fork.current_version,
+            current_version=spec.bellatrix_fork_version,
+            epoch=epoch,
+        ),
+        latest_execution_payload_header=types.ExecutionPayloadHeaderBellatrix(),
+    )
+
+
+def upgrade_to_capella(pre, types, spec: ChainSpec):
+    epoch = h.get_current_epoch(pre, spec)
+    return _copy_shared(
+        pre,
+        types.state["capella"],
+        fork=types.Fork(
+            previous_version=pre.fork.current_version,
+            current_version=spec.capella_fork_version,
+            epoch=epoch,
+        ),
+        latest_execution_payload_header=_convert_payload_header(
+            pre.latest_execution_payload_header, types.ExecutionPayloadHeaderCapella
+        ),
+        next_withdrawal_index=0,
+        next_withdrawal_validator_index=0,
+        historical_summaries=[],
+    )
+
+
+def upgrade_to_deneb(pre, types, spec: ChainSpec):
+    epoch = h.get_current_epoch(pre, spec)
+    return _copy_shared(
+        pre,
+        types.state["deneb"],
+        fork=types.Fork(
+            previous_version=pre.fork.current_version,
+            current_version=spec.deneb_fork_version,
+            epoch=epoch,
+        ),
+        latest_execution_payload_header=_convert_payload_header(
+            pre.latest_execution_payload_header, types.ExecutionPayloadHeaderDeneb
+        ),
+    )
+
+
+UPGRADES = {
+    "altair": upgrade_to_altair,
+    "bellatrix": upgrade_to_bellatrix,
+    "capella": upgrade_to_capella,
+    "deneb": upgrade_to_deneb,
+}
+
+
+def upgrade_state(pre, target_fork: str, types, spec: ChainSpec):
+    """Apply the chained upgrade functions from the state's fork up to
+    ``target_fork``."""
+    from ..types.spec import FORK_ORDER
+
+    cur = FORK_ORDER.index(type(pre).fork_name)
+    tgt = FORK_ORDER.index(target_fork)
+    state = pre
+    for fork in FORK_ORDER[cur + 1 : tgt + 1]:
+        if fork not in UPGRADES:
+            raise NotImplementedError(
+                f"fork {fork!r} is scheduled but not implemented; "
+                f"supported: phase0..{list(UPGRADES)[-1]}"
+            )
+        state = UPGRADES[fork](state, types, spec)
+        h.invalidate_caches(state)
+    return state

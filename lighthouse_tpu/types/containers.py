@@ -27,6 +27,7 @@ from .ssz import (
     Vector,
     boolean,
     bytes4,
+    bytes20,
     bytes32,
     bytes48,
     bytes96,
@@ -34,8 +35,6 @@ from .ssz import (
     uint8,
     uint256,
 )
-
-bytes20 = ByteVector(20)
 
 
 @lru_cache(maxsize=None)
@@ -435,6 +434,9 @@ def build_types(preset: Preset) -> SimpleNamespace:
 
     class SignedAggregateAndProof(Container):
         fields = {"message": AggregateAndProof.ssz_type, "signature": bytes96}
+
+    class SyncAggregatorSelectionData(Container):
+        fields = {"slot": uint64, "subcommittee_index": uint64}
 
     class SyncCommitteeMessage(Container):
         fields = {

@@ -269,6 +269,11 @@ def minimal_spec(**overrides) -> ChainSpec:
         churn_limit_quotient=32,
         shard_committee_period=64,
         min_validator_withdrawability_delay=256,
+        altair_fork_epoch=0,
+        bellatrix_fork_epoch=0,
+        capella_fork_epoch=0,
+        deneb_fork_epoch=0,
+        electra_fork_epoch=None,
         altair_fork_version=bytes.fromhex("01000001"),
         bellatrix_fork_version=bytes.fromhex("02000001"),
         capella_fork_version=bytes.fromhex("03000001"),

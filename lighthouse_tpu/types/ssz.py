@@ -57,6 +57,8 @@ def merkleize(chunks: Sequence[bytes], limit: Optional[int] = None) -> bytes:
     count = len(chunks)
     if limit is None:
         limit = count
+    elif count > limit:
+        raise ValueError(f"merkleize: {count} chunks exceeds limit {limit}")
     if limit == 0:
         return ZERO_CHUNK
     depth = max(0, (limit - 1).bit_length())
@@ -436,6 +438,8 @@ class _ContainerType(SszType):
                 var_fields.append(name)
                 pos += 4
         offsets.append(len(data))
+        if not var_fields and pos != len(data):
+            raise ValueError("container: trailing bytes")
         if var_fields and offsets[0] != pos:
             raise ValueError("container: bad first offset")
         for i, name in enumerate(var_fields):
@@ -460,7 +464,6 @@ class _ContainerMeta(type):
         cls = super().__new__(mcls, name, bases, ns)
         if ns.get("fields"):
             cls.ssz_type = _ContainerType(cls)
-            cls.__slots__ = ()
         return cls
 
 

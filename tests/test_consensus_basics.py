@@ -1,0 +1,128 @@
+"""Consensus-layer basics: shuffling invariants, interop genesis, empty-slot
+advancement through epoch processing on every fork (the sanity_slots tier of
+the reference's test ladder, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.consensus import compute_shuffled_index, shuffle_list
+from lighthouse_tpu.consensus import helpers as h
+from lighthouse_tpu.consensus.genesis import interop_genesis_state, interop_keypair
+from lighthouse_tpu.consensus.per_slot import process_slots
+from lighthouse_tpu.types.containers import build_types
+from lighthouse_tpu.types.spec import minimal_spec
+
+SEED = bytes(range(32))
+
+
+class TestShuffling:
+    def test_list_matches_single_index(self):
+        for n in (2, 7, 100, 333):
+            vals = np.arange(1000, 1000 + n)
+            shuffled = shuffle_list(vals, SEED, rounds=10)
+            expect = [vals[compute_shuffled_index(i, n, SEED, 10)] for i in range(n)]
+            assert shuffled.tolist() == expect
+
+    def test_permutation(self):
+        vals = np.arange(257)
+        out = shuffle_list(vals, SEED, rounds=90)
+        assert sorted(out.tolist()) == list(range(257))
+        assert out.tolist() != list(range(257))  # astronomically unlikely identity
+
+    def test_seed_sensitivity(self):
+        vals = np.arange(64)
+        a = shuffle_list(vals, SEED, rounds=10)
+        b = shuffle_list(vals, bytes(32), rounds=10)
+        assert a.tolist() != b.tolist()
+
+    def test_single_element(self):
+        assert shuffle_list(np.array([5]), SEED, 10).tolist() == [5]
+        assert compute_shuffled_index(0, 1, SEED, 10) == 0
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return minimal_spec(altair_fork_epoch=0, bellatrix_fork_epoch=0, capella_fork_epoch=0,
+                        deneb_fork_epoch=None, electra_fork_epoch=None)
+
+
+@pytest.fixture(scope="module")
+def types(spec):
+    return build_types(spec.preset)
+
+
+class TestInteropGenesis:
+    def test_phase0_genesis(self, types):
+        spec0 = minimal_spec(
+            altair_fork_epoch=None, bellatrix_fork_epoch=None, capella_fork_epoch=None,
+            deneb_fork_epoch=None, electra_fork_epoch=None,
+        )
+        state = interop_genesis_state(16, types, spec0)
+        assert type(state).fork_name == "phase0"
+        assert len(state.validators) == 16
+        assert all(v.activation_epoch == 0 for v in state.validators)
+        assert state.genesis_validators_root != bytes(32)
+        # deterministic
+        state2 = interop_genesis_state(16, types, spec0)
+        assert state.hash_tree_root() == state2.hash_tree_root()
+
+    def test_capella_genesis(self, types, spec):
+        state = interop_genesis_state(24, types, spec)
+        assert type(state).fork_name == "capella"
+        assert state.fork.current_version == spec.capella_fork_version
+        assert state.fork.previous_version == spec.bellatrix_fork_version
+        assert len(state.current_sync_committee.pubkeys) == spec.preset.sync_committee_size
+        assert len(state.inactivity_scores) == 24
+
+    def test_keypairs_deterministic(self):
+        sk, pk = interop_keypair(3)
+        sk2, pk2 = interop_keypair(3)
+        assert sk.to_bytes() == sk2.to_bytes() and pk == pk2
+        assert interop_keypair(4)[1] != pk
+
+
+class TestSlotProcessing:
+    def test_advance_one_epoch_capella(self, types, spec):
+        state = interop_genesis_state(24, types, spec)
+        state = process_slots(state, spec.slots_per_epoch + 1, types, spec)
+        assert state.slot == spec.slots_per_epoch + 1
+        assert h.get_current_epoch(state, spec) == 1
+        # block roots chained: every past slot has a root
+        for s in range(state.slot):
+            assert bytes(state.block_roots[s % spec.preset.slots_per_historical_root]) != bytes(32)
+
+    def test_advance_through_fork_upgrade(self, types):
+        spec = minimal_spec(
+            altair_fork_epoch=0, bellatrix_fork_epoch=0, capella_fork_epoch=0,
+            deneb_fork_epoch=2, electra_fork_epoch=None,
+        )
+        state = interop_genesis_state(24, types, spec)
+        assert type(state).fork_name == "capella"
+        state = process_slots(state, 2 * spec.slots_per_epoch, types, spec)
+        assert type(state).fork_name == "deneb"
+        assert state.fork.current_version == spec.deneb_fork_version
+        assert state.fork.epoch == 2
+
+    def test_effective_balance_hysteresis(self, types, spec):
+        state = interop_genesis_state(16, types, spec)
+        # drain a validator's balance below the downward hysteresis bound
+        state.balances[0] = 31 * 10**9 - 1
+        state = process_slots(state, spec.slots_per_epoch, types, spec)
+        assert state.validators[0].effective_balance == 30 * 10**9
+
+    def test_proposer_index_in_active_set(self, types, spec):
+        state = interop_genesis_state(24, types, spec)
+        p = h.get_beacon_proposer_index(state, spec)
+        assert 0 <= p < 24
+
+
+class TestCommittees:
+    def test_committees_partition_active_set(self, types, spec):
+        state = interop_genesis_state(24, types, spec)
+        epoch = 0
+        seen = []
+        count = h.get_committee_count_per_slot(state, epoch, spec)
+        for slot in range(spec.slots_per_epoch):
+            for index in range(count):
+                seen.extend(int(x) for x in h.get_beacon_committee(state, slot, index, spec))
+        assert sorted(seen) == list(range(24))
