@@ -146,8 +146,17 @@ def get_active_validator_indices(state, epoch: int) -> np.ndarray:
 
 
 def get_validator_churn_limit(state, spec: ChainSpec) -> int:
-    n_active = len(get_active_validator_indices(state, get_current_epoch(state, spec)))
-    return max(spec.min_per_epoch_churn_limit, n_active // spec.churn_limit_quotient)
+    # Constant within an epoch; memoized because mass ejections call this once
+    # per exit and each miss re-scans the whole registry.
+    cc = _caches(state)
+    epoch = get_current_epoch(state, spec)
+    hit = cc.get("churn_limit")
+    if hit is not None and hit[0] == epoch:
+        return hit[1]
+    n_active = len(get_active_validator_indices(state, epoch))
+    limit = max(spec.min_per_epoch_churn_limit, n_active // spec.churn_limit_quotient)
+    cc["churn_limit"] = (epoch, limit)
+    return limit
 
 
 def get_validator_activation_churn_limit(state, spec: ChainSpec) -> int:

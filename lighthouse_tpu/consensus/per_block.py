@@ -297,13 +297,24 @@ def process_attestation(state, attestation, types, spec: ChainSpec, verify: bool
 
     fork = type(state).fork_name
     if fork == "phase0":
+        # Spec: the attestation's FFG source must match the state's justified
+        # checkpoint for its target epoch (altair+ gets this inside
+        # get_attestation_participation_flag_indices).
+        is_current = data.target.epoch == h.get_current_epoch(state, spec)
+        expected_source = (
+            state.current_justified_checkpoint
+            if is_current
+            else state.previous_justified_checkpoint
+        )
+        if data.source != expected_source:
+            raise BlockProcessingError("attestation: source checkpoint mismatch")
         pending = types.PendingAttestation(
             aggregation_bits=list(attestation.aggregation_bits),
             data=data,
             inclusion_delay=state.slot - data.slot,
             proposer_index=h.get_beacon_proposer_index(state, spec),
         )
-        if data.target.epoch == h.get_current_epoch(state, spec):
+        if is_current:
             state.current_epoch_attestations = list(state.current_epoch_attestations) + [pending]
         else:
             state.previous_epoch_attestations = list(state.previous_epoch_attestations) + [

@@ -74,40 +74,79 @@ def _participation_array(lst, n: int) -> np.ndarray:
 # ------------------------------------------------- justification (shared)
 
 
+def compute_justification_and_finalization(
+    *,
+    bits,
+    old_previous_justified,  # (epoch, root)
+    old_current_justified,  # (epoch, root)
+    previous_epoch: int,
+    current_epoch: int,
+    previous_boundary_root: bytes,
+    current_boundary_root: bytes,
+    total_active_balance: int,
+    previous_target_balance: int,
+    current_target_balance: int,
+):
+    """Pure spec ``weigh_justification_and_finalization`` →
+    ``(new_bits, new_justified | None, new_finalized | None)``.
+
+    Single source of truth for the 4-rule finalization table; used by both the
+    mutating epoch transition below and fork choice's unrealized-checkpoint
+    ("pull-up") computation, which must never drift apart."""
+    bits = [False] + list(bits)[:-1]
+    justified = None
+    if previous_target_balance * 3 >= total_active_balance * 2:
+        justified = (previous_epoch, previous_boundary_root)
+        bits[1] = True
+    if current_target_balance * 3 >= total_active_balance * 2:
+        justified = (current_epoch, current_boundary_root)
+        bits[0] = True
+
+    # Finalization: 2nd/3rd/4th most recent epochs justified as source.
+    finalized = None
+    if all(bits[1:4]) and old_previous_justified[0] + 3 == current_epoch:
+        finalized = old_previous_justified
+    if all(bits[1:3]) and old_previous_justified[0] + 2 == current_epoch:
+        finalized = old_previous_justified
+    if all(bits[0:3]) and old_current_justified[0] + 2 == current_epoch:
+        finalized = old_current_justified
+    if all(bits[0:2]) and old_current_justified[0] + 1 == current_epoch:
+        finalized = old_current_justified
+    return bits, justified, finalized
+
+
 def weigh_justification_and_finalization(
     state, total_active_balance: int, previous_target_balance: int, current_target_balance: int,
     spec: ChainSpec,
 ) -> None:
     previous_epoch = h.get_previous_epoch(state, spec)
     current_epoch = h.get_current_epoch(state, spec)
-    old_previous_justified = state.previous_justified_checkpoint
-    old_current_justified = state.current_justified_checkpoint
-    types_cp = type(old_current_justified)
+    types_cp = type(state.current_justified_checkpoint)
 
+    bits, justified, finalized = compute_justification_and_finalization(
+        bits=state.justification_bits,
+        old_previous_justified=(
+            int(state.previous_justified_checkpoint.epoch),
+            bytes(state.previous_justified_checkpoint.root),
+        ),
+        old_current_justified=(
+            int(state.current_justified_checkpoint.epoch),
+            bytes(state.current_justified_checkpoint.root),
+        ),
+        previous_epoch=previous_epoch,
+        current_epoch=current_epoch,
+        previous_boundary_root=h.get_block_root(state, previous_epoch, spec),
+        current_boundary_root=h.get_block_root(state, current_epoch, spec),
+        total_active_balance=total_active_balance,
+        previous_target_balance=previous_target_balance,
+        current_target_balance=current_target_balance,
+    )
     state.previous_justified_checkpoint = state.current_justified_checkpoint
-    bits = list(state.justification_bits)
-    bits = [False] + bits[:-1]
-    if previous_target_balance * 3 >= total_active_balance * 2:
-        state.current_justified_checkpoint = types_cp(
-            epoch=previous_epoch, root=h.get_block_root(state, previous_epoch, spec)
-        )
-        bits[1] = True
-    if current_target_balance * 3 >= total_active_balance * 2:
-        state.current_justified_checkpoint = types_cp(
-            epoch=current_epoch, root=h.get_block_root(state, current_epoch, spec)
-        )
-        bits[0] = True
     state.justification_bits = bits
-
-    # Finalization: 2nd/3rd/4th most recent epochs justified as source.
-    if all(bits[1:4]) and old_previous_justified.epoch + 3 == current_epoch:
-        state.finalized_checkpoint = old_previous_justified
-    if all(bits[1:3]) and old_previous_justified.epoch + 2 == current_epoch:
-        state.finalized_checkpoint = old_previous_justified
-    if all(bits[0:3]) and old_current_justified.epoch + 2 == current_epoch:
-        state.finalized_checkpoint = old_current_justified
-    if all(bits[0:2]) and old_current_justified.epoch + 1 == current_epoch:
-        state.finalized_checkpoint = old_current_justified
+    if justified is not None:
+        state.current_justified_checkpoint = types_cp(epoch=justified[0], root=justified[1])
+    if finalized is not None:
+        state.finalized_checkpoint = types_cp(epoch=finalized[0], root=finalized[1])
 
 
 def is_in_inactivity_leak(state, spec: ChainSpec) -> bool:

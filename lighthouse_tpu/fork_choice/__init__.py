@@ -1,0 +1,37 @@
+"""Fork choice: proto-array DAG + spec wrapper.
+
+Equivalent of the reference's ``consensus/proto_array`` and
+``consensus/fork_choice`` crates.
+"""
+
+from .fork_choice import (
+    ForkChoice,
+    ForkChoiceError,
+    InvalidAttestation,
+    InvalidBlock,
+    compute_unrealized_checkpoints,
+    justified_balances,
+)
+from .proto_array import (
+    ExecutionStatus,
+    InvalidAncestorError,
+    ProtoArray,
+    ProtoArrayError,
+    ProtoNode,
+    VoteTracker,
+)
+
+__all__ = [
+    "ForkChoice",
+    "ForkChoiceError",
+    "InvalidAttestation",
+    "InvalidBlock",
+    "compute_unrealized_checkpoints",
+    "justified_balances",
+    "ExecutionStatus",
+    "InvalidAncestorError",
+    "ProtoArray",
+    "ProtoArrayError",
+    "ProtoNode",
+    "VoteTracker",
+]

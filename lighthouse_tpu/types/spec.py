@@ -188,6 +188,7 @@ class ChainSpec:
     terminal_block_hash: bytes = b"\x00" * 32
     terminal_block_hash_activation_epoch: int = FAR_FUTURE_EPOCH
     # Fork choice
+    intervals_per_slot: int = 3
     proposer_score_boost: int = 40
     reorg_head_weight_threshold: int = 20
     reorg_parent_weight_threshold: int = 160

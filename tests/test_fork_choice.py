@@ -1,0 +1,364 @@
+"""Fork choice tests: scripted proto-array scenarios (modeled on the
+reference's ``consensus/proto_array/src/fork_choice_test_definition.rs``
+votes/FFG/execution-status suites) plus ForkChoice wrapper behavior."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.consensus.genesis import interop_genesis_state
+from lighthouse_tpu.fork_choice import (
+    ExecutionStatus,
+    ForkChoice,
+    InvalidBlock,
+    ProtoArray,
+    ProtoArrayError,
+    VoteTracker,
+    compute_unrealized_checkpoints,
+)
+from lighthouse_tpu.types.containers import build_types
+from lighthouse_tpu.types.spec import minimal_spec
+
+SPE = 8  # minimal-preset slots per epoch
+
+
+def root(n: int) -> bytes:
+    return n.to_bytes(32, "little")
+
+
+def make_array(justified=(0, root(0)), finalized=(0, root(0))) -> ProtoArray:
+    pa = ProtoArray(
+        slots_per_epoch=SPE, justified_checkpoint=justified, finalized_checkpoint=finalized
+    )
+    pa.on_block(
+        slot=0,
+        root=root(0),
+        parent_root=None,
+        state_root=root(0),
+        target_root=root(0),
+        justified_checkpoint=justified,
+        finalized_checkpoint=finalized,
+        unrealized_justified_checkpoint=justified,
+        unrealized_finalized_checkpoint=finalized,
+    )
+    return pa
+
+
+def add_block(pa, slot, r, parent, justified=(0, root(0)), finalized=(0, root(0))):
+    pa.on_block(
+        slot=slot,
+        root=r,
+        parent_root=parent,
+        state_root=r,
+        target_root=parent if parent is not None else r,
+        justified_checkpoint=justified,
+        finalized_checkpoint=finalized,
+        unrealized_justified_checkpoint=justified,
+        unrealized_finalized_checkpoint=finalized,
+        current_slot=slot,
+    )
+
+
+def get_head(pa, votes, old_bal, new_bal, current_slot=100, boost=(None, 0)):
+    deltas = pa.compute_deltas(votes, old_bal, new_bal)
+    pa.apply_score_changes(
+        deltas,
+        justified_checkpoint=pa.justified_checkpoint,
+        finalized_checkpoint=pa.finalized_checkpoint,
+        current_slot=current_slot,
+        new_proposer_boost=boost,
+    )
+    return pa.find_head(pa.justified_checkpoint[1], current_slot)
+
+
+class TestProtoArrayVotes:
+    """The reference's "votes" scripted scenario: heads follow LMD weight."""
+
+    def test_genesis_is_head(self):
+        pa = make_array()
+        votes = VoteTracker()
+        assert get_head(pa, votes, np.zeros(0), np.zeros(0)) == root(0)
+
+    def test_tie_breaks_to_higher_root(self):
+        pa = make_array()
+        add_block(pa, 1, root(2), root(0))
+        add_block(pa, 1, root(1), root(0))
+        votes = VoteTracker()
+        # No votes: tie between root(1) and root(2) broken by root bytes.
+        assert get_head(pa, votes, np.zeros(0), np.zeros(0)) == max(root(1), root(2))
+
+    def test_single_vote_moves_head(self):
+        pa = make_array()
+        add_block(pa, 1, root(2), root(0))
+        add_block(pa, 1, root(1), root(0))
+        loser = min(root(1), root(2))
+        votes = VoteTracker()
+        votes.ensure(2)
+        bal = np.array([1, 1], dtype=np.int64)
+        # validator 0 votes for the tie-loser: now it wins 1 vs 0.
+        rid = pa.root_id(loser)
+        votes.next_root_id[0] = rid
+        votes.next_epoch[0] = 1
+        assert get_head(pa, votes, np.zeros(2, dtype=np.int64), bal) == loser
+
+    def test_majority_wins_and_vote_moves(self):
+        pa = make_array()
+        add_block(pa, 1, root(1), root(0))
+        add_block(pa, 1, root(2), root(0))
+        votes = VoteTracker()
+        votes.ensure(3)
+        bal = np.ones(3, dtype=np.int64)
+        for v, r in [(0, root(1)), (1, root(2)), (2, root(2))]:
+            votes.next_root_id[v] = pa.root_id(r)
+            votes.next_epoch[v] = 1
+        assert get_head(pa, votes, np.zeros(3, dtype=np.int64), bal) == root(2)
+        # Both root(1) voters move to a child of root(1): subtree outweighs.
+        add_block(pa, 2, root(3), root(1))
+        for v in (1, 2):
+            votes.next_root_id[v] = pa.root_id(root(3))
+            votes.next_epoch[v] = 2
+        assert get_head(pa, votes, bal, bal) == root(3)
+
+    def test_balance_change_reweights(self):
+        pa = make_array()
+        add_block(pa, 1, root(1), root(0))
+        add_block(pa, 1, root(2), root(0))
+        votes = VoteTracker()
+        votes.ensure(2)
+        for v, r in [(0, root(1)), (1, root(2))]:
+            votes.next_root_id[v] = pa.root_id(r)
+            votes.next_epoch[v] = 1
+        b0 = np.array([1, 2], dtype=np.int64)
+        assert get_head(pa, votes, np.zeros(2, dtype=np.int64), b0) == root(2)
+        b1 = np.array([3, 2], dtype=np.int64)
+        assert get_head(pa, votes, b0, b1) == root(1)
+
+    def test_validator_set_shrinks(self):
+        """A validator leaving (balance→0) stops weighing on its vote."""
+        pa = make_array()
+        add_block(pa, 1, root(1), root(0))
+        add_block(pa, 1, root(2), root(0))
+        loser, winner = sorted([root(1), root(2)])
+        votes = VoteTracker()
+        votes.ensure(2)
+        votes.next_root_id[0] = pa.root_id(loser)
+        votes.next_epoch[0] = 1
+        b0 = np.array([1, 0], dtype=np.int64)
+        assert get_head(pa, votes, np.zeros(2, dtype=np.int64), b0) == loser
+        b1 = np.array([0, 0], dtype=np.int64)
+        assert get_head(pa, votes, b0, b1) == winner
+
+    def test_equivocation_removes_weight(self):
+        pa = make_array()
+        add_block(pa, 1, root(1), root(0))
+        add_block(pa, 1, root(2), root(0))
+        loser, winner = sorted([root(1), root(2)])
+        votes = VoteTracker()
+        votes.ensure(2)
+        votes.next_root_id[0] = pa.root_id(loser)
+        votes.next_epoch[0] = 1
+        bal = np.array([5, 0], dtype=np.int64)
+        assert get_head(pa, votes, np.zeros(2, dtype=np.int64), bal) == loser
+        votes.equivocating[0] = True
+        assert get_head(pa, votes, bal, bal) == winner
+        # Regression: the equivocator's balance must be subtracted exactly
+        # once — further head computations must not go negative.
+        assert get_head(pa, votes, bal, bal) == winner
+        assert get_head(pa, votes, bal, bal) == winner
+        assert all(n.weight >= 0 for n in pa.nodes)
+
+
+class TestProtoArrayFFG:
+    """The reference's "ffg" scenarios: justified checkpoint filters heads."""
+
+    def test_head_must_match_justified_checkpoint(self):
+        pa = make_array()
+        # chain 0 <- 1 <- 2 with block 2 justifying epoch 1 @ root(1)
+        add_block(pa, SPE, root(1), root(0))
+        add_block(pa, SPE + 1, root(2), root(1), justified=(1, root(1)))
+        # competing chain that never justified
+        add_block(pa, SPE + 1, root(9), root(0))
+        votes = VoteTracker()
+        votes.ensure(2)
+        bal = np.ones(2, dtype=np.int64)
+        for v in range(2):
+            votes.next_root_id[v] = pa.root_id(root(9))
+            votes.next_epoch[v] = 1
+        # Move store's justified to (1, root(1)): heads from root(1) only.
+        pa.justified_checkpoint = (1, root(1))
+        current_slot = 5 * SPE  # far in the future: no 2-epoch allowance
+        deltas = pa.compute_deltas(votes, np.zeros(2, dtype=np.int64), bal)
+        pa.apply_score_changes(
+            deltas,
+            justified_checkpoint=(1, root(1)),
+            finalized_checkpoint=(0, root(0)),
+            current_slot=current_slot,
+        )
+        assert pa.find_head(root(1), current_slot) == root(2)
+
+    def test_finalized_descendant_required(self):
+        pa = make_array(finalized=(0, root(0)))
+        add_block(pa, SPE, root(1), root(0), justified=(1, root(1)))
+        add_block(pa, SPE + 1, root(2), root(1), justified=(1, root(1)))
+        # A fork from genesis that doesn't descend from finalized root(1):
+        add_block(pa, SPE + 2, root(9), root(0))
+        votes = VoteTracker()
+        deltas = pa.compute_deltas(votes, np.zeros(0), np.zeros(0))
+        pa.apply_score_changes(
+            deltas,
+            justified_checkpoint=(1, root(1)),
+            finalized_checkpoint=(1, root(1)),
+            current_slot=SPE + 3,
+        )
+        assert pa.find_head(root(1), SPE + 3) == root(2)
+
+    def test_proposer_boost_tips_tie(self):
+        pa = make_array()
+        add_block(pa, 1, root(1), root(0))
+        add_block(pa, 1, root(2), root(0))
+        loser = min(root(1), root(2))
+        votes = VoteTracker()
+        head = get_head(pa, votes, np.zeros(0), np.zeros(0), boost=(loser, 10))
+        assert head == loser
+        # Boost is transient: next call without boost reverts to tie-winner.
+        head = get_head(pa, votes, np.zeros(0), np.zeros(0))
+        assert head == max(root(1), root(2))
+
+
+class TestExecutionStatus:
+    """Reference "execution_status" scenarios: payload invalidation."""
+
+    def _chain(self):
+        pa = make_array()
+        for i in range(1, 4):
+            pa.on_block(
+                slot=i,
+                root=root(i),
+                parent_root=root(i - 1),
+                state_root=root(i),
+                target_root=root(0),
+                justified_checkpoint=(0, root(0)),
+                finalized_checkpoint=(0, root(0)),
+                unrealized_justified_checkpoint=(0, root(0)),
+                unrealized_finalized_checkpoint=(0, root(0)),
+                execution_status=ExecutionStatus.OPTIMISTIC,
+                execution_block_hash=root(100 + i),
+                current_slot=i,
+            )
+        return pa
+
+    def test_invalidate_tip_reverts_head(self):
+        pa = self._chain()
+        votes = VoteTracker()
+        assert get_head(pa, votes, np.zeros(0), np.zeros(0)) == root(3)
+        pa.on_invalid_execution_payload(root(3), latest_valid_hash=root(102))
+        assert pa.get_block(root(3)).execution_status == ExecutionStatus.INVALID
+        assert pa.get_block(root(2)).execution_status == ExecutionStatus.VALID
+        assert get_head(pa, votes, np.zeros(0), np.zeros(0)) == root(2)
+
+    def test_invalidation_propagates_to_descendants(self):
+        pa = self._chain()
+        pa.on_invalid_execution_payload(root(1), latest_valid_hash=None)
+        for i in (1, 2, 3):
+            assert pa.get_block(root(i)).execution_status == ExecutionStatus.INVALID
+        votes = VoteTracker()
+        assert get_head(pa, votes, np.zeros(0), np.zeros(0)) == root(0)
+
+    def test_validation_propagates_to_ancestors(self):
+        pa = self._chain()
+        pa.on_valid_execution_payload(root(3))
+        for i in (1, 2, 3):
+            assert pa.get_block(root(i)).execution_status == ExecutionStatus.VALID
+
+
+class TestPrune:
+    def test_prune_keeps_descendants_and_head(self):
+        pa = make_array()
+        pa.prune_threshold = 0
+        for i in range(1, 10):
+            add_block(pa, i, root(i), root(i - 1))
+        pruned = pa.prune(root(5))
+        assert len(pruned) == 5
+        assert not pa.contains_block(root(4))
+        assert pa.contains_block(root(5))
+        # Pruning happens once justified/finalized advanced to the anchor.
+        pa.justified_checkpoint = (0, root(5))
+        votes = VoteTracker()
+        assert get_head(pa, votes, np.zeros(0), np.zeros(0)) == root(9)
+
+    def test_prune_below_threshold_is_noop(self):
+        pa = make_array()
+        add_block(pa, 1, root(1), root(0))
+        assert pa.prune(root(1)) == []
+        assert pa.contains_block(root(0))
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return minimal_spec(
+        altair_fork_epoch=0, bellatrix_fork_epoch=0, capella_fork_epoch=0,
+        deneb_fork_epoch=None,
+    )
+
+
+@pytest.fixture(scope="module")
+def types(spec):
+    return build_types(spec.preset)
+
+
+class TestForkChoiceWrapper:
+    def test_genesis_head(self, spec, types):
+        state = interop_genesis_state(16, types, spec)
+        groot = b"\x11" * 32
+        fc = ForkChoice(spec=spec, genesis_block_root=groot, genesis_state=state)
+        assert fc.get_head(0) == groot
+
+    def test_future_block_rejected(self, spec, types):
+        state = interop_genesis_state(16, types, spec)
+        groot = b"\x11" * 32
+        fc = ForkChoice(spec=spec, genesis_block_root=groot, genesis_state=state)
+
+        class FakeBlock:
+            slot = 5
+            parent_root = groot
+            state_root = b"\x00" * 32
+            body = None
+
+        with pytest.raises(InvalidBlock):
+            fc.on_block(current_slot=1, block=FakeBlock, block_root=b"\x22" * 32, state=state)
+
+    def test_unknown_parent_rejected(self, spec, types):
+        state = interop_genesis_state(16, types, spec)
+        groot = b"\x11" * 32
+        fc = ForkChoice(spec=spec, genesis_block_root=groot, genesis_state=state)
+
+        class FakeBlock:
+            slot = 1
+            parent_root = b"\x99" * 32
+            state_root = b"\x00" * 32
+            body = None
+
+        with pytest.raises(InvalidBlock):
+            fc.on_block(current_slot=1, block=FakeBlock, block_root=b"\x22" * 32, state=state)
+
+    def test_unrealized_checkpoints_genesis(self, spec, types):
+        state = interop_genesis_state(16, types, spec)
+        j, f = compute_unrealized_checkpoints(state, spec)
+        assert j[0] == 0 and f[0] == 0
+
+    def test_attestation_queued_then_applied(self, spec, types):
+        state = interop_genesis_state(16, types, spec)
+        groot = b"\x11" * 32
+        fc = ForkChoice(spec=spec, genesis_block_root=groot, genesis_state=state)
+        fc.on_attestation(
+            current_slot=1,
+            attestation_slot=1,
+            attesting_indices=[0, 3],
+            beacon_block_root=groot,
+            target_epoch=0,
+            target_root=groot,
+        )
+        assert len(fc.queued_attestations) == 1
+        fc.update_time(2)
+        assert len(fc.queued_attestations) == 0
+        assert fc.votes.next_root_id[0] == fc.proto.root_id(groot)
+        assert fc.get_head(2) == groot
