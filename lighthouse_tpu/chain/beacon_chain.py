@@ -1,0 +1,468 @@
+"""The chain core: block import pipeline, attestation processing, block and
+attestation production, canonical-head management.
+
+Equivalent of the reference's ``beacon_node/beacon_chain`` crate
+(`beacon_chain.rs:378-504` ``BeaconChain``; import pipeline
+`block_verification.rs:21-45`; production `beacon_chain.rs:4137,4720`;
+head recompute `canonical_head.rs:496`), scaled to the harness/test surface
+first: everything here runs against ``MemoryStore`` + ``ManualSlotClock`` +
+``MockExecutionEngine`` with no networking, the reference's own test topology
+(SURVEY.md §4 tier 3).
+
+Block import is the same staged pipeline, with bulk signature verification
+(all of a block's signatures in one batched multi-pairing — the TPU hot path)
+happening inside ``state_transition(strategy=VERIFY_BULK)``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..consensus import helpers as h
+from ..consensus.per_block import BlockProcessingError, BlockSignatureStrategy
+from ..consensus.per_slot import process_slots
+from ..consensus.state_transition import state_transition
+from ..fork_choice import ExecutionStatus, ForkChoice, InvalidAttestation
+from ..store import DBColumn, MemoryStore
+from ..types.spec import ChainSpec
+from .mock_el import MockExecutionEngine
+from .slot_clock import ManualSlotClock, SlotClock
+
+
+class ChainError(Exception):
+    pass
+
+
+class BlockError(ChainError):
+    pass
+
+
+class AttestationError(ChainError):
+    pass
+
+
+def genesis_block_root_of(state) -> bytes:
+    """Canonical genesis block root: the state's latest header with its
+    state_root filled in (how the reference derives it at anchor time)."""
+    header = state.latest_block_header.copy()
+    header.state_root = state.hash_tree_root()
+    return header.hash_tree_root()
+
+
+class NaiveAggregationPool:
+    """Aggregate same-data attestations by OR-ing bits and summing signatures
+    (reference: ``beacon_chain/src/naive_aggregation_pool.rs``)."""
+
+    SLOT_RETENTION = 64
+
+    def __init__(self) -> None:
+        # (slot, data_root) -> {bits_tuple} aggregated attestation
+        self._pool: Dict[Tuple[int, bytes], object] = {}
+
+    def insert(self, attestation) -> None:
+        from ..crypto.bls import api as bls
+
+        key = (int(attestation.data.slot), attestation.data.hash_tree_root())
+        existing = self._pool.get(key)
+        if existing is None:
+            self._pool[key] = attestation.copy()
+            return
+        new_bits = list(attestation.aggregation_bits)
+        old_bits = list(existing.aggregation_bits)
+        if any(a and b for a, b in zip(new_bits, old_bits)):
+            return  # overlapping — naive pool only merges disjoint signers
+        agg = bls.AggregateSignature.from_bytes(bytes(existing.signature))
+        agg.add_assign(bls.Signature.from_bytes(bytes(attestation.signature)))
+        existing.aggregation_bits = [a or b for a, b in zip(new_bits, old_bits)]
+        existing.signature = agg.to_bytes()
+
+    def get_for_block(self, state, spec: ChainSpec, limit: int) -> List[object]:
+        """Attestations eligible for inclusion in a block on ``state``."""
+        out = []
+        for (slot, _), att in sorted(self._pool.items(), key=lambda kv: -kv[0][0]):
+            if slot + spec.min_attestation_inclusion_delay > state.slot:
+                continue
+            if slot + spec.slots_per_epoch < state.slot:
+                continue
+            out.append(att)
+            if len(out) >= limit:
+                break
+        return out
+
+    def prune(self, current_slot: int) -> None:
+        cutoff = current_slot - self.SLOT_RETENTION
+        self._pool = {k: v for k, v in self._pool.items() if k[0] >= cutoff}
+
+
+class BeaconChain:
+    def __init__(
+        self,
+        *,
+        genesis_state,
+        types,
+        spec: ChainSpec,
+        store: Optional[MemoryStore] = None,
+        slot_clock: Optional[SlotClock] = None,
+        execution_engine: Optional[MockExecutionEngine] = None,
+        kzg=None,
+    ):
+        self.spec = spec
+        self.types = types
+        self.store = store if store is not None else MemoryStore()
+        self.execution_engine = (
+            execution_engine if execution_engine is not None else MockExecutionEngine()
+        )
+        self.kzg = kzg
+        self.genesis_state = genesis_state
+        self.genesis_time = int(genesis_state.genesis_time)
+        self.genesis_validators_root = bytes(genesis_state.genesis_validators_root)
+        self.slot_clock = (
+            slot_clock
+            if slot_clock is not None
+            else ManualSlotClock(self.genesis_time, spec.seconds_per_slot)
+        )
+
+        self.genesis_block_root = genesis_block_root_of(genesis_state)
+        # Object caches over the store (the reference's snapshot/state caches).
+        self._blocks: Dict[bytes, object] = {}
+        self._states: Dict[bytes, object] = {}  # post-state by block root
+        self._state_class: Dict[bytes, type] = {}
+        self._store_block(self.genesis_block_root, None, genesis_state)
+
+        self.fork_choice = ForkChoice(
+            spec=spec,
+            genesis_block_root=self.genesis_block_root,
+            genesis_state=genesis_state,
+        )
+        self.fork_choice.set_justified_state_provider(self._states.get)
+        self.head_root = self.genesis_block_root
+        self.attestation_pool = NaiveAggregationPool()
+        self.observed_block_roots: set = set()
+
+    # ------------------------------------------------------------- storage
+
+    def _store_block(self, block_root: bytes, signed_block, post_state) -> None:
+        if signed_block is not None:
+            self._blocks[block_root] = signed_block
+            self.store.put(DBColumn.BEACON_BLOCK, block_root, signed_block.as_ssz_bytes())
+        self._states[block_root] = post_state
+        self.store.put(DBColumn.BEACON_STATE, block_root, post_state.as_ssz_bytes())
+
+    def get_block(self, block_root: bytes):
+        return self._blocks.get(block_root)
+
+    def get_state(self, block_root: bytes):
+        return self._states.get(block_root)
+
+    @property
+    def head_state(self):
+        return self._states[self.head_root]
+
+    def current_slot(self) -> int:
+        now = self.slot_clock.now()
+        return now if now is not None else 0
+
+    # ------------------------------------------------------- block import
+
+    def process_block(self, signed_block, block_delay_seconds: Optional[float] = None) -> bytes:
+        """Full import pipeline (reference ``beacon_chain.rs:3035``
+        ``process_block`` + ``:3362 import_block``): state catch-up, bulk
+        signature verification, state-root check, payload notify, fork choice,
+        persistence, head recompute."""
+        block = signed_block.message
+        block_root = block.hash_tree_root()
+        if block_root in self._blocks or block_root == self.genesis_block_root:
+            return block_root  # duplicate import is a no-op
+        current_slot = self.current_slot()
+        if int(block.slot) > current_slot:
+            raise BlockError(f"block from future slot {block.slot} (now {current_slot})")
+        parent_root = bytes(block.parent_root)
+        parent_state = self._states.get(parent_root)
+        if parent_state is None:
+            raise BlockError(f"unknown parent {parent_root.hex()[:16]}")
+
+        state = parent_state.copy()
+        try:
+            state = state_transition(
+                state,
+                signed_block,
+                self.types,
+                self.spec,
+                strategy=BlockSignatureStrategy.VERIFY_BULK,
+                validate_result=True,
+                payload_verifier=self.execution_engine.notify_new_payload,
+            )
+        except (BlockProcessingError, ValueError) as e:
+            raise BlockError(f"state transition failed: {e}") from e
+
+        if block_delay_seconds is None:
+            since_start = self.slot_clock.seconds_from_current_slot_start()
+            block_delay_seconds = since_start if since_start is not None else 1e9
+        payload_status = (
+            ExecutionStatus.VALID
+            if hasattr(block.body, "execution_payload")
+            else ExecutionStatus.IRRELEVANT
+        )
+        self.fork_choice.on_block(
+            current_slot=current_slot,
+            block=block,
+            block_root=block_root,
+            state=state,
+            payload_verification_status=payload_status,
+            block_delay_seconds=block_delay_seconds,
+        )
+        self._store_block(block_root, signed_block, state)
+        self.observed_block_roots.add(block_root)
+
+        # Feed the block's attestations to fork choice (reference
+        # ``import_block`` → on_attestation(is_from_block=true)).
+        for att in block.body.attestations:
+            try:
+                indexed = h.get_indexed_attestation(state, att, self.types, self.spec)
+                self.fork_choice.on_attestation(
+                    current_slot=current_slot,
+                    attestation_slot=int(att.data.slot),
+                    attesting_indices=list(indexed.attesting_indices),
+                    beacon_block_root=bytes(att.data.beacon_block_root),
+                    target_epoch=int(att.data.target.epoch),
+                    target_root=bytes(att.data.target.root),
+                    is_from_block=True,
+                )
+            except InvalidAttestation:
+                continue  # attestations for unknown forks don't block import
+
+        self.recompute_head()
+        return block_root
+
+    # ------------------------------------------------- attestation import
+
+    def process_attestation(self, attestation, is_from_block: bool = False) -> None:
+        """Verify an unaggregated/aggregated attestation (signature + spec
+        checks against the target's state) and apply it to fork choice + the
+        aggregation pool (reference ``attestation_verification.rs`` +
+        ``beacon_chain.rs:2139``)."""
+        from ..consensus import signature_sets as sets
+
+        data = attestation.data
+        head_root = bytes(data.beacon_block_root)
+        state = self._states.get(head_root)
+        if state is None:
+            raise AttestationError("attestation references unknown head block")
+        base = state
+        if h.compute_epoch_at_slot(int(data.slot), self.spec) > h.get_current_epoch(
+            base, self.spec
+        ):
+            base = base.copy()
+            process_slots(
+                base,
+                h.compute_start_slot_at_epoch(
+                    h.compute_epoch_at_slot(int(data.slot), self.spec), self.spec
+                ),
+                self.types,
+                self.spec,
+            )
+        try:
+            indexed = h.get_indexed_attestation(base, attestation, self.types, self.spec)
+        except Exception as e:
+            raise AttestationError(f"cannot index attestation: {e}") from e
+        # Batch-of-one through the active backend (same path the gossip batch
+        # coalescer uses, attestation_verification/batch.rs:205) so the
+        # fake/jax backends apply here too.
+        from ..crypto.bls import api as bls
+
+        try:
+            s = sets.indexed_attestation_signature_set(base, indexed, self.spec)
+            ok = bls.verify_signature_sets([s])
+        except bls.BlsError as e:
+            raise AttestationError(f"malformed attestation signature: {e}") from e
+        if not ok:
+            raise AttestationError("bad attestation signature")
+        self.fork_choice.on_attestation(
+            current_slot=self.current_slot(),
+            attestation_slot=int(data.slot),
+            attesting_indices=list(indexed.attesting_indices),
+            beacon_block_root=head_root,
+            target_epoch=int(data.target.epoch),
+            target_root=bytes(data.target.root),
+            is_from_block=is_from_block,
+        )
+        self.attestation_pool.insert(attestation)
+
+    # ----------------------------------------------------------- production
+
+    def state_at_slot(self, slot: int, block_root: Optional[bytes] = None):
+        """State at ``block_root`` (default: head) advanced with empty slots
+        to ``slot``."""
+        root = self.head_root if block_root is None else block_root
+        state = self._states.get(root)
+        if state is None:
+            raise ChainError(f"unknown block root {root.hex()[:16]}")
+        if int(state.slot) > slot:
+            raise ChainError(f"state {state.slot} is past requested slot {slot}")
+        if int(state.slot) == slot:
+            return state, root
+        state = state.copy()
+        state = process_slots(state, slot, self.types, self.spec)
+        return state, root
+
+    def produce_block(
+        self,
+        slot: int,
+        randao_reveal: bytes,
+        graffiti: bytes = b"\x00" * 32,
+        sync_aggregate=None,
+        parent_root: Optional[bytes] = None,
+        pre_state=None,
+    ):
+        """Assemble an unsigned block on the current head (or on
+        ``parent_root`` — how tests build forks); reference
+        ``produce_block_with_verification:4137`` → ``produce_block_on_state:4720``.
+        ``pre_state``: the already-slot-advanced state for (parent_root, slot)
+        if the caller has it (avoids re-advancing); it will be mutated.
+        Returns ``(block, post_state_root)``; caller signs."""
+        types, spec = self.types, self.spec
+        if pre_state is not None:
+            if parent_root is None:
+                raise ChainError("pre_state requires an explicit parent_root")
+            state = pre_state
+            if int(state.slot) != slot:
+                raise ChainError(f"pre_state at slot {state.slot}, expected {slot}")
+        else:
+            state, parent_root = self.state_at_slot(slot, parent_root)
+        if state is self._states.get(parent_root):
+            state = state.copy()
+        fork = type(state).fork_name
+        proposer = h.get_beacon_proposer_index(state, spec)
+
+        max_atts = spec.preset.max_attestations
+        attestations = self._packed_attestations(state, max_atts)
+
+        body_cls = types.block_body[fork]
+        body_kwargs = dict(
+            randao_reveal=randao_reveal,
+            eth1_data=state.eth1_data.copy(),
+            graffiti=graffiti,
+            proposer_slashings=[],
+            attester_slashings=[],
+            attestations=attestations,
+            deposits=[],
+            voluntary_exits=[],
+        )
+        if hasattr(body_cls, "fields") and "sync_aggregate" in body_cls.fields:
+            if sync_aggregate is None:
+                from ..crypto.bls import api as bls
+
+                sync_aggregate = types.SyncAggregate(
+                    sync_committee_bits=[False] * spec.preset.sync_committee_size,
+                    sync_committee_signature=bls.INFINITY_SIGNATURE,
+                )
+            body_kwargs["sync_aggregate"] = sync_aggregate
+        if "execution_payload" in body_cls.fields:
+            body_kwargs["execution_payload"] = self.execution_engine.produce_payload(
+                state, types, spec
+            )
+        if "bls_to_execution_changes" in body_cls.fields:
+            body_kwargs["bls_to_execution_changes"] = []
+        if "blob_kzg_commitments" in body_cls.fields:
+            body_kwargs["blob_kzg_commitments"] = []
+
+        block_cls = types.block[fork]
+        block = block_cls(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=parent_root,
+            state_root=b"\x00" * 32,
+            body=body_cls(**body_kwargs),
+        )
+
+        # Dry-run the block on the state to compute the post-state root
+        # (reference: per_block_processing(VerifyRandao) dry run; signatures
+        # are the caller's and randao is verified at import).
+        signed_cls = types.signed_block[fork]
+        wrapper = signed_cls(message=block, signature=b"\x00" * 96)
+        from ..consensus.per_block import per_block_processing
+
+        per_block_processing(
+            state,
+            wrapper,
+            types,
+            spec,
+            strategy=BlockSignatureStrategy.NO_VERIFICATION,
+            verify_block_root=False,
+            payload_verifier=None,
+        )
+        block.state_root = state.hash_tree_root()
+        return block, bytes(block.state_root)
+
+    def _packed_attestations(self, state, limit: int) -> List[object]:
+        """Greedy selection from the pool, validity-filtered by trial
+        application (the reference uses max-cover packing in the op pool; the
+        op-pool milestone replaces this)."""
+        from ..consensus.per_block import process_attestation
+
+        candidates = self.attestation_pool.get_for_block(state, self.spec, limit * 4)
+        scratch = state.copy()
+        out = []
+        for att in candidates:
+            try:
+                process_attestation(scratch, att, self.types, self.spec, verify=False)
+            except Exception:
+                continue
+            out.append(att)
+            if len(out) >= limit:
+                break
+        return out
+
+    def produce_attestation_data(self, slot: int, committee_index: int):
+        """Reference ``produce_unaggregated_attestation:1759`` — the data all
+        committee members at (slot, index) sign."""
+        types, spec = self.types, self.spec
+        state = self.head_state
+        head_root = self.head_root
+        if int(state.slot) < slot:
+            state, _ = self.state_at_slot(slot)
+        epoch = h.compute_epoch_at_slot(slot, spec)
+        epoch_start = h.compute_start_slot_at_epoch(epoch, spec)
+        if self._blocks_slot(head_root) <= epoch_start:
+            target_root = head_root  # head at/before the boundary is the target
+        else:
+            target_root = h.get_block_root(state, epoch, spec)
+        return types.AttestationData(
+            slot=slot,
+            index=committee_index,
+            beacon_block_root=head_root,
+            source=state.current_justified_checkpoint.copy(),
+            target=types.Checkpoint(epoch=epoch, root=target_root),
+        )
+
+    def _blocks_slot(self, block_root: bytes) -> int:
+        if block_root == self.genesis_block_root:
+            return int(self.genesis_state.slot)
+        return int(self._blocks[block_root].message.slot)
+
+    # ----------------------------------------------------------------- head
+
+    def recompute_head(self) -> bytes:
+        """Reference ``canonical_head.rs:496`` ``recompute_head_at_slot``."""
+        head = self.fork_choice.get_head(self.current_slot())
+        self.head_root = head
+        return head
+
+    def per_slot_task(self) -> None:
+        """Per-slot tick (reference ``timer`` → ``per_slot_task``)."""
+        slot = self.current_slot()
+        self.fork_choice.update_time(slot)
+        self.recompute_head()
+        self.attestation_pool.prune(slot)
+
+    # ------------------------------------------------------------- queries
+
+    def finalized_checkpoint(self) -> Tuple[int, bytes]:
+        return self.fork_choice.finalized_checkpoint
+
+    def justified_checkpoint(self) -> Tuple[int, bytes]:
+        return self.fork_choice.justified_checkpoint
+
+    def block_root_at_slot(self, slot: int) -> Optional[bytes]:
+        """Canonical chain block root at ``slot`` (walks from head)."""
+        return self.fork_choice.proto.ancestor_at_slot(self.head_root, slot)

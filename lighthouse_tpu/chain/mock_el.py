@@ -1,0 +1,77 @@
+"""Mock execution engine.
+
+Equivalent of the reference's ``MockServer``/``MockExecutionLayer``
+(`beacon_node/execution_layer/src/test_utils/`) — the fake EL that every
+harness/simulator test runs against.  Builds payloads that satisfy
+``process_execution_payload``'s checks (parent hash chain, prev_randao,
+timestamp) and answers ``notify_new_payload`` with a configurable verdict so
+tests can inject INVALID payloads (the reference's ``payload_invalidation.rs``
+fault-injection pattern).
+"""
+
+from __future__ import annotations
+
+from hashlib import sha256
+from typing import Optional, Set
+
+from ..consensus import helpers as h
+from ..consensus.per_block import compute_timestamp_at_slot, is_merge_transition_complete
+from ..types.spec import ChainSpec
+
+
+class MockExecutionEngine:
+    def __init__(self) -> None:
+        self.invalid_hashes: Set[bytes] = set()
+        self.offline = False
+        self.payloads_seen = 0
+
+    # ------------------------------------------------------------- produce
+
+    def produce_payload(self, state, types, spec: ChainSpec):
+        """Build the payload for a block on ``state`` (already advanced to the
+        block's slot).  The analog of engine_getPayload against the mock EL."""
+        fork = type(state).fork_name
+        cls = {
+            "bellatrix": types.ExecutionPayloadBellatrix,
+            "capella": types.ExecutionPayloadCapella,
+            "deneb": types.ExecutionPayloadDeneb,
+        }[fork]
+        parent_hash = bytes(state.latest_execution_payload_header.block_hash)
+        if not is_merge_transition_complete(state):
+            parent_hash = b"\x00" * 32
+        timestamp = compute_timestamp_at_slot(state, state.slot, spec)
+        prev_randao = h.get_randao_mix(state, h.get_current_epoch(state, spec), spec)
+        block_hash = sha256(
+            b"mock-el" + parent_hash + int(state.slot).to_bytes(8, "little")
+        ).digest()
+        kwargs = dict(
+            parent_hash=parent_hash,
+            fee_recipient=b"\x00" * 20,
+            state_root=b"\x00" * 32,
+            receipts_root=b"\x00" * 32,
+            logs_bloom=b"\x00" * 256,
+            prev_randao=prev_randao,
+            block_number=int(state.slot),
+            gas_limit=30_000_000,
+            gas_used=0,
+            timestamp=timestamp,
+            extra_data=b"",
+            base_fee_per_gas=7,
+            block_hash=block_hash,
+            transactions=[],
+        )
+        if fork in ("capella", "deneb"):
+            kwargs["withdrawals"] = h.get_expected_withdrawals(state, types, spec)
+        if fork == "deneb":
+            kwargs["blob_gas_used"] = 0
+            kwargs["excess_blob_gas"] = 0
+        return cls(**kwargs)
+
+    # -------------------------------------------------------------- verify
+
+    def notify_new_payload(self, payload) -> bool:
+        """engine_newPayload: VALID unless the hash was marked invalid."""
+        if self.offline:
+            raise ConnectionError("mock execution engine offline")
+        self.payloads_seen += 1
+        return bytes(payload.block_hash) not in self.invalid_hashes

@@ -55,8 +55,20 @@ def validator_pubkey(state, index: int) -> bls.PublicKey:
     return pubkey_cache(bytes(state.validators[index].pubkey))
 
 
+_SIG_CACHE: Dict[bytes, bls.Signature] = {}
+
+
 def _sig(signature_bytes: bytes) -> bls.Signature:
-    return bls.Signature(_bytes=bytes(signature_bytes))
+    """Decompressed-signature cache (the signature-side analog of the
+    reference's ``validator_pubkey_cache``).  Raises ``BlsError`` on
+    malformed bytes — the caller's block/attestation is invalid."""
+    key = bytes(signature_bytes)
+    sig = _SIG_CACHE.get(key)
+    if sig is None:
+        if len(_SIG_CACHE) > 1 << 16:
+            _SIG_CACHE.clear()
+        sig = _SIG_CACHE[key] = bls.Signature.from_bytes(key)
+    return sig
 
 
 # ---------------------------------------------------------------- blocks

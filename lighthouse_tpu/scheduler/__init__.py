@@ -1,0 +1,14 @@
+"""Priority work scheduler (reference: ``beacon_node/beacon_processor``)."""
+
+from .processor import BeaconProcessor, ProcessorMetrics, ReprocessQueue
+from .work import BATCH_RULES, DRAIN_ORDER, W, WorkEvent
+
+__all__ = [
+    "BATCH_RULES",
+    "BeaconProcessor",
+    "DRAIN_ORDER",
+    "ProcessorMetrics",
+    "ReprocessQueue",
+    "W",
+    "WorkEvent",
+]

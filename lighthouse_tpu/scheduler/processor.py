@@ -1,0 +1,241 @@
+"""The priority work scheduler.
+
+Equivalent of the reference's ``BeaconProcessor``
+(`beacon_node/beacon_processor/src/lib.rs:753` ``spawn_manager``): a manager
+thread drains bounded per-class queues in strict priority order into a pool of
+``<= max_workers`` worker threads, coalescing attestation-class work into
+batches sized to the device program's bucket shapes.
+
+Design notes vs the reference:
+- The reference's workers are tokio blocking threads; here they are plain
+  threads.  CPU-bound Python work holds the GIL, but the workloads this
+  scheduler feeds — the batched JAX verification program, native SSZ/hash
+  code, IO — all release it, which is exactly the deployment shape
+  (host Python orchestrates, device/native code computes).
+- Batch coalescing IS the TPU batch formation: one drained
+  ``GossipAttestationBatch`` becomes one padded device invocation
+  (``ops/verify.py`` buckets), so queue pressure directly widens device
+  batches — the mechanism the reference uses to amortize multi-pairings
+  (``attestation_verification/batch.rs``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from .work import (
+    BATCH_RULES,
+    DEFAULT_QUEUE_LENGTH,
+    DEFAULT_QUEUE_LENGTHS,
+    DRAIN_ORDER,
+    W,
+    WorkEvent,
+)
+
+
+@dataclass
+class ProcessorMetrics:
+    received: Dict[str, int] = field(default_factory=dict)
+    processed: Dict[str, int] = field(default_factory=dict)
+    dropped: Dict[str, int] = field(default_factory=dict)
+    batches: Dict[str, int] = field(default_factory=dict)
+    batch_items: Dict[str, int] = field(default_factory=dict)
+
+    def bump(self, table: Dict[str, int], key: str, n: int = 1) -> None:
+        table[key] = table.get(key, 0) + n
+
+
+class BeaconProcessor:
+    def __init__(self, max_workers: int = 4, queue_lengths: Optional[dict] = None):
+        self.max_workers = max(1, max_workers)
+        self._drain_set = frozenset(DRAIN_ORDER)
+        self._queues: Dict[str, deque] = {}
+        self._limits = dict(DEFAULT_QUEUE_LENGTHS)
+        if queue_lengths:
+            self._limits.update(queue_lengths)
+        self._lock = threading.Condition()
+        self._active_workers = 0
+        self._shutdown = False
+        self._idle = threading.Event()
+        self._idle.set()
+        self.metrics = ProcessorMetrics()
+        self._manager = threading.Thread(target=self._manage, name="beacon-processor", daemon=True)
+        self._manager.start()
+
+    # ------------------------------------------------------------ ingress
+
+    def send(self, event: WorkEvent) -> bool:
+        """Enqueue; returns False when the class queue is full and the event
+        was dropped (reference: queue-full drop + metric)."""
+        if event.work_type not in self._drain_set:
+            raise ValueError(f"unknown work type {event.work_type!r} (not in DRAIN_ORDER)")
+        with self._lock:
+            if self._shutdown:
+                return False
+            q = self._queues.setdefault(event.work_type, deque())
+            limit = self._limits.get(event.work_type, DEFAULT_QUEUE_LENGTH)
+            self.metrics.bump(self.metrics.received, event.work_type)
+            if len(q) >= limit:
+                self.metrics.bump(self.metrics.dropped, event.work_type)
+                return False
+            q.append(event)
+            self._idle.clear()
+            self._lock.notify_all()
+            return True
+
+    # ------------------------------------------------------------ manager
+
+    def _next_work(self) -> Optional[List[WorkEvent]]:
+        """First non-empty queue in drain order; batchable classes coalesce
+        up to their batch size (must hold the lock)."""
+        for wt in DRAIN_ORDER:
+            q = self._queues.get(wt)
+            if not q:
+                continue
+            rule = BATCH_RULES.get(wt)
+            if rule is not None and len(q) > 1:
+                _, max_batch = rule
+                batch = []
+                while q and len(batch) < max_batch:
+                    batch.append(q.popleft())
+                return batch
+            return [q.popleft()]
+        return None
+
+    def _manage(self) -> None:
+        while True:
+            with self._lock:
+                while not self._shutdown and (
+                    self._active_workers >= self.max_workers or self._next_ready() is None
+                ):
+                    if self._active_workers == 0 and self._all_empty():
+                        self._idle.set()
+                    self._lock.wait(timeout=0.05)
+                if self._shutdown:
+                    return
+                batch = self._next_work()
+                if batch is None:
+                    continue
+                self._active_workers += 1
+            threading.Thread(target=self._run_worker, args=(batch,), daemon=True).start()
+
+    def _next_ready(self) -> Optional[str]:
+        for wt in DRAIN_ORDER:
+            if self._queues.get(wt):
+                return wt
+        return None
+
+    def _all_empty(self) -> bool:
+        return all(not q for q in self._queues.values())
+
+    def _run_worker(self, batch: List[WorkEvent]) -> None:
+        wt = batch[0].work_type
+        try:
+            if len(batch) > 1 and batch[0].process_batch is not None:
+                batch_wt = BATCH_RULES[wt][0]
+                self.metrics.bump(self.metrics.batches, batch_wt)
+                self.metrics.bump(self.metrics.batch_items, batch_wt, len(batch))
+                batch[0].process_batch([ev.item for ev in batch])
+                self.metrics.bump(self.metrics.processed, wt, len(batch))
+            else:
+                for ev in batch:
+                    ev.process(ev.item)
+                    self.metrics.bump(self.metrics.processed, wt)
+        except Exception:
+            # A worker panic must not kill the node (reference logs + metric).
+            self.metrics.bump(self.metrics.dropped, wt, len(batch))
+        finally:
+            with self._lock:
+                self._active_workers -= 1
+                if self._active_workers == 0 and self._all_empty():
+                    self._idle.set()
+                self._lock.notify_all()
+
+    # ------------------------------------------------------------ control
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        """Block until all queues are drained and workers are done."""
+        return self._idle.wait(timeout)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+        self._manager.join(timeout=2.0)
+
+
+class ReprocessQueue:
+    """Delay queue for work that can't run yet: early blocks (before their
+    slot), attestations referencing unknown blocks, backfill batches
+    (reference: ``work_reprocessing_queue.rs``, doc ``:1-12``)."""
+
+    MAX_DELAYED = 16384
+
+    def __init__(self, processor: BeaconProcessor):
+        self.processor = processor
+        self._lock = threading.Condition()
+        self._by_time: List = []  # heap of (due, seq, event)
+        self._awaiting_root: Dict[bytes, List[WorkEvent]] = {}
+        self._seq = 0
+        self._n_awaiting = 0
+        self._shutdown = False
+        self._thread = threading.Thread(target=self._run, name="reprocess-queue", daemon=True)
+        self._thread.start()
+
+    def schedule_at(self, due: float, event: WorkEvent) -> None:
+        """Run ``event`` at wall-clock time ``due`` (early-block delay)."""
+        import heapq
+
+        with self._lock:
+            self._seq += 1
+            heapq.heappush(self._by_time, (due, self._seq, event))
+            self._lock.notify_all()
+
+    def await_block(self, block_root: bytes, event: WorkEvent) -> bool:
+        """Queue ``event`` until ``block_imported(block_root)``."""
+        with self._lock:
+            if self._n_awaiting >= self.MAX_DELAYED:
+                return False
+            self._awaiting_root.setdefault(block_root, []).append(event)
+            self._n_awaiting += 1
+            return True
+
+    def block_imported(self, block_root: bytes) -> int:
+        """Release work waiting on a now-imported block; returns #released."""
+        with self._lock:
+            events = self._awaiting_root.pop(block_root, [])
+            self._n_awaiting -= len(events)
+        for ev in events:
+            self.processor.send(ev)
+        return len(events)
+
+    def _run(self) -> None:
+        import heapq
+
+        while True:
+            with self._lock:
+                if self._shutdown:
+                    return
+                now = time.monotonic()
+                due_events = []
+                while self._by_time and self._by_time[0][0] <= now:
+                    _, _, ev = heapq.heappop(self._by_time)
+                    due_events.append(ev)
+                timeout = (
+                    max(0.0, self._by_time[0][0] - now) if self._by_time else 0.1
+                )
+            for ev in due_events:
+                self.processor.send(ev)
+            with self._lock:
+                if not self._shutdown:
+                    self._lock.wait(timeout=min(timeout, 0.1))
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+        self._thread.join(timeout=2.0)

@@ -1,0 +1,127 @@
+"""Work taxonomy for the priority scheduler.
+
+Mirrors the reference's ``Work`` enum — 36 priority classes drained in a
+hard-coded order (``beacon_node/beacon_processor/src/lib.rs:549-615`` and the
+drain order at ``:932-1110``).  The order encodes consensus-criticality:
+chain-extending data (blocks, blobs) first, then priority-0 API requests,
+aggregates, unaggregated attestations, sync work, and finally backfill and
+low-priority API traffic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+
+class W:
+    """Work type ids (reference ``WorkType``)."""
+
+    # chain extension (highest priority)
+    GOSSIP_BLOCK = "gossip_block"
+    GOSSIP_BLOB_SIDECAR = "gossip_blob_sidecar"
+    DELAYED_IMPORT_BLOCK = "delayed_import_block"
+    RPC_BLOCK = "rpc_block"
+    RPC_BLOBS = "rpc_blobs"
+    CHAIN_SEGMENT = "chain_segment"
+    # priority API
+    API_REQUEST_P0 = "api_request_p0"
+    # aggregates & proofs
+    GOSSIP_AGGREGATE = "gossip_aggregate"
+    GOSSIP_AGGREGATE_BATCH = "gossip_aggregate_batch"
+    # unaggregated attestations
+    GOSSIP_ATTESTATION = "gossip_attestation"
+    GOSSIP_ATTESTATION_BATCH = "gossip_attestation_batch"
+    UNKNOWN_BLOCK_ATTESTATION = "unknown_block_attestation"
+    UNKNOWN_BLOCK_AGGREGATE = "unknown_block_aggregate"
+    # sync committee
+    GOSSIP_SYNC_SIGNATURE = "gossip_sync_signature"
+    GOSSIP_SYNC_CONTRIBUTION = "gossip_sync_contribution"
+    # other gossip ops
+    GOSSIP_VOLUNTARY_EXIT = "gossip_voluntary_exit"
+    GOSSIP_PROPOSER_SLASHING = "gossip_proposer_slashing"
+    GOSSIP_ATTESTER_SLASHING = "gossip_attester_slashing"
+    GOSSIP_BLS_TO_EXECUTION_CHANGE = "gossip_bls_to_execution_change"
+    GOSSIP_LIGHT_CLIENT_FINALITY_UPDATE = "gossip_lc_finality"
+    GOSSIP_LIGHT_CLIENT_OPTIMISTIC_UPDATE = "gossip_lc_optimistic"
+    # RPC serving
+    STATUS = "status"
+    BLOCKS_BY_RANGE_REQUEST = "blocks_by_range"
+    BLOCKS_BY_ROOTS_REQUEST = "blocks_by_roots"
+    BLOBS_BY_RANGE_REQUEST = "blobs_by_range"
+    BLOBS_BY_ROOTS_REQUEST = "blobs_by_roots"
+    LIGHT_CLIENT_BOOTSTRAP_REQUEST = "lc_bootstrap"
+    # low priority
+    BACKFILL_SYNC = "backfill_sync"
+    API_REQUEST_P1 = "api_request_p1"
+
+
+# Drain order (reference ``beacon_processor/src/lib.rs:932-1110``): the
+# manager always serves the first non-empty queue in this list.
+DRAIN_ORDER = (
+    W.GOSSIP_BLOCK,
+    W.GOSSIP_BLOB_SIDECAR,
+    W.DELAYED_IMPORT_BLOCK,
+    W.RPC_BLOCK,
+    W.RPC_BLOBS,
+    W.CHAIN_SEGMENT,
+    W.API_REQUEST_P0,
+    W.GOSSIP_AGGREGATE,
+    W.GOSSIP_ATTESTATION,
+    W.UNKNOWN_BLOCK_AGGREGATE,
+    W.UNKNOWN_BLOCK_ATTESTATION,
+    W.GOSSIP_SYNC_CONTRIBUTION,
+    W.GOSSIP_SYNC_SIGNATURE,
+    W.GOSSIP_ATTESTER_SLASHING,
+    W.GOSSIP_PROPOSER_SLASHING,
+    W.GOSSIP_VOLUNTARY_EXIT,
+    W.GOSSIP_BLS_TO_EXECUTION_CHANGE,
+    W.STATUS,
+    W.BLOCKS_BY_RANGE_REQUEST,
+    W.BLOCKS_BY_ROOTS_REQUEST,
+    W.BLOBS_BY_RANGE_REQUEST,
+    W.BLOBS_BY_ROOTS_REQUEST,
+    W.LIGHT_CLIENT_BOOTSTRAP_REQUEST,
+    W.GOSSIP_LIGHT_CLIENT_FINALITY_UPDATE,
+    W.GOSSIP_LIGHT_CLIENT_OPTIMISTIC_UPDATE,
+    W.BACKFILL_SYNC,
+    W.API_REQUEST_P1,
+)
+
+# Default per-queue bounds (reference scales these to the validator count,
+# ``lib.rs:96``; these are the minimal-preset-scale defaults).
+DEFAULT_QUEUE_LENGTHS = {
+    W.GOSSIP_BLOCK: 1024,
+    W.GOSSIP_BLOB_SIDECAR: 1024,
+    W.GOSSIP_AGGREGATE: 4096,
+    W.GOSSIP_ATTESTATION: 16384,
+    W.UNKNOWN_BLOCK_ATTESTATION: 8192,
+    W.UNKNOWN_BLOCK_AGGREGATE: 4096,
+    W.BACKFILL_SYNC: 1024,
+    W.API_REQUEST_P0: 1024,
+    W.API_REQUEST_P1: 1024,
+}
+DEFAULT_QUEUE_LENGTH = 4096
+
+# Batchable work: (batch_work_type, max batch size).  Matches the reference's
+# 64-attestation coalescing (``lib.rs:200-201``) — and the device batch
+# buckets, so one drained batch feeds one TPU program invocation.
+BATCH_RULES = {
+    W.GOSSIP_ATTESTATION: (W.GOSSIP_ATTESTATION_BATCH, 64),
+    W.GOSSIP_AGGREGATE: (W.GOSSIP_AGGREGATE_BATCH, 64),
+}
+
+
+@dataclass
+class WorkEvent:
+    """One unit of work: ``process(*items)`` runs on a worker thread.
+
+    ``drop_during_sync`` mirrors the reference's flag of the same name —
+    gossip work that is stale while syncing can be discarded."""
+
+    work_type: str
+    process: Callable[..., Any]
+    item: Any = None
+    drop_during_sync: bool = False
+    # Batch handler: called with a list of items when coalesced.
+    process_batch: Optional[Callable[..., Any]] = None

@@ -1,0 +1,5 @@
+"""Storage layer (reference: ``beacon_node/store``)."""
+
+from .kv import DBColumn, KeyValueStore, MemoryStore, StoreError
+
+__all__ = ["DBColumn", "KeyValueStore", "MemoryStore", "StoreError"]

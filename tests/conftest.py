@@ -8,8 +8,23 @@ logic is validated without TPU hardware, exactly like the driver's
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient environment may point JAX_PLATFORMS at the TPU
+# tunnel (sitecustomize imports jax before this file runs, snapshotting the
+# env), and tests must never depend on — or hang on — real TPU hardware.
+# Both the env var and the live config must be set.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Persistent compilation cache: the pairing kernels take tens of seconds to
+# compile; cache them across pytest runs.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax  # noqa: E402  (env above must be set first)
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,161 @@
+"""Scheduler tests: strict priority drain order, attestation batch
+coalescing, bounded-queue drops, reprocessing delay queue (modeled on the
+reference's beacon_processor unit tests + work_reprocessing_queue docs)."""
+
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.scheduler import BeaconProcessor, ReprocessQueue, W, WorkEvent
+
+
+@pytest.fixture()
+def processor():
+    p = BeaconProcessor(max_workers=1)
+    yield p
+    p.shutdown()
+
+
+def gate_event(work_type, gate, started=None):
+    def run(_):
+        if started is not None:
+            started.set()
+        gate.wait(5.0)
+
+    return WorkEvent(work_type=work_type, process=run)
+
+
+class TestPriority:
+    def test_blocks_before_attestations(self, processor):
+        order = []
+        gate = threading.Event()
+        started = threading.Event()
+        # Occupy the single worker so subsequent sends pile up in queues.
+        processor.send(gate_event(W.STATUS, gate, started))
+        assert started.wait(2.0)
+        done = threading.Event()
+
+        def make(wt):
+            return WorkEvent(work_type=wt, process=lambda _: order.append(wt))
+
+        # Enqueue in "wrong" order: attestation first, block last.
+        processor.send(make(W.GOSSIP_ATTESTATION))
+        processor.send(make(W.BACKFILL_SYNC))
+        processor.send(make(W.GOSSIP_AGGREGATE))
+        processor.send(make(W.GOSSIP_BLOCK))
+        processor.send(
+            WorkEvent(work_type=W.API_REQUEST_P1, process=lambda _: done.set())
+        )
+        gate.set()
+        assert done.wait(5.0)
+        assert order == [
+            W.GOSSIP_BLOCK,
+            W.GOSSIP_AGGREGATE,
+            W.GOSSIP_ATTESTATION,
+            W.BACKFILL_SYNC,
+        ]
+
+    def test_metrics_counted(self, processor):
+        processor.send(WorkEvent(work_type=W.GOSSIP_BLOCK, process=lambda _: None))
+        assert processor.wait_idle(5.0)
+        assert processor.metrics.received[W.GOSSIP_BLOCK] == 1
+        assert processor.metrics.processed[W.GOSSIP_BLOCK] == 1
+
+
+class TestBatching:
+    def test_attestations_coalesce(self, processor):
+        gate = threading.Event()
+        started = threading.Event()
+        processor.send(gate_event(W.STATUS, gate, started))
+        assert started.wait(2.0)
+
+        batches = []
+        singles = []
+
+        def single(item):
+            singles.append(item)
+
+        def batch(items):
+            batches.append(list(items))
+
+        for i in range(70):
+            processor.send(
+                WorkEvent(
+                    work_type=W.GOSSIP_ATTESTATION,
+                    process=single,
+                    process_batch=batch,
+                    item=i,
+                )
+            )
+        gate.set()
+        assert processor.wait_idle(5.0)
+        total = sum(len(b) for b in batches) + len(singles)
+        assert total == 70
+        # with the worker gated, the first drain takes a full 64-batch
+        assert any(len(b) == 64 for b in batches)
+        assert processor.metrics.batch_items[W.GOSSIP_ATTESTATION_BATCH] >= 64
+
+    def test_worker_error_does_not_kill_processor(self, processor):
+        def boom(_):
+            raise RuntimeError("injected")
+
+        processor.send(WorkEvent(work_type=W.GOSSIP_BLOCK, process=boom))
+        assert processor.wait_idle(5.0)
+        done = threading.Event()
+        processor.send(WorkEvent(work_type=W.GOSSIP_BLOCK, process=lambda _: done.set()))
+        assert done.wait(5.0)
+
+
+class TestBackpressure:
+    def test_full_queue_drops(self):
+        p = BeaconProcessor(max_workers=1, queue_lengths={W.GOSSIP_ATTESTATION: 4})
+        try:
+            gate = threading.Event()
+            started = threading.Event()
+            p.send(gate_event(W.STATUS, gate, started))
+            assert started.wait(2.0)
+            accepted = sum(
+                p.send(
+                    WorkEvent(work_type=W.GOSSIP_ATTESTATION, process=lambda _: None)
+                )
+                for _ in range(10)
+            )
+            assert accepted == 4
+            assert p.metrics.dropped[W.GOSSIP_ATTESTATION] == 6
+            gate.set()
+        finally:
+            p.shutdown()
+
+
+class TestReprocess:
+    def test_delayed_event_fires(self, processor):
+        rq = ReprocessQueue(processor)
+        try:
+            done = threading.Event()
+            rq.schedule_at(
+                time.monotonic() + 0.15,
+                WorkEvent(work_type=W.DELAYED_IMPORT_BLOCK, process=lambda _: done.set()),
+            )
+            assert not done.wait(0.05)  # not yet
+            assert done.wait(2.0)
+        finally:
+            rq.shutdown()
+
+    def test_await_block_release(self, processor):
+        rq = ReprocessQueue(processor)
+        try:
+            done = threading.Event()
+            root = b"\xaa" * 32
+            rq.await_block(
+                root,
+                WorkEvent(
+                    work_type=W.UNKNOWN_BLOCK_ATTESTATION, process=lambda _: done.set()
+                ),
+            )
+            assert not done.wait(0.05)
+            assert rq.block_imported(root) == 1
+            assert done.wait(2.0)
+            assert rq.block_imported(root) == 0
+        finally:
+            rq.shutdown()
