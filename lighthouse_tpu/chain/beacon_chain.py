@@ -23,7 +23,7 @@ from ..consensus.per_block import BlockProcessingError, BlockSignatureStrategy
 from ..consensus.per_slot import process_slots
 from ..consensus.state_transition import state_transition
 from ..fork_choice import ExecutionStatus, ForkChoice, InvalidAttestation
-from ..store import DBColumn, MemoryStore
+from ..store import HotColdDB, MemoryStore
 from ..types.spec import ChainSpec
 from .mock_el import MockExecutionEngine
 from .slot_clock import ManualSlotClock, SlotClock
@@ -102,13 +102,23 @@ class BeaconChain:
         types,
         spec: ChainSpec,
         store: Optional[MemoryStore] = None,
+        db: Optional[HotColdDB] = None,
         slot_clock: Optional[SlotClock] = None,
         execution_engine: Optional[MockExecutionEngine] = None,
         kzg=None,
     ):
         self.spec = spec
         self.types = types
-        self.store = store if store is not None else MemoryStore()
+        if db is not None:
+            if store is not None:
+                raise ChainError("pass either store= or db=, not both")
+            db.types = types if db.types is None else db.types
+            db.spec = spec if db.spec is None else db.spec
+            self.db = db
+            self.store = db.hot
+        else:
+            self.store = store if store is not None else MemoryStore()
+            self.db = HotColdDB(hot=self.store, types=types, spec=spec)
         self.execution_engine = (
             execution_engine if execution_engine is not None else MockExecutionEngine()
         )
@@ -138,15 +148,21 @@ class BeaconChain:
         self.head_root = self.genesis_block_root
         self.attestation_pool = NaiveAggregationPool()
         self.observed_block_roots: set = set()
+        self._migrated_slot = 0
 
     # ------------------------------------------------------------- storage
 
     def _store_block(self, block_root: bytes, signed_block, post_state) -> None:
         if signed_block is not None:
             self._blocks[block_root] = signed_block
-            self.store.put(DBColumn.BEACON_BLOCK, block_root, signed_block.as_ssz_bytes())
+            self.db.put_block(block_root, signed_block)
+            # The post-state root was verified against the block's claim in
+            # state_transition — reuse it instead of re-merkleizing.
+            state_root = bytes(signed_block.message.state_root)
+        else:
+            state_root = post_state.hash_tree_root()  # genesis
         self._states[block_root] = post_state
-        self.store.put(DBColumn.BEACON_STATE, block_root, post_state.as_ssz_bytes())
+        self.db.put_state(state_root, post_state, block_root)
 
     def get_block(self, block_root: bytes):
         return self._blocks.get(block_root)
@@ -446,7 +462,53 @@ class BeaconChain:
         """Reference ``canonical_head.rs:496`` ``recompute_head_at_slot``."""
         head = self.fork_choice.get_head(self.current_slot())
         self.head_root = head
+        self._maybe_migrate()
         return head
+
+    def _maybe_migrate(self) -> None:
+        """Freeze newly-finalized history and drop abandoned forks from the
+        object caches (reference: background ``migrate.rs`` — synchronous
+        here; the networked node runs it off the hot path)."""
+        f_epoch, f_root = self.fork_choice.finalized_checkpoint
+        f_slot = f_epoch * self.spec.slots_per_epoch
+        if f_slot <= self._migrated_slot or f_root not in self._states:
+            return
+        proto = self.fork_choice.proto
+
+        def canonical_root_at_slot(slot: int):
+            return proto.ancestor_at_slot(f_root, slot)
+
+        def state_for_root(block_root: bytes):
+            return self._states.get(block_root)
+
+        # Forks not descending from the finalized root are dead.
+        abandoned = [
+            root
+            for root in self._states
+            if root != f_root
+            and self._blocks_slot(root) <= f_slot
+            and proto.ancestor_at_slot(f_root, self._blocks_slot(root)) != root
+        ]
+        self.db.migrate(
+            finalized_slot=f_slot,
+            finalized_state=self._states[f_root],
+            canonical_root_at_slot=canonical_root_at_slot,
+            state_for_root=state_for_root,
+            abandoned_state_roots=[
+                bytes(self._blocks[r].message.state_root)
+                for r in abandoned
+                if r in self._blocks
+            ],
+        )
+        # Prune object caches: keep finalized root and everything after it.
+        for root in abandoned:
+            self._states.pop(root, None)
+            self._blocks.pop(root, None)
+        for root in list(self._states):
+            if root != f_root and self._blocks_slot(root) < f_slot:
+                self._states.pop(root, None)
+        self.fork_choice.prune()
+        self._migrated_slot = f_slot
 
     def per_slot_task(self) -> None:
         """Per-slot tick (reference ``timer`` → ``per_slot_task``)."""

@@ -1,0 +1,71 @@
+"""Native (C++) runtime components, compiled on demand with g++.
+
+The reference links LevelDB/LMDB/SQLite as native storage engines; here the
+equivalent embedded engine is ``lockbox.cc``, built once into a shared
+library and loaded via ctypes (no pybind11 in the image).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_BUILD_LOCK = threading.Lock()
+_LIB = None
+
+
+def _build_dir() -> str:
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "build")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load_lockbox() -> ctypes.CDLL:
+    """Compile (if needed) and load the lockbox shared library."""
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    with _BUILD_LOCK:
+        if _LIB is not None:
+            return _LIB
+        src = os.path.join(os.path.dirname(os.path.abspath(__file__)), "lockbox.cc")
+        so = os.path.join(_build_dir(), "liblockbox.so")
+        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+            tmp = so + ".tmp"
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(tmp, so)
+        lib = ctypes.CDLL(so)
+        lib.lockbox_open.restype = ctypes.c_void_p
+        lib.lockbox_open.argtypes = [ctypes.c_char_p]
+        lib.lockbox_close.argtypes = [ctypes.c_void_p]
+        lib.lockbox_put.restype = ctypes.c_int
+        lib.lockbox_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.lockbox_get.restype = ctypes.c_int64
+        lib.lockbox_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.lockbox_delete.restype = ctypes.c_int
+        lib.lockbox_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+        lib.lockbox_count.restype = ctypes.c_uint64
+        lib.lockbox_count.argtypes = [ctypes.c_void_p]
+        lib.lockbox_keys.restype = ctypes.c_uint64
+        lib.lockbox_keys.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.lockbox_flush.restype = ctypes.c_int
+        lib.lockbox_flush.argtypes = [ctypes.c_void_p]
+        lib.lockbox_compact.restype = ctypes.c_int
+        lib.lockbox_compact.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return lib

@@ -187,7 +187,8 @@ class ReprocessQueue:
         self._thread.start()
 
     def schedule_at(self, due: float, event: WorkEvent) -> None:
-        """Run ``event`` at wall-clock time ``due`` (early-block delay)."""
+        """Run ``event`` at ``time.monotonic()``-clock instant ``due``
+        (early-block delay): ``schedule_at(time.monotonic() + d, ev)``."""
         import heapq
 
         with self._lock:
