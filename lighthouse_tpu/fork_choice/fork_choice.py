@@ -105,8 +105,8 @@ def compute_unrealized_checkpoints(
         old_current_justified=justified,
         previous_epoch=previous_epoch,
         current_epoch=current_epoch,
-        previous_boundary_root=h.get_block_root(state, previous_epoch, spec),
-        current_boundary_root=h.get_block_root(state, current_epoch, spec),
+        previous_boundary_root=lambda: h.get_block_root(state, previous_epoch, spec),
+        current_boundary_root=lambda: h.get_block_root(state, current_epoch, spec),
         total_active_balance=total_active,
         previous_target_balance=prev_target,
         current_target_balance=curr_target,
@@ -124,6 +124,11 @@ def _phase0_target_balances(state, arrays: EpochArrays, spec: ChainSpec):
     current_epoch = h.get_current_epoch(state, spec)
 
     def target_indices(attestations, epoch):
+        attestations = list(attestations)
+        if not attestations:
+            # No attestations ⇒ no boundary-root lookup: a state sitting on
+            # the epoch-start slot has no current boundary root yet.
+            return []
         out = set()
         boundary = h.get_block_root(state, epoch, spec)
         for a in attestations:

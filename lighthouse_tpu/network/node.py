@@ -1,0 +1,72 @@
+"""In-process beacon node: chain + scheduler + network service + router +
+sync, wired on the hub fabric.
+
+The building block of the N-node simulator (reference:
+``testing/node_test_rig`` ``LocalBeaconNode`` + ``testing/simulator``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..chain import BeaconChain, BeaconChainHarness
+from ..scheduler import BeaconProcessor
+from . import topics as topics_mod
+from .router import Router
+from .service import NetworkService
+from .sync import SyncManager
+from .transport import Hub
+
+
+class LocalNode:
+    def __init__(
+        self,
+        *,
+        hub: Hub,
+        peer_id: str,
+        harness: Optional[BeaconChainHarness] = None,
+        chain: Optional[BeaconChain] = None,
+        max_workers: int = 2,
+    ):
+        if harness is not None:
+            chain = harness.chain
+        assert chain is not None
+        self.harness = harness
+        self.chain = chain
+        self.peer_id = peer_id
+        self.endpoint = hub.register(peer_id)
+        self.service = NetworkService(self.endpoint)
+        self.processor = BeaconProcessor(max_workers=max_workers)
+        self.router = Router(chain=chain, service=self.service, processor=self.processor)
+        self.sync = SyncManager(chain=chain, service=self.service, router=self.router)
+        digest = self.router.fork_digest
+        fork = type(chain.genesis_state).fork_name
+        for topic in topics_mod.core_topics(digest, fork, chain.spec):
+            self.service.subscribe(str(topic))
+        for subnet in range(chain.spec.attestation_subnet_count):
+            self.service.subscribe(
+                str(topics_mod.attestation_subnet_topic(digest, subnet))
+            )
+
+    # ------------------------------------------------------------ publish
+
+    def publish_block(self, signed_block) -> int:
+        topic = topics_mod.GossipTopic(self.router.fork_digest, topics_mod.BEACON_BLOCK)
+        return self.service.publish(str(topic), signed_block.as_ssz_bytes())
+
+    def publish_attestation(self, attestation) -> int:
+        subnet = topics_mod.compute_subnet_for_attestation(
+            self.chain.head_state,
+            int(attestation.data.slot),
+            int(attestation.data.index),
+            self.chain.spec,
+        )
+        topic = topics_mod.attestation_subnet_topic(self.router.fork_digest, subnet)
+        return self.service.publish(str(topic), attestation.as_ssz_bytes())
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        return self.processor.wait_idle(timeout)
+
+    def shutdown(self) -> None:
+        self.service.shutdown()
+        self.processor.shutdown()

@@ -1,0 +1,203 @@
+"""Req/resp RPC protocol: typed requests/responses + ssz_snappy codec.
+
+Equivalent of the reference's ``lighthouse_network/src/rpc/protocol.rs``
+(Status/Goodbye/BlocksByRange/BlocksByRoot/BlobsByRange/BlobsByRoot/Ping/
+Metadata protocol ids) and ``rpc/codec/ssz_snappy.rs`` (length-prefixed
+snappy-framed SSZ chunks with a result byte and per-fork context bytes on
+block responses).
+
+Wire shape per response chunk:
+    [u8 result] [varint ssz_length] [4-byte context (forked types only)]
+    [snappy-framed SSZ payload]
+result 0 = success, 1 = invalid request, 2 = server error, 3 = resource
+unavailable (reference ``RPCResponseErrorCode``).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from . import snappy_codec
+from .snappy_codec import _read_varint, _write_varint  # shared varint
+
+PROTOCOL_PREFIX = "/eth2/beacon_chain/req"
+
+STATUS = "status/1"
+GOODBYE = "goodbye/1"
+BLOCKS_BY_RANGE = "beacon_blocks_by_range/2"
+BLOCKS_BY_ROOT = "beacon_blocks_by_root/2"
+BLOBS_BY_RANGE = "blob_sidecars_by_range/1"
+BLOBS_BY_ROOT = "blob_sidecars_by_root/1"
+PING = "ping/1"
+METADATA = "metadata/2"
+
+SUCCESS = 0
+INVALID_REQUEST = 1
+SERVER_ERROR = 2
+RESOURCE_UNAVAILABLE = 3
+
+MAX_REQUEST_BLOCKS = 1024
+
+
+class RpcError(ValueError):
+    pass
+
+
+@dataclass
+class Status:
+    """Reference ``StatusMessage`` — the handshake that drives sync."""
+
+    fork_digest: bytes
+    finalized_root: bytes
+    finalized_epoch: int
+    head_root: bytes
+    head_slot: int
+
+    def to_bytes(self) -> bytes:
+        return (
+            self.fork_digest
+            + self.finalized_root
+            + struct.pack("<Q", self.finalized_epoch)
+            + self.head_root
+            + struct.pack("<Q", self.head_slot)
+        )
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Status":
+        if len(data) != 84:
+            raise RpcError(f"status must be 84 bytes, got {len(data)}")
+        return cls(
+            fork_digest=data[0:4],
+            finalized_root=data[4:36],
+            finalized_epoch=struct.unpack_from("<Q", data, 36)[0],
+            head_root=data[44:76],
+            head_slot=struct.unpack_from("<Q", data, 76)[0],
+        )
+
+
+@dataclass
+class Goodbye:
+    reason: int  # 1 shutdown, 2 irrelevant network, 3 fault/error
+
+    def to_bytes(self) -> bytes:
+        return struct.pack("<Q", self.reason)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Goodbye":
+        return cls(struct.unpack("<Q", data)[0])
+
+
+@dataclass
+class Ping:
+    seq_number: int
+
+    def to_bytes(self) -> bytes:
+        return struct.pack("<Q", self.seq_number)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Ping":
+        return cls(struct.unpack("<Q", data)[0])
+
+
+@dataclass
+class MetaData:
+    seq_number: int
+    attnets: int  # 64-bit bitfield
+    syncnets: int  # 4-bit bitfield (1 byte on the wire)
+
+    def to_bytes(self) -> bytes:
+        return struct.pack("<Q", self.seq_number) + struct.pack("<Q", self.attnets) + bytes(
+            [self.syncnets]
+        )
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "MetaData":
+        return cls(
+            struct.unpack_from("<Q", data, 0)[0],
+            struct.unpack_from("<Q", data, 8)[0],
+            data[16],
+        )
+
+
+@dataclass
+class BlocksByRangeRequest:
+    start_slot: int
+    count: int
+
+    def to_bytes(self) -> bytes:
+        # v2 drops `step`; encoded as step=1 for v1 compat in the reference
+        return struct.pack("<QQQ", self.start_slot, self.count, 1)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "BlocksByRangeRequest":
+        start, count, _step = struct.unpack("<QQQ", data)
+        return cls(start, count)
+
+
+@dataclass
+class BlocksByRootRequest:
+    roots: List[bytes]
+
+    def to_bytes(self) -> bytes:
+        return b"".join(self.roots)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "BlocksByRootRequest":
+        if len(data) % 32:
+            raise RpcError("roots payload not a multiple of 32")
+        return cls([data[i : i + 32] for i in range(0, len(data), 32)])
+
+
+REQUEST_TYPES = {
+    STATUS: Status,
+    GOODBYE: Goodbye,
+    PING: Ping,
+    METADATA: type(None),  # metadata request has an empty body
+    BLOCKS_BY_RANGE: BlocksByRangeRequest,
+    BLOCKS_BY_ROOT: BlocksByRootRequest,
+}
+
+
+def encode_request(protocol: str, request) -> bytes:
+    body = b"" if request is None else request.to_bytes()
+    return _write_varint(len(body)) + snappy_codec.frame_compress(body)
+
+
+def decode_request(protocol: str, data: bytes):
+    length, pos = _read_varint(data, 0)
+    body = snappy_codec.frame_decompress(data[pos:])
+    if len(body) != length:
+        raise RpcError("request length prefix mismatch")
+    cls = REQUEST_TYPES[protocol]
+    return None if cls is type(None) else cls.from_bytes(body)
+
+
+def encode_response_chunk(
+    result: int, payload: bytes, context_bytes: Optional[bytes] = None
+) -> bytes:
+    out = bytes([result]) + _write_varint(len(payload))
+    if context_bytes is not None:
+        out += context_bytes
+    return out + snappy_codec.frame_compress(payload)
+
+
+def decode_response_chunk(
+    data: bytes, has_context: bool = False
+) -> Tuple[int, bytes, Optional[bytes], int]:
+    """Returns (result, payload, context_bytes, bytes_consumed)."""
+    if not data:
+        raise RpcError("empty chunk")
+    result = data[0]
+    length, pos = _read_varint(data, 1)
+    context = None
+    if has_context and result == SUCCESS:
+        context = data[pos : pos + 4]
+        pos += 4
+    # frames are self-delimiting only via content; chunks here are one
+    # frame-stream each, delimited by the transport message boundary.
+    payload = snappy_codec.frame_decompress(data[pos:])
+    if len(payload) != length:
+        raise RpcError("response length prefix mismatch")
+    return result, payload, context, len(data)

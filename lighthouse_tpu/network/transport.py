@@ -1,0 +1,117 @@
+"""In-process network fabric.
+
+The test/simulator transport: N nodes on one process exchanging gossip and
+RPC bytes through queues — the topology of the reference's
+``testing/simulator`` (N in-process beacon nodes on one runtime,
+``testing/node_test_rig``).  The ``Endpoint`` interface is what a real
+libp2p-style TCP/QUIC transport would implement; everything above it
+(gossip dedup/forwarding, RPC codecs, peer scoring, sync) is
+transport-agnostic.
+
+Fault injection: per-link drop probability and a partition set — the levers
+the reference's sync tests and ``fallback-sim`` pull.
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Set, Tuple
+
+
+@dataclass
+class Envelope:
+    kind: str  # "gossip" | "rpc_request" | "rpc_response"
+    sender: str
+    topic: Optional[str] = None  # gossip
+    protocol: Optional[str] = None  # rpc
+    request_id: int = 0
+    data: bytes = b""
+
+
+class Endpoint:
+    def __init__(self, hub: "Hub", peer_id: str):
+        self.hub = hub
+        self.peer_id = peer_id
+        self.inbound: "queue.Queue[Envelope]" = queue.Queue()
+        self.on_connect: Optional[Callable[[str], None]] = None
+        self.on_disconnect: Optional[Callable[[str], None]] = None
+
+    def connected_peers(self) -> Set[str]:
+        return self.hub.peers_of(self.peer_id)
+
+    def send(self, to: str, env: Envelope) -> bool:
+        return self.hub.deliver(self.peer_id, to, env)
+
+    def disconnect(self, peer: str) -> None:
+        self.hub.disconnect(self.peer_id, peer)
+
+
+class Hub:
+    """The wire: tracks links, delivers envelopes, injects faults."""
+
+    def __init__(self, seed: int = 0):
+        self._endpoints: Dict[str, Endpoint] = {}
+        self._links: Set[Tuple[str, str]] = set()
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed)
+        self.drop_probability: float = 0.0
+        self._partitions: Dict[str, int] = {}  # peer -> partition id
+
+    def register(self, peer_id: str) -> Endpoint:
+        with self._lock:
+            if peer_id in self._endpoints:
+                raise ValueError(f"duplicate peer id {peer_id}")
+            ep = Endpoint(self, peer_id)
+            self._endpoints[peer_id] = ep
+            return ep
+
+    def connect(self, a: str, b: str) -> None:
+        """Symmetric dial (reference: libp2p connection established)."""
+        with self._lock:
+            self._links.add((min(a, b), max(a, b)))
+        for x, y in ((a, b), (b, a)):
+            ep = self._endpoints.get(x)
+            if ep and ep.on_connect:
+                ep.on_connect(y)
+
+    def disconnect(self, a: str, b: str) -> None:
+        with self._lock:
+            self._links.discard((min(a, b), max(a, b)))
+        for x, y in ((a, b), (b, a)):
+            ep = self._endpoints.get(x)
+            if ep and ep.on_disconnect:
+                ep.on_disconnect(y)
+
+    def peers_of(self, peer_id: str) -> Set[str]:
+        with self._lock:
+            out = set()
+            for a, b in self._links:
+                if a == peer_id:
+                    out.add(b)
+                elif b == peer_id:
+                    out.add(a)
+            return out
+
+    def set_partition(self, peer_id: str, partition: int) -> None:
+        self._partitions[peer_id] = partition
+
+    def clear_partitions(self) -> None:
+        self._partitions.clear()
+
+    def deliver(self, sender: str, to: str, env: Envelope) -> bool:
+        with self._lock:
+            linked = (min(sender, to), max(sender, to)) in self._links
+        if not linked:
+            return False
+        if self._partitions.get(sender, 0) != self._partitions.get(to, 0):
+            return False
+        if self.drop_probability and self._rng.random() < self.drop_probability:
+            return False
+        ep = self._endpoints.get(to)
+        if ep is None:
+            return False
+        ep.inbound.put(env)
+        return True
