@@ -145,8 +145,11 @@ class BeaconChain:
             genesis_state=genesis_state,
         )
         self.fork_choice.set_justified_state_provider(self._states.get)
+        from ..op_pool import OperationPool
+
         self.head_root = self.genesis_block_root
         self.attestation_pool = NaiveAggregationPool()
+        self.op_pool = OperationPool()
         self.observed_block_roots: set = set()
         self._migrated_slot = 0
 
@@ -350,19 +353,27 @@ class BeaconChain:
         fork = type(state).fork_name
         proposer = h.get_beacon_proposer_index(state, spec)
 
-        max_atts = spec.preset.max_attestations
-        attestations = self._packed_attestations(state, max_atts)
+        # Mature naive-pool aggregates into the op pool, then max-cover pack
+        # (reference: produce_block_on_state → op_pool.get_attestations).
+        for att in self.attestation_pool.get_for_block(state, spec, 10_000):
+            self.op_pool.insert_attestation(att)
+        attestations = self.op_pool.get_attestations(
+            state, types, spec, spec.preset.max_attestations
+        )
+        proposer_slashings, attester_slashings = self.op_pool.get_slashings(
+            state, spec, types
+        )
 
         body_cls = types.block_body[fork]
         body_kwargs = dict(
             randao_reveal=randao_reveal,
             eth1_data=state.eth1_data.copy(),
             graffiti=graffiti,
-            proposer_slashings=[],
-            attester_slashings=[],
+            proposer_slashings=proposer_slashings,
+            attester_slashings=attester_slashings,
             attestations=attestations,
             deposits=[],
-            voluntary_exits=[],
+            voluntary_exits=self.op_pool.get_voluntary_exits(state, types, spec),
         )
         if hasattr(body_cls, "fields") and "sync_aggregate" in body_cls.fields:
             if sync_aggregate is None:
@@ -378,7 +389,9 @@ class BeaconChain:
                 state, types, spec
             )
         if "bls_to_execution_changes" in body_cls.fields:
-            body_kwargs["bls_to_execution_changes"] = []
+            body_kwargs["bls_to_execution_changes"] = (
+                self.op_pool.get_bls_to_execution_changes(state, spec)
+            )
         if "blob_kzg_commitments" in body_cls.fields:
             body_kwargs["blob_kzg_commitments"] = []
 
@@ -409,25 +422,6 @@ class BeaconChain:
         )
         block.state_root = state.hash_tree_root()
         return block, bytes(block.state_root)
-
-    def _packed_attestations(self, state, limit: int) -> List[object]:
-        """Greedy selection from the pool, validity-filtered by trial
-        application (the reference uses max-cover packing in the op pool; the
-        op-pool milestone replaces this)."""
-        from ..consensus.per_block import process_attestation
-
-        candidates = self.attestation_pool.get_for_block(state, self.spec, limit * 4)
-        scratch = state.copy()
-        out = []
-        for att in candidates:
-            try:
-                process_attestation(scratch, att, self.types, self.spec, verify=False)
-            except Exception:
-                continue
-            out.append(att)
-            if len(out) >= limit:
-                break
-        return out
 
     def produce_attestation_data(self, slot: int, committee_index: int):
         """Reference ``produce_unaggregated_attestation:1759`` — the data all
@@ -516,6 +510,7 @@ class BeaconChain:
         self.fork_choice.update_time(slot)
         self.recompute_head()
         self.attestation_pool.prune(slot)
+        self.op_pool.prune(self.head_state, self.spec, current_slot=slot)
 
     # ------------------------------------------------------------- queries
 

@@ -92,14 +92,22 @@ def compute_justification_and_finalization(
 
     Single source of truth for the 4-rule finalization table; used by both the
     mutating epoch transition below and fork choice's unrealized-checkpoint
-    ("pull-up") computation, which must never drift apart."""
+    ("pull-up") computation, which must never drift apart.
+
+    Boundary roots may be bytes or zero-arg callables: a state sitting exactly
+    on the current epoch's start slot has no current-boundary root yet
+    (``get_block_root`` requires ``slot < state.slot``), but then the current
+    target balance is necessarily below the 2/3 threshold (participation was
+    just rotated), so a lazy root is simply never evaluated."""
     bits = [False] + list(bits)[:-1]
     justified = None
     if previous_target_balance * 3 >= total_active_balance * 2:
-        justified = (previous_epoch, previous_boundary_root)
+        root = previous_boundary_root() if callable(previous_boundary_root) else previous_boundary_root
+        justified = (previous_epoch, root)
         bits[1] = True
     if current_target_balance * 3 >= total_active_balance * 2:
-        justified = (current_epoch, current_boundary_root)
+        root = current_boundary_root() if callable(current_boundary_root) else current_boundary_root
+        justified = (current_epoch, root)
         bits[0] = True
 
     # Finalization: 2nd/3rd/4th most recent epochs justified as source.

@@ -1,0 +1,79 @@
+"""Beacon-API JSON (de)serialization for SSZ containers.
+
+The beacon API encodes uint64 as decimal strings, byte vectors as 0x-hex,
+bitlists/bitvectors as 0x-hex SSZ bytes, and containers as objects — this
+module derives all of that generically from the container's SSZ type
+(reference: the serde derives across ``consensus/types``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..types import ssz as ssz_mod
+
+
+def to_json(value: Any) -> Any:
+    if isinstance(value, ssz_mod.Container):
+        return {name: to_json(getattr(value, name)) for name in value.fields}
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, int):
+        return str(value)
+    if isinstance(value, (bytes, bytearray)):
+        return "0x" + bytes(value).hex()
+    if isinstance(value, (list, tuple)):
+        if value and all(isinstance(b, bool) for b in value):
+            # bitlist/bitvector → SSZ hex is the API convention; a plain bool
+            # list is ambiguous here, so emit the list of bools' SSZ-ish hex
+            return _bits_to_hex(list(value))
+        return [to_json(v) for v in value]
+    return value
+
+
+def _bits_to_hex(bits) -> str:
+    # bitlist encoding with delimiter bit (beacon API uses SSZ encoding)
+    out = bytearray((len(bits) + 8) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            out[i // 8] |= 1 << (i % 8)
+    out[len(bits) // 8] |= 1 << (len(bits) % 8)
+    return "0x" + bytes(out).hex()
+
+
+def container_from_json(cls, obj: dict):
+    """Inverse of ``to_json`` for containers (sufficient for the API
+    surface's POST bodies; SSZ octet-stream is the preferred wire format)."""
+    kwargs = {}
+    for name, ftype in cls.fields.items():
+        kwargs[name] = _field_from_json(ftype, obj[name])
+    return cls(**kwargs)
+
+
+def _field_from_json(ftype, v):
+    if isinstance(ftype, ssz_mod.UintType):
+        return int(v)
+    if isinstance(v, str) and v.startswith("0x"):
+        raw = bytes.fromhex(v[2:])
+        if isinstance(ftype, ssz_mod.Bitlist):
+            return _hex_to_bits(raw)
+        return raw
+    if isinstance(v, dict):
+        # nested container: the field type wraps the class
+        cls = getattr(ftype, "container_class", None)
+        if cls is not None:
+            return container_from_json(cls, v)
+    if isinstance(v, list):
+        return [_field_from_json(getattr(ftype, "elem", None), x) for x in v]
+    return v
+
+
+def _hex_to_bits(raw: bytes):
+    # strip the bitlist delimiter
+    bits = []
+    for i in range(len(raw) * 8):
+        bits.append(bool(raw[i // 8] >> (i % 8) & 1))
+    while bits and not bits[-1]:
+        bits.pop()
+    if bits:
+        bits.pop()  # delimiter
+    return bits

@@ -1,0 +1,230 @@
+"""Operation pool: attestations (max-cover packed), slashings, exits,
+BLS-to-execution changes.
+
+Equivalent of the reference's ``beacon_node/operation_pool`` (3.5k LoC):
+compact attestation storage keyed by ``AttestationData`` root with multiple
+(possibly overlapping) aggregates per key, greedy **max-cover** selection for
+block production (`operation_pool/src/max_cover.rs`), and validity-filtered
+pools for the other operation types.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..consensus import helpers as h
+from ..types.spec import ChainSpec
+
+
+def max_cover(candidates: Sequence[Tuple[object, Set[int]]], limit: int) -> List[object]:
+    """Greedy maximum-coverage: repeatedly take the candidate covering the
+    most yet-uncovered items (reference ``max_cover.rs`` — same greedy
+    (1 - 1/e)-approximation, with covered items deducted from remaining
+    candidates each round)."""
+    remaining = [(item, set(cover)) for item, cover in candidates]
+    covered: Set[int] = set()
+    out: List[object] = []
+    while remaining and len(out) < limit:
+        best_i = max(range(len(remaining)), key=lambda i: len(remaining[i][1] - covered))
+        item, cover = remaining.pop(best_i)
+        fresh = cover - covered
+        if not fresh:
+            break
+        covered |= fresh
+        out.append(item)
+    return out
+
+
+@dataclass
+class _AttestationGroup:
+    """All aggregates seen for one AttestationData (reference
+    ``attestation_storage.rs`` compact representation)."""
+
+    data: object
+    aggregates: List[object] = field(default_factory=list)  # Attestation objects
+
+    def insert(self, attestation) -> None:
+        new_bits = list(attestation.aggregation_bits)
+        for existing in self.aggregates:
+            if list(existing.aggregation_bits) == new_bits:
+                return  # exact duplicate
+        # keep only non-subsumed aggregates
+        self.aggregates = [
+            a
+            for a in self.aggregates
+            if not _is_subset(list(a.aggregation_bits), new_bits)
+        ]
+        if not any(
+            _is_subset(new_bits, list(a.aggregation_bits)) for a in self.aggregates
+        ):
+            self.aggregates.append(attestation.copy())
+
+
+def _is_subset(a: List[bool], b: List[bool]) -> bool:
+    return all((not x) or y for x, y in zip(a, b))
+
+
+class OperationPool:
+    def __init__(self) -> None:
+        self._attestations: Dict[Tuple[int, bytes], _AttestationGroup] = {}
+        self._proposer_slashings: Dict[int, object] = {}  # by proposer index
+        self._attester_slashings: List[object] = []
+        self._voluntary_exits: Dict[int, object] = {}  # by validator index
+        self._bls_changes: Dict[int, object] = {}  # by validator index
+
+    # ------------------------------------------------------- attestations
+
+    def insert_attestation(self, attestation) -> None:
+        key = (int(attestation.data.slot), attestation.data.hash_tree_root())
+        group = self._attestations.get(key)
+        if group is None:
+            group = self._attestations[key] = _AttestationGroup(data=attestation.data)
+        group.insert(attestation)
+
+    def num_attestations(self) -> int:
+        return sum(len(g.aggregates) for g in self._attestations.values())
+
+    def get_attestations(self, state, types, spec: ChainSpec, limit: int) -> List[object]:
+        """Max-cover packing of attestations valid for a block on ``state``
+        (reference ``op_pool.get_attestations`` → ``AttMaxCover``): coverage
+        sets are the attesting validator indices not yet known to the state's
+        participation."""
+        from ..consensus.per_block import process_attestation
+
+        candidates: List[Tuple[object, Set[int]]] = []
+        for (slot, _), group in self._attestations.items():
+            if slot + spec.min_attestation_inclusion_delay > int(state.slot):
+                continue
+            if slot + spec.slots_per_epoch < int(state.slot):
+                continue
+            for att in group.aggregates:
+                try:
+                    committee = h.get_beacon_committee(
+                        state, int(att.data.slot), int(att.data.index), spec
+                    )
+                except Exception:
+                    continue
+                cover = {
+                    int(committee[i])
+                    for i, bit in enumerate(att.aggregation_bits)
+                    if bit and i < len(committee)
+                }
+                if cover:
+                    candidates.append((att, cover))
+        picked = max_cover(candidates, limit)
+        # Validity filter by trial application (the reference's per-op checks)
+        scratch = state.copy()
+        out = []
+        for att in picked:
+            try:
+                process_attestation(scratch, att, types, spec, verify=False)
+            except Exception:
+                continue
+            out.append(att)
+        return out
+
+    # ---------------------------------------------------------- slashings
+
+    def insert_proposer_slashing(self, slashing) -> None:
+        self._proposer_slashings[int(slashing.signed_header_1.message.proposer_index)] = slashing
+
+    def insert_attester_slashing(self, slashing) -> None:
+        self._attester_slashings.append(slashing)
+
+    def get_slashings(self, state, spec: ChainSpec, types) -> Tuple[List, List]:
+        """(proposer_slashings, attester_slashings) valid against ``state``,
+        bounded by the preset maxima."""
+        epoch = h.get_current_epoch(state, spec)
+        proposer = []
+        for idx, s in self._proposer_slashings.items():
+            if idx < len(state.validators) and h.is_slashable_validator(
+                state.validators[idx], epoch
+            ):
+                proposer.append(s)
+            if len(proposer) >= spec.preset.max_proposer_slashings:
+                break
+        attester = []
+        covered: Set[int] = set()
+        for s in self._attester_slashings:
+            att1 = set(int(i) for i in s.attestation_1.attesting_indices)
+            att2 = set(int(i) for i in s.attestation_2.attesting_indices)
+            slashable = {
+                i
+                for i in att1 & att2
+                if i < len(state.validators)
+                and h.is_slashable_validator(state.validators[i], epoch)
+            }
+            if slashable - covered:
+                covered |= slashable
+                attester.append(s)
+            if len(attester) >= spec.preset.max_attester_slashings:
+                break
+        return proposer, attester
+
+    # -------------------------------------------------------------- exits
+
+    def insert_voluntary_exit(self, signed_exit) -> None:
+        self._voluntary_exits[int(signed_exit.message.validator_index)] = signed_exit
+
+    def get_voluntary_exits(self, state, types, spec: ChainSpec) -> List[object]:
+        """Exits includable in a block on ``state``: full spec validity via
+        trial application (a stale pool entry must never break production —
+        reference filters with ``verify_operation`` revalidation)."""
+        from ..consensus.per_block import process_voluntary_exit
+
+        scratch = None
+        out = []
+        for idx, ex in self._voluntary_exits.items():
+            if idx >= len(state.validators):
+                continue
+            if scratch is None:
+                scratch = state.copy()
+            try:
+                process_voluntary_exit(scratch, ex, types, spec, verify=False)
+            except Exception:
+                continue
+            out.append(ex)
+            if len(out) >= spec.preset.max_voluntary_exits:
+                break
+        return out
+
+    # --------------------------------------------------- bls-to-execution
+
+    def insert_bls_to_execution_change(self, signed_change) -> None:
+        self._bls_changes[int(signed_change.message.validator_index)] = signed_change
+
+    def get_bls_to_execution_changes(self, state, spec: ChainSpec) -> List[object]:
+        out = []
+        for idx, ch in self._bls_changes.items():
+            if idx < len(state.validators) and bytes(
+                state.validators[idx].withdrawal_credentials
+            )[:1] == b"\x00":
+                out.append(ch)
+            if len(out) >= spec.preset.max_bls_to_execution_changes:
+                break
+        return out
+
+    # ------------------------------------------------------------- pruning
+
+    def prune(self, state, spec: ChainSpec, current_slot: Optional[int] = None) -> None:
+        """Drop operations no longer includable (reference ``prune_all``).
+        ``current_slot`` is the wall-clock slot (the head block may be old)."""
+        from ..types.spec import FAR_FUTURE_EPOCH
+
+        cur = int(state.slot) if current_slot is None else current_slot
+        self._attestations = {
+            k: g for k, g in self._attestations.items() if k[0] + spec.slots_per_epoch >= cur
+        }
+        n = len(state.validators)
+        self._voluntary_exits = {
+            i: e
+            for i, e in self._voluntary_exits.items()
+            if i < n and state.validators[i].exit_epoch == FAR_FUTURE_EPOCH
+        }
+        epoch = h.get_current_epoch(state, spec)
+        self._proposer_slashings = {
+            i: s
+            for i, s in self._proposer_slashings.items()
+            if i < n and h.is_slashable_validator(state.validators[i], epoch)
+        }
