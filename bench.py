@@ -1,9 +1,9 @@
 """North-star benchmark: batched BLS signature-set verification throughput.
 
 Measures the fused device program (scalar muls + aggregation + multi-pairing +
-final exponentiation) on the reference's headline config — 128 aggregate
-signature sets, 32-validator committees (BASELINE.md "north-star targets") —
-and prints ONE JSON line.
+final exponentiation) on the reference's headline configs — 128 aggregate
+signature sets x 32-validator committees, plus the 4,096-set scale config
+(BASELINE.md "north-star targets") — and prints ONE JSON line.
 
 ``vs_baseline`` compares against a documented estimate of the reference's
 blst-on-64-CPU-threads throughput for the same semantics (one 64-bit-weighted
@@ -11,6 +11,11 @@ multi-pairing per batch).  Lighthouse publishes no absolute numbers
 (BASELINE.json.published == {}); the figure below is derived from blst's
 well-known ~0.4-0.5 ms/thread per aggregate-verify pairing cost:
     64 threads / 0.45 ms  ->  ~142k sets/s.  We use 142_000 sets/s.
+
+Robustness contract (VERDICT r1 item 1b): backend init is retried with
+backoff, and a parseable JSON line is emitted on stdout even when the bench
+fails (value 0, with an ``error`` field), so the driver always records a
+result.
 """
 
 from __future__ import annotations
@@ -19,6 +24,7 @@ import json
 import os
 import sys
 import time
+import traceback
 
 BLST_64T_SETS_PER_SEC = 142_000.0
 
@@ -26,40 +32,104 @@ N_SETS = 128
 N_KEYS = 32
 REPS = 5
 
+SCALE_N_SETS = 4096
+SCALE_REPS = 2
 
-def main() -> None:
-    os.environ.setdefault("JAX_ENABLE_X64", "0")
+INIT_ATTEMPTS = 5
+INIT_BACKOFF_S = 3.0
+
+
+def _emit(value: float, vs_baseline: float, extra: dict) -> None:
+    line = {
+        "metric": f"verify_signature_sets throughput ({N_SETS} sets x {N_KEYS}-key committees)",
+        "value": round(value, 1),
+        "unit": "sets/sec",
+        "vs_baseline": round(vs_baseline, 4),
+    }
+    line.update(extra)
+    print(json.dumps(line))
+    sys.stdout.flush()
+
+
+def _init_backend():
+    """Import jax + initialize the default backend, retrying transient failures."""
     import jax
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from __graft_entry__ import _build_example
-    from lighthouse_tpu.ops.pairing import fe_is_one
-    from lighthouse_tpu.ops.verify import _device_verify
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
-    batch = _build_example(n_sets=N_SETS, n_keys=N_KEYS, seed=3)
+    last = None
+    for attempt in range(INIT_ATTEMPTS):
+        try:
+            devs = jax.devices()
+            return jax, devs
+        except Exception as e:  # backend init UNAVAILABLE etc.
+            last = e
+            print(
+                f"bench: backend init attempt {attempt + 1}/{INIT_ATTEMPTS} failed: {e}",
+                file=sys.stderr,
+            )
+            time.sleep(INIT_BACKOFF_S * (attempt + 1))
+    raise RuntimeError(f"backend init failed after {INIT_ATTEMPTS} attempts: {last}")
 
+
+def _bench_shape(jax, _device_verify, fe_is_one, build, n_sets, n_keys, reps, seed):
+    batch = build(n_sets=n_sets, n_keys=n_keys, seed=seed)
     # Warmup / compile.
     fe, w_z = _device_verify(*batch)
     jax.block_until_ready((fe, w_z))
-    assert fe_is_one(fe), "benchmark batch failed to verify"
+    assert fe_is_one(fe), f"benchmark batch ({n_sets}x{n_keys}) failed to verify"
 
     t0 = time.perf_counter()
-    for _ in range(REPS):
+    for _ in range(reps):
         fe, w_z = _device_verify(*batch)
     jax.block_until_ready((fe, w_z))
-    dt = (time.perf_counter() - t0) / REPS
+    dt = (time.perf_counter() - t0) / reps
+    return n_sets / dt
 
-    sets_per_sec = N_SETS / dt
-    print(
-        json.dumps(
-            {
-                "metric": f"verify_signature_sets throughput ({N_SETS} sets x {N_KEYS}-key committees)",
-                "value": round(sets_per_sec, 1),
-                "unit": "sets/sec",
-                "vs_baseline": round(sets_per_sec / BLST_64T_SETS_PER_SEC, 4),
-            }
+
+def main() -> None:
+    os.environ.setdefault("JAX_ENABLE_X64", "0")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    extra: dict = {}
+    try:
+        jax, devs = _init_backend()
+        extra["platform"] = devs[0].platform
+        from __graft_entry__ import _build_example
+        from lighthouse_tpu.ops.pairing import fe_is_one
+        from lighthouse_tpu.ops.verify import _device_verify
+
+        headline = _bench_shape(
+            jax, _device_verify, fe_is_one, _build_example, N_SETS, N_KEYS, REPS, seed=3
         )
-    )
+
+        # Scale config: 4,096 sets x 32-key committees (best-effort — a failure
+        # here must not void the headline number).
+        try:
+            scale = _bench_shape(
+                jax, _device_verify, fe_is_one, _build_example,
+                SCALE_N_SETS, N_KEYS, SCALE_REPS, seed=5,
+            )
+            extra["sets_per_sec_4096x32"] = round(scale, 1)
+            extra["vs_baseline_4096x32"] = round(scale / BLST_64T_SETS_PER_SEC, 4)
+        except Exception as e:
+            extra["scale_bench_error"] = f"{type(e).__name__}: {e}"
+
+        _emit(headline, headline / BLST_64T_SETS_PER_SEC, extra)
+    except Exception as e:
+        traceback.print_exc()
+        extra["error"] = f"{type(e).__name__}: {e}"
+        _emit(0.0, 0.0, extra)
+        # Exit 0: the JSON line itself records the failure; a nonzero rc would
+        # leave the driver with no parsed artifact at all (VERDICT r1).
 
 
 if __name__ == "__main__":

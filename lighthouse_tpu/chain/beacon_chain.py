@@ -79,10 +79,19 @@ class NaiveAggregationPool:
     def get_for_block(self, state, spec: ChainSpec, limit: int) -> List[object]:
         """Attestations eligible for inclusion in a block on ``state``."""
         out = []
+        state_slot = int(state.slot)
+        state_epoch = state_slot // spec.slots_per_epoch
+        post_deneb = spec.fork_name_at_slot(state_slot) not in (
+            "phase0", "altair", "bellatrix", "capella",
+        )
         for (slot, _), att in sorted(self._pool.items(), key=lambda kv: -kv[0][0]):
-            if slot + spec.min_attestation_inclusion_delay > state.slot:
+            if slot + spec.min_attestation_inclusion_delay > state_slot:
                 continue
-            if slot + spec.slots_per_epoch < state.slot:
+            if post_deneb:
+                # EIP-7045: current- and previous-epoch attestations included.
+                if slot // spec.slots_per_epoch + 1 < state_epoch:
+                    continue
+            elif slot + spec.slots_per_epoch < state_slot:
                 continue
             out.append(att)
             if len(out) >= limit:

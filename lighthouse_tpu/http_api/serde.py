@@ -3,18 +3,29 @@
 The beacon API encodes uint64 as decimal strings, byte vectors as 0x-hex,
 bitlists/bitvectors as 0x-hex SSZ bytes, and containers as objects — this
 module derives all of that generically from the container's SSZ type
-(reference: the serde derives across ``consensus/types``)."""
+descriptors (reference: the serde derives across ``consensus/types``).
+
+Encoding is type-driven: bit fields reuse the descriptor's own SSZ
+``serialize``/``deserialize`` so Bitvector fields carry no bitlist delimiter
+bit and an empty Bitlist round-trips as ``0x01``.
+"""
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 from ..types import ssz as ssz_mod
 
+_HEX_TYPES = (ssz_mod.Bitlist, ssz_mod.Bitvector, ssz_mod.ByteVector, ssz_mod.ByteList)
 
-def to_json(value: Any) -> Any:
+
+def to_json(value: Any, ftype: Optional[ssz_mod.SszType] = None) -> Any:
     if isinstance(value, ssz_mod.Container):
-        return {name: to_json(getattr(value, name)) for name in value.fields}
+        return {
+            name: to_json(getattr(value, name), ft) for name, ft in value.fields.items()
+        }
+    if isinstance(ftype, _HEX_TYPES):
+        return "0x" + ftype.serialize(value).hex()
     if isinstance(value, bool):
         return value
     if isinstance(value, int):
@@ -22,22 +33,12 @@ def to_json(value: Any) -> Any:
     if isinstance(value, (bytes, bytearray)):
         return "0x" + bytes(value).hex()
     if isinstance(value, (list, tuple)):
-        if value and all(isinstance(b, bool) for b in value):
-            # bitlist/bitvector → SSZ hex is the API convention; a plain bool
-            # list is ambiguous here, so emit the list of bools' SSZ-ish hex
-            return _bits_to_hex(list(value))
-        return [to_json(v) for v in value]
+        elem = getattr(ftype, "elem", None)
+        if elem is None and value and all(isinstance(b, bool) for b in value):
+            # Untyped bool list: assume bitlist (SSZ hex with delimiter).
+            return "0x" + ssz_mod.Bitlist(len(value)).serialize(list(value)).hex()
+        return [to_json(v, elem) for v in value]
     return value
-
-
-def _bits_to_hex(bits) -> str:
-    # bitlist encoding with delimiter bit (beacon API uses SSZ encoding)
-    out = bytearray((len(bits) + 8) // 8)
-    for i, b in enumerate(bits):
-        if b:
-            out[i // 8] |= 1 << (i % 8)
-    out[len(bits) // 8] |= 1 << (len(bits) % 8)
-    return "0x" + bytes(out).hex()
 
 
 def container_from_json(cls, obj: dict):
@@ -50,30 +51,21 @@ def container_from_json(cls, obj: dict):
 
 
 def _field_from_json(ftype, v):
+    if isinstance(ftype, ssz_mod.BooleanType):
+        return v if isinstance(v, bool) else v in ("true", "1", 1)
     if isinstance(ftype, ssz_mod.UintType):
         return int(v)
+    if isinstance(ftype, (ssz_mod.Bitlist, ssz_mod.Bitvector)):
+        return ftype.deserialize(bytes.fromhex(v[2:]))
     if isinstance(v, str) and v.startswith("0x"):
-        raw = bytes.fromhex(v[2:])
-        if isinstance(ftype, ssz_mod.Bitlist):
-            return _hex_to_bits(raw)
-        return raw
+        return bytes.fromhex(v[2:])
+    if isinstance(ftype, ssz_mod._ContainerType):
+        return container_from_json(ftype.cls, v)
     if isinstance(v, dict):
-        # nested container: the field type wraps the class
-        cls = getattr(ftype, "container_class", None)
+        # nested container via a wrapper type exposing the class
+        cls = getattr(ftype, "container_class", None) or getattr(ftype, "cls", None)
         if cls is not None:
             return container_from_json(cls, v)
     if isinstance(v, list):
         return [_field_from_json(getattr(ftype, "elem", None), x) for x in v]
     return v
-
-
-def _hex_to_bits(raw: bytes):
-    # strip the bitlist delimiter
-    bits = []
-    for i in range(len(raw) * 8):
-        bits.append(bool(raw[i // 8] >> (i % 8) & 1))
-    while bits and not bits[-1]:
-        bits.pop()
-    if bits:
-        bits.pop()  # delimiter
-    return bits

@@ -44,6 +44,16 @@ bool read_exact(FILE* f, void* buf, size_t n) {
   return fread(buf, 1, n, f) == n;
 }
 
+// Open read/write honoring seeks.  "ab+" must not be used for the log:
+// append mode writes at EOF regardless of fseeko, so after a failed append
+// left torn bytes at EOF the next record would land *after* the torn bytes
+// and every later record would be silently discarded by scan() on reopen.
+FILE* open_rw(const char* path) {
+  FILE* f = fopen(path, "rb+");
+  if (!f) f = fopen(path, "wb+");
+  return f;
+}
+
 // Scan the log, rebuilding the index.  Returns the offset of the first
 // corrupt/torn record (== file size when the log is clean).
 uint64_t scan(Lockbox* box) {
@@ -101,7 +111,7 @@ extern "C" {
 void* lockbox_open(const char* path) {
   auto* box = new Lockbox();
   box->path = path;
-  box->log = fopen(path, "ab+");
+  box->log = open_rw(path);
   if (!box->log) {
     delete box;
     return nullptr;
@@ -239,10 +249,11 @@ int lockbox_compact(void* h) {
   fclose(tmp);
   fclose(box->log);
   if (rename(tmp_path.c_str(), box->path.c_str()) != 0) {
-    box->log = fopen(box->path.c_str(), "ab+");
+    box->log = open_rw(box->path.c_str());
     return -1;
   }
-  box->log = fopen(box->path.c_str(), "ab+");
+  box->log = open_rw(box->path.c_str());
+  if (!box->log) return -1;
   box->index = std::move(new_index);
   box->log_size = off;
   return 0;

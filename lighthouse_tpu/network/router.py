@@ -131,9 +131,10 @@ class Router:
             chain.process_block(signed)
         except BlockError as e:
             if "unknown parent" in str(e) and self.sync is not None:
-                # don't penalize: we may simply be behind (reference queues
-                # for reprocessing + triggers a parent lookup)
-                self.service.forward(topic, compressed, exclude=sender)
+                # Don't penalize: we may simply be behind. But do NOT forward
+                # either — an unknown-parent block has passed no validation,
+                # so propagating it would relay junk (the reference queues it
+                # for reprocessing and only propagates validated blocks).
                 self.sync.on_unknown_parent(signed, sender)
                 return
             self.service.peer_manager.report(sender, PeerAction.LOW_TOLERANCE, f"bad block: {e}")

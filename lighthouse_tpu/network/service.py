@@ -128,7 +128,7 @@ class NetworkService:
         with self._req_lock:
             rid = self._next_request_id
             self._next_request_id += 1
-            entry = {"chunks": [], "done": threading.Event(), "protocol": protocol}
+            entry = {"chunks": [], "done": threading.Event(), "protocol": protocol, "peer": peer}
             self._pending[rid] = entry
         env = Envelope(
             kind="rpc_request",
@@ -233,6 +233,15 @@ class NetworkService:
         with self._req_lock:
             entry = self._pending.get(env.request_id)
         if entry is None:
+            return
+        if env.sender != entry["peer"]:
+            # Only the peer the request was sent to may answer it: request ids
+            # are a predictable counter, so without this check any connected
+            # peer could inject forged chunks into another peer's pending
+            # request (poisoning sync and misattributing penalties).
+            from .peer_manager import PeerAction
+
+            self.peer_manager.report(env.sender, PeerAction.LOW_TOLERANCE, "forged rpc response")
             return
         if env.data == b"":
             with self._req_lock:

@@ -12,7 +12,8 @@ hash-tree-root for free.  The pair-hash primitive is a seam
 device implementation without touching any container code.
 
 Spec: consensus-specs ssz/simple-serialize.md (the same document the reference
-implements; behavior cross-checked against ssz_static EF vectors in tests).
+implements; behavior checked against hand-derived known-answer roots in
+tests/test_ssz.py and the vendored conformance vectors in tests/vectors/).
 """
 
 from __future__ import annotations

@@ -28,3 +28,7 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 import jax  # noqa: E402  (env above must be set first)
 
 jax.config.update("jax_platforms", "cpu")
+# sitecustomize imports jax before this file runs, so the env vars above never
+# reach jax's config snapshot — set the compile cache through the live config.
+jax.config.update("jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
