@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+from .. import metrics
 from ..consensus import helpers as h
 from ..consensus.per_block import BlockProcessingError, BlockSignatureStrategy
 from ..consensus.per_slot import process_slots
@@ -25,6 +26,7 @@ from ..consensus.state_transition import state_transition
 from ..fork_choice import ExecutionStatus, ForkChoice, InvalidAttestation
 from ..store import HotColdDB, MemoryStore
 from ..types.spec import ChainSpec
+from .events import EventBus
 from .mock_el import MockExecutionEngine
 from .slot_clock import ManualSlotClock, SlotClock
 
@@ -80,23 +82,19 @@ class NaiveAggregationPool:
         """Attestations eligible for inclusion in a block on ``state``."""
         out = []
         state_slot = int(state.slot)
-        state_epoch = state_slot // spec.slots_per_epoch
-        post_deneb = spec.fork_name_at_slot(state_slot) not in (
-            "phase0", "altair", "bellatrix", "capella",
-        )
         for (slot, _), att in sorted(self._pool.items(), key=lambda kv: -kv[0][0]):
-            if slot + spec.min_attestation_inclusion_delay > state_slot:
-                continue
-            if post_deneb:
-                # EIP-7045: current- and previous-epoch attestations included.
-                if slot // spec.slots_per_epoch + 1 < state_epoch:
-                    continue
-            elif slot + spec.slots_per_epoch < state_slot:
+            if not spec.attestation_includable(slot, state_slot):
                 continue
             out.append(att)
             if len(out) >= limit:
                 break
         return out
+
+    def get_aggregate(self, slot: int, data_root: bytes):
+        """Best aggregate for (slot, attestation_data_root) — the
+        ``aggregate_attestation`` API's source (naive_aggregation_pool.rs get)."""
+        att = self._pool.get((int(slot), bytes(data_root)))
+        return None if att is None else att.copy()
 
     def prune(self, current_slot: int) -> None:
         cutoff = current_slot - self.SLOT_RETENTION
@@ -161,6 +159,8 @@ class BeaconChain:
         self.op_pool = OperationPool()
         self.observed_block_roots: set = set()
         self._migrated_slot = 0
+        self.events = EventBus()
+        self._last_finalized_epoch = 0
 
     # ------------------------------------------------------------- storage
 
@@ -197,6 +197,10 @@ class BeaconChain:
         ``process_block`` + ``:3362 import_block``): state catch-up, bulk
         signature verification, state-root check, payload notify, fork choice,
         persistence, head recompute."""
+        with metrics.BLOCK_IMPORT_SECONDS.time():
+            return self._process_block_inner(signed_block, block_delay_seconds)
+
+    def _process_block_inner(self, signed_block, block_delay_seconds):
         block = signed_block.message
         block_root = block.hash_tree_root()
         if block_root in self._blocks or block_root == self.genesis_block_root:
@@ -211,15 +215,16 @@ class BeaconChain:
 
         state = parent_state.copy()
         try:
-            state = state_transition(
-                state,
-                signed_block,
-                self.types,
-                self.spec,
-                strategy=BlockSignatureStrategy.VERIFY_BULK,
-                validate_result=True,
-                payload_verifier=self.execution_engine.notify_new_payload,
-            )
+            with metrics.BLOCK_STATE_TRANSITION_SECONDS.time():
+                state = state_transition(
+                    state,
+                    signed_block,
+                    self.types,
+                    self.spec,
+                    strategy=BlockSignatureStrategy.VERIFY_BULK,
+                    validate_result=True,
+                    payload_verifier=self.execution_engine.notify_new_payload,
+                )
         except (BlockProcessingError, ValueError) as e:
             raise BlockError(f"state transition failed: {e}") from e
 
@@ -259,7 +264,9 @@ class BeaconChain:
             except InvalidAttestation:
                 continue  # attestations for unknown forks don't block import
 
-        self.recompute_head()
+        with metrics.BLOCK_FORK_CHOICE_SECONDS.time():
+            self.recompute_head()
+        self.events.block(slot=int(block.slot), block_root=block_root)
         return block_root
 
     # ------------------------------------------------- attestation import
@@ -463,8 +470,32 @@ class BeaconChain:
 
     def recompute_head(self) -> bytes:
         """Reference ``canonical_head.rs:496`` ``recompute_head_at_slot``."""
+        old_head = self.head_root
         head = self.fork_choice.get_head(self.current_slot())
         self.head_root = head
+        if head != old_head and head in self._states:
+            st = self._states[head]
+            old_epoch = self._blocks_slot(old_head) // self.spec.slots_per_epoch
+            new_epoch = self._blocks_slot(head) // self.spec.slots_per_epoch
+            self.events.head(
+                slot=self._blocks_slot(head),
+                block_root=head,
+                state_root=bytes(self._blocks[head].message.state_root)
+                if head in self._blocks
+                else st.hash_tree_root(),
+                epoch_transition=new_epoch > old_epoch,
+            )
+        f_epoch, f_root = self.fork_choice.finalized_checkpoint
+        if f_epoch > self._last_finalized_epoch:
+            self._last_finalized_epoch = f_epoch
+            f_state = self._states.get(f_root)
+            self.events.finalized(
+                epoch=f_epoch,
+                block_root=f_root,
+                state_root=bytes(self._blocks[f_root].message.state_root)
+                if f_root in self._blocks
+                else (f_state.hash_tree_root() if f_state is not None else b"\x00" * 32),
+            )
         self._maybe_migrate()
         return head
 

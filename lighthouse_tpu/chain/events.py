@@ -1,0 +1,100 @@
+"""Server-sent-event bus for the beacon API ``/eth/v1/events`` stream.
+
+Equivalent of the reference's ``beacon_chain/src/events.rs`` (``ServerSentEventHandler``
+— per-topic broadcast channels the HTTP API subscribes to).  Subscribers get a
+bounded queue; slow consumers drop events rather than stall the chain.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, List, Optional, Tuple
+
+TOPIC_HEAD = "head"
+TOPIC_BLOCK = "block"
+TOPIC_ATTESTATION = "attestation"
+TOPIC_FINALIZED = "finalized_checkpoint"
+TOPIC_EXIT = "voluntary_exit"
+TOPIC_BLOB_SIDECAR = "blob_sidecar"
+TOPIC_CHAIN_REORG = "chain_reorg"
+
+ALL_TOPICS = (
+    TOPIC_HEAD,
+    TOPIC_BLOCK,
+    TOPIC_ATTESTATION,
+    TOPIC_FINALIZED,
+    TOPIC_EXIT,
+    TOPIC_BLOB_SIDECAR,
+    TOPIC_CHAIN_REORG,
+)
+
+
+class EventSubscription:
+    def __init__(self, topics: List[str], maxsize: int = 256):
+        self.topics = set(topics)
+        self.q: "queue.Queue[Tuple[str, dict]]" = queue.Queue(maxsize=maxsize)
+        self.dropped = 0
+
+    def poll(self, timeout: Optional[float] = None) -> Optional[Tuple[str, dict]]:
+        try:
+            return self.q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class EventBus:
+    def __init__(self) -> None:
+        self._subs: List[EventSubscription] = []
+        self._lock = threading.Lock()
+
+    def subscribe(self, topics: List[str]) -> EventSubscription:
+        bad = [t for t in topics if t not in ALL_TOPICS]
+        if bad:
+            raise ValueError(f"unknown event topics: {bad}")
+        sub = EventSubscription(topics)
+        with self._lock:
+            self._subs.append(sub)
+        return sub
+
+    def unsubscribe(self, sub: EventSubscription) -> None:
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+    def publish(self, topic: str, data: dict) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for sub in subs:
+            if topic in sub.topics:
+                try:
+                    sub.q.put_nowait((topic, data))
+                except queue.Full:
+                    sub.dropped += 1
+
+    # Convenience emitters mirroring the reference's EventKind variants.
+
+    def head(self, *, slot: int, block_root: bytes, state_root: bytes,
+             epoch_transition: bool) -> None:
+        self.publish(TOPIC_HEAD, {
+            "slot": str(slot),
+            "block": "0x" + block_root.hex(),
+            "state": "0x" + state_root.hex(),
+            "epoch_transition": epoch_transition,
+            "execution_optimistic": False,
+        })
+
+    def block(self, *, slot: int, block_root: bytes) -> None:
+        self.publish(TOPIC_BLOCK, {
+            "slot": str(slot),
+            "block": "0x" + block_root.hex(),
+            "execution_optimistic": False,
+        })
+
+    def finalized(self, *, epoch: int, block_root: bytes, state_root: bytes) -> None:
+        self.publish(TOPIC_FINALIZED, {
+            "epoch": str(epoch),
+            "block": "0x" + block_root.hex(),
+            "state": "0x" + state_root.hex(),
+            "execution_optimistic": False,
+        })

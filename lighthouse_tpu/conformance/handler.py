@@ -42,6 +42,8 @@ class Case:
         p = os.path.join(self.path, name)
         if not os.path.exists(p):
             return None
+        if yaml is None:
+            raise RuntimeError("PyYAML is required to load spec-test yaml cases")
         with open(p) as f:
             return yaml.safe_load(f)
 
@@ -52,7 +54,7 @@ class Case:
             from ..network import snappy_codec
 
             with open(p, "rb") as f:
-                return snappy_codec.decompress_raw(f.read())
+                return snappy_codec.decompress(f.read())
         p = os.path.join(self.path, name + ".ssz")
         if os.path.exists(p):
             with open(p, "rb") as f:

@@ -1,0 +1,180 @@
+"""Typed beacon-node HTTP client.
+
+Equivalent of the reference's ``common/eth2`` crate (``BeaconNodeHttpClient``
+— the client the validator client, lcli, and tests drive every beacon node
+through).  stdlib ``urllib`` over TCP; JSON wire format.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from .serde import container_from_json, to_json
+
+
+class ApiClientError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class BeaconNodeHttpClient:
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # ------------------------------------------------------------- plumbing
+
+    def _request(self, method: str, path: str, body: Any = None,
+                 headers: Optional[Dict[str, str]] = None) -> Any:
+        url = self.base_url + path
+        data = None
+        hdrs = {"Accept": "application/json"}
+        if body is not None:
+            data = json.dumps(body).encode()
+            hdrs["Content-Type"] = "application/json"
+        if headers:
+            hdrs.update(headers)
+        req = urllib.request.Request(url, data=data, method=method, headers=hdrs)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                raw = resp.read()
+                if not raw:
+                    return None
+                return json.loads(raw)
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            try:
+                payload = json.loads(raw)
+                msg = payload.get("message", raw.decode(errors="replace"))
+            except (json.JSONDecodeError, AttributeError):
+                msg = raw.decode(errors="replace")
+            raise ApiClientError(e.code, msg) from None
+
+    def get(self, path: str) -> Any:
+        return self._request("GET", path)
+
+    def post(self, path: str, body: Any = None,
+             headers: Optional[Dict[str, str]] = None) -> Any:
+        return self._request("POST", path, body, headers)
+
+    # ----------------------------------------------------------------- node
+
+    def node_version(self) -> str:
+        return self.get("/eth/v1/node/version")["data"]["version"]
+
+    def node_syncing(self) -> dict:
+        return self.get("/eth/v1/node/syncing")["data"]
+
+    def node_health_ok(self) -> bool:
+        try:
+            self.get("/eth/v1/node/health")
+            return True
+        except ApiClientError:
+            return False
+
+    # --------------------------------------------------------------- beacon
+
+    def genesis(self) -> dict:
+        return self.get("/eth/v1/beacon/genesis")["data"]
+
+    def state_fork(self, state_id: str = "head") -> dict:
+        return self.get(f"/eth/v1/beacon/states/{state_id}/fork")["data"]
+
+    def state_root(self, state_id: str = "head") -> bytes:
+        data = self.get(f"/eth/v1/beacon/states/{state_id}/root")["data"]
+        return bytes.fromhex(data["root"][2:])
+
+    def finality_checkpoints(self, state_id: str = "head") -> dict:
+        return self.get(f"/eth/v1/beacon/states/{state_id}/finality_checkpoints")["data"]
+
+    def validators(self, state_id: str = "head",
+                   ids: Optional[List[str]] = None) -> List[dict]:
+        path = f"/eth/v1/beacon/states/{state_id}/validators"
+        if ids:
+            path += "?id=" + ",".join(str(i) for i in ids)
+        return self.get(path)["data"]
+
+    def block_header(self, block_id: str = "head") -> dict:
+        return self.get(f"/eth/v1/beacon/headers/{block_id}")["data"]
+
+    def block(self, block_id: str = "head") -> dict:
+        return self.get(f"/eth/v2/beacon/blocks/{block_id}")
+
+    def block_root(self, block_id: str = "head") -> bytes:
+        data = self.get(f"/eth/v1/beacon/blocks/{block_id}/root")["data"]
+        return bytes.fromhex(data["root"][2:])
+
+    def publish_block(self, signed_block) -> None:
+        fork = type(signed_block.message).fork_name
+        self.post(
+            "/eth/v2/beacon/blocks",
+            to_json(signed_block),
+            headers={"Eth-Consensus-Version": fork},
+        )
+
+    def submit_attestations(self, attestations) -> None:
+        self.post(
+            "/eth/v1/beacon/pool/attestations",
+            [to_json(a) for a in attestations],
+        )
+
+    def submit_voluntary_exit(self, signed_exit) -> None:
+        self.post("/eth/v1/beacon/pool/voluntary_exits", to_json(signed_exit))
+
+    # ------------------------------------------------------------ validator
+
+    def proposer_duties(self, epoch: int) -> dict:
+        return self.get(f"/eth/v1/validator/duties/proposer/{epoch}")
+
+    def attester_duties(self, epoch: int, indices: List[int]) -> dict:
+        return self.post(
+            f"/eth/v1/validator/duties/attester/{epoch}",
+            [str(i) for i in indices],
+        )
+
+    def sync_duties(self, epoch: int, indices: List[int]) -> dict:
+        return self.post(
+            f"/eth/v1/validator/duties/sync/{epoch}",
+            [str(i) for i in indices],
+        )
+
+    def produce_block(self, slot: int, randao_reveal: bytes,
+                      graffiti: Optional[bytes] = None) -> dict:
+        path = f"/eth/v3/validator/blocks/{slot}?randao_reveal=0x{randao_reveal.hex()}"
+        if graffiti:
+            path += f"&graffiti=0x{graffiti.hex()}"
+        return self.get(path)
+
+    def attestation_data(self, slot: int, committee_index: int, types=None):
+        data = self.get(
+            f"/eth/v1/validator/attestation_data?slot={slot}"
+            f"&committee_index={committee_index}"
+        )["data"]
+        if types is not None:
+            return container_from_json(types.AttestationData, data)
+        return data
+
+    def aggregate_attestation(self, slot: int, data_root: bytes, types=None):
+        data = self.get(
+            f"/eth/v2/validator/aggregate_attestation"
+            f"?attestation_data_root=0x{data_root.hex()}&slot={slot}"
+        )["data"]
+        if types is not None:
+            return container_from_json(types.Attestation, data)
+        return data
+
+    def publish_aggregate_and_proofs(self, signed_aggregates) -> None:
+        self.post(
+            "/eth/v1/validator/aggregate_and_proofs",
+            [to_json(a) for a in signed_aggregates],
+        )
+
+    # --------------------------------------------------------------- config
+
+    def config_spec(self) -> dict:
+        return self.get("/eth/v1/config/spec")["data"]

@@ -1,0 +1,1160 @@
+"""The beacon-node HTTP API server.
+
+Equivalent of the reference's ``beacon_node/http_api`` crate
+(``src/lib.rs`` — the warp route table, 205 routes; handlers dispatched
+through the priority scheduler via ``task_spawner.rs``).  This implements the
+contract surface the validator client and sync tooling need: node status,
+beacon state/block queries, pool submissions, validator duties + block
+production, SSE events, config, debug, and Prometheus ``/metrics``.
+
+Transport: stdlib ``ThreadingHTTPServer`` (one thread per connection — the
+Python analog of warp's task-per-request; real work still funnels through the
+``BeaconProcessor`` so API load obeys the same drain order as gossip).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from .. import metrics
+from ..chain import events as ev
+from ..consensus import helpers as h
+from .serde import container_from_json, to_json
+from .task_spawner import P0, P1, OverloadedError, TaskSpawner
+
+VERSION_STRING = "lighthouse-tpu/0.2.0"
+FAR_FUTURE_EPOCH = 2**64 - 1
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+def _not_found(what: str) -> ApiError:
+    return ApiError(404, f"NOT_FOUND: {what}")
+
+
+def _bad(msg: str) -> ApiError:
+    return ApiError(400, f"BAD_REQUEST: {msg}")
+
+
+# --------------------------------------------------------------- id parsing
+
+
+def parse_root_or_slot(s: str) -> Tuple[Optional[bytes], Optional[int]]:
+    if s.startswith("0x"):
+        try:
+            root = bytes.fromhex(s[2:])
+        except ValueError:
+            raise _bad(f"invalid root {s!r}")
+        if len(root) != 32:
+            raise _bad(f"root must be 32 bytes: {s!r}")
+        return root, None
+    try:
+        return None, int(s)
+    except ValueError:
+        raise _bad(f"invalid block/state id {s!r}")
+
+
+class Context:
+    """Everything a route handler needs."""
+
+    def __init__(self, server: "HttpApiServer", params: Dict[str, str],
+                 query: Dict[str, List[str]], body: Any, headers):
+        self.server = server
+        self.chain = server.chain
+        self.params = params
+        self.query = query
+        self.body = body
+        self.headers = headers
+
+    def q1(self, name: str, default: Optional[str] = None) -> Optional[str]:
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+    # ------------------------------------------------------- id resolution
+
+    def resolve_block_root(self, block_id: str) -> bytes:
+        chain = self.chain
+        if block_id == "head":
+            return chain.head_root
+        if block_id == "genesis":
+            return chain.genesis_block_root
+        if block_id == "finalized":
+            return chain.finalized_checkpoint()[1]
+        if block_id == "justified":
+            return chain.justified_checkpoint()[1]
+        root, slot = parse_root_or_slot(block_id)
+        if root is not None:
+            if chain.get_block(root) is None and root != chain.genesis_block_root:
+                if chain.db.get_block(root) is None:
+                    raise _not_found(f"block {block_id}")
+            return root
+        found = chain.block_root_at_slot(slot)
+        if found is None:
+            raise _not_found(f"block at slot {slot}")
+        return found
+
+    def resolve_block(self, block_id: str):
+        root = self.resolve_block_root(block_id)
+        block = self.chain.get_block(root) or self.chain.db.get_block(root)
+        if block is None:
+            if root == self.chain.genesis_block_root:
+                raise _not_found("genesis block body is not stored")
+            raise _not_found(f"block {block_id}")
+        return root, block
+
+    def resolve_state(self, state_id: str):
+        """Returns (state, block_root). ``state_id``: head|genesis|finalized|
+        justified|<slot>|<0xstate_root>."""
+        chain = self.chain
+        if state_id == "head":
+            return chain.head_state, chain.head_root
+        if state_id == "genesis":
+            return chain.genesis_state, chain.genesis_block_root
+        if state_id in ("finalized", "justified"):
+            _, root = (
+                chain.finalized_checkpoint()
+                if state_id == "finalized"
+                else chain.justified_checkpoint()
+            )
+            state = chain.get_state(root)
+            if state is None:
+                raise _not_found(f"{state_id} state pruned")
+            return state, root
+        root, slot = parse_root_or_slot(state_id)
+        if root is not None:
+            for broot, st in chain._states.items():
+                if st.hash_tree_root() == root:
+                    return st, broot
+            st = chain.db.get_hot_state(root)
+            if st is None:
+                raise _not_found(f"state {state_id}")
+            return st, b"\x00" * 32
+        state, root = chain.state_at_slot(slot)
+        return state, root
+
+
+# ------------------------------------------------------------------ routes
+
+ROUTES: List[Tuple[str, str, str, Callable[[Context], Any]]] = []
+
+
+def route(method: str, pattern: str, priority: str = P1):
+    segs = pattern.strip("/").split("/")
+
+    def deco(fn):
+        ROUTES.append((method, pattern, priority, fn))
+        fn._segs = segs
+        return fn
+
+    return deco
+
+
+def match_route(method: str, path: str):
+    path_segs = path.strip("/").split("/")
+    for m, pattern, priority, fn in ROUTES:
+        if m != method:
+            continue
+        segs = pattern.strip("/").split("/")
+        if len(segs) != len(path_segs):
+            continue
+        params = {}
+        ok = True
+        for want, got in zip(segs, path_segs):
+            if want.startswith("{") and want.endswith("}"):
+                params[want[1:-1]] = got
+            elif want != got:
+                ok = False
+                break
+        if ok:
+            return priority, fn, params
+    return None
+
+
+# ------------------------------------------------------------- node routes
+
+
+@route("GET", "/eth/v1/node/version")
+def node_version(ctx):
+    return {"data": {"version": VERSION_STRING}}
+
+
+@route("GET", "/eth/v1/node/identity")
+def node_identity(ctx):
+    peer_id = getattr(ctx.server, "peer_id", "") or ""
+    return {"data": {
+        "peer_id": peer_id,
+        "enr": "",
+        "p2p_addresses": [],
+        "discovery_addresses": [],
+        "metadata": {"seq_number": "0", "attnets": "0x" + "00" * 8, "syncnets": "0x00"},
+    }}
+
+
+@route("GET", "/eth/v1/node/syncing")
+def node_syncing(ctx):
+    chain = ctx.chain
+    head_slot = chain._blocks_slot(chain.head_root)
+    current = chain.current_slot()
+    distance = max(0, current - head_slot)
+    return {"data": {
+        "head_slot": str(head_slot),
+        "sync_distance": str(distance),
+        "is_syncing": distance > 1,
+        "is_optimistic": False,
+        "el_offline": False,
+    }}
+
+
+@route("GET", "/eth/v1/node/health")
+def node_health(ctx):
+    chain = ctx.chain
+    distance = chain.current_slot() - chain._blocks_slot(chain.head_root)
+    raise ApiError(200 if distance <= 1 else 206, "")
+
+
+@route("GET", "/eth/v1/node/peers")
+def node_peers(ctx):
+    peers = []
+    pm = getattr(ctx.server, "peer_manager", None)
+    if pm is not None:
+        for pid, info in pm.peers().items():
+            peers.append({
+                "peer_id": str(pid),
+                "enr": "",
+                "last_seen_p2p_address": "",
+                "state": "connected" if info.connected else "disconnected",
+                "direction": "outbound",
+            })
+    return {"data": peers, "meta": {"count": len(peers)}}
+
+
+@route("GET", "/eth/v1/node/peer_count")
+def node_peer_count(ctx):
+    pm = getattr(ctx.server, "peer_manager", None)
+    n = len([p for p in pm.peers().values() if p.connected]) if pm else 0
+    return {"data": {
+        "connected": str(n), "connecting": "0", "disconnected": "0", "disconnecting": "0",
+    }}
+
+
+# ----------------------------------------------------------- beacon routes
+
+
+@route("GET", "/eth/v1/beacon/genesis")
+def beacon_genesis(ctx):
+    chain = ctx.chain
+    return {"data": {
+        "genesis_time": str(chain.genesis_time),
+        "genesis_validators_root": "0x" + chain.genesis_validators_root.hex(),
+        "genesis_fork_version": "0x" + chain.spec.genesis_fork_version.hex(),
+    }}
+
+
+def _finality_meta(ctx, block_root):
+    f_epoch, f_root = ctx.chain.finalized_checkpoint()
+    try:
+        slot = ctx.chain._blocks_slot(block_root)
+        finalized = slot <= f_epoch * ctx.chain.spec.slots_per_epoch
+    except KeyError:
+        finalized = False
+    return {"execution_optimistic": False, "finalized": finalized}
+
+
+@route("GET", "/eth/v1/beacon/states/{state_id}/root")
+def state_root(ctx):
+    state, broot = ctx.resolve_state(ctx.params["state_id"])
+    out = {"data": {"root": "0x" + state.hash_tree_root().hex()}}
+    out.update(_finality_meta(ctx, broot))
+    return out
+
+
+@route("GET", "/eth/v1/beacon/states/{state_id}/fork")
+def state_fork(ctx):
+    state, broot = ctx.resolve_state(ctx.params["state_id"])
+    out = {"data": to_json(state.fork)}
+    out.update(_finality_meta(ctx, broot))
+    return out
+
+
+@route("GET", "/eth/v1/beacon/states/{state_id}/finality_checkpoints")
+def state_finality(ctx):
+    state, broot = ctx.resolve_state(ctx.params["state_id"])
+    out = {"data": {
+        "previous_justified": to_json(state.previous_justified_checkpoint),
+        "current_justified": to_json(state.current_justified_checkpoint),
+        "finalized": to_json(state.finalized_checkpoint),
+    }}
+    out.update(_finality_meta(ctx, broot))
+    return out
+
+
+def validator_status(v, balance: int, epoch: int) -> str:
+    """The standard beacon-API validator status taxonomy
+    (reference ``consensus/types/src/validator.rs`` + api spec)."""
+    if epoch < int(v.activation_eligibility_epoch):
+        return "pending_initialized"
+    if epoch < int(v.activation_epoch):
+        return "pending_queued"
+    if epoch < int(v.exit_epoch):
+        if int(v.exit_epoch) == FAR_FUTURE_EPOCH:
+            return "active_ongoing"
+        return "active_slashed" if v.slashed else "active_exiting"
+    if epoch < int(v.withdrawable_epoch):
+        return "exited_slashed" if v.slashed else "exited_unslashed"
+    return "withdrawal_possible" if balance > 0 else "withdrawal_done"
+
+
+def _validator_entry(state, i: int, epoch: int) -> dict:
+    v = state.validators[i]
+    bal = int(state.balances[i])
+    return {
+        "index": str(i),
+        "balance": str(bal),
+        "status": validator_status(v, bal, epoch),
+        "validator": to_json(v),
+    }
+
+
+def _parse_validator_id(state, vid: str) -> Optional[int]:
+    if vid.startswith("0x"):
+        pk = bytes.fromhex(vid[2:])
+        for i, v in enumerate(state.validators):
+            if bytes(v.pubkey) == pk:
+                return i
+        return None
+    idx = int(vid)
+    return idx if idx < len(state.validators) else None
+
+
+@route("GET", "/eth/v1/beacon/states/{state_id}/validators")
+def state_validators(ctx):
+    state, broot = ctx.resolve_state(ctx.params["state_id"])
+    epoch = h.get_current_epoch(state, ctx.chain.spec)
+    ids = ctx.query.get("id")
+    statuses = set(ctx.query.get("status", []))
+    if ids:
+        wanted = []
+        for vid in ids:
+            for part in vid.split(","):
+                i = _parse_validator_id(state, part)
+                if i is not None:
+                    wanted.append(i)
+    else:
+        wanted = range(len(state.validators))
+    data = [_validator_entry(state, i, epoch) for i in wanted]
+    if statuses:
+        data = [d for d in data if d["status"] in statuses]
+    out = {"data": data}
+    out.update(_finality_meta(ctx, broot))
+    return out
+
+
+@route("POST", "/eth/v1/beacon/states/{state_id}/validators")
+def state_validators_post(ctx):
+    body = ctx.body or {}
+    ctx.query = dict(ctx.query)
+    if body.get("ids"):
+        ctx.query["id"] = [str(x) for x in body["ids"]]
+    if body.get("statuses"):
+        ctx.query["status"] = list(body["statuses"])
+    return state_validators(ctx)
+
+
+@route("GET", "/eth/v1/beacon/states/{state_id}/validators/{validator_id}")
+def state_validator(ctx):
+    state, broot = ctx.resolve_state(ctx.params["state_id"])
+    epoch = h.get_current_epoch(state, ctx.chain.spec)
+    i = _parse_validator_id(state, ctx.params["validator_id"])
+    if i is None:
+        raise _not_found(f"validator {ctx.params['validator_id']}")
+    out = {"data": _validator_entry(state, i, epoch)}
+    out.update(_finality_meta(ctx, broot))
+    return out
+
+
+@route("GET", "/eth/v1/beacon/states/{state_id}/validator_balances")
+def state_balances(ctx):
+    state, broot = ctx.resolve_state(ctx.params["state_id"])
+    ids = ctx.query.get("id")
+    if ids:
+        wanted = []
+        for vid in ids:
+            for part in vid.split(","):
+                i = _parse_validator_id(state, part)
+                if i is not None:
+                    wanted.append(i)
+    else:
+        wanted = range(len(state.balances))
+    out = {"data": [
+        {"index": str(i), "balance": str(int(state.balances[i]))} for i in wanted
+    ]}
+    out.update(_finality_meta(ctx, broot))
+    return out
+
+
+@route("GET", "/eth/v1/beacon/states/{state_id}/committees")
+def state_committees(ctx):
+    state, broot = ctx.resolve_state(ctx.params["state_id"])
+    spec = ctx.chain.spec
+    epoch = (
+        int(ctx.q1("epoch"))
+        if ctx.q1("epoch") is not None
+        else h.get_current_epoch(state, spec)
+    )
+    want_index = ctx.q1("index")
+    want_slot = ctx.q1("slot")
+    data = []
+    for slot in range(
+        epoch * spec.slots_per_epoch, (epoch + 1) * spec.slots_per_epoch
+    ):
+        if want_slot is not None and slot != int(want_slot):
+            continue
+        count = h.get_committee_count_per_slot(state, epoch, spec)
+        for index in range(count):
+            if want_index is not None and index != int(want_index):
+                continue
+            committee = h.get_beacon_committee(state, slot, index, spec)
+            data.append({
+                "index": str(index),
+                "slot": str(slot),
+                "validators": [str(int(v)) for v in committee],
+            })
+    out = {"data": data}
+    out.update(_finality_meta(ctx, broot))
+    return out
+
+
+@route("GET", "/eth/v1/beacon/states/{state_id}/sync_committees")
+def state_sync_committees(ctx):
+    state, broot = ctx.resolve_state(ctx.params["state_id"])
+    if not hasattr(state, "current_sync_committee"):
+        raise _bad("state has no sync committees (phase0)")
+    pk_to_index = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
+    indices = [
+        pk_to_index[bytes(pk)] for pk in state.current_sync_committee.pubkeys
+    ]
+    sub_size = max(1, len(indices) // 4)
+    out = {"data": {
+        "validators": [str(i) for i in indices],
+        "validator_aggregates": [
+            [str(i) for i in indices[k : k + sub_size]]
+            for k in range(0, len(indices), sub_size)
+        ],
+    }}
+    out.update(_finality_meta(ctx, broot))
+    return out
+
+
+@route("GET", "/eth/v1/beacon/states/{state_id}/randao")
+def state_randao(ctx):
+    state, broot = ctx.resolve_state(ctx.params["state_id"])
+    spec = ctx.chain.spec
+    epoch = (
+        int(ctx.q1("epoch"))
+        if ctx.q1("epoch") is not None
+        else h.get_current_epoch(state, spec)
+    )
+    mix = h.get_randao_mix(state, epoch, spec)
+    out = {"data": {"randao": "0x" + bytes(mix).hex()}}
+    out.update(_finality_meta(ctx, broot))
+    return out
+
+
+def _header_json(ctx, root: bytes, signed_block) -> dict:
+    msg = signed_block.message
+    header = {
+        "slot": str(int(msg.slot)),
+        "proposer_index": str(int(msg.proposer_index)),
+        "parent_root": "0x" + bytes(msg.parent_root).hex(),
+        "state_root": "0x" + bytes(msg.state_root).hex(),
+        "body_root": "0x" + msg.body.hash_tree_root().hex(),
+    }
+    return {
+        "root": "0x" + root.hex(),
+        "canonical": ctx.chain.block_root_at_slot(int(msg.slot)) == root,
+        "header": {
+            "message": header,
+            "signature": "0x" + bytes(signed_block.signature).hex(),
+        },
+    }
+
+
+@route("GET", "/eth/v1/beacon/headers")
+def beacon_headers(ctx):
+    slot = ctx.q1("slot")
+    parent_root = ctx.q1("parent_root")
+    chain = ctx.chain
+    results = []
+    if slot is not None:
+        root = chain.block_root_at_slot(int(slot))
+        if root is not None and chain.get_block(root) is not None:
+            results.append((root, chain.get_block(root)))
+    elif parent_root is not None:
+        want = bytes.fromhex(parent_root[2:])
+        for root, blk in chain._blocks.items():
+            if bytes(blk.message.parent_root) == want:
+                results.append((root, blk))
+    else:
+        root = chain.head_root
+        blk = chain.get_block(root)
+        if blk is not None:
+            results.append((root, blk))
+    return {
+        "data": [_header_json(ctx, r, b) for r, b in results],
+        "execution_optimistic": False,
+        "finalized": False,
+    }
+
+
+@route("GET", "/eth/v1/beacon/headers/{block_id}")
+def beacon_header(ctx):
+    root, block = ctx.resolve_block(ctx.params["block_id"])
+    out = {"data": _header_json(ctx, root, block)}
+    out.update(_finality_meta(ctx, root))
+    return out
+
+
+@route("GET", "/eth/v2/beacon/blocks/{block_id}")
+def beacon_block(ctx):
+    root, block = ctx.resolve_block(ctx.params["block_id"])
+    out = {
+        "version": type(block.message).fork_name,
+        "data": to_json(block),
+    }
+    out.update(_finality_meta(ctx, root))
+    return out
+
+
+@route("GET", "/eth/v1/beacon/blocks/{block_id}/root")
+def beacon_block_root(ctx):
+    root = ctx.resolve_block_root(ctx.params["block_id"])
+    out = {"data": {"root": "0x" + root.hex()}}
+    out.update(_finality_meta(ctx, root))
+    return out
+
+
+@route("GET", "/eth/v1/beacon/blocks/{block_id}/attestations")
+def beacon_block_attestations(ctx):
+    root, block = ctx.resolve_block(ctx.params["block_id"])
+    out = {"data": [to_json(a) for a in block.message.body.attestations]}
+    out.update(_finality_meta(ctx, root))
+    return out
+
+
+@route("GET", "/eth/v1/beacon/blob_sidecars/{block_id}")
+def beacon_blob_sidecars(ctx):
+    root, _ = ctx.resolve_block(ctx.params["block_id"])
+    sidecars = ctx.chain.get_blobs(root) if hasattr(ctx.chain, "get_blobs") else []
+    indices = ctx.query.get("indices")
+    if indices:
+        want = {int(i) for x in indices for i in x.split(",")}
+        sidecars = [s for s in sidecars if int(s.index) in want]
+    return {"data": [to_json(s) for s in sidecars]}
+
+
+def _signed_block_from_json(ctx, body) -> Any:
+    types, spec = ctx.chain.types, ctx.chain.spec
+    version = None
+    for k in ("Eth-Consensus-Version", "eth-consensus-version"):
+        if ctx.headers.get(k):
+            version = ctx.headers.get(k).lower()
+            break
+    if version is None:
+        slot = int(body["message"]["slot"])
+        version = spec.fork_name_at_slot(slot)
+    cls = types.signed_block.get(version)
+    if cls is None:
+        raise _bad(f"unknown consensus version {version!r}")
+    return container_from_json(cls, body)
+
+
+def _import_and_publish_block(ctx, signed_block):
+    from ..chain.beacon_chain import BlockError
+
+    chain = ctx.chain
+    try:
+        chain.process_block(signed_block)
+    except BlockError as e:
+        if "unknown parent" in str(e):
+            raise ApiError(202, f"block queued: {e}")
+        raise _bad(f"invalid block: {e}")
+    publish = getattr(ctx.server, "publish_block_fn", None)
+    if publish is not None:
+        publish(signed_block)
+    return None
+
+
+@route("POST", "/eth/v1/beacon/blocks", P0)
+def publish_block_v1(ctx):
+    return _import_and_publish_block(ctx, _signed_block_from_json(ctx, ctx.body))
+
+
+@route("POST", "/eth/v2/beacon/blocks", P0)
+def publish_block_v2(ctx):
+    return _import_and_publish_block(ctx, _signed_block_from_json(ctx, ctx.body))
+
+
+# -------------------------------------------------------------- pool routes
+
+
+@route("POST", "/eth/v1/beacon/pool/attestations", P0)
+def pool_attestations_post(ctx):
+    from ..chain.beacon_chain import AttestationError
+
+    chain = ctx.chain
+    failures = []
+    for i, att_json in enumerate(ctx.body or []):
+        try:
+            att = container_from_json(chain.types.Attestation, att_json)
+            chain.process_attestation(att)
+            publish = getattr(ctx.server, "publish_attestation_fn", None)
+            if publish is not None:
+                publish(att)
+        except (AttestationError, KeyError, ValueError) as e:
+            failures.append({"index": i, "message": str(e)})
+    if failures:
+        raise ApiError(400, json.dumps({
+            "code": 400,
+            "message": "error processing attestations",
+            "failures": failures,
+        }))
+    return None
+
+
+@route("GET", "/eth/v1/beacon/pool/attestations")
+def pool_attestations_get(ctx):
+    atts = list(ctx.chain.attestation_pool._pool.values())
+    return {"data": [to_json(a) for a in atts]}
+
+
+@route("POST", "/eth/v1/beacon/pool/voluntary_exits", P0)
+def pool_exits_post(ctx):
+    from ..consensus.per_block import process_voluntary_exit
+
+    chain = ctx.chain
+    exit_ = container_from_json(chain.types.SignedVoluntaryExit, ctx.body)
+    # Validate against a head-state scratch before pooling (the reference's
+    # verify_operation path).
+    try:
+        process_voluntary_exit(
+            chain.head_state.copy(), exit_, chain.types, chain.spec, verify=True
+        )
+    except Exception as e:
+        raise _bad(f"invalid voluntary exit: {e}")
+    chain.op_pool.insert_voluntary_exit(exit_)
+    chain.events.publish(ev.TOPIC_EXIT, to_json(exit_))
+    return None
+
+
+@route("GET", "/eth/v1/beacon/pool/voluntary_exits")
+def pool_exits_get(ctx):
+    return {"data": [to_json(e) for e in ctx.chain.op_pool._voluntary_exits.values()]}
+
+
+@route("POST", "/eth/v1/beacon/pool/proposer_slashings", P0)
+def pool_proposer_slashings_post(ctx):
+    chain = ctx.chain
+    slashing = container_from_json(chain.types.ProposerSlashing, ctx.body)
+    chain.op_pool.insert_proposer_slashing(slashing)
+    return None
+
+
+@route("GET", "/eth/v1/beacon/pool/proposer_slashings")
+def pool_proposer_slashings_get(ctx):
+    return {"data": [to_json(s) for s in ctx.chain.op_pool._proposer_slashings.values()]}
+
+
+@route("POST", "/eth/v1/beacon/pool/attester_slashings", P0)
+def pool_attester_slashings_post(ctx):
+    chain = ctx.chain
+    slashing = container_from_json(chain.types.AttesterSlashing, ctx.body)
+    chain.op_pool.insert_attester_slashing(slashing)
+    return None
+
+
+@route("GET", "/eth/v1/beacon/pool/attester_slashings")
+def pool_attester_slashings_get(ctx):
+    return {"data": [to_json(s) for s in ctx.chain.op_pool._attester_slashings]}
+
+
+@route("POST", "/eth/v1/beacon/pool/bls_to_execution_changes", P0)
+def pool_bls_changes_post(ctx):
+    chain = ctx.chain
+    for change_json in ctx.body or []:
+        change = container_from_json(chain.types.SignedBLSToExecutionChange, change_json)
+        chain.op_pool.insert_bls_to_execution_change(change)
+    return None
+
+
+# --------------------------------------------------------- validator routes
+
+
+def _advance_to_epoch(ctx, epoch: int):
+    """Head state advanced (empty slots) to the start of ``epoch``."""
+    chain = ctx.chain
+    spec = chain.spec
+    state = chain.head_state
+    target = epoch * spec.slots_per_epoch
+    if int(state.slot) < target:
+        state, _ = chain.state_at_slot(target)
+    return state
+
+
+def _dependent_root(ctx, epoch: int) -> bytes:
+    """Block root the duties depend on (last block before epoch start)."""
+    chain = ctx.chain
+    slot = epoch * chain.spec.slots_per_epoch
+    if slot == 0:
+        return chain.genesis_block_root
+    root = chain.block_root_at_slot(slot - 1)
+    return root if root is not None else chain.genesis_block_root
+
+
+@route("GET", "/eth/v1/validator/duties/proposer/{epoch}", P0)
+def duties_proposer(ctx):
+    chain = ctx.chain
+    spec = chain.spec
+    epoch = int(ctx.params["epoch"])
+    state = _advance_to_epoch(ctx, epoch)
+    duties = []
+    state = state.copy()
+    from ..consensus.per_slot import process_slots
+
+    for slot in range(epoch * spec.slots_per_epoch, (epoch + 1) * spec.slots_per_epoch):
+        if int(state.slot) < slot:
+            process_slots(state, slot, chain.types, spec)
+        proposer = h.get_beacon_proposer_index(state, spec)
+        duties.append({
+            "pubkey": "0x" + bytes(state.validators[proposer].pubkey).hex(),
+            "validator_index": str(proposer),
+            "slot": str(slot),
+        })
+    return {
+        "dependent_root": "0x" + _dependent_root(ctx, epoch).hex(),
+        "execution_optimistic": False,
+        "data": duties,
+    }
+
+
+@route("POST", "/eth/v1/validator/duties/attester/{epoch}", P0)
+def duties_attester(ctx):
+    chain = ctx.chain
+    spec = chain.spec
+    epoch = int(ctx.params["epoch"])
+    indices = [int(i) for i in (ctx.body or [])]
+    state = _advance_to_epoch(ctx, epoch)
+    committees_per_slot = h.get_committee_count_per_slot(state, epoch, spec)
+    wanted = set(indices)
+    duties = []
+    for slot in range(epoch * spec.slots_per_epoch, (epoch + 1) * spec.slots_per_epoch):
+        for index in range(committees_per_slot):
+            committee = h.get_beacon_committee(state, slot, index, spec)
+            for pos, vidx in enumerate(committee):
+                if int(vidx) in wanted:
+                    duties.append({
+                        "pubkey": "0x" + bytes(state.validators[int(vidx)].pubkey).hex(),
+                        "validator_index": str(int(vidx)),
+                        "committee_index": str(index),
+                        "committee_length": str(len(committee)),
+                        "committees_at_slot": str(committees_per_slot),
+                        "validator_committee_index": str(pos),
+                        "slot": str(slot),
+                    })
+    return {
+        "dependent_root": "0x" + _dependent_root(ctx, max(epoch - 1, 0)).hex(),
+        "execution_optimistic": False,
+        "data": duties,
+    }
+
+
+@route("POST", "/eth/v1/validator/duties/sync/{epoch}", P0)
+def duties_sync(ctx):
+    chain = ctx.chain
+    epoch = int(ctx.params["epoch"])
+    indices = {int(i) for i in (ctx.body or [])}
+    state = _advance_to_epoch(ctx, epoch)
+    if not hasattr(state, "current_sync_committee"):
+        return {"data": [], "execution_optimistic": False}
+    pk_to_index = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
+    duties: Dict[int, List[int]] = {}
+    for pos, pk in enumerate(state.current_sync_committee.pubkeys):
+        vidx = pk_to_index.get(bytes(pk))
+        if vidx is not None and vidx in indices:
+            duties.setdefault(vidx, []).append(pos)
+    return {
+        "execution_optimistic": False,
+        "data": [
+            {
+                "pubkey": "0x" + bytes(state.validators[vidx].pubkey).hex(),
+                "validator_index": str(vidx),
+                "validator_sync_committee_indices": [str(p) for p in positions],
+            }
+            for vidx, positions in duties.items()
+        ],
+    }
+
+
+@route("GET", "/eth/v3/validator/blocks/{slot}", P0)
+def produce_block_v3(ctx):
+    chain = ctx.chain
+    slot = int(ctx.params["slot"])
+    reveal = ctx.q1("randao_reveal")
+    if reveal is None:
+        raise _bad("randao_reveal is required")
+    graffiti = ctx.q1("graffiti")
+    kwargs = {}
+    if graffiti:
+        kwargs["graffiti"] = bytes.fromhex(graffiti[2:]).ljust(32, b"\x00")
+    block, _ = chain.produce_block(slot, bytes.fromhex(reveal[2:]), **kwargs)
+    return {
+        "version": type(block).fork_name,
+        "execution_payload_blinded": False,
+        "execution_payload_value": "0",
+        "consensus_block_value": "0",
+        "data": to_json(block),
+    }
+
+
+@route("GET", "/eth/v1/validator/attestation_data", P0)
+def attestation_data(ctx):
+    slot = ctx.q1("slot")
+    committee_index = ctx.q1("committee_index")
+    if slot is None or committee_index is None:
+        raise _bad("slot and committee_index are required")
+    data = ctx.chain.produce_attestation_data(int(slot), int(committee_index))
+    return {"data": to_json(data)}
+
+
+@route("GET", "/eth/v1/validator/aggregate_attestation", P0)
+@route("GET", "/eth/v2/validator/aggregate_attestation", P0)
+def aggregate_attestation(ctx):
+    root_hex = ctx.q1("attestation_data_root")
+    slot = ctx.q1("slot")
+    if root_hex is None or slot is None:
+        raise _bad("attestation_data_root and slot are required")
+    att = ctx.chain.attestation_pool.get_aggregate(
+        int(slot), bytes.fromhex(root_hex[2:])
+    )
+    if att is None:
+        raise _not_found("no aggregate for that data root")
+    return {"data": to_json(att)}
+
+
+@route("POST", "/eth/v1/validator/aggregate_and_proofs", P0)
+@route("POST", "/eth/v2/validator/aggregate_and_proofs", P0)
+def aggregate_and_proofs(ctx):
+    from ..chain.beacon_chain import AttestationError
+
+    chain = ctx.chain
+    failures = []
+    for i, agg_json in enumerate(ctx.body or []):
+        try:
+            signed = container_from_json(chain.types.SignedAggregateAndProof, agg_json)
+            chain.process_attestation(signed.message.aggregate)
+        except (AttestationError, KeyError, ValueError) as e:
+            failures.append({"index": i, "message": str(e)})
+    if failures:
+        raise ApiError(400, json.dumps({
+            "code": 400,
+            "message": "error processing aggregates",
+            "failures": failures,
+        }))
+    return None
+
+
+@route("POST", "/eth/v1/validator/beacon_committee_subscriptions", P0)
+def committee_subscriptions(ctx):
+    return None  # subnet backbone subscriptions are static in this stack
+
+
+@route("POST", "/eth/v1/validator/sync_committee_subscriptions", P0)
+def sync_subscriptions(ctx):
+    return None
+
+
+@route("POST", "/eth/v1/validator/prepare_beacon_proposer", P0)
+def prepare_proposer(ctx):
+    return None
+
+
+# ------------------------------------------------------------ config routes
+
+
+@route("GET", "/eth/v1/config/spec")
+def config_spec(ctx):
+    spec = ctx.chain.spec
+    preset = spec.preset
+    out = {}
+    for obj in (spec, preset):
+        for k, v in vars(obj).items():
+            if isinstance(v, bool) or k in ("preset", "config_name", "name"):
+                continue
+            if isinstance(v, int):
+                out[k.upper()] = str(v)
+            elif isinstance(v, bytes):
+                out[k.upper()] = "0x" + v.hex()
+    out["PRESET_BASE"] = preset.name
+    out["CONFIG_NAME"] = spec.config_name
+    out["SECONDS_PER_SLOT"] = str(spec.seconds_per_slot)
+    return {"data": out}
+
+
+@route("GET", "/eth/v1/config/fork_schedule")
+def config_fork_schedule(ctx):
+    spec = ctx.chain.spec
+    sched = []
+    prev = spec.genesis_fork_version
+    forks = [
+        ("phase0", spec.genesis_fork_version, 0),
+        ("altair", spec.altair_fork_version, spec.altair_fork_epoch),
+        ("bellatrix", spec.bellatrix_fork_version, spec.bellatrix_fork_epoch),
+        ("capella", spec.capella_fork_version, spec.capella_fork_epoch),
+        ("deneb", spec.deneb_fork_version, spec.deneb_fork_epoch),
+        ("electra", spec.electra_fork_version, getattr(spec, "electra_fork_epoch", None)),
+    ]
+    for _, version, epoch in forks:
+        if epoch is None:
+            continue
+        sched.append({
+            "previous_version": "0x" + prev.hex(),
+            "current_version": "0x" + version.hex(),
+            "epoch": str(epoch),
+        })
+        prev = version
+    return {"data": sched}
+
+
+@route("GET", "/eth/v1/config/deposit_contract")
+def config_deposit_contract(ctx):
+    spec = ctx.chain.spec
+    return {"data": {
+        "chain_id": str(getattr(spec, "deposit_chain_id", 1)),
+        "address": "0x" + "00" * 20,
+    }}
+
+
+# ------------------------------------------------------------- debug routes
+
+
+@route("GET", "/eth/v2/debug/beacon/states/{state_id}")
+def debug_state(ctx):
+    state, _ = ctx.resolve_state(ctx.params["state_id"])
+    return {
+        "version": type(state).fork_name,
+        "execution_optimistic": False,
+        "finalized": False,
+        "data": to_json(state),
+    }
+
+
+@route("GET", "/eth/v1/debug/beacon/heads")
+def debug_heads(ctx):
+    chain = ctx.chain
+    proto = chain.fork_choice.proto
+    heads = []
+    for root in proto.head_roots() if hasattr(proto, "head_roots") else [chain.head_root]:
+        heads.append({
+            "root": "0x" + root.hex(),
+            "slot": str(chain._blocks_slot(root)),
+            "execution_optimistic": False,
+        })
+    return {"data": heads}
+
+
+@route("GET", "/eth/v1/debug/fork_choice")
+def debug_fork_choice(ctx):
+    chain = ctx.chain
+    proto = chain.fork_choice.proto
+    nodes = []
+    for node in proto.nodes_snapshot() if hasattr(proto, "nodes_snapshot") else []:
+        nodes.append(node)
+    j_epoch, j_root = chain.justified_checkpoint()
+    f_epoch, f_root = chain.finalized_checkpoint()
+    return {
+        "justified_checkpoint": {"epoch": str(j_epoch), "root": "0x" + j_root.hex()},
+        "finalized_checkpoint": {"epoch": str(f_epoch), "root": "0x" + f_root.hex()},
+        "fork_choice_nodes": nodes,
+    }
+
+
+# ------------------------------------------------------------------ server
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = VERSION_STRING
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    @property
+    def api(self) -> "HttpApiServer":
+        return self.server.api_server  # type: ignore[attr-defined]
+
+    def _write_json(self, code: int, payload) -> None:
+        body = b"" if payload is None else json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _handle(self, method: str) -> None:
+        parsed = urlparse(self.path)
+        path = parsed.path
+        with metrics.HTTP_REQUEST_SECONDS.time():
+            metrics.HTTP_REQUESTS.inc(method=method)
+            try:
+                if path == "/metrics" and method == "GET":
+                    body = metrics.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/eth/v1/events" and method == "GET":
+                    self._serve_events(parse_qs(parsed.query))
+                    return
+                m = match_route(method, path)
+                if m is None:
+                    self._write_json(404, {"code": 404, "message": f"NOT_FOUND: {path}"})
+                    return
+                priority, fn, params = m
+                body = None
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    raw = self.rfile.read(length)
+                    if raw:
+                        try:
+                            body = json.loads(raw)
+                        except json.JSONDecodeError:
+                            self._write_json(400, {"code": 400, "message": "invalid JSON"})
+                            return
+                ctx = Context(self.api, params, parse_qs(parsed.query), body, self.headers)
+                try:
+                    result = self.api.spawner.blocking_json_task(priority, lambda: fn(ctx))
+                    self._write_json(200, result)
+                except ApiError as e:
+                    if e.code in (200, 206):  # health-style status responses
+                        self._write_json(e.code, None)
+                    else:
+                        try:
+                            payload = json.loads(e.message)
+                        except (json.JSONDecodeError, TypeError):
+                            payload = {"code": e.code, "message": e.message}
+                        self._write_json(e.code, payload)
+                except OverloadedError as e:
+                    self._write_json(503, {"code": 503, "message": str(e)})
+                except TimeoutError as e:
+                    self._write_json(504, {"code": 504, "message": str(e)})
+            except BrokenPipeError:
+                pass
+            except Exception as e:  # internal error — never kill the thread
+                try:
+                    self._write_json(500, {"code": 500, "message": f"{type(e).__name__}: {e}"})
+                except Exception:
+                    pass
+
+    def _serve_events(self, query) -> None:
+        topics = []
+        for t in query.get("topics", []):
+            topics.extend(t.split(","))
+        if not topics:
+            self._write_json(400, {"code": 400, "message": "topics required"})
+            return
+        try:
+            sub = self.api.chain.events.subscribe(topics)
+        except ValueError as e:
+            self._write_json(400, {"code": 400, "message": str(e)})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        try:
+            while not self.api._shutdown.is_set():
+                item = sub.poll(timeout=0.25)
+                if item is None:
+                    continue
+                topic, data = item
+                chunk = f"event: {topic}\ndata: {json.dumps(data)}\n\n".encode()
+                self.wfile.write(chunk)
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self.api.chain.events.unsubscribe(sub)
+
+    def do_GET(self):
+        self._handle("GET")
+
+    def do_POST(self):
+        self._handle("POST")
+
+
+class HttpApiServer:
+    """Serve the beacon API for a chain over TCP.
+
+    ``processor`` routes handlers through the scheduler (P0/P1); ``None``
+    runs them inline.  ``publish_block_fn``/``publish_attestation_fn`` are
+    called after successful local import to gossip the object out (wired by
+    ``LocalNode``)."""
+
+    def __init__(
+        self,
+        chain,
+        *,
+        processor=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        peer_id: str = "",
+        peer_manager=None,
+        publish_block_fn=None,
+        publish_attestation_fn=None,
+    ):
+        self.chain = chain
+        self.spawner = TaskSpawner(processor)
+        self.peer_id = peer_id
+        self.peer_manager = peer_manager
+        self.publish_block_fn = publish_block_fn
+        self.publish_attestation_fn = publish_attestation_fn
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.api_server = self  # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+        self._shutdown = threading.Event()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "HttpApiServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="http-api", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

@@ -1,0 +1,54 @@
+"""Dispatch beacon-API handlers through the priority scheduler.
+
+Equivalent of the reference's ``beacon_node/http_api/src/task_spawner.rs``:
+every route runs as ``Priority::P0`` (validator-critical) or ``Priority::P1``
+work on the ``BeaconProcessor``, so API load contends with gossip under the
+same drain order instead of starving block import.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+from ..scheduler import BeaconProcessor
+from ..scheduler.work import W, WorkEvent
+
+P0 = W.API_REQUEST_P0
+P1 = W.API_REQUEST_P1
+
+
+class TaskSpawner:
+    def __init__(self, processor: Optional[BeaconProcessor], timeout: float = 30.0):
+        self.processor = processor
+        self.timeout = timeout
+
+    def blocking_json_task(self, priority: str, func: Callable[[], Any]) -> Any:
+        """Run ``func`` on the processor at ``priority`` and block for the
+        result (the warp handler's await).  Falls back to inline execution
+        when there is no processor (bare-chain servers in tests)."""
+        if self.processor is None:
+            return func()
+        done = threading.Event()
+        box: dict = {}
+
+        def run(_item=None):
+            try:
+                box["result"] = func()
+            except BaseException as e:  # propagate to the HTTP thread
+                box["error"] = e
+            finally:
+                done.set()
+
+        accepted = self.processor.send(WorkEvent(work_type=priority, process=run))
+        if not accepted:
+            raise OverloadedError("beacon processor queue full")
+        if not done.wait(self.timeout):
+            raise TimeoutError("beacon processor did not run the API task in time")
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+
+class OverloadedError(Exception):
+    pass

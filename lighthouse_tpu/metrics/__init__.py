@@ -1,0 +1,214 @@
+"""Process-wide metrics registry: counters, gauges, histograms, stage timers.
+
+Equivalent of the reference's ``common/lighthouse_metrics`` (lib.rs:1-18 —
+thin helpers over a global prometheus registry) plus the hot-path stage
+timers the chain inlines throughout import/verification
+(``beacon_node/beacon_chain/src/metrics.rs:40-271``).
+
+Design: a plain-Python registry with lock-free-enough updates (single
+attribute stores under the GIL), rendered on demand in the Prometheus text
+exposition format by the HTTP server's ``/metrics`` route.  No external
+dependency; histograms use fixed log-spaced buckets like the reference's
+``exponential_buckets``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_REGISTRY_LOCK = threading.Lock()
+_REGISTRY: "Dict[str, _Metric]" = {}
+
+
+def _labels_key(labels: Optional[dict]) -> Tuple:
+    if not labels:
+        return ()
+    return tuple(sorted(labels.items()))
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._series: Dict[Tuple, object] = {}
+        self._lock = threading.Lock()
+
+    def render(self) -> List[str]:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+
+def _fmt_labels(key: Tuple) -> str:
+    if not key:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        key = _labels_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+    def get(self, **labels) -> float:
+        return self._series.get(_labels_key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._series.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        self._series[_labels_key(labels)] = float(value)
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        key = _labels_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+    def dec(self, value: float = 1.0, **labels) -> None:
+        self.inc(-value, **labels)
+
+    def get(self, **labels) -> float:
+        return self._series.get(_labels_key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for key, v in sorted(self._series.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+# Log-spaced from 1ms to ~65s — the reference's exponential_buckets shape.
+DEFAULT_BUCKETS = tuple(0.001 * (2.0 ** i) for i in range(17))
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_text: str, buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        super().__init__(name, help_text)
+        self.buckets = tuple(sorted(buckets))
+
+    def observe(self, value: float, **labels) -> None:
+        key = _labels_key(labels)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = {"counts": [0] * len(self.buckets), "sum": 0.0, "n": 0}
+                self._series[key] = series
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    series["counts"][i] += 1
+            series["sum"] += value
+            series["n"] += 1
+
+    def time(self, **labels) -> "_HistTimer":
+        return _HistTimer(self, labels)
+
+    def stats(self, **labels) -> Tuple[int, float]:
+        """(count, total_seconds) for a label set."""
+        s = self._series.get(_labels_key(labels))
+        return (0, 0.0) if s is None else (s["n"], s["sum"])
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for key, s in sorted(self._series.items()):
+            for i, ub in enumerate(self.buckets):
+                lk = key + (("le", repr(ub)),)
+                out.append(f"{self.name}_bucket{_fmt_labels(lk)} {s['counts'][i]}")
+            lk = key + (("le", "+Inf"),)
+            out.append(f"{self.name}_bucket{_fmt_labels(lk)} {s['n']}")
+            out.append(f"{self.name}_sum{_fmt_labels(key)} {s['sum']}")
+            out.append(f"{self.name}_count{_fmt_labels(key)} {s['n']}")
+        return out
+
+
+class _HistTimer:
+    """``with HIST.time():`` stage timer (reference ``start_timer``)."""
+
+    def __init__(self, hist: Histogram, labels: dict):
+        self._hist = hist
+        self._labels = labels
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.perf_counter() - self._t0, **self._labels)
+        return False
+
+
+def _register(metric: _Metric) -> _Metric:
+    with _REGISTRY_LOCK:
+        existing = _REGISTRY.get(metric.name)
+        if existing is not None:
+            return existing
+        _REGISTRY[metric.name] = metric
+        return metric
+
+
+def counter(name: str, help_text: str = "") -> Counter:
+    return _register(Counter(name, help_text))  # type: ignore[return-value]
+
+
+def gauge(name: str, help_text: str = "") -> Gauge:
+    return _register(Gauge(name, help_text))  # type: ignore[return-value]
+
+
+def histogram(name: str, help_text: str = "", buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
+    return _register(Histogram(name, help_text, buckets))  # type: ignore[return-value]
+
+
+def render_prometheus() -> str:
+    """The full registry in Prometheus text exposition format."""
+    with _REGISTRY_LOCK:
+        metrics = list(_REGISTRY.values())
+    lines: List[str] = []
+    for m in metrics:
+        lines.extend(m.render())
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------- hot-path
+# Chain stage timers (reference beacon_chain/src/metrics.rs:40-271).
+
+BLOCK_IMPORT_SECONDS = histogram(
+    "beacon_block_import_seconds", "Full block import pipeline time"
+)
+BLOCK_STATE_TRANSITION_SECONDS = histogram(
+    "beacon_block_state_transition_seconds", "state_transition() inside import"
+)
+BLOCK_FORK_CHOICE_SECONDS = histogram(
+    "beacon_block_fork_choice_seconds", "fork choice on_block + head recompute"
+)
+EPOCH_PROCESSING_SECONDS = histogram(
+    "beacon_epoch_processing_seconds", "per-epoch processing time"
+)
+ATTESTATION_BATCH_SECONDS = histogram(
+    "beacon_attestation_batch_verify_seconds", "device batch signature verification"
+)
+ATTESTATION_BATCH_SIZE = histogram(
+    "beacon_attestation_batch_size", "signature sets per device batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+)
+SIGNATURE_SETS_VERIFIED = counter(
+    "beacon_signature_sets_verified_total", "signature sets through the batch verifier"
+)
+DEVICE_BATCH_INVOCATIONS = counter(
+    "beacon_device_batch_invocations_total", "batched device program invocations"
+)
+HTTP_REQUESTS = counter("http_api_requests_total", "Beacon API requests")
+HTTP_REQUEST_SECONDS = histogram("http_api_request_seconds", "Beacon API request time")

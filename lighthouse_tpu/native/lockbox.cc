@@ -94,6 +94,7 @@ uint64_t scan(Lockbox* box) {
 int append_record(Lockbox* box, uint8_t op, const char* key, uint32_t klen,
                   const char* val, uint32_t vlen) {
   FILE* f = box->log;
+  if (!f) return -1;  // a failed compact may have left the log closed
   if (fseeko(f, box->log_size, SEEK_SET) != 0) return -1;
   if (fwrite(&op, 1, 1, f) != 1) return -1;
   if (fwrite(&klen, 4, 1, f) != 1) return -1;
@@ -249,7 +250,8 @@ int lockbox_compact(void* h) {
   fclose(tmp);
   fclose(box->log);
   if (rename(tmp_path.c_str(), box->path.c_str()) != 0) {
-    box->log = open_rw(box->path.c_str());
+    box->log = open_rw(box->path.c_str());  // may be NULL; append_record guards
+    remove(tmp_path.c_str());
     return -1;
   }
   box->log = open_rw(box->path.c_str());

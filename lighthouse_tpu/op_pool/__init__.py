@@ -94,19 +94,8 @@ class OperationPool:
 
         candidates: List[Tuple[object, Set[int]]] = []
         state_slot = int(state.slot)
-        state_epoch = state_slot // spec.slots_per_epoch
-        post_deneb = spec.fork_name_at_slot(state_slot) not in (
-            "phase0", "altair", "bellatrix", "capella",
-        )
         for (slot, _), group in self._attestations.items():
-            if slot + spec.min_attestation_inclusion_delay > state_slot:
-                continue
-            if post_deneb:
-                # EIP-7045: any current- or previous-epoch attestation is
-                # includable (the one-epoch slot window is lifted).
-                if slot // spec.slots_per_epoch + 1 < state_epoch:
-                    continue
-            elif slot + spec.slots_per_epoch < state_slot:
+            if not spec.attestation_includable(slot, state_slot):
                 continue
             for att in group.aggregates:
                 try:

@@ -227,6 +227,21 @@ class ChainSpec:
     def fork_name_at_slot(self, slot: int) -> str:
         return self.fork_name_at_epoch(slot // self.slots_per_epoch)
 
+    def attestation_includable(self, att_slot: int, state_slot: int) -> bool:
+        """Is an attestation from ``att_slot`` includable in a block at
+        ``state_slot``?  Pre-Deneb: within one epoch of slots.  Post-Deneb
+        (EIP-7045): any current- or previous-epoch attestation.  Single source
+        of truth for both the naive pool and the op pool."""
+        if att_slot + self.min_attestation_inclusion_delay > state_slot:
+            return False
+        if self.fork_name_at_slot(state_slot) in (
+            "phase0", "altair", "bellatrix", "capella",
+        ):
+            return att_slot + self.slots_per_epoch >= state_slot
+        return (
+            att_slot // self.slots_per_epoch + 1 >= state_slot // self.slots_per_epoch
+        )
+
     def fork_version_for(self, fork_name: str) -> bytes:
         return {
             "phase0": self.genesis_fork_version,

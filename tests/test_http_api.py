@@ -1,0 +1,225 @@
+"""Beacon API server + client tests: a chain served over real TCP, driven
+end-to-end (produce → sign → publish) through the typed client — the
+reference's ``http_api/tests`` topology (server over a harness chain)."""
+
+import http.client
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.crypto.bls.backends import set_backend
+from lighthouse_tpu.http_api import ApiClientError, BeaconNodeHttpClient, HttpApiServer
+from lighthouse_tpu.http_api.serde import container_from_json
+from lighthouse_tpu.scheduler import BeaconProcessor
+
+
+@pytest.fixture(scope="module")
+def served():
+    set_backend("fake")
+    harness = BeaconChainHarness(validator_count=16, fake_crypto=True)
+    harness.extend_chain(4)
+    processor = BeaconProcessor(max_workers=2)
+    server = HttpApiServer(harness.chain, processor=processor).start()
+    client = BeaconNodeHttpClient(server.url)
+    yield harness, server, client
+    server.stop()
+    processor.shutdown()
+    set_backend("host")
+
+
+def test_node_endpoints(served):
+    harness, server, client = served
+    assert client.node_version().startswith("lighthouse-tpu/")
+    syncing = client.node_syncing()
+    assert syncing["head_slot"] == str(harness.chain._blocks_slot(harness.head_root))
+    assert syncing["is_syncing"] is False
+    assert client.node_health_ok()
+
+
+def test_genesis_and_state_endpoints(served):
+    harness, server, client = served
+    g = client.genesis()
+    assert g["genesis_time"] == str(harness.chain.genesis_time)
+    assert g["genesis_validators_root"] == "0x" + harness.chain.genesis_validators_root.hex()
+
+    fork = client.state_fork("head")
+    assert fork["current_version"].startswith("0x")
+
+    root = client.state_root("head")
+    assert root == harness.head_state.hash_tree_root()
+
+    fc = client.finality_checkpoints("head")
+    assert int(fc["finalized"]["epoch"]) >= 0
+
+
+def test_validators_endpoint(served):
+    harness, server, client = served
+    vals = client.validators("head")
+    assert len(vals) == 16
+    assert vals[0]["status"] == "active_ongoing"
+    one = client.validators("head", ids=["3"])
+    assert len(one) == 1 and one[0]["index"] == "3"
+    # by pubkey
+    pk = one[0]["validator"]["pubkey"]
+    by_pk = client.validators("head", ids=[pk])
+    assert by_pk[0]["index"] == "3"
+
+
+def test_headers_and_blocks(served):
+    harness, server, client = served
+    head = client.block_header("head")
+    assert head["root"] == "0x" + harness.head_root.hex()
+    assert head["canonical"] is True
+
+    blk = client.block("head")
+    assert blk["data"]["message"]["slot"] == head["header"]["message"]["slot"]
+    assert client.block_root("head") == harness.head_root
+
+    by_slot = client.block_header(head["header"]["message"]["slot"])
+    assert by_slot["root"] == head["root"]
+
+    with pytest.raises(ApiClientError) as e:
+        client.block("0x" + "ab" * 32)
+    assert e.value.status == 404
+
+
+def test_duties(served):
+    harness, server, client = served
+    spec = harness.spec
+    epoch = harness.chain.current_slot() // spec.slots_per_epoch
+    duties = client.proposer_duties(epoch)
+    assert len(duties["data"]) == spec.slots_per_epoch
+    assert all(d["pubkey"].startswith("0x") for d in duties["data"])
+
+    att = client.attester_duties(epoch, list(range(16)))
+    # every active validator attests exactly once per epoch
+    assert len(att["data"]) == 16
+    d0 = att["data"][0]
+    assert int(d0["committee_length"]) > 0
+    assert int(d0["validator_committee_index"]) < int(d0["committee_length"])
+
+
+def test_produce_sign_publish_roundtrip(served):
+    """The core VC loop over the wire: duties → produce → sign → publish."""
+    harness, server, client = served
+    chain = harness.chain
+    slot = harness.advance_slot()
+    state, _ = chain.state_at_slot(slot)
+
+    from lighthouse_tpu.consensus import helpers as h
+
+    proposer = h.get_beacon_proposer_index(state, harness.spec)
+    reveal = harness.randao_reveal(state, slot, proposer)
+
+    resp = client.produce_block(slot, reveal)
+    fork = resp["version"]
+    block = container_from_json(harness.types.block[fork], resp["data"])
+    assert int(block.slot) == slot
+    signed = harness.sign_block(block, state)
+
+    client.publish_block(signed)
+    assert chain.head_root == block.hash_tree_root()
+
+
+def test_attestation_flow(served):
+    """attestation_data → sign → submit to pool → aggregate visible."""
+    harness, server, client = served
+    chain = harness.chain
+    slot = harness.advance_slot()  # fresh slot: no harness attestations yet
+
+    data = client.attestation_data(slot, 0, types=harness.types)
+    assert int(data.slot) == slot
+
+    from lighthouse_tpu.consensus import helpers as h
+
+    state, _ = chain.state_at_slot(slot)
+    committee = h.get_beacon_committee(state, slot, 0, harness.spec)
+    vidx = int(committee[0])
+    sig = harness.sign_attestation_data(state, data, vidx)
+    bits = [False] * len(committee)
+    bits[0] = True
+    att = harness.types.Attestation(
+        aggregation_bits=bits, data=data, signature=sig.to_bytes()
+    )
+    client.submit_attestations([att])
+
+    agg = client.aggregate_attestation(slot, data.hash_tree_root(), types=harness.types)
+    assert list(agg.aggregation_bits) == bits
+
+
+def test_pool_rejects_bad_attestation(served):
+    harness, server, client = served
+    data = harness.chain.produce_attestation_data(harness.chain.current_slot(), 0)
+    bad = harness.types.Attestation(
+        aggregation_bits=[True],
+        data=harness.types.AttestationData(
+            slot=data.slot,
+            index=data.index,
+            beacon_block_root=b"\xee" * 32,  # unknown head
+            source=data.source,
+            target=data.target,
+        ),
+        signature=b"\x00" * 96,
+    )
+    with pytest.raises(ApiClientError) as e:
+        client.submit_attestations([bad])
+    assert e.value.status == 400
+
+
+def test_config_and_debug(served):
+    harness, server, client = served
+    spec_json = client.config_spec()
+    assert spec_json["SECONDS_PER_SLOT"] == str(harness.spec.seconds_per_slot)
+    assert spec_json["PRESET_BASE"] == harness.spec.preset.name
+
+    sched = client.get("/eth/v1/config/fork_schedule")["data"]
+    assert sched[0]["previous_version"] == "0x" + harness.spec.genesis_fork_version.hex()
+
+    heads = client.get("/eth/v1/debug/beacon/heads")["data"]
+    assert any(hd["root"] == "0x" + harness.head_root.hex() for hd in heads)
+
+
+def test_metrics_endpoint(served):
+    harness, server, client = served
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    text = resp.read().decode()
+    conn.close()
+    assert resp.status == 200
+    assert "beacon_block_import_seconds" in text
+    assert "http_api_requests_total" in text
+
+
+def test_events_sse(served):
+    harness, server, client = served
+    received = []
+    ready = threading.Event()
+
+    def listen():
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+        conn.request("GET", "/eth/v1/events?topics=block,head")
+        resp = conn.getresponse()
+        ready.set()
+        buf = b""
+        while len(received) < 2:
+            chunk = resp.read1(4096)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                frame, buf = buf.split(b"\n\n", 1)
+                received.append(frame.decode())
+        conn.close()
+
+    t = threading.Thread(target=listen, daemon=True)
+    t.start()
+    assert ready.wait(5)
+    time.sleep(0.3)  # subscription registered after response headers
+    harness.extend_chain(1)
+    t.join(timeout=10)
+    assert any("event: block" in f for f in received)
+    block_frames = [f for f in received if "event: block" in f]
+    assert f'"0x{harness.head_root.hex()}"' in block_frames[-1]
