@@ -23,11 +23,11 @@ from urllib.parse import parse_qs, urlparse
 from .. import metrics
 from ..chain import events as ev
 from ..consensus import helpers as h
+from ..types.spec import FAR_FUTURE_EPOCH
 from .serde import container_from_json, to_json
 from .task_spawner import P0, P1, OverloadedError, TaskSpawner
 
 VERSION_STRING = "lighthouse-tpu/0.2.0"
-FAR_FUTURE_EPOCH = 2**64 - 1
 
 
 class ApiError(Exception):
@@ -138,8 +138,24 @@ class Context:
             if st is None:
                 raise _not_found(f"state {state_id}")
             return st, b"\x00" * 32
-        state, root = chain.state_at_slot(slot)
-        return state, root
+        head_state = chain.head_state
+        if slot >= int(head_state.slot):
+            state, root = chain.state_at_slot(slot)
+            return state, root
+        # Historical slot: resolve the canonical block at/before it and
+        # advance through any empty slots.
+        broot = chain.block_root_at_slot(slot)
+        if broot is None:
+            raise _not_found(f"state at slot {slot}")
+        st = chain.get_state(broot)
+        if st is None:
+            raise _not_found(f"state at slot {slot} pruned from the hot cache")
+        if int(st.slot) < slot:
+            from ..consensus.per_slot import process_slots
+
+            st = st.copy()
+            process_slots(st, slot, chain.types, chain.spec)
+        return st, broot
 
 
 # ------------------------------------------------------------------ routes
@@ -332,7 +348,7 @@ def _parse_validator_id(state, vid: str) -> Optional[int]:
                 return i
         return None
     idx = int(vid)
-    return idx if idx < len(state.validators) else None
+    return idx if 0 <= idx < len(state.validators) else None
 
 
 @route("GET", "/eth/v1/beacon/states/{state_id}/validators")
@@ -732,7 +748,7 @@ def duties_proposer(ctx):
     for slot in range(epoch * spec.slots_per_epoch, (epoch + 1) * spec.slots_per_epoch):
         if int(state.slot) < slot:
             process_slots(state, slot, chain.types, spec)
-        proposer = h.get_beacon_proposer_index(state, spec)
+        proposer = h.get_beacon_proposer_index(state, spec, slot=slot)
         duties.append({
             "pubkey": "0x" + bytes(state.validators[proposer].pubkey).hex(),
             "validator_index": str(proposer),
@@ -1026,25 +1042,30 @@ class _Handler(BaseHTTPRequestHandler):
                 if path == "/eth/v1/events" and method == "GET":
                     self._serve_events(parse_qs(parsed.query))
                     return
+                # Drain the body before any response — an unread body on a
+                # keep-alive connection corrupts the next request.
+                body = None
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b""
                 m = match_route(method, path)
                 if m is None:
                     self._write_json(404, {"code": 404, "message": f"NOT_FOUND: {path}"})
                     return
                 priority, fn, params = m
-                body = None
-                length = int(self.headers.get("Content-Length") or 0)
-                if length:
-                    raw = self.rfile.read(length)
-                    if raw:
-                        try:
-                            body = json.loads(raw)
-                        except json.JSONDecodeError:
-                            self._write_json(400, {"code": 400, "message": "invalid JSON"})
-                            return
+                if raw:
+                    try:
+                        body = json.loads(raw)
+                    except json.JSONDecodeError:
+                        self._write_json(400, {"code": 400, "message": "invalid JSON"})
+                        return
                 ctx = Context(self.api, params, parse_qs(parsed.query), body, self.headers)
                 try:
                     result = self.api.spawner.blocking_json_task(priority, lambda: fn(ctx))
                     self._write_json(200, result)
+                except (ValueError, KeyError, TypeError) as e:
+                    # Malformed user input (bad ints/hex/missing fields) is a
+                    # contract 400, not a 500.
+                    self._write_json(400, {"code": 400, "message": f"BAD_REQUEST: {e}"})
                 except ApiError as e:
                     if e.code in (200, 206):  # health-style status responses
                         self._write_json(e.code, None)

@@ -60,7 +60,9 @@ class Counter(_Metric):
 
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
-        for key, v in sorted(self._series.items()):
+        with self._lock:
+            items = sorted(self._series.items())
+        for key, v in items:
             out.append(f"{self.name}{_fmt_labels(key)} {v}")
         return out
 
@@ -69,7 +71,8 @@ class Gauge(_Metric):
     kind = "gauge"
 
     def set(self, value: float, **labels) -> None:
-        self._series[_labels_key(labels)] = float(value)
+        with self._lock:
+            self._series[_labels_key(labels)] = float(value)
 
     def inc(self, value: float = 1.0, **labels) -> None:
         key = _labels_key(labels)
@@ -84,7 +87,9 @@ class Gauge(_Metric):
 
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
-        for key, v in sorted(self._series.items()):
+        with self._lock:
+            items = sorted(self._series.items())
+        for key, v in items:
             out.append(f"{self.name}{_fmt_labels(key)} {v}")
         return out
 
@@ -123,7 +128,12 @@ class Histogram(_Metric):
 
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
-        for key, s in sorted(self._series.items()):
+        with self._lock:
+            snapshot = sorted(
+                (key, {"counts": list(s["counts"]), "sum": s["sum"], "n": s["n"]})
+                for key, s in self._series.items()
+            )
+        for key, s in snapshot:
             for i, ub in enumerate(self.buckets):
                 lk = key + (("le", repr(ub)),)
                 out.append(f"{self.name}_bucket{_fmt_labels(lk)} {s['counts'][i]}")

@@ -157,6 +157,7 @@ int64_t lockbox_get(void* h, const char* key, uint32_t klen, char* out,
                     uint64_t cap) {
   auto* box = static_cast<Lockbox*>(h);
   std::lock_guard<std::mutex> g(box->mu);
+  if (!box->log) return -2;  // failed compact may have left the log closed
   auto it = box->index.find(std::string(key, klen));
   if (it == box->index.end()) return -1;
   if (it->second.len <= cap) {
@@ -217,6 +218,7 @@ int lockbox_flush(void* h) {
 int lockbox_compact(void* h) {
   auto* box = static_cast<Lockbox*>(h);
   std::lock_guard<std::mutex> g(box->mu);
+  if (!box->log) return -1;  // a prior failed compact closed the log
   std::string tmp_path = box->path + ".compact";
   FILE* tmp = fopen(tmp_path.c_str(), "wb");
   if (!tmp) return -1;

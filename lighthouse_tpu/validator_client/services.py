@@ -1,0 +1,246 @@
+"""Validator-client services: duties polling, attesting, aggregating,
+proposing — the per-slot production loop.
+
+Equivalent of the reference's ``validator_client/src/{duties_service,
+attestation_service, block_service}.rs``: duties are polled per epoch and
+keyed by dependent_root; attestations are produced at slot+1/3, aggregates at
+slot+2/3, blocks at slot start (``attestation_service.rs:1-60``,
+``duties_service.rs:1-47``).  All beacon-node access goes through the
+fallback (multi-BN redundancy, ``beacon_node_fallback.rs``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..http_api.client import ApiClientError, BeaconNodeHttpClient
+from ..http_api.serde import container_from_json
+from .validator_store import ValidatorStore
+
+
+class NoViableBeaconNode(Exception):
+    pass
+
+
+class BeaconNodeFallback:
+    """Try each configured BN in order; first success wins
+    (reference ``beacon_node_fallback.rs`` first_success)."""
+
+    def __init__(self, clients: List[BeaconNodeHttpClient]):
+        assert clients, "at least one beacon node required"
+        self.clients = list(clients)
+
+    def first_success(self, fn: Callable[[BeaconNodeHttpClient], object]):
+        errors = []
+        for client in self.clients:
+            try:
+                return fn(client)
+            except (ApiClientError, OSError) as e:
+                errors.append(f"{client.base_url}: {e}")
+        raise NoViableBeaconNode("; ".join(errors))
+
+
+class AttesterDuty:
+    __slots__ = (
+        "pubkey", "validator_index", "slot", "committee_index",
+        "committee_length", "committees_at_slot", "validator_committee_index",
+    )
+
+    def __init__(self, d: dict):
+        self.pubkey = bytes.fromhex(d["pubkey"][2:])
+        self.validator_index = int(d["validator_index"])
+        self.slot = int(d["slot"])
+        self.committee_index = int(d["committee_index"])
+        self.committee_length = int(d["committee_length"])
+        self.committees_at_slot = int(d["committees_at_slot"])
+        self.validator_committee_index = int(d["validator_committee_index"])
+
+
+class DutiesService:
+    def __init__(self, *, store: ValidatorStore, fallback: BeaconNodeFallback):
+        self.store = store
+        self.fallback = fallback
+        # epoch -> {pubkey: AttesterDuty}
+        self._attesters: Dict[int, Dict[bytes, List[AttesterDuty]]] = {}
+        # epoch -> {slot: pubkey} (only our validators)
+        self._proposers: Dict[int, Dict[int, bytes]] = {}
+        self._dependent_roots: Dict[int, str] = {}
+        self._indices: Dict[bytes, int] = {}  # pubkey -> validator index
+
+    # ------------------------------------------------------------- indices
+
+    def resolve_indices(self) -> Dict[bytes, int]:
+        unknown = [pk for pk in self.store.pubkeys if pk not in self._indices]
+        if unknown:
+            ids = ["0x" + pk.hex() for pk in unknown]
+            data = self.fallback.first_success(
+                lambda c: c.validators("head", ids=ids)
+            )
+            for entry in data:
+                pk = bytes.fromhex(entry["validator"]["pubkey"][2:])
+                self._indices[pk] = int(entry["index"])
+        return self._indices
+
+    # -------------------------------------------------------------- duties
+
+    def update(self, epoch: int) -> None:
+        """Poll proposer + attester duties for ``epoch`` (and attesters for
+        ``epoch+1`` so the first slot of the next epoch is never missed)."""
+        indices = self.resolve_indices()
+        if not indices:
+            return
+        self._poll_attesters(epoch, indices)
+        self._poll_attesters(epoch + 1, indices)
+        self._poll_proposers(epoch)
+        for old in [e for e in self._attesters if e + 2 < epoch]:
+            del self._attesters[old]
+        for old in [e for e in self._proposers if e + 2 < epoch]:
+            del self._proposers[old]
+
+    def _poll_attesters(self, epoch: int, indices: Dict[bytes, int]) -> None:
+        resp = self.fallback.first_success(
+            lambda c: c.attester_duties(epoch, sorted(indices.values()))
+        )
+        dep = resp.get("dependent_root", "")
+        if self._dependent_roots.get(epoch) == dep and epoch in self._attesters:
+            return  # unchanged — same shuffling decision root
+        self._dependent_roots[epoch] = dep
+        by_pk: Dict[bytes, List[AttesterDuty]] = {}
+        for d in resp["data"]:
+            duty = AttesterDuty(d)
+            by_pk.setdefault(duty.pubkey, []).append(duty)
+        self._attesters[epoch] = by_pk
+
+    def _poll_proposers(self, epoch: int) -> None:
+        resp = self.fallback.first_success(lambda c: c.proposer_duties(epoch))
+        ours: Dict[int, bytes] = {}
+        for d in resp["data"]:
+            pk = bytes.fromhex(d["pubkey"][2:])
+            if self.store.has_key(pk):
+                ours[int(d["slot"])] = pk
+        self._proposers[epoch] = ours
+
+    def attester_duties_at_slot(self, slot: int, spec) -> List[AttesterDuty]:
+        epoch = slot // spec.slots_per_epoch
+        out = []
+        for duties in self._attesters.get(epoch, {}).values():
+            out.extend(d for d in duties if d.slot == slot)
+        return out
+
+    def proposer_at_slot(self, slot: int, spec) -> Optional[bytes]:
+        epoch = slot // spec.slots_per_epoch
+        return self._proposers.get(epoch, {}).get(slot)
+
+
+class AttestationService:
+    """Produce + publish attestations at slot+1/3, aggregates at slot+2/3
+    (reference ``attestation_service.rs`` spawn_attestation_tasks)."""
+
+    def __init__(self, *, store: ValidatorStore, duties: DutiesService,
+                 fallback: BeaconNodeFallback, types):
+        self.store = store
+        self.duties = duties
+        self.fallback = fallback
+        self.types = types
+
+    def attest(self, slot: int) -> int:
+        """Sign + submit one attestation per duty at ``slot``; returns count."""
+        spec = self.store.spec
+        duties = self.duties.attester_duties_at_slot(slot, spec)
+        if not duties:
+            return 0
+        by_committee: Dict[int, List[AttesterDuty]] = {}
+        for d in duties:
+            by_committee.setdefault(d.committee_index, []).append(d)
+        attestations = []
+        for committee_index, committee_duties in sorted(by_committee.items()):
+            data = self.fallback.first_success(
+                lambda c: c.attestation_data(slot, committee_index, types=self.types)
+            )
+            for duty in committee_duties:
+                try:
+                    sig = self.store.sign_attestation(duty.pubkey, data)
+                except Exception:
+                    continue  # slashing-protected or missing key: skip
+                bits = [False] * duty.committee_length
+                bits[duty.validator_committee_index] = True
+                attestations.append(self.types.Attestation(
+                    aggregation_bits=bits, data=data, signature=sig
+                ))
+        if attestations:
+            self.fallback.first_success(
+                lambda c: c.submit_attestations(attestations)
+            )
+        return len(attestations)
+
+    def aggregate(self, slot: int) -> int:
+        """For duties where we are the aggregator: fetch the pool aggregate,
+        wrap in SignedAggregateAndProof, publish; returns count."""
+        spec = self.store.spec
+        duties = self.duties.attester_duties_at_slot(slot, spec)
+        signed_aggregates = []
+        seen_committees = set()
+        for duty in duties:
+            if duty.committee_index in seen_committees:
+                continue
+            proof = self.store.selection_proof(duty.pubkey, slot)
+            if not self.store.is_aggregator(duty.committee_length, proof):
+                continue
+            seen_committees.add(duty.committee_index)
+            data = self.fallback.first_success(
+                lambda c: c.attestation_data(slot, duty.committee_index, types=self.types)
+            )
+            try:
+                aggregate = self.fallback.first_success(
+                    lambda c: c.aggregate_attestation(
+                        slot, data.hash_tree_root(), types=self.types
+                    )
+                )
+            except NoViableBeaconNode:
+                continue  # no aggregate in the pool for this data
+            message = self.types.AggregateAndProof(
+                aggregator_index=duty.validator_index,
+                aggregate=aggregate,
+                selection_proof=proof,
+            )
+            sig = self.store.sign_aggregate_and_proof(duty.pubkey, message)
+            signed_aggregates.append(self.types.SignedAggregateAndProof(
+                message=message, signature=sig
+            ))
+        if signed_aggregates:
+            self.fallback.first_success(
+                lambda c: c.publish_aggregate_and_proofs(signed_aggregates)
+            )
+        return len(signed_aggregates)
+
+
+class BlockService:
+    """Propose when we hold the proposer's key (``block_service.rs``)."""
+
+    def __init__(self, *, store: ValidatorStore, duties: DutiesService,
+                 fallback: BeaconNodeFallback, types,
+                 graffiti: bytes = b"lighthouse-tpu".ljust(32, b"\x00")):
+        self.store = store
+        self.duties = duties
+        self.fallback = fallback
+        self.types = types
+        self.graffiti = graffiti
+
+    def propose(self, slot: int) -> Optional[bytes]:
+        """Produce, sign (slashing-gated) and publish a block if it is our
+        duty; returns the block root or None."""
+        spec = self.store.spec
+        pubkey = self.duties.proposer_at_slot(slot, spec)
+        if pubkey is None:
+            return None
+        epoch = slot // spec.slots_per_epoch
+        reveal = self.store.randao_reveal(pubkey, epoch)
+        resp = self.fallback.first_success(
+            lambda c: c.produce_block(slot, reveal, graffiti=self.graffiti)
+        )
+        fork = resp["version"]
+        block = container_from_json(self.types.block[fork], resp["data"])
+        sig = self.store.sign_block(pubkey, block)  # slashing DB veto point
+        signed = self.types.signed_block[fork](message=block, signature=sig)
+        self.fallback.first_success(lambda c: c.publish_block(signed))
+        return block.hash_tree_root()

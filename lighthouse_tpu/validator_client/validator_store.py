@@ -1,0 +1,132 @@
+"""Validator store: keys + domain-aware signing, gated by slashing protection.
+
+Equivalent of the reference's ``validator_client/src/validator_store.rs`` —
+every signature a validator produces flows through here so the
+EIP-3076 DB can veto it (``sign_block``/``sign_attestation`` →
+``slashing_protection.check_and_insert_*``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..consensus import helpers as h
+from ..crypto.bls import api as bls
+from ..types.spec import (
+    DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    DOMAIN_SELECTION_PROOF,
+    DOMAIN_SYNC_COMMITTEE,
+    DOMAIN_VOLUNTARY_EXIT,
+    ChainSpec,
+)
+from ..types.ssz import UintType
+from .slashing_protection import SlashingProtectionDB
+
+uint64 = UintType(8)
+
+
+class ValidatorStore:
+    def __init__(
+        self,
+        *,
+        keys: List[bls.SecretKey],
+        spec: ChainSpec,
+        genesis_validators_root: bytes,
+        slashing_db: Optional[SlashingProtectionDB] = None,
+        fake_signatures: bool = False,
+    ):
+        self.spec = spec
+        self.genesis_validators_root = genesis_validators_root
+        self.slashing_db = slashing_db if slashing_db is not None else SlashingProtectionDB()
+        self._by_pubkey: Dict[bytes, bls.SecretKey] = {
+            sk.public_key().to_bytes(): sk for sk in keys
+        }
+        self._fake = fake_signatures
+        if fake_signatures:
+            from ..crypto.bls import curve, serde
+
+            self._canned = serde.g2_compress(curve.G2)
+
+    @property
+    def pubkeys(self) -> List[bytes]:
+        return list(self._by_pubkey)
+
+    def has_key(self, pubkey: bytes) -> bool:
+        return bytes(pubkey) in self._by_pubkey
+
+    # ------------------------------------------------------------- signing
+
+    def _domain(self, domain_type: bytes, epoch: int) -> bytes:
+        fork_version = self.spec.fork_version_for(self.spec.fork_name_at_epoch(epoch))
+        return h.compute_domain(domain_type, fork_version, self.genesis_validators_root)
+
+    def _raw_sign(self, pubkey: bytes, signing_root: bytes) -> bytes:
+        if self._fake:
+            return self._canned
+        sk = self._by_pubkey.get(bytes(pubkey))
+        if sk is None:
+            raise KeyError(f"no key for pubkey {bytes(pubkey).hex()[:16]}")
+        return sk.sign(signing_root).to_bytes()
+
+    def sign_block(self, pubkey: bytes, block) -> bytes:
+        """Slashing-gated block signature (validator_store.rs sign_block)."""
+        slot = int(block.slot)
+        epoch = slot // self.spec.slots_per_epoch
+        domain = self._domain(DOMAIN_BEACON_PROPOSER, epoch)
+        signing_root = h.compute_signing_root(block.hash_tree_root(), domain)
+        self.slashing_db.check_and_insert_block_proposal(
+            bytes(pubkey), slot, signing_root
+        )
+        return self._raw_sign(pubkey, signing_root)
+
+    def sign_attestation(self, pubkey: bytes, data) -> bytes:
+        """Slashing-gated attestation signature over ``AttestationData``."""
+        domain = self._domain(DOMAIN_BEACON_ATTESTER, int(data.target.epoch))
+        signing_root = h.compute_signing_root(data.hash_tree_root(), domain)
+        self.slashing_db.check_and_insert_attestation(
+            bytes(pubkey), int(data.source.epoch), int(data.target.epoch), signing_root
+        )
+        return self._raw_sign(pubkey, signing_root)
+
+    def randao_reveal(self, pubkey: bytes, epoch: int) -> bytes:
+        domain = self._domain(DOMAIN_RANDAO, epoch)
+        root = h.compute_signing_root(uint64.hash_tree_root(epoch), domain)
+        return self._raw_sign(pubkey, root)
+
+    def selection_proof(self, pubkey: bytes, slot: int) -> bytes:
+        """Aggregation-slot selection proof (sign the slot number)."""
+        epoch = slot // self.spec.slots_per_epoch
+        domain = self._domain(DOMAIN_SELECTION_PROOF, epoch)
+        root = h.compute_signing_root(uint64.hash_tree_root(slot), domain)
+        return self._raw_sign(pubkey, root)
+
+    def sign_aggregate_and_proof(self, pubkey: bytes, aggregate_and_proof) -> bytes:
+        epoch = int(aggregate_and_proof.aggregate.data.slot) // self.spec.slots_per_epoch
+        domain = self._domain(DOMAIN_AGGREGATE_AND_PROOF, epoch)
+        root = h.compute_signing_root(aggregate_and_proof.hash_tree_root(), domain)
+        return self._raw_sign(pubkey, root)
+
+    def sign_voluntary_exit(self, pubkey: bytes, voluntary_exit) -> bytes:
+        domain = self._domain(DOMAIN_VOLUNTARY_EXIT, int(voluntary_exit.epoch))
+        root = h.compute_signing_root(voluntary_exit.hash_tree_root(), domain)
+        return self._raw_sign(pubkey, root)
+
+    def sign_sync_committee_message(self, pubkey: bytes, slot: int,
+                                    block_root: bytes) -> bytes:
+        epoch = slot // self.spec.slots_per_epoch
+        domain = self._domain(DOMAIN_SYNC_COMMITTEE, epoch)
+        root = h.compute_signing_root(bytes(block_root), domain)
+        return self._raw_sign(pubkey, root)
+
+    # ---------------------------------------------------------- aggregation
+
+    def is_aggregator(self, committee_length: int, selection_proof: bytes) -> bool:
+        """spec ``is_aggregator``: hash(selection_proof) mod max(1, len//16) == 0."""
+        import hashlib
+
+        modulo = max(1, committee_length // self.spec.target_aggregators_per_committee)
+        digest = hashlib.sha256(selection_proof).digest()
+        return int.from_bytes(digest[:8], "little") % modulo == 0

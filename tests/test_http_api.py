@@ -101,6 +101,33 @@ def test_duties(served):
     assert int(d0["validator_committee_index"]) < int(d0["committee_length"])
 
 
+def test_proposer_duties_match_per_slot_computation(served):
+    """Every duty entry must name the proposer the chain itself would pick at
+    that slot (regression: duties for slots before head reported the
+    head-slot proposer)."""
+    harness, server, client = served
+    from lighthouse_tpu.consensus import helpers as h
+
+    spec = harness.spec
+    epoch = harness.chain.current_slot() // spec.slots_per_epoch
+    duties = client.proposer_duties(epoch)["data"]
+    for d in duties:
+        slot = int(d["slot"])
+        state, _ = harness.chain.state_at_slot(max(slot, harness.chain.current_slot()))
+        # recompute on a state in the same epoch, explicit slot
+        expected = h.get_beacon_proposer_index(state, spec, slot=slot)
+        assert int(d["validator_index"]) == expected, f"slot {slot}"
+
+
+def test_historical_state_by_slot(served):
+    """GET /states/<past slot>/root resolves instead of 500ing."""
+    harness, server, client = served
+    root = client.state_root("2")
+    blk_root = harness.chain.block_root_at_slot(2)
+    st = harness.chain.get_state(blk_root)
+    assert root == st.hash_tree_root()
+
+
 def test_produce_sign_publish_roundtrip(served):
     """The core VC loop over the wire: duties → produce → sign → publish."""
     harness, server, client = served
