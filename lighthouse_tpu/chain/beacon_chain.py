@@ -101,6 +101,18 @@ class NaiveAggregationPool:
         self._pool = {k: v for k, v in self._pool.items() if k[0] >= cutoff}
 
 
+class AttestationCandidate:
+    """A spec-checked, indexed attestation awaiting signature verification
+    (the unit the gossip batch verifier coalesces)."""
+
+    __slots__ = ("attestation", "indexed", "signature_set")
+
+    def __init__(self, attestation, indexed, signature_set):
+        self.attestation = attestation
+        self.indexed = indexed
+        self.signature_set = signature_set
+
+
 class BeaconChain:
     def __init__(
         self,
@@ -161,6 +173,9 @@ class BeaconChain:
         self._migrated_slot = 0
         self.events = EventBus()
         self._last_finalized_epoch = 0
+        from .observed import ObservedCaches
+
+        self.observed = ObservedCaches()
 
     # ------------------------------------------------------------- storage
 
@@ -271,12 +286,14 @@ class BeaconChain:
 
     # ------------------------------------------------- attestation import
 
-    def process_attestation(self, attestation, is_from_block: bool = False) -> None:
-        """Verify an unaggregated/aggregated attestation (signature + spec
-        checks against the target's state) and apply it to fork choice + the
-        aggregation pool (reference ``attestation_verification.rs`` +
-        ``beacon_chain.rs:2139``)."""
+    def preverify_attestation(self, attestation) -> "AttestationCandidate":
+        """Spec checks + committee indexing; returns a candidate carrying the
+        signature set WITHOUT verifying it — the gossip batch coalescer
+        verifies many candidates in one device program
+        (reference ``attestation_verification.rs`` split into
+        ``verify_*_for_gossip`` parts 1/2 around the batch seam)."""
         from ..consensus import signature_sets as sets
+        from ..crypto.bls import api as bls
 
         data = attestation.data
         head_root = bytes(data.beacon_block_root)
@@ -300,28 +317,48 @@ class BeaconChain:
             indexed = h.get_indexed_attestation(base, attestation, self.types, self.spec)
         except Exception as e:
             raise AttestationError(f"cannot index attestation: {e}") from e
-        # Batch-of-one through the active backend (same path the gossip batch
-        # coalescer uses, attestation_verification/batch.rs:205) so the
-        # fake/jax backends apply here too.
-        from ..crypto.bls import api as bls
-
         try:
-            s = sets.indexed_attestation_signature_set(base, indexed, self.spec)
-            ok = bls.verify_signature_sets([s])
+            sig_set = sets.indexed_attestation_signature_set(base, indexed, self.spec)
         except bls.BlsError as e:
             raise AttestationError(f"malformed attestation signature: {e}") from e
-        if not ok:
-            raise AttestationError("bad attestation signature")
+        return AttestationCandidate(attestation, indexed, sig_set)
+
+    def apply_attestation(self, cand: "AttestationCandidate",
+                          is_from_block: bool = False) -> None:
+        """Apply an already-signature-verified candidate to fork choice and
+        the aggregation pool, and record it in the observed caches."""
+        data = cand.attestation.data
         self.fork_choice.on_attestation(
             current_slot=self.current_slot(),
             attestation_slot=int(data.slot),
-            attesting_indices=list(indexed.attesting_indices),
-            beacon_block_root=head_root,
+            attesting_indices=list(cand.indexed.attesting_indices),
+            beacon_block_root=bytes(data.beacon_block_root),
             target_epoch=int(data.target.epoch),
             target_root=bytes(data.target.root),
             is_from_block=is_from_block,
         )
-        self.attestation_pool.insert(attestation)
+        self.attestation_pool.insert(cand.attestation)
+        # Observe only single-attester (unaggregated) items: recording every
+        # index of an aggregate would later drop the validators' own subnet
+        # attestations as "already seen" and starve downstream aggregation.
+        if len(cand.indexed.attesting_indices) == 1:
+            self.observed.attesters.observe(
+                int(data.target.epoch), int(cand.indexed.attesting_indices[0])
+            )
+
+    def process_attestation(self, attestation, is_from_block: bool = False) -> None:
+        """Verify an unaggregated/aggregated attestation (signature + spec
+        checks against the target's state) and apply it to fork choice + the
+        aggregation pool (reference ``attestation_verification.rs`` +
+        ``beacon_chain.rs:2139``).  Batch-of-one through the active backend —
+        the gossip router uses preverify/apply directly to verify whole
+        drained batches in one device program."""
+        from ..crypto.bls import api as bls
+
+        cand = self.preverify_attestation(attestation)
+        if not bls.verify_signature_sets([cand.signature_set]):
+            raise AttestationError("bad attestation signature")
+        self.apply_attestation(cand, is_from_block)
 
     # ----------------------------------------------------------- production
 
@@ -551,6 +588,8 @@ class BeaconChain:
         self.recompute_head()
         self.attestation_pool.prune(slot)
         self.op_pool.prune(self.head_state, self.spec, current_slot=slot)
+        self.observed.prune(self.fork_choice.finalized_checkpoint[0],
+                            self.spec.slots_per_epoch)
 
     # ------------------------------------------------------------- queries
 

@@ -1,0 +1,124 @@
+"""Gossip dedup caches: the chain's first line of DoS defense.
+
+Equivalent of the reference's ``beacon_node/beacon_chain/src/observed_*``
+family (``observed_attesters.rs``, ``observed_aggregates.rs``,
+``observed_block_producers.rs``): before any signature work, gossip
+verification consults these caches so the same attestation/aggregate/block
+can never be re-verified arbitrarily often under replay — the spec's p2p
+validation rules made O(1).
+
+Membership is checked during gossip pre-verification and inserted only after
+successful signature verification (the reference's observe-after-verify
+order), so an attacker cannot poison the cache with invalid items.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Set, Tuple
+
+
+class ObservedAttesters:
+    """One unaggregated attestation per (validator, target epoch) — the
+    beacon_attestation_{subnet} gossip rule (observed_attesters.rs)."""
+
+    def __init__(self) -> None:
+        self._seen: Dict[int, Set[int]] = {}  # target_epoch -> {validator_index}
+        self._lock = threading.Lock()
+
+    def is_known(self, target_epoch: int, validator_index: int) -> bool:
+        with self._lock:
+            return validator_index in self._seen.get(target_epoch, ())
+
+    def observe(self, target_epoch: int, validator_index: int) -> bool:
+        """Record; returns False if it was already known."""
+        with self._lock:
+            s = self._seen.setdefault(target_epoch, set())
+            if validator_index in s:
+                return False
+            s.add(validator_index)
+            return True
+
+    def prune(self, finalized_epoch: int) -> None:
+        with self._lock:
+            for e in [e for e in self._seen if e < finalized_epoch]:
+                del self._seen[e]
+
+
+class ObservedAggregators(ObservedAttesters):
+    """One aggregate per (aggregator, target epoch) — the
+    beacon_aggregate_and_proof gossip rule (observed_attesters.rs
+    ``ObservedAggregators``)."""
+
+
+class ObservedAggregates:
+    """Seen aggregate attestation roots per slot, for exact-duplicate drops
+    (observed_aggregates.rs ``ObservedAttestations``)."""
+
+    def __init__(self) -> None:
+        self._seen: Dict[int, Set[bytes]] = {}  # slot -> {attestation htr}
+        self._lock = threading.Lock()
+
+    def is_known(self, slot: int, attestation_root: bytes) -> bool:
+        with self._lock:
+            return attestation_root in self._seen.get(slot, ())
+
+    def observe(self, slot: int, attestation_root: bytes) -> bool:
+        with self._lock:
+            s = self._seen.setdefault(slot, set())
+            if attestation_root in s:
+                return False
+            s.add(attestation_root)
+            return True
+
+    def prune(self, finalized_slot: int) -> None:
+        with self._lock:
+            for s in [s for s in self._seen if s < finalized_slot]:
+                del self._seen[s]
+
+
+class ObservedBlockProducers:
+    """One block per (proposer, slot); a second distinct root is an
+    equivocation (observed_block_producers.rs)."""
+
+    def __init__(self) -> None:
+        self._seen: Dict[Tuple[int, int], bytes] = {}  # (slot, proposer) -> root
+        self._lock = threading.Lock()
+
+    def status(self, slot: int, proposer: int, block_root: bytes) -> str:
+        """Read-only check: 'new', 'duplicate' (same root) or 'equivocation'.
+        Used BEFORE import; ``observe`` records only after the block passes
+        verification (observe-after-verify — an invalid block must not be
+        able to brand the honest proposer an equivocator)."""
+        with self._lock:
+            prev = self._seen.get((slot, proposer))
+            if prev is None:
+                return "new"
+            return "duplicate" if prev == block_root else "equivocation"
+
+    def observe(self, slot: int, proposer: int, block_root: bytes) -> None:
+        with self._lock:
+            self._seen.setdefault((slot, proposer), block_root)
+
+    def prune(self, finalized_slot: int) -> None:
+        with self._lock:
+            for k in [k for k in self._seen if k[0] < finalized_slot]:
+                del self._seen[k]
+
+
+class ObservedCaches:
+    """The bundle a chain owns, pruned together each finalization."""
+
+    def __init__(self) -> None:
+        self.attesters = ObservedAttesters()
+        self.aggregators = ObservedAggregators()
+        self.aggregates = ObservedAggregates()
+        self.block_producers = ObservedBlockProducers()
+        self.sync_contributors = ObservedAttesters()  # (slot-as-epoch, validator)
+
+    def prune(self, finalized_epoch: int, slots_per_epoch: int) -> None:
+        finalized_slot = finalized_epoch * slots_per_epoch
+        self.attesters.prune(finalized_epoch)
+        self.aggregators.prune(finalized_epoch)
+        self.aggregates.prune(finalized_slot)
+        self.block_producers.prune(finalized_slot)

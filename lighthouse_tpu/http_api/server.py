@@ -153,8 +153,9 @@ class Context:
         if int(st.slot) < slot:
             from ..consensus.per_slot import process_slots
 
-            st = st.copy()
-            process_slots(st, slot, chain.types, chain.spec)
+            # process_slots returns a NEW object when a fork upgrade occurs
+            # mid-advance — always take the return value.
+            st = process_slots(st.copy(), slot, chain.types, chain.spec)
         return st, broot
 
 
@@ -590,7 +591,10 @@ def _signed_block_from_json(ctx, body) -> Any:
     cls = types.signed_block.get(version)
     if cls is None:
         raise _bad(f"unknown consensus version {version!r}")
-    return container_from_json(cls, body)
+    try:
+        return container_from_json(cls, body)
+    except (KeyError, TypeError, ValueError) as e:
+        raise _bad(f"malformed {version} SignedBeaconBlock body: {e}")
 
 
 def _import_and_publish_block(ctx, signed_block):
@@ -1062,9 +1066,10 @@ class _Handler(BaseHTTPRequestHandler):
                 try:
                     result = self.api.spawner.blocking_json_task(priority, lambda: fn(ctx))
                     self._write_json(200, result)
-                except (ValueError, KeyError, TypeError) as e:
-                    # Malformed user input (bad ints/hex/missing fields) is a
-                    # contract 400, not a 500.
+                except ValueError as e:
+                    # Malformed user-supplied ints/hex parse straight to
+                    # ValueError — a contract 400.  Other exception types stay
+                    # 500s so server bugs aren't masked as client errors.
                     self._write_json(400, {"code": 400, "message": f"BAD_REQUEST: {e}"})
                 except ApiError as e:
                     if e.code in (200, 206):  # health-style status responses

@@ -27,10 +27,18 @@ class LocalNode:
         harness: Optional[BeaconChainHarness] = None,
         chain: Optional[BeaconChain] = None,
         max_workers: int = 2,
+        bls_backend: Optional[str] = None,
     ):
         if harness is not None:
             chain = harness.chain
         assert chain is not None
+        if bls_backend is not None:
+            # Node assembly selects the execution backend ("jax" = the batched
+            # device multi-pairing program); tests pass None to keep whatever
+            # the harness configured (fake/host).
+            from ..crypto.bls.backends import set_backend
+
+            set_backend(bls_backend)
         self.harness = harness
         self.chain = chain
         self.peer_id = peer_id

@@ -127,6 +127,21 @@ class Router:
         except Exception:
             self.service.peer_manager.report(sender, PeerAction.LOW_TOLERANCE, "undecodable block")
             return
+        # Proposer dedup/equivocation gate before any state work; the cache
+        # is only POPULATED after successful import (observe-after-verify),
+        # so an attacker's junk block cannot brand the honest proposer an
+        # equivocator (observed_block_producers.rs).
+        block_root = signed.message.hash_tree_root()
+        seen = chain.observed.block_producers.status(
+            int(signed.message.slot), int(signed.message.proposer_index), block_root
+        )
+        if seen == "duplicate":
+            return
+        if seen == "equivocation":
+            self.service.peer_manager.report(
+                sender, PeerAction.LOW_TOLERANCE, "proposer equivocation"
+            )
+            return
         try:
             chain.process_block(signed)
         except BlockError as e:
@@ -139,18 +154,29 @@ class Router:
                 return
             self.service.peer_manager.report(sender, PeerAction.LOW_TOLERANCE, f"bad block: {e}")
             return
+        chain.observed.block_producers.observe(
+            int(signed.message.slot), int(signed.message.proposer_index), block_root
+        )
         self.service.forward(topic, compressed, exclude=sender)
 
     def _process_gossip_attestations(self, items: List[tuple]) -> None:
         """Batch-coalesced attestation verification (reference
-        ``process_gossip_attestation_batch``): one backend call for the whole
-        drained batch would slot in here; per-item spec checks stay
-        individual with the fidelity fallback."""
+        ``process_gossip_attestation_batch`` /
+        ``attestation_verification/batch.rs:31-224``): every item in the
+        drained batch is spec-checked and dedup'd individually, then ALL
+        signature sets verify in ONE backend call — one padded device program
+        per drained queue batch.  On batch failure, fall back to per-item
+        verification so only the actually-bad items are penalized (the
+        fidelity fallback, batch.rs:205)."""
+        from ..crypto.bls import api as bls
+
+        chain = self.chain
+        candidates = []  # (candidate, topic, compressed, sender)
         for topic, uncompressed, compressed, sender in items:
-            chain = self.chain
             try:
                 kind = topics_mod.GossipTopic.parse(topic).kind
-                if kind == topics_mod.BEACON_AGGREGATE_AND_PROOF:
+                is_aggregate = kind == topics_mod.BEACON_AGGREGATE_AND_PROOF
+                if is_aggregate:
                     agg = chain.types.SignedAggregateAndProof.from_ssz_bytes(uncompressed)
                     attestation = agg.message.aggregate
                 else:
@@ -160,16 +186,59 @@ class Router:
                     sender, PeerAction.LOW_TOLERANCE, "undecodable attestation"
                 )
                 continue
+            # Observed-cache dedup BEFORE any signature work (the gossip
+            # replay/DoS defense; observed_attesters.rs semantics).
+            target_epoch = int(attestation.data.target.epoch)
+            if is_aggregate:
+                att_root = attestation.hash_tree_root()
+                if chain.observed.aggregates.is_known(int(attestation.data.slot), att_root):
+                    continue  # exact duplicate aggregate
+                if chain.observed.aggregators.is_known(
+                    target_epoch, int(agg.message.aggregator_index)
+                ):
+                    continue  # aggregator already aggregated this epoch
             try:
-                chain.process_attestation(attestation)
+                cand = chain.preverify_attestation(attestation)
             except AttestationError as e:
-                msg = str(e)
-                if "unknown head block" in msg:
+                if "unknown head block" in str(e):
                     continue  # behind — ignore, don't penalize (reference queues)
                 self.service.peer_manager.report(
                     sender, PeerAction.MID_TOLERANCE, f"bad attestation: {e}"
                 )
                 continue
+            if not is_aggregate:
+                vidx = (
+                    int(cand.indexed.attesting_indices[0])
+                    if len(cand.indexed.attesting_indices) == 1
+                    else None
+                )
+                if vidx is not None and chain.observed.attesters.is_known(
+                    target_epoch, vidx
+                ):
+                    continue  # validator already attested this epoch
+            candidates.append((cand, is_aggregate, agg if is_aggregate else None,
+                               topic, compressed, sender))
+        if not candidates:
+            return
+
+        # ONE device program for the whole drained batch.
+        batch_ok = bls.verify_signature_sets([c[0].signature_set for c in candidates])
+        for cand, is_aggregate, agg, topic, compressed, sender in candidates:
+            ok = batch_ok or bls.verify_signature_sets([cand.signature_set])
+            if not ok:
+                self.service.peer_manager.report(
+                    sender, PeerAction.MID_TOLERANCE, "bad attestation signature"
+                )
+                continue
+            chain.apply_attestation(cand)
+            if is_aggregate:
+                chain.observed.aggregates.observe(
+                    int(cand.attestation.data.slot), cand.attestation.hash_tree_root()
+                )
+                chain.observed.aggregators.observe(
+                    int(cand.attestation.data.target.epoch),
+                    int(agg.message.aggregator_index),
+                )
             self.service.forward(topic, compressed, exclude=sender)
 
     # --------------------------------------------------------------- rpc

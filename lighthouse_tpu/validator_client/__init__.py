@@ -97,7 +97,20 @@ class ValidatorClient:
     def run_forever(self, *, genesis_time: int, stop_after_slots: Optional[int] = None):
         """Wall-clock loop: propose at slot start, attest at +1/3, aggregate
         at +2/3 (the reference's slot-timing contract)."""
+        import logging
+
+        log = logging.getLogger("validator_client")
         sps = self.spec.seconds_per_slot
+
+        def safely(what, fn, *args):
+            # One failed duty (BN restart, slashing veto, ...) must never
+            # kill the loop — log and carry on to the next phase/slot.
+            try:
+                return fn(*args)
+            except Exception as e:
+                log.warning("%s failed at slot task: %s", what, e)
+                return None
+
         done = 0
         while stop_after_slots is None or done < stop_after_slots:
             now = time.time()
@@ -105,11 +118,11 @@ class ValidatorClient:
             slot_start = genesis_time + slot * sps
             epoch = slot // self.spec.slots_per_epoch
             if self._last_duties_epoch != epoch:
-                self.update_duties(epoch)
-            self.blocks.propose(slot)
+                safely("duties update", self.update_duties, epoch)
+            safely("propose", self.blocks.propose, slot)
             time.sleep(max(0.0, slot_start + sps / 3 - time.time()))
-            self.attester.attest(slot)
+            safely("attest", self.attester.attest, slot)
             time.sleep(max(0.0, slot_start + 2 * sps / 3 - time.time()))
-            self.attester.aggregate(slot)
+            safely("aggregate", self.attester.aggregate, slot)
             time.sleep(max(0.0, slot_start + sps - time.time()))
             done += 1

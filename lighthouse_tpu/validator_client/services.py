@@ -96,6 +96,8 @@ class DutiesService:
             del self._attesters[old]
         for old in [e for e in self._proposers if e + 2 < epoch]:
             del self._proposers[old]
+        for old in [e for e in self._dependent_roots if e + 2 < epoch]:
+            del self._dependent_roots[old]
 
     def _poll_attesters(self, epoch: int, indices: Dict[bytes, int]) -> None:
         resp = self.fallback.first_success(
@@ -179,24 +181,27 @@ class AttestationService:
         spec = self.store.spec
         duties = self.duties.attester_duties_at_slot(slot, spec)
         signed_aggregates = []
-        seen_committees = set()
+        fetched: Dict[int, Optional[object]] = {}  # committee -> aggregate (dedup fetch only)
         for duty in duties:
-            if duty.committee_index in seen_committees:
-                continue
             proof = self.store.selection_proof(duty.pubkey, slot)
             if not self.store.is_aggregator(duty.committee_length, proof):
                 continue
-            seen_committees.add(duty.committee_index)
-            data = self.fallback.first_success(
-                lambda c: c.attestation_data(slot, duty.committee_index, types=self.types)
-            )
-            try:
-                aggregate = self.fallback.first_success(
-                    lambda c: c.aggregate_attestation(
-                        slot, data.hash_tree_root(), types=self.types
-                    )
+            # Every elected aggregator publishes, even when several of our
+            # validators share a committee; only the FETCH is deduplicated.
+            if duty.committee_index not in fetched:
+                data = self.fallback.first_success(
+                    lambda c: c.attestation_data(slot, duty.committee_index, types=self.types)
                 )
-            except NoViableBeaconNode:
+                try:
+                    fetched[duty.committee_index] = self.fallback.first_success(
+                        lambda c: c.aggregate_attestation(
+                            slot, data.hash_tree_root(), types=self.types
+                        )
+                    )
+                except NoViableBeaconNode:
+                    fetched[duty.committee_index] = None
+            aggregate = fetched[duty.committee_index]
+            if aggregate is None:
                 continue  # no aggregate in the pool for this data
             message = self.types.AggregateAndProof(
                 aggregator_index=duty.validator_index,

@@ -1,0 +1,143 @@
+"""Gossip attestation batching: one device program per drained batch,
+observed-cache dedup, and the batch-fail → individual-reverify fidelity
+fallback (VERDICT r1 item 5; reference attestation_verification/batch.rs)."""
+
+import pytest
+
+from lighthouse_tpu import metrics
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.consensus import helpers as h
+from lighthouse_tpu.crypto.bls.backends import set_backend
+from lighthouse_tpu.network import topics as topics_mod
+from lighthouse_tpu.network.node import LocalNode
+from lighthouse_tpu.network.snappy_codec import compress
+from lighthouse_tpu.network.transport import Hub
+
+GENESIS_TIME = 1_600_000_000
+
+
+def _mk_node(fake=True):
+    harness = BeaconChainHarness(
+        validator_count=16, fake_crypto=fake, genesis_time=GENESIS_TIME
+    )
+    hub = Hub()
+    node = LocalNode(hub=hub, peer_id="n0", harness=harness)
+    return harness, node
+
+
+def _attestation_items(harness, node, slot, committee_index=0, tamper=()):
+    """Build gossip items (topic, uncompressed, compressed, sender) — one
+    single-attester attestation per committee member."""
+    chain = harness.chain
+    state, _ = (
+        chain.state_at_slot(slot)
+        if int(chain.head_state.slot) < slot
+        else (chain.head_state, chain.head_root)
+    )
+    committee = h.get_beacon_committee(state, slot, committee_index, harness.spec)
+    data = chain.produce_attestation_data(slot, committee_index)
+    subnet = topics_mod.compute_subnet_for_attestation(
+        state, slot, committee_index, harness.spec
+    )
+    topic = str(topics_mod.attestation_subnet_topic(node.router.fork_digest, subnet))
+    items = []
+    for pos, vidx in enumerate(committee):
+        bits = [False] * len(committee)
+        bits[pos] = True
+        sig = harness.sign_attestation_data(state, data, int(vidx)).to_bytes()
+        if pos in tamper:
+            # valid G2 point, wrong signer => passes deserialization, fails
+            # cryptographic verification (exercises the batch fallback)
+            wrong = committee[(pos + 1) % len(committee)]
+            sig = harness.sign_attestation_data(state, data, int(wrong)).to_bytes()
+        att = harness.types.Attestation(
+            aggregation_bits=bits, data=data, signature=sig
+        )
+        raw = att.as_ssz_bytes()
+        items.append((topic, raw, compress(raw), "peer-x"))
+    return items, committee
+
+
+def test_one_device_batch_per_drained_batch():
+    """N attestations in one drained batch => exactly ONE backend invocation
+    (the padded device program), asserted via the batch counters."""
+    set_backend("fake")
+    try:
+        harness, node = _mk_node(fake=True)
+        slot = harness.advance_slot()
+        items, committee = _attestation_items(harness, node, slot)
+        assert len(items) >= 2
+
+        before_inv = metrics.DEVICE_BATCH_INVOCATIONS.get()
+        before_sets = metrics.SIGNATURE_SETS_VERIFIED.get()
+        node.router._process_gossip_attestations(items)
+        assert metrics.DEVICE_BATCH_INVOCATIONS.get() - before_inv == 1
+        assert metrics.SIGNATURE_SETS_VERIFIED.get() - before_sets == len(items)
+        # all applied to the pool
+        assert len(harness.chain.attestation_pool._pool) == 1
+        agg = next(iter(harness.chain.attestation_pool._pool.values()))
+        assert sum(agg.aggregation_bits) == len(items)
+    finally:
+        set_backend("host")
+
+
+def test_observed_cache_dedup_blocks_replay():
+    """A replayed batch does no signature work at all (DoS defense)."""
+    set_backend("fake")
+    try:
+        harness, node = _mk_node(fake=True)
+        slot = harness.advance_slot()
+        items, _ = _attestation_items(harness, node, slot)
+        node.router._process_gossip_attestations(items)
+        before = metrics.DEVICE_BATCH_INVOCATIONS.get()
+        node.router._process_gossip_attestations(items)  # replay
+        assert metrics.DEVICE_BATCH_INVOCATIONS.get() == before, (
+            "replayed attestations must be dropped by the observed caches "
+            "before any backend call"
+        )
+    finally:
+        set_backend("host")
+
+
+def test_fidelity_fallback_isolates_bad_items():
+    """Real crypto: a batch with one bad signature fails as a whole, falls
+    back to per-item verification, and only the bad item is dropped."""
+    set_backend("host")
+    harness, node = _mk_node(fake=False)
+    slot = harness.advance_slot()
+    items, committee = _attestation_items(harness, node, slot, tamper={1})
+
+    before_inv = metrics.DEVICE_BATCH_INVOCATIONS.get()
+    node.router._process_gossip_attestations(items)
+    # 1 batch call + len(items) individual fallback calls
+    assert metrics.DEVICE_BATCH_INVOCATIONS.get() - before_inv == 1 + len(items)
+    agg = next(iter(harness.chain.attestation_pool._pool.values()))
+    assert sum(agg.aggregation_bits) == len(items) - 1, (
+        "exactly the tampered attestation must be rejected"
+    )
+    # the bad item's sender was penalized
+    pm = node.service.peer_manager
+    assert pm._peer("peer-x").score < 0
+
+
+def test_equivocating_proposer_penalized():
+    """Two distinct blocks from the same (slot, proposer) via gossip: the
+    second is an equivocation — dropped and penalized, never imported."""
+    set_backend("fake")
+    try:
+        harness, node = _mk_node(fake=True)
+        slot = harness.advance_slot()
+        b1 = harness.produce_signed_block(slot=slot, graffiti=b"\x01" * 32)
+        b2 = harness.produce_signed_block(slot=slot, graffiti=b"\x02" * 32)
+        assert b1.message.hash_tree_root() != b2.message.hash_tree_root()
+        topic = str(
+            topics_mod.GossipTopic(node.router.fork_digest, topics_mod.BEACON_BLOCK)
+        )
+        raw1, raw2 = b1.as_ssz_bytes(), b2.as_ssz_bytes()
+        node.router._process_gossip_block(topic, raw1, compress(raw1), "peer-a")
+        assert harness.chain.head_root == b1.message.hash_tree_root()
+        node.router._process_gossip_block(topic, raw2, compress(raw2), "peer-b")
+        assert harness.chain.get_block(b2.message.hash_tree_root()) is None
+        assert node.service.peer_manager._peer("peer-b").score < 0
+    finally:
+        set_backend("host")
