@@ -238,7 +238,7 @@ class BeaconChain:
                     self.spec,
                     strategy=BlockSignatureStrategy.VERIFY_BULK,
                     validate_result=True,
-                    payload_verifier=self.execution_engine.notify_new_payload,
+                    payload_verifier=self._payload_verifier_for(signed_block),
                 )
         except (BlockProcessingError, ValueError) as e:
             raise BlockError(f"state transition failed: {e}") from e
@@ -246,11 +246,16 @@ class BeaconChain:
         if block_delay_seconds is None:
             since_start = self.slot_clock.seconds_from_current_slot_start()
             block_delay_seconds = since_start if since_start is not None else 1e9
-        payload_status = (
-            ExecutionStatus.VALID
-            if hasattr(block.body, "execution_payload")
-            else ExecutionStatus.IRRELEVANT
-        )
+        if hasattr(block.body, "execution_payload"):
+            ph = bytes(block.body.execution_payload.block_hash)
+            optimistic = getattr(self.execution_engine, "optimistic_hashes", None)
+            payload_status = (
+                ExecutionStatus.OPTIMISTIC
+                if optimistic is not None and ph in optimistic
+                else ExecutionStatus.VALID
+            )
+        else:
+            payload_status = ExecutionStatus.IRRELEVANT
         self.fork_choice.on_block(
             current_slot=current_slot,
             block=block,
@@ -283,6 +288,28 @@ class BeaconChain:
             self.recompute_head()
         self.events.block(slot=int(block.slot), block_root=block_root)
         return block_root
+
+    def _payload_verifier_for(self, signed_block):
+        """The payload_verifier closure for one block's import.  A real
+        ``ExecutionLayer`` needs the deneb extras (blob versioned hashes +
+        parent beacon block root, engine_newPayloadV3); the in-proc mock's
+        plain ``notify_new_payload(payload)`` is used as-is."""
+        el = self.execution_engine
+        if not hasattr(el, "notify_forkchoice_updated"):
+            return el.notify_new_payload  # in-proc mock
+        body = signed_block.message.body
+        commitments = list(getattr(body, "blob_kzg_commitments", []) or [])
+        if not commitments and type(signed_block.message).fork_name != "deneb":
+            return el.notify_new_payload
+        from ..execution_layer.engine_api import kzg_commitment_to_versioned_hash
+
+        versioned = [kzg_commitment_to_versioned_hash(c) for c in commitments]
+        parent_root = bytes(signed_block.message.parent_root)
+        return lambda payload: el.notify_new_payload(
+            payload,
+            versioned_hashes=versioned,
+            parent_beacon_block_root=parent_root,
+        )
 
     # ------------------------------------------------- attestation import
 
@@ -522,6 +549,29 @@ class BeaconChain:
                 else st.hash_tree_root(),
                 epoch_transition=new_epoch > old_epoch,
             )
+        # Real ELs track our head (engine_forkchoiceUpdated on head change);
+        # the in-proc mock has no such method and is skipped.
+        if head != old_head and hasattr(self.execution_engine, "notify_forkchoice_updated"):
+            st2 = self._states.get(head)
+            if st2 is not None and hasattr(st2, "latest_execution_payload_header"):
+                f_root_now = self.fork_choice.finalized_checkpoint[1]
+                f_state = self._states.get(f_root_now)
+                f_hash = (
+                    bytes(f_state.latest_execution_payload_header.block_hash)
+                    if f_state is not None
+                    and hasattr(f_state, "latest_execution_payload_header")
+                    else b"\x00" * 32
+                )
+                try:
+                    self.execution_engine.notify_forkchoice_updated(
+                        head_block_hash=bytes(
+                            st2.latest_execution_payload_header.block_hash
+                        ),
+                        finalized_block_hash=f_hash,
+                        fork=type(st2).fork_name,
+                    )
+                except Exception:
+                    pass  # EL hiccups must never block head updates
         f_epoch, f_root = self.fork_choice.finalized_checkpoint
         if f_epoch > self._last_finalized_epoch:
             self._last_finalized_epoch = f_epoch

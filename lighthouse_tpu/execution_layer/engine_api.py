@@ -1,0 +1,225 @@
+"""Typed engine-API JSON-RPC client.
+
+Equivalent of the reference's ``execution_layer/src/engine_api/http.rs``
+(``HttpJsonRpc`` — newPayload/forkchoiceUpdated/getPayload V1-V3, capability
+exchange), with the payload JSON (de)serialization the engine spec defines:
+camelCase keys, 0x-hex QUANTITY/DATA encodings.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from . import auth
+
+STATUS_VALID = "VALID"
+STATUS_INVALID = "INVALID"
+STATUS_SYNCING = "SYNCING"
+STATUS_ACCEPTED = "ACCEPTED"
+STATUS_INVALID_BLOCK_HASH = "INVALID_BLOCK_HASH"
+
+SUPPORTED_METHODS = [
+    "engine_exchangeCapabilities",
+    "engine_newPayloadV1",
+    "engine_newPayloadV2",
+    "engine_newPayloadV3",
+    "engine_forkchoiceUpdatedV1",
+    "engine_forkchoiceUpdatedV2",
+    "engine_forkchoiceUpdatedV3",
+    "engine_getPayloadV1",
+    "engine_getPayloadV2",
+    "engine_getPayloadV3",
+]
+
+
+class EngineApiError(Exception):
+    def __init__(self, message: str, code: Optional[int] = None):
+        super().__init__(message)
+        self.code = code
+
+
+class EngineOffline(EngineApiError):
+    pass
+
+
+# --------------------------------------------------------- payload serde
+
+
+def _q(v: int) -> str:  # QUANTITY
+    return hex(int(v))
+
+
+def _d(b: bytes) -> str:  # DATA
+    return "0x" + bytes(b).hex()
+
+
+def withdrawal_to_json(w) -> Dict[str, str]:
+    """Engine-API WithdrawalV1 encoding — shared by payload serde and
+    PayloadAttributes construction."""
+    return {
+        "index": _q(w.index),
+        "validatorIndex": _q(w.validator_index),
+        "address": _d(w.address),
+        "amount": _q(w.amount),
+    }
+
+
+def kzg_commitment_to_versioned_hash(commitment: bytes) -> bytes:
+    """EIP-4844 versioned hash: 0x01 || sha256(commitment)[1:]."""
+    from hashlib import sha256
+
+    return b"\x01" + sha256(bytes(commitment)).digest()[1:]
+
+
+def payload_to_json(payload) -> Dict[str, Any]:
+    """ExecutionPayload container -> engine-API ExecutionPayloadV1/2/3 JSON."""
+    out = {
+        "parentHash": _d(payload.parent_hash),
+        "feeRecipient": _d(payload.fee_recipient),
+        "stateRoot": _d(payload.state_root),
+        "receiptsRoot": _d(payload.receipts_root),
+        "logsBloom": _d(payload.logs_bloom),
+        "prevRandao": _d(payload.prev_randao),
+        "blockNumber": _q(payload.block_number),
+        "gasLimit": _q(payload.gas_limit),
+        "gasUsed": _q(payload.gas_used),
+        "timestamp": _q(payload.timestamp),
+        "extraData": _d(payload.extra_data),
+        "baseFeePerGas": _q(payload.base_fee_per_gas),
+        "blockHash": _d(payload.block_hash),
+        "transactions": [_d(tx) for tx in payload.transactions],
+    }
+    if hasattr(payload, "withdrawals"):
+        out["withdrawals"] = [withdrawal_to_json(w) for w in payload.withdrawals]
+    if hasattr(payload, "blob_gas_used"):
+        out["blobGasUsed"] = _q(payload.blob_gas_used)
+        out["excessBlobGas"] = _q(payload.excess_blob_gas)
+    return out
+
+
+def payload_from_json(obj: Dict[str, Any], types, fork: str):
+    """Engine-API JSON -> the fork's ExecutionPayload container."""
+    cls = {
+        "bellatrix": types.ExecutionPayloadBellatrix,
+        "capella": types.ExecutionPayloadCapella,
+        "deneb": types.ExecutionPayloadDeneb,
+    }[fork]
+    kwargs = dict(
+        parent_hash=bytes.fromhex(obj["parentHash"][2:]),
+        fee_recipient=bytes.fromhex(obj["feeRecipient"][2:]),
+        state_root=bytes.fromhex(obj["stateRoot"][2:]),
+        receipts_root=bytes.fromhex(obj["receiptsRoot"][2:]),
+        logs_bloom=bytes.fromhex(obj["logsBloom"][2:]),
+        prev_randao=bytes.fromhex(obj["prevRandao"][2:]),
+        block_number=int(obj["blockNumber"], 16),
+        gas_limit=int(obj["gasLimit"], 16),
+        gas_used=int(obj["gasUsed"], 16),
+        timestamp=int(obj["timestamp"], 16),
+        extra_data=bytes.fromhex(obj["extraData"][2:]),
+        base_fee_per_gas=int(obj["baseFeePerGas"], 16),
+        block_hash=bytes.fromhex(obj["blockHash"][2:]),
+        transactions=[bytes.fromhex(tx[2:]) for tx in obj["transactions"]],
+    )
+    if fork in ("capella", "deneb"):
+        kwargs["withdrawals"] = [
+            types.Withdrawal(
+                index=int(w["index"], 16),
+                validator_index=int(w["validatorIndex"], 16),
+                address=bytes.fromhex(w["address"][2:]),
+                amount=int(w["amount"], 16),
+            )
+            for w in obj.get("withdrawals", [])
+        ]
+    if fork == "deneb":
+        kwargs["blob_gas_used"] = int(obj.get("blobGasUsed", "0x0"), 16)
+        kwargs["excess_blob_gas"] = int(obj.get("excessBlobGas", "0x0"), 16)
+    return cls(**kwargs)
+
+
+# ----------------------------------------------------------------- client
+
+
+class EngineApiClient:
+    def __init__(self, url: str, jwt_secret: bytes, timeout: float = 8.0):
+        self.url = url
+        self.jwt_secret = jwt_secret
+        self.timeout = timeout
+        self._id = 0
+
+    def rpc(self, method: str, params: List[Any]) -> Any:
+        self._id += 1
+        body = json.dumps({
+            "jsonrpc": "2.0", "id": self._id, "method": method, "params": params,
+        }).encode()
+        req = urllib.request.Request(
+            self.url,
+            data=body,
+            method="POST",
+            headers={
+                "Content-Type": "application/json",
+                "Authorization": "Bearer " + auth.generate_token(self.jwt_secret),
+            },
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            # An HTTP status from the engine is NOT "offline": 401 is an auth
+            # failure the operator must see (engines.rs State::AuthFailed).
+            detail = e.read().decode(errors="replace")[:200]
+            if e.code == 401:
+                raise EngineApiError(f"auth failed (401): {detail}", e.code) from None
+            raise EngineApiError(f"engine HTTP {e.code}: {detail}", e.code) from None
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise EngineOffline(f"engine unreachable: {e}") from None
+        if "error" in payload and payload["error"]:
+            err = payload["error"]
+            raise EngineApiError(err.get("message", "rpc error"), err.get("code"))
+        return payload.get("result")
+
+    # ------------------------------------------------------------- methods
+
+    def exchange_capabilities(self) -> List[str]:
+        return self.rpc("engine_exchangeCapabilities", [SUPPORTED_METHODS])
+
+    def new_payload(self, payload, fork: str,
+                    versioned_hashes: Optional[List[bytes]] = None,
+                    parent_beacon_block_root: Optional[bytes] = None) -> Dict[str, Any]:
+        """engine_newPayloadV1/V2/V3 by fork; returns the PayloadStatus."""
+        pj = payload_to_json(payload)
+        if fork == "deneb":
+            return self.rpc("engine_newPayloadV3", [
+                pj,
+                [_d(h) for h in (versioned_hashes or [])],
+                _d(parent_beacon_block_root or b"\x00" * 32),
+            ])
+        version = "engine_newPayloadV2" if fork == "capella" else "engine_newPayloadV1"
+        return self.rpc(version, [pj])
+
+    def forkchoice_updated(self, *, head_block_hash: bytes,
+                           safe_block_hash: bytes,
+                           finalized_block_hash: bytes,
+                           fork: str,
+                           payload_attributes: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        state = {
+            "headBlockHash": _d(head_block_hash),
+            "safeBlockHash": _d(safe_block_hash),
+            "finalizedBlockHash": _d(finalized_block_hash),
+        }
+        version = {
+            "bellatrix": "engine_forkchoiceUpdatedV1",
+            "capella": "engine_forkchoiceUpdatedV2",
+            "deneb": "engine_forkchoiceUpdatedV3",
+        }.get(fork, "engine_forkchoiceUpdatedV3")
+        return self.rpc(version, [state, payload_attributes])
+
+    def get_payload(self, payload_id: str, fork: str) -> Dict[str, Any]:
+        version = {
+            "bellatrix": "engine_getPayloadV1",
+            "capella": "engine_getPayloadV2",
+            "deneb": "engine_getPayloadV3",
+        }.get(fork, "engine_getPayloadV3")
+        return self.rpc(version, [payload_id])

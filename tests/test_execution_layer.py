@@ -1,0 +1,170 @@
+"""Execution-layer tests: JWT auth, engine state machine, and a chain whose
+block imports call engine_newPayload over a real socket — surviving an EL
+restart (VERDICT r1 item 8)."""
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.execution_layer import (
+    EngineOffline,
+    ExecutionLayer,
+    JwtError,
+    generate_token,
+    validate_token,
+)
+from lighthouse_tpu.execution_layer.engines import STATE_OFFLINE, STATE_ONLINE
+from lighthouse_tpu.execution_layer.mock_server import MockEngineServer
+
+SECRET = bytes(range(32))
+
+
+# ----------------------------------------------------------------- JWT
+
+
+class TestJwtAuth:
+    def test_roundtrip(self):
+        token = generate_token(SECRET)
+        validate_token(token, SECRET)  # no raise
+
+    def test_wrong_secret_rejected(self):
+        token = generate_token(SECRET)
+        with pytest.raises(JwtError, match="bad signature"):
+            validate_token(token, b"\x01" * 32)
+
+    def test_stale_iat_rejected(self):
+        token = generate_token(SECRET, iat=1_000_000)
+        with pytest.raises(JwtError, match="stale"):
+            validate_token(token, SECRET)
+
+    def test_malformed_rejected(self):
+        with pytest.raises(JwtError):
+            validate_token("not.a.jwt.at.all", SECRET)
+
+
+# ------------------------------------------------------- engine machine
+
+
+def test_engine_state_machine_and_capabilities():
+    server = MockEngineServer(SECRET).start()
+    try:
+        el = ExecutionLayer(url=server.url, jwt_secret=SECRET)
+        assert el.engine.state == STATE_OFFLINE
+        assert el.is_online()
+        assert el.engine.state == STATE_ONLINE
+        assert "engine_newPayloadV3" in el.engine.capabilities
+    finally:
+        server.stop()
+
+
+def test_engine_rejects_bad_jwt():
+    from lighthouse_tpu.execution_layer.engines import STATE_AUTH_FAILED
+
+    server = MockEngineServer(SECRET).start()
+    try:
+        el = ExecutionLayer(url=server.url, jwt_secret=b"\x02" * 32)
+        assert not el.is_online()
+        # a 401 is an auth failure the operator must see, not "offline"
+        assert el.engine.state == STATE_AUTH_FAILED
+    finally:
+        server.stop()
+
+
+def test_engine_offline_when_unreachable():
+    el = ExecutionLayer(url="http://127.0.0.1:9", jwt_secret=SECRET, timeout=0.3)
+    assert not el.is_online()
+    with pytest.raises(EngineOffline):
+        el.engine.request(lambda api: api.exchange_capabilities())
+
+
+# --------------------------------------------------- chain integration
+
+
+@pytest.fixture()
+def el_chain():
+    """Harness chain whose execution engine is the REAL ExecutionLayer client
+    speaking JSON-RPC to a socket-served mock engine."""
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+
+    set_backend("fake")
+    harness = BeaconChainHarness(validator_count=16, fake_crypto=True)
+    server = MockEngineServer(SECRET).start()
+    el = ExecutionLayer(url=server.url, jwt_secret=SECRET)
+    harness.chain.execution_engine = el
+    yield harness, server, el
+    server.stop()
+    set_backend("host")
+
+
+def test_block_import_calls_new_payload_over_socket(el_chain):
+    harness, server, el = el_chain
+    before = server.payloads_seen
+    roots = harness.extend_chain(3)
+    assert len(roots) == 3
+    # every import called engine_newPayload over the socket
+    assert server.payloads_seen == before + 3
+    # head changes drove engine_forkchoiceUpdated too
+    assert server.fcu_seen > 0
+
+
+def test_produce_payload_roundtrip(el_chain):
+    """produce_block pulls its payload from the engine: forkchoiceUpdated
+    with attributes -> payloadId -> getPayload -> container."""
+    harness, server, el = el_chain
+    harness.extend_chain(1)
+    slot = harness.advance_slot()
+    signed = harness.produce_signed_block(slot=slot)
+    payload = signed.message.body.execution_payload
+    assert int(payload.block_number) > 0
+    assert bytes(payload.parent_hash) != b""
+    harness.chain.process_block(signed)
+    assert harness.chain.head_root == signed.message.hash_tree_root()
+
+
+def test_invalid_payload_rejected(el_chain):
+    harness, server, el = el_chain
+    harness.extend_chain(1)
+    slot = harness.advance_slot()
+    signed = harness.produce_signed_block(slot=slot)
+    server.invalid_hashes.add(
+        bytes(signed.message.body.execution_payload.block_hash)
+    )
+    from lighthouse_tpu.chain.beacon_chain import BlockError
+
+    with pytest.raises(BlockError):
+        harness.chain.process_block(signed)
+
+
+def test_syncing_payload_imports_optimistically(el_chain):
+    harness, server, el = el_chain
+    harness.extend_chain(1)
+    slot = harness.advance_slot()
+    signed = harness.produce_signed_block(slot=slot)
+    block_hash = bytes(signed.message.body.execution_payload.block_hash)
+    server.syncing_hashes.add(block_hash)
+    harness.chain.process_block(signed)
+    assert block_hash in el.optimistic_hashes
+
+
+def test_chain_survives_el_restart(el_chain):
+    """EL dies mid-operation; the engine flips offline; after the EL comes
+    back on the same port, imports succeed again (engines.rs recovery)."""
+    harness, server, el = el_chain
+    harness.extend_chain(2)
+    port = int(server.url.rsplit(":", 1)[1])
+    server.stop()
+
+    slot = harness.advance_slot()
+    with pytest.raises(EngineOffline):
+        harness.produce_signed_block(slot=slot)  # getPayload against dead EL
+    assert el.engine.state == STATE_OFFLINE
+
+    # resurrect on the same port (a real EL restart)
+    revived = MockEngineServer(SECRET, port=port).start()
+    try:
+        el.engine._last_upcheck = 0.0  # skip the cooldown for the test
+        signed = harness.produce_signed_block(slot=slot)
+        harness.chain.process_block(signed)
+        assert harness.chain.head_root == signed.message.hash_tree_root()
+        assert el.engine.state == STATE_ONLINE
+    finally:
+        revived.stop()
