@@ -64,7 +64,15 @@ class NaiveAggregationPool:
     def insert(self, attestation) -> None:
         from ..crypto.bls import api as bls
 
-        key = (int(attestation.data.slot), attestation.data.hash_tree_root())
+        # electra attestations with identical data (index=0) but different
+        # committee_bits must NOT merge — their aggregation bitlists index
+        # different committees.
+        cb = getattr(attestation, "committee_bits", None)
+        key = (
+            int(attestation.data.slot),
+            attestation.data.hash_tree_root()
+            + (bytes(1 if b else 0 for b in cb) if cb is not None else b""),
+        )
         existing = self._pool.get(key)
         if existing is None:
             self._pool[key] = attestation.copy()
@@ -176,6 +184,14 @@ class BeaconChain:
         from .observed import ObservedCaches
 
         self.observed = ObservedCaches()
+        from .da import DataAvailabilityChecker
+
+        self.da_checker = DataAvailabilityChecker(
+            spec=spec, types=types, kzg=kzg,
+            header_verifier=self.verify_block_header_signature,
+            slot_provider=self.current_slot,
+        )
+        self._blob_sidecars: Dict[bytes, list] = {}
 
     # ------------------------------------------------------------- storage
 
@@ -193,6 +209,10 @@ class BeaconChain:
 
     def get_block(self, block_root: bytes):
         return self._blocks.get(block_root)
+
+    def get_blobs(self, block_root: bytes) -> list:
+        """Blob sidecars stored at import (the blob_sidecars API's source)."""
+        return list(self._blob_sidecars.get(block_root, []))
 
     def get_state(self, block_root: bytes):
         return self._states.get(block_root)
@@ -215,7 +235,15 @@ class BeaconChain:
         with metrics.BLOCK_IMPORT_SECONDS.time():
             return self._process_block_inner(signed_block, block_delay_seconds)
 
-    def _process_block_inner(self, signed_block, block_delay_seconds):
+    def process_block_with_blobs(self, signed_block, sidecars,
+                                 block_delay_seconds: Optional[float] = None) -> bytes:
+        """Import a block together with its blob sidecars (RPC/API path)."""
+        with metrics.BLOCK_IMPORT_SECONDS.time():
+            return self._process_block_inner(
+                signed_block, block_delay_seconds, sidecars=sidecars
+            )
+
+    def _process_block_inner(self, signed_block, block_delay_seconds, sidecars=None):
         block = signed_block.message
         block_root = block.hash_tree_root()
         if block_root in self._blocks or block_root == self.genesis_block_root:
@@ -227,6 +255,26 @@ class BeaconChain:
         parent_state = self._states.get(parent_root)
         if parent_state is None:
             raise BlockError(f"unknown parent {parent_root.hex()[:16]}")
+
+        # Deneb data-availability gate (data_availability_checker.rs): a
+        # block with commitments imports only when every blob is verified.
+        # Runs AFTER the slot/parent sanity checks so junk blocks can never
+        # park in the pending store (DoS surface).
+        if getattr(block.body, "blob_kzg_commitments", None):
+            from .da import BlobError
+
+            try:
+                status, result = self.da_checker.check_availability(
+                    signed_block, sidecars=sidecars
+                )
+            except BlobError as e:
+                raise BlockError(f"blob verification failed: {e}") from e
+            if status != "available":
+                self.da_checker.put_pending_block(signed_block)
+                raise BlockError(f"pending availability: missing blobs {result}")
+            blob_sidecars = result
+        else:
+            blob_sidecars = []
 
         state = parent_state.copy()
         try:
@@ -266,6 +314,15 @@ class BeaconChain:
         )
         self._store_block(block_root, signed_block, state)
         self.observed_block_roots.add(block_root)
+        if blob_sidecars:
+            self._blob_sidecars[block_root] = list(blob_sidecars)
+            for sc in blob_sidecars:
+                self.events.publish("blob_sidecar", {
+                    "block_root": "0x" + block_root.hex(),
+                    "index": str(int(sc.index)),
+                    "slot": str(int(block.slot)),
+                    "kzg_commitment": "0x" + bytes(sc.kzg_commitment).hex(),
+                })
 
         # Feed the block's attestations to fork choice (reference
         # ``import_block`` → on_attestation(is_from_block=true)).
@@ -289,6 +346,33 @@ class BeaconChain:
         self.events.block(slot=int(block.slot), block_root=block_root)
         return block_root
 
+    def verify_block_header_signature(self, signed_header) -> bool:
+        """Proposer signature on a detached ``SignedBeaconBlockHeader`` (the
+        blob-sidecar gossip rule — a forged header must not enter the DA
+        cache or be re-forwarded)."""
+        from ..consensus import signature_sets as sets
+        from ..crypto.bls import api as bls
+        from ..types.spec import DOMAIN_BEACON_PROPOSER
+
+        header = signed_header.message
+        state = self._states.get(bytes(header.parent_root)) or self.head_state
+        proposer = int(header.proposer_index)
+        if proposer >= len(state.validators):
+            return False
+        epoch = int(header.slot) // self.spec.slots_per_epoch
+        domain = h.get_domain(state, DOMAIN_BEACON_PROPOSER, epoch, self.spec)
+        root = h.compute_signing_root(header.hash_tree_root(), domain)
+        try:
+            pk = sets.pubkey_cache(bytes(state.validators[proposer].pubkey))
+            s = bls.SignatureSet.single_pubkey(
+                bls.Signature.from_bytes(bytes(signed_header.signature)), pk, root
+            )
+            # through the active backend (fake/host/jax), like every other
+            # chain signature check
+            return bls.verify_signature_sets([s])
+        except (bls.BlsError, ValueError):
+            return False
+
     def _payload_verifier_for(self, signed_block):
         """The payload_verifier closure for one block's import.  A real
         ``ExecutionLayer`` needs the deneb extras (blob versioned hashes +
@@ -299,16 +383,22 @@ class BeaconChain:
             return el.notify_new_payload  # in-proc mock
         body = signed_block.message.body
         commitments = list(getattr(body, "blob_kzg_commitments", []) or [])
-        if not commitments and type(signed_block.message).fork_name != "deneb":
+        if not commitments and type(signed_block.message).fork_name not in (
+            "deneb", "electra",
+        ):
             return el.notify_new_payload
         from ..execution_layer.engine_api import kzg_commitment_to_versioned_hash
 
         versioned = [kzg_commitment_to_versioned_hash(c) for c in commitments]
         parent_root = bytes(signed_block.message.parent_root)
+        fork = type(signed_block.message).fork_name
+        requests = getattr(body, "execution_requests", None)
         return lambda payload: el.notify_new_payload(
             payload,
             versioned_hashes=versioned,
             parent_beacon_block_root=parent_root,
+            execution_requests=requests,
+            fork=fork,
         )
 
     # ------------------------------------------------- attestation import
@@ -412,6 +502,7 @@ class BeaconChain:
         sync_aggregate=None,
         parent_root: Optional[bytes] = None,
         pre_state=None,
+        blob_kzg_commitments: Optional[List[bytes]] = None,
     ):
         """Assemble an unsigned block on the current head (or on
         ``parent_root`` — how tests build forks); reference
@@ -437,9 +528,12 @@ class BeaconChain:
         # (reference: produce_block_on_state → op_pool.get_attestations).
         for att in self.attestation_pool.get_for_block(state, spec, 10_000):
             self.op_pool.insert_attestation(att)
-        attestations = self.op_pool.get_attestations(
-            state, types, spec, spec.preset.max_attestations
+        max_atts = (
+            spec.preset.max_attestations_electra
+            if fork == "electra"
+            else spec.preset.max_attestations
         )
+        attestations = self.op_pool.get_attestations(state, types, spec, max_atts)
         proposer_slashings, attester_slashings = self.op_pool.get_slashings(
             state, spec, types
         )
@@ -465,15 +559,29 @@ class BeaconChain:
                 )
             body_kwargs["sync_aggregate"] = sync_aggregate
         if "execution_payload" in body_cls.fields:
-            body_kwargs["execution_payload"] = self.execution_engine.produce_payload(
-                state, types, spec
-            )
+            if fork == "electra" and hasattr(
+                self.execution_engine, "produce_payload_and_requests"
+            ):
+                payload, requests = self.execution_engine.produce_payload_and_requests(
+                    state, types, spec
+                )
+                body_kwargs["execution_payload"] = payload
+                body_kwargs["execution_requests"] = requests
+            else:
+                body_kwargs["execution_payload"] = self.execution_engine.produce_payload(
+                    state, types, spec
+                )
         if "bls_to_execution_changes" in body_cls.fields:
             body_kwargs["bls_to_execution_changes"] = (
                 self.op_pool.get_bls_to_execution_changes(state, spec)
             )
         if "blob_kzg_commitments" in body_cls.fields:
-            body_kwargs["blob_kzg_commitments"] = []
+            body_kwargs["blob_kzg_commitments"] = list(blob_kzg_commitments or [])
+        if "execution_requests" in body_cls.fields and "execution_requests" not in body_kwargs:
+            # mock-EL path: no EL-triggered requests
+            body_kwargs["execution_requests"] = types.ExecutionRequests(
+                deposits=[], withdrawals=[], consolidations=[]
+            )
 
         block_cls = types.block[fork]
         block = block_cls(
@@ -517,9 +625,14 @@ class BeaconChain:
             target_root = head_root  # head at/before the boundary is the target
         else:
             target_root = h.get_block_root(state, epoch, spec)
+        # EIP-7549: post-electra the data's index is always 0 — the committee
+        # is conveyed by the attestation's committee_bits instead.
+        data_index = (
+            0 if spec.fork_name_at_slot(slot) == "electra" else committee_index
+        )
         return types.AttestationData(
             slot=slot,
-            index=committee_index,
+            index=data_index,
             beacon_block_root=head_root,
             source=state.current_justified_checkpoint.copy(),
             target=types.Checkpoint(epoch=epoch, root=target_root),
@@ -640,6 +753,19 @@ class BeaconChain:
         self.op_pool.prune(self.head_state, self.spec, current_slot=slot)
         self.observed.prune(self.fork_choice.finalized_checkpoint[0],
                             self.spec.slots_per_epoch)
+        f_slot = self.fork_choice.finalized_checkpoint[0] * self.spec.slots_per_epoch
+        self.da_checker.prune(f_slot)
+        # Blob retention horizon (spec MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS):
+        # drop sidecars for pruned forks immediately and canonical blobs once
+        # they age out — otherwise 128KiB-per-blob storage grows forever.
+        horizon_slot = slot - (
+            self.spec.min_epochs_for_blob_sidecars_requests * self.spec.slots_per_epoch
+        )
+        for root in list(self._blob_sidecars):
+            if root not in self._blocks:
+                self._blob_sidecars.pop(root, None)
+            elif int(self._blocks[root].message.slot) < horizon_slot:
+                self._blob_sidecars.pop(root, None)
 
     # ------------------------------------------------------------- queries
 

@@ -37,6 +37,7 @@ class BeaconChainHarness:
         spec: Optional[ChainSpec] = None,
         genesis_time: int = 1_600_000_000,
         fake_crypto: bool = False,
+        kzg=None,
     ):
         """``fake_crypto=True`` switches the BLS backend to the always-valid
         impl and signs with a canned G2 point — the reference's
@@ -69,6 +70,7 @@ class BeaconChainHarness:
             spec=self.spec,
             slot_clock=ManualSlotClock(genesis_time, self.spec.seconds_per_slot),
             execution_engine=MockExecutionEngine(),
+            kzg=kzg,
         )
 
     # ------------------------------------------------------------- signing
@@ -156,6 +158,60 @@ class BeaconChainHarness:
         )
         return self.sign_block(block, pre_state)
 
+    def produce_signed_block_with_blobs(
+        self,
+        blobs: Sequence[bytes],
+        slot: Optional[int] = None,
+        sync_participation: bool = True,
+    ):
+        """Produce + sign a deneb block carrying ``blobs``, returning
+        ``(signed_block, sidecars)`` with inclusion proofs + KZG proofs from
+        the chain's KZG engine (the fake-EL analog of the blobsBundle flow)."""
+        from .da import compute_blob_inclusion_proof
+
+        chain, types = self.chain, self.types
+        kzg = chain.kzg
+        assert kzg is not None, "harness needs a Kzg engine for blob production"
+        slot = chain.current_slot() if slot is None else slot
+        pre_state, parent_root = chain.state_at_slot(slot)
+        proposer = h.get_beacon_proposer_index(pre_state, self.spec)
+        reveal = self.randao_reveal(pre_state, slot, proposer)
+        sync_aggregate = None
+        if sync_participation and hasattr(pre_state, "current_sync_committee"):
+            sync_aggregate = self.make_sync_aggregate(pre_state, parent_root, slot)
+        commitments = [kzg.blob_to_kzg_commitment(b) for b in blobs]
+        proofs = [kzg.compute_blob_kzg_proof(b, c) for b, c in zip(blobs, commitments)]
+        block, _ = chain.produce_block(
+            slot, reveal, sync_aggregate=sync_aggregate,
+            parent_root=parent_root, pre_state=pre_state.copy(),
+            blob_kzg_commitments=commitments,
+        )
+        signed = self.sign_block(block, pre_state)
+        header = types.SignedBeaconBlockHeader(
+            message=types.BeaconBlockHeader(
+                slot=block.slot,
+                proposer_index=block.proposer_index,
+                parent_root=block.parent_root,
+                state_root=block.state_root,
+                body_root=block.body.hash_tree_root(),
+            ),
+            signature=signed.signature,
+        )
+        sidecars = [
+            types.BlobSidecar(
+                index=i,
+                blob=blob,
+                kzg_commitment=commitments[i],
+                kzg_proof=proofs[i],
+                signed_block_header=header,
+                kzg_commitment_inclusion_proof=compute_blob_inclusion_proof(
+                    block.body, i
+                ),
+            )
+            for i, blob in enumerate(blobs)
+        ]
+        return signed, sidecars
+
     def attest_to_head(
         self, slot: Optional[int] = None, validators: Optional[Sequence[int]] = None
     ) -> int:
@@ -171,6 +227,7 @@ class BeaconChainHarness:
         included = 0
         committees = h.get_committee_count_per_slot(state, h.compute_epoch_at_slot(slot, spec), spec)
         allowed = set(validators) if validators is not None else None
+        electra = spec.fork_name_at_slot(slot) == "electra"
         for index in range(committees):
             committee = h.get_beacon_committee(state, slot, index, spec)
             data = chain.produce_attestation_data(slot, index)
@@ -179,11 +236,20 @@ class BeaconChainHarness:
                     continue
                 bits = [False] * len(committee)
                 bits[pos] = True
-                att = types.Attestation(
-                    aggregation_bits=bits,
-                    data=data,
-                    signature=self.sign_attestation_data(state, data, int(vidx)).to_bytes(),
-                )
+                sig = self.sign_attestation_data(state, data, int(vidx)).to_bytes()
+                if electra:
+                    committee_bits = [False] * spec.preset.max_committees_per_slot
+                    committee_bits[index] = True
+                    att = types.AttestationElectra(
+                        aggregation_bits=bits,
+                        data=data,
+                        signature=sig,
+                        committee_bits=committee_bits,
+                    )
+                else:
+                    att = types.Attestation(
+                        aggregation_bits=bits, data=data, signature=sig
+                    )
                 chain.process_attestation(att)
                 included += 1
         return included

@@ -35,6 +35,7 @@ class MockExecutionEngine:
             "bellatrix": types.ExecutionPayloadBellatrix,
             "capella": types.ExecutionPayloadCapella,
             "deneb": types.ExecutionPayloadDeneb,
+            "electra": types.ExecutionPayloadDeneb,  # structurally identical
         }[fork]
         parent_hash = bytes(state.latest_execution_payload_header.block_hash)
         if not is_merge_transition_complete(state):
@@ -60,9 +61,9 @@ class MockExecutionEngine:
             block_hash=block_hash,
             transactions=[],
         )
-        if fork in ("capella", "deneb"):
+        if fork in ("capella", "deneb", "electra"):
             kwargs["withdrawals"] = h.get_expected_withdrawals(state, types, spec)
-        if fork == "deneb":
+        if fork in ("deneb", "electra"):
             kwargs["blob_gas_used"] = 0
             kwargs["excess_blob_gas"] = 0
         return cls(**kwargs)

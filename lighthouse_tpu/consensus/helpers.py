@@ -311,7 +311,13 @@ def is_slashable_attestation_data(data_1, data_2) -> bool:
     return double or surround
 
 
-def is_eligible_for_activation_queue(v, spec: ChainSpec) -> bool:
+def is_eligible_for_activation_queue(v, spec: ChainSpec, fork: str = "phase0") -> bool:
+    if fork == "electra":
+        # EIP-7251: any balance >= 32 ETH queues
+        return (
+            v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+            and v.effective_balance >= spec.min_activation_balance
+        )
     return (
         v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
         and v.effective_balance == spec.max_effective_balance
@@ -328,7 +334,23 @@ def is_eligible_for_activation(state, v) -> bool:
 # ----------------------------------------------------------- attestations
 
 
-def get_attesting_indices(state, data, aggregation_bits, spec: ChainSpec) -> List[int]:
+def get_attesting_indices(state, data, aggregation_bits, spec: ChainSpec,
+                          committee_bits=None) -> List[int]:
+    if committee_bits is not None:
+        # EIP-7549: one attestation spans the slot's committees, selected by
+        # committee_bits; aggregation_bits concatenates those committees.
+        output = set()
+        offset = 0
+        bits = list(aggregation_bits)
+        for committee_index in get_committee_indices(committee_bits):
+            committee = get_beacon_committee(state, data.slot, committee_index, spec)
+            for pos, vidx in enumerate(committee):
+                if offset + pos < len(bits) and bits[offset + pos]:
+                    output.add(int(vidx))
+            offset += len(committee)
+        if offset != len(bits):
+            raise ValueError("electra aggregation bitlist length mismatch")
+        return sorted(output)
     committee = get_beacon_committee(state, data.slot, data.index, spec)
     if len(aggregation_bits) != len(committee):
         raise ValueError("aggregation bitlist length != committee size")
@@ -336,19 +358,32 @@ def get_attesting_indices(state, data, aggregation_bits, spec: ChainSpec) -> Lis
 
 
 def get_indexed_attestation(state, attestation, types, spec: ChainSpec):
-    indices = get_attesting_indices(state, attestation.data, attestation.aggregation_bits, spec)
-    return types.IndexedAttestation(
+    committee_bits = getattr(attestation, "committee_bits", None)
+    indices = get_attesting_indices(
+        state, attestation.data, attestation.aggregation_bits, spec,
+        committee_bits=committee_bits,
+    )
+    cls = (
+        types.IndexedAttestationElectra
+        if committee_bits is not None
+        else types.IndexedAttestation
+    )
+    return cls(
         attesting_indices=indices,
         data=attestation.data,
         signature=attestation.signature,
     )
 
 
-def is_valid_indexed_attestation_structure(indexed, spec: ChainSpec) -> bool:
+def is_valid_indexed_attestation_structure(indexed, spec: ChainSpec,
+                                           electra: bool = False) -> bool:
     """Structural half of ``is_valid_indexed_attestation`` (signature checks
     happen through the batched BLS path, signature_sets.py)."""
     indices = list(indexed.attesting_indices)
-    if not indices or len(indices) > spec.preset.max_validators_per_committee:
+    limit = spec.preset.max_validators_per_committee
+    if electra:
+        limit *= spec.preset.max_committees_per_slot  # EIP-7549 span
+    if not indices or len(indices) > limit:
         return False
     return indices == sorted(set(indices))
 
@@ -379,6 +414,14 @@ def initiate_validator_exit(state, index: int, spec: ChainSpec) -> None:
     v = state.validators[index]
     if v.exit_epoch != FAR_FUTURE_EPOCH:
         return
+    if type(state).fork_name == "electra":
+        # EIP-7251: balance-weighted exit churn
+        v.exit_epoch = compute_exit_epoch_and_update_churn(
+            state, int(v.effective_balance), spec
+        )
+        v.withdrawable_epoch = v.exit_epoch + spec.min_validator_withdrawability_delay
+        _caches(state).pop("total_active_balance", None)
+        return
     eq = _exit_queue(state, spec)
     if eq[1] >= get_validator_churn_limit(state, spec):
         eq[0] += 1
@@ -406,6 +449,8 @@ def slash_validator(
         min_quotient = spec.min_slashing_penalty_quotient
     elif fork == "altair":
         min_quotient = spec.min_slashing_penalty_quotient_altair
+    elif fork == "electra":
+        min_quotient = spec.min_slashing_penalty_quotient_electra
     else:
         min_quotient = spec.min_slashing_penalty_quotient_bellatrix
     decrease_balance(state, slashed_index, v.effective_balance // min_quotient)
@@ -413,7 +458,12 @@ def slash_validator(
     proposer_index = get_beacon_proposer_index(state, spec)
     if whistleblower_index is None:
         whistleblower_index = proposer_index
-    whistleblower_reward = v.effective_balance // spec.whistleblower_reward_quotient
+    wb_quotient = (
+        spec.whistleblower_reward_quotient_electra
+        if fork == "electra"
+        else spec.whistleblower_reward_quotient
+    )
+    whistleblower_reward = v.effective_balance // wb_quotient
     if fork == "phase0":
         proposer_reward = whistleblower_reward // spec.proposer_reward_quotient
     else:
@@ -529,7 +579,205 @@ def is_partially_withdrawable_validator(v, balance: int, spec: ChainSpec) -> boo
     )
 
 
+# ---------------------------------------------------------------- electra
+# EIP-7251 (maxEB), EIP-7549 (committee-spanning attestations),
+# EIP-7002/6110 (execution-triggered exits / deposits).  Reference:
+# consensus/types + state_processing electra arms.
+
+
+def has_compounding_withdrawal_credential(v, spec: ChainSpec) -> bool:
+    return bytes(v.withdrawal_credentials)[:1] == spec.compounding_withdrawal_prefix
+
+
+def has_execution_withdrawal_credential(v, spec: ChainSpec) -> bool:
+    return has_compounding_withdrawal_credential(v, spec) or has_eth1_withdrawal_credential(v)
+
+
+def get_max_effective_balance(v, spec: ChainSpec) -> int:
+    if has_compounding_withdrawal_credential(v, spec):
+        return spec.max_effective_balance_electra
+    return spec.min_activation_balance
+
+
+def is_fully_withdrawable_validator_electra(v, balance: int, epoch: int, spec) -> bool:
+    return (
+        has_execution_withdrawal_credential(v, spec)
+        and v.withdrawable_epoch <= epoch
+        and balance > 0
+    )
+
+
+def is_partially_withdrawable_validator_electra(v, balance: int, spec: ChainSpec) -> bool:
+    max_eb = get_max_effective_balance(v, spec)
+    return (
+        has_execution_withdrawal_credential(v, spec)
+        and v.effective_balance == max_eb
+        and balance > max_eb
+    )
+
+
+def get_balance_churn_limit(state, spec: ChainSpec) -> int:
+    """Per-epoch churn in GWEI (EIP-7251 replaces count-based churn)."""
+    churn = max(
+        spec.min_per_epoch_churn_limit_electra,
+        get_total_active_balance(state, spec) // spec.churn_limit_quotient,
+    )
+    return churn - churn % spec.effective_balance_increment
+
+
+def get_activation_exit_churn_limit(state, spec: ChainSpec) -> int:
+    return min(spec.max_per_epoch_activation_exit_churn_limit,
+               get_balance_churn_limit(state, spec))
+
+
+def get_consolidation_churn_limit(state, spec: ChainSpec) -> int:
+    return get_balance_churn_limit(state, spec) - get_activation_exit_churn_limit(state, spec)
+
+
+def get_pending_balance_to_withdraw(state, validator_index: int) -> int:
+    return sum(
+        int(w.amount)
+        for w in state.pending_partial_withdrawals
+        if int(w.validator_index) == validator_index
+    )
+
+
+def compute_exit_epoch_and_update_churn(state, exit_balance: int, spec: ChainSpec) -> int:
+    earliest = max(
+        int(state.earliest_exit_epoch),
+        compute_activation_exit_epoch(get_current_epoch(state, spec), spec),
+    )
+    per_epoch_churn = get_activation_exit_churn_limit(state, spec)
+    if int(state.earliest_exit_epoch) < earliest:
+        balance_to_consume = per_epoch_churn
+    else:
+        balance_to_consume = int(state.exit_balance_to_consume)
+    if exit_balance > balance_to_consume:
+        balance_to_process = exit_balance - balance_to_consume
+        additional_epochs = (balance_to_process - 1) // per_epoch_churn + 1
+        earliest += additional_epochs
+        balance_to_consume += additional_epochs * per_epoch_churn
+    state.exit_balance_to_consume = balance_to_consume - exit_balance
+    state.earliest_exit_epoch = earliest
+    return earliest
+
+
+def compute_consolidation_epoch_and_update_churn(
+    state, consolidation_balance: int, spec: ChainSpec
+) -> int:
+    earliest = max(
+        int(state.earliest_consolidation_epoch),
+        compute_activation_exit_epoch(get_current_epoch(state, spec), spec),
+    )
+    per_epoch_churn = get_consolidation_churn_limit(state, spec)
+    if int(state.earliest_consolidation_epoch) < earliest:
+        balance_to_consume = per_epoch_churn
+    else:
+        balance_to_consume = int(state.consolidation_balance_to_consume)
+    if consolidation_balance > balance_to_consume:
+        balance_to_process = consolidation_balance - balance_to_consume
+        additional_epochs = (balance_to_process - 1) // per_epoch_churn + 1
+        earliest += additional_epochs
+        balance_to_consume += additional_epochs * per_epoch_churn
+    state.consolidation_balance_to_consume = balance_to_consume - consolidation_balance
+    state.earliest_consolidation_epoch = earliest
+    return earliest
+
+
+def switch_to_compounding_validator(state, index: int, types, spec: ChainSpec) -> None:
+    v = state.validators[index]
+    v.withdrawal_credentials = (
+        spec.compounding_withdrawal_prefix + bytes(v.withdrawal_credentials)[1:]
+    )
+    queue_excess_active_balance(state, index, types, spec)
+
+
+def queue_excess_active_balance(state, index: int, types, spec: ChainSpec) -> None:
+    balance = int(state.balances[index])
+    if balance > spec.min_activation_balance:
+        excess = balance - spec.min_activation_balance
+        state.balances[index] = spec.min_activation_balance
+        v = state.validators[index]
+        state.pending_deposits = list(state.pending_deposits) + [
+            types.PendingDeposit(
+                pubkey=bytes(v.pubkey),
+                withdrawal_credentials=bytes(v.withdrawal_credentials),
+                amount=excess,
+                signature=b"\xc0" + b"\x00" * 95,  # G2_POINT_AT_INFINITY
+                slot=0,  # GENESIS_SLOT
+            )
+        ]
+
+
+def get_committee_indices(committee_bits) -> List[int]:
+    return [i for i, bit in enumerate(committee_bits) if bit]
+
+
+def get_expected_withdrawals_electra(state, types, spec: ChainSpec):
+    """(withdrawals, processed_partial_count): EIP-7002 pending partial
+    withdrawals drain first, then the compounding-aware validator sweep."""
+    epoch = get_current_epoch(state, spec)
+    withdrawal_index = int(state.next_withdrawal_index)
+    withdrawals = []
+    processed_partials = 0
+    for w in state.pending_partial_withdrawals:
+        if (
+            int(w.withdrawable_epoch) > epoch
+            or len(withdrawals) == spec.preset.max_pending_partials_per_withdrawals_sweep
+        ):
+            break
+        vidx = int(w.validator_index)
+        v = state.validators[vidx]
+        has_sufficient_eb = int(v.effective_balance) >= spec.min_activation_balance
+        has_excess = int(state.balances[vidx]) > spec.min_activation_balance
+        if v.exit_epoch == FAR_FUTURE_EPOCH and has_sufficient_eb and has_excess:
+            withdrawable = min(
+                int(state.balances[vidx]) - spec.min_activation_balance, int(w.amount)
+            )
+            withdrawals.append(types.Withdrawal(
+                index=withdrawal_index,
+                validator_index=vidx,
+                address=bytes(v.withdrawal_credentials)[12:],
+                amount=withdrawable,
+            ))
+            withdrawal_index += 1
+        processed_partials += 1
+
+    n = len(state.validators)
+    validator_index = int(state.next_withdrawal_validator_index)
+    bound = min(n, spec.preset.max_validators_per_withdrawals_sweep)
+    for _ in range(bound):
+        v = state.validators[validator_index]
+        # subtract partials already included for this validator this payload
+        partially_withdrawn = sum(
+            int(w.amount) for w in withdrawals if int(w.validator_index) == validator_index
+        )
+        balance = int(state.balances[validator_index]) - partially_withdrawn
+        if is_fully_withdrawable_validator_electra(v, balance, epoch, spec):
+            withdrawals.append(types.Withdrawal(
+                index=withdrawal_index,
+                validator_index=validator_index,
+                address=bytes(v.withdrawal_credentials)[12:],
+                amount=balance,
+            ))
+            withdrawal_index += 1
+        elif is_partially_withdrawable_validator_electra(v, balance, spec):
+            withdrawals.append(types.Withdrawal(
+                index=withdrawal_index,
+                validator_index=validator_index,
+                address=bytes(v.withdrawal_credentials)[12:],
+                amount=balance - get_max_effective_balance(v, spec),
+            ))
+            withdrawal_index += 1
+        if len(withdrawals) == spec.preset.max_withdrawals_per_payload:
+            break
+        validator_index = (validator_index + 1) % n
+    return withdrawals, processed_partials
+
+
 def get_expected_withdrawals(state, types, spec: ChainSpec):
+    if type(state).fork_name == "electra":
+        return get_expected_withdrawals_electra(state, types, spec)[0]
     epoch = get_current_epoch(state, spec)
     withdrawal_index = state.next_withdrawal_index
     validator_index = state.next_withdrawal_validator_index

@@ -202,9 +202,24 @@ def process_eth1_data(state, eth1_data, spec: ChainSpec) -> None:
 
 
 def process_operations(state, body, types, spec: ChainSpec, verify: bool) -> None:
-    expected_deposits = min(
-        spec.preset.max_deposits, state.eth1_data.deposit_count - state.eth1_deposit_index
-    )
+    is_electra = type(state).fork_name == "electra"
+    if is_electra:
+        # EIP-6110: the eth1 bridge drains up to deposit_requests_start_index,
+        # then deposits flow exclusively through execution requests.
+        eth1_limit = min(
+            int(state.eth1_data.deposit_count), int(state.deposit_requests_start_index)
+        )
+        if int(state.eth1_deposit_index) < eth1_limit:
+            expected_deposits = min(
+                spec.preset.max_deposits, eth1_limit - int(state.eth1_deposit_index)
+            )
+        else:
+            expected_deposits = 0
+    else:
+        expected_deposits = min(
+            spec.preset.max_deposits,
+            state.eth1_data.deposit_count - state.eth1_deposit_index,
+        )
     if len(body.deposits) != expected_deposits:
         raise BlockProcessingError(
             f"expected {expected_deposits} deposits, block has {len(body.deposits)}"
@@ -222,6 +237,15 @@ def process_operations(state, body, types, spec: ChainSpec, verify: bool) -> Non
     if hasattr(body, "bls_to_execution_changes"):
         for ch in body.bls_to_execution_changes:
             process_bls_to_execution_change(state, ch, types, spec, verify)
+    if hasattr(body, "execution_requests"):
+        from . import electra
+
+        for req in body.execution_requests.deposits:
+            electra.process_deposit_request(state, req, types, spec)
+        for req in body.execution_requests.withdrawals:
+            electra.process_withdrawal_request(state, req, types, spec)
+        for req in body.execution_requests.consolidations:
+            electra.process_consolidation_request(state, req, types, spec)
 
 
 def process_proposer_slashing(state, slashing, types, spec: ChainSpec, verify: bool) -> None:
@@ -247,8 +271,11 @@ def process_attester_slashing(state, slashing, types, spec: ChainSpec, verify: b
     a1, a2 = slashing.attestation_1, slashing.attestation_2
     if not h.is_slashable_attestation_data(a1.data, a2.data):
         raise BlockProcessingError("attester slashing: data not slashable")
+    # electra slashings carry committee-spanning indexed attestations with
+    # the EIP-7549 size limit
+    is_electra = type(state).fork_name == "electra"
     for att in (a1, a2):
-        if not h.is_valid_indexed_attestation_structure(att, spec):
+        if not h.is_valid_indexed_attestation_structure(att, spec, electra=is_electra):
             raise BlockProcessingError("attester slashing: malformed indexed attestation")
         if verify:
             if not sets.indexed_attestation_signature_set(state, att, spec).verify():
@@ -284,12 +311,33 @@ def _validate_attestation_data(state, data, spec: ChainSpec) -> None:
 def process_attestation(state, attestation, types, spec: ChainSpec, verify: bool) -> None:
     data = attestation.data
     _validate_attestation_data(state, data, spec)
-    committee = h.get_beacon_committee(state, data.slot, data.index, spec)
-    if len(attestation.aggregation_bits) != len(committee):
-        raise BlockProcessingError("attestation: bitlist/committee length mismatch")
+    committee_bits = getattr(attestation, "committee_bits", None)
+    if committee_bits is not None:
+        # EIP-7549: data.index must be zero; committees are selected by bits;
+        # the bitlist concatenates the selected committees (length checked
+        # inside get_attesting_indices).
+        if int(data.index) != 0:
+            raise BlockProcessingError("attestation: electra data.index != 0")
+        committee_indices = h.get_committee_indices(committee_bits)
+        committees_per_slot = h.get_committee_count_per_slot(
+            state, h.compute_epoch_at_slot(int(data.slot), spec), spec
+        )
+        if not committee_indices:
+            raise BlockProcessingError("attestation: no committee bits set")
+        if any(ci >= committees_per_slot for ci in committee_indices):
+            raise BlockProcessingError("attestation: committee index out of range")
+    else:
+        committee = h.get_beacon_committee(state, data.slot, data.index, spec)
+        if len(attestation.aggregation_bits) != len(committee):
+            raise BlockProcessingError("attestation: bitlist/committee length mismatch")
 
-    indexed = h.get_indexed_attestation(state, attestation, types, spec)
-    if not h.is_valid_indexed_attestation_structure(indexed, spec):
+    try:
+        indexed = h.get_indexed_attestation(state, attestation, types, spec)
+    except ValueError as e:
+        raise BlockProcessingError(f"attestation: {e}") from e
+    if not h.is_valid_indexed_attestation_structure(
+        indexed, spec, electra=committee_bits is not None
+    ):
         raise BlockProcessingError("attestation: malformed indexed attestation")
     if verify:
         if not sets.indexed_attestation_signature_set(state, indexed, spec).verify():
@@ -360,9 +408,24 @@ def is_valid_merkle_branch(leaf: bytes, branch, depth: int, index: int, root: by
     return value == bytes(root)
 
 
-def get_validator_from_deposit(pubkey, withdrawal_credentials, amount, types, spec: ChainSpec):
+def get_validator_from_deposit(pubkey, withdrawal_credentials, amount, types,
+                               spec: ChainSpec, fork: str = "phase0"):
+    if fork == "electra":
+        # EIP-7251: cap by credential type (compounding -> 2048 ETH)
+        probe = types.Validator(
+            pubkey=bytes(pubkey),
+            withdrawal_credentials=bytes(withdrawal_credentials),
+            effective_balance=0,
+            activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+            activation_epoch=FAR_FUTURE_EPOCH,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        )
+        cap = h.get_max_effective_balance(probe, spec)
+    else:
+        cap = spec.max_effective_balance
     effective_balance = min(
-        amount - amount % spec.effective_balance_increment, spec.max_effective_balance
+        amount - amount % spec.effective_balance_increment, cap
     )
     return types.Validator(
         pubkey=bytes(pubkey),
@@ -397,6 +460,20 @@ def apply_deposit(state, deposit, types, spec: ChainSpec, verify_proof: bool = T
             raise BlockProcessingError("deposit: invalid merkle proof")
     state.eth1_deposit_index += 1
 
+    if type(state).fork_name == "electra":
+        # EIP-6110: eth1-bridge deposits queue as pending (slot=GENESIS_SLOT);
+        # validation + registry growth happen in process_pending_deposits.
+        state.pending_deposits = list(state.pending_deposits) + [
+            types.PendingDeposit(
+                pubkey=bytes(deposit.data.pubkey),
+                withdrawal_credentials=bytes(deposit.data.withdrawal_credentials),
+                amount=int(deposit.data.amount),
+                signature=bytes(deposit.data.signature),
+                slot=0,  # GENESIS_SLOT
+            )
+        ]
+        return
+
     pubkey = bytes(deposit.data.pubkey)
     index_map = _pubkey_index_map(state)
     if pubkey not in index_map:
@@ -406,7 +483,7 @@ def apply_deposit(state, deposit, types, spec: ChainSpec, verify_proof: bool = T
         try:
             pk = sets.pubkey_cache(pubkey)
             ok = bls.SignatureSet.single_pubkey(
-                bls.Signature(_bytes=bytes(deposit.data.signature)), pk, message
+                bls.Signature.from_bytes(bytes(deposit.data.signature)), pk, message
             ).verify()
         except (bls.BlsError, ValueError):
             ok = False
@@ -449,6 +526,10 @@ def process_voluntary_exit(state, signed_exit, types, spec: ChainSpec, verify: b
         raise BlockProcessingError("exit: not yet valid")
     if current_epoch < v.activation_epoch + spec.shard_committee_period:
         raise BlockProcessingError("exit: validator too young")
+    if type(state).fork_name == "electra":
+        # EIP-7251: only exit when no partial withdrawals are queued
+        if h.get_pending_balance_to_withdraw(state, int(exit_.validator_index)) > 0:
+            raise BlockProcessingError("exit: pending partial withdrawals")
     if verify:
         if not sets.voluntary_exit_signature_set(state, signed_exit, spec).verify():
             raise BlockProcessingError("exit: bad signature")
@@ -530,12 +611,22 @@ def compute_timestamp_at_slot(state, slot: int, spec: ChainSpec) -> int:
 
 
 def process_withdrawals(state, payload, types, spec: ChainSpec) -> None:
-    expected = h.get_expected_withdrawals(state, types, spec)
+    if type(state).fork_name == "electra":
+        expected, processed_partials = h.get_expected_withdrawals_electra(
+            state, types, spec
+        )
+    else:
+        expected = h.get_expected_withdrawals(state, types, spec)
+        processed_partials = 0
     got = list(payload.withdrawals)
     if got != expected:
         raise BlockProcessingError("withdrawals: payload does not match expected set")
     for w in expected:
         h.decrease_balance(state, w.validator_index, w.amount)
+    if processed_partials:
+        state.pending_partial_withdrawals = list(state.pending_partial_withdrawals)[
+            processed_partials:
+        ]
     if expected:
         state.next_withdrawal_index = expected[-1].index + 1
     n = len(state.validators)
@@ -558,7 +649,12 @@ def process_execution_payload(state, body, types, spec: ChainSpec, payload_verif
     if payload.timestamp != compute_timestamp_at_slot(state, state.slot, spec):
         raise BlockProcessingError("payload: bad timestamp")
     if hasattr(body, "blob_kzg_commitments"):
-        if len(body.blob_kzg_commitments) > spec.max_blobs_per_block:
+        max_blobs = (
+            spec.max_blobs_per_block_electra
+            if type(state).fork_name == "electra"
+            else spec.max_blobs_per_block
+        )
+        if len(body.blob_kzg_commitments) > max_blobs:
             raise BlockProcessingError("payload: too many blob commitments")
     if payload_verifier is not None:
         if not payload_verifier(payload):
@@ -569,6 +665,7 @@ def process_execution_payload(state, body, types, spec: ChainSpec, payload_verif
         "bellatrix": types.ExecutionPayloadHeaderBellatrix,
         "capella": types.ExecutionPayloadHeaderCapella,
         "deneb": types.ExecutionPayloadHeaderDeneb,
+        "electra": types.ExecutionPayloadHeaderDeneb,
     }[fork]
     kwargs = {}
     for name in hdr_cls.fields:

@@ -273,6 +273,11 @@ def process_epoch_altair(state, types, spec: ChainSpec) -> None:
     _process_registry_updates(state, arrays, spec)
     _process_slashings(state, arrays, balances, total_active_balance, spec)
     _process_eth1_data_reset(state, spec)
+    if type(state).fork_name == "electra":
+        from .electra import process_pending_consolidations, process_pending_deposits
+
+        process_pending_deposits(state, types, spec)
+        process_pending_consolidations(state, types, spec)
     _process_effective_balance_updates(state, arrays, spec)
     _process_slashings_reset(state, spec)
     _process_randao_mixes_reset(state, spec)
@@ -447,15 +452,25 @@ def _phase0_attestation_deltas(state, arrays: EpochArrays, total_active_balance:
 
 def _process_registry_updates(state, arrays: EpochArrays, spec: ChainSpec) -> None:
     current_epoch = h.get_current_epoch(state, spec)
+    fork = type(state).fork_name
     # eligibility + ejections
     for index, v in enumerate(state.validators):
-        if h.is_eligible_for_activation_queue(v, spec):
+        if h.is_eligible_for_activation_queue(v, spec, fork=fork):
             v.activation_eligibility_epoch = current_epoch + 1
         if (
             h.is_active_validator(v, current_epoch)
             and v.effective_balance <= spec.ejection_balance
         ):
             h.initiate_validator_exit(state, index, spec)
+    if fork == "electra":
+        # EIP-7251: no activation-count churn — churn moved to the
+        # balance-weighted pending-deposit queue.
+        for index, v in enumerate(state.validators):
+            if h.is_eligible_for_activation(state, v):
+                v.activation_epoch = h.compute_activation_exit_epoch(
+                    current_epoch, spec
+                )
+        return
     # dequeue activations up to churn
     queue = sorted(
         (
@@ -489,8 +504,14 @@ def _process_slashings(
     mask = arrays.slashed & (arrays.withdrawable_epoch == target_epoch)
     if not mask.any():
         return
-    penalty_numerator = (arrays.effective_balance // increment) * adjusted_total
-    penalty = penalty_numerator // total_balance * increment
+    if fork == "electra":
+        # EIP-7251: per-increment penalty (avoids the u64 overflow of the
+        # eb * adjusted_total product at 2048-ETH effective balances)
+        penalty_per_increment = adjusted_total // (total_balance // increment)
+        penalty = (arrays.effective_balance // increment) * penalty_per_increment
+    else:
+        penalty_numerator = (arrays.effective_balance // increment) * adjusted_total
+        penalty = penalty_numerator // total_balance * increment
     for index in np.nonzero(mask)[0]:
         h.decrease_balance(state, int(index), int(penalty[index]))
 
@@ -506,12 +527,16 @@ def _process_effective_balance_updates(state, arrays: EpochArrays, spec: ChainSp
     hysteresis_increment = increment // spec.preset.hysteresis_quotient
     downward = hysteresis_increment * spec.preset.hysteresis_downward_multiplier
     upward = hysteresis_increment * spec.preset.hysteresis_upward_multiplier
+    is_electra = type(state).fork_name == "electra"
     for index, v in enumerate(state.validators):
         balance = state.balances[index]
         if balance + downward < v.effective_balance or v.effective_balance + upward < balance:
-            v.effective_balance = min(
-                balance - balance % increment, spec.max_effective_balance
+            cap = (
+                h.get_max_effective_balance(v, spec)  # EIP-7251 per-credential cap
+                if is_electra
+                else spec.max_effective_balance
             )
+            v.effective_balance = min(balance - balance % increment, cap)
 
 
 def _process_slashings_reset(state, spec: ChainSpec) -> None:

@@ -116,11 +116,87 @@ def upgrade_to_deneb(pre, types, spec: ChainSpec):
     )
 
 
+def upgrade_to_electra(pre, types, spec: ChainSpec):
+    """Deneb -> electra (EIP-7251 et al.): initialize the churn/queue fields,
+    re-queue pre-activation validators' balances, and queue excess balances
+    of compounding validators (reference: the electra fork upgrade in
+    consensus/fork/src)."""
+    from ..types.spec import FAR_FUTURE_EPOCH
+
+    epoch = h.get_current_epoch(pre, spec)
+    exit_epochs = [
+        int(v.exit_epoch) for v in pre.validators if v.exit_epoch != FAR_FUTURE_EPOCH
+    ]
+    # spec: seed from compute_activation_exit_epoch(current), max with any
+    # in-flight exits, then +1
+    earliest_exit_epoch = (
+        max(exit_epochs + [h.compute_activation_exit_epoch(epoch, spec)]) + 1
+    )
+
+    post = _copy_shared(
+        pre,
+        types.state["electra"],
+        fork=types.Fork(
+            previous_version=pre.fork.current_version,
+            current_version=spec.electra_fork_version,
+            epoch=epoch,
+        ),
+        latest_execution_payload_header=_convert_payload_header(
+            pre.latest_execution_payload_header, types.ExecutionPayloadHeaderDeneb
+        ),
+        deposit_requests_start_index=spec.unset_deposit_requests_start_index,
+        deposit_balance_to_consume=0,
+        exit_balance_to_consume=0,
+        earliest_exit_epoch=earliest_exit_epoch,
+        consolidation_balance_to_consume=0,
+        earliest_consolidation_epoch=h.compute_activation_exit_epoch(epoch, spec),
+        pending_deposits=[],
+        pending_partial_withdrawals=[],
+        pending_consolidations=[],
+    )
+    post.exit_balance_to_consume = h.get_activation_exit_churn_limit(post, spec)
+    post.consolidation_balance_to_consume = h.get_consolidation_churn_limit(post, spec)
+
+    # Re-queue: validators still awaiting activation restart through the
+    # pending-deposit queue with their entire balance.
+    pre_activation = sorted(
+        (
+            i
+            for i, v in enumerate(post.validators)
+            if v.activation_epoch == FAR_FUTURE_EPOCH
+        ),
+        key=lambda i: (int(post.validators[i].activation_eligibility_epoch), i),
+    )
+    for index in pre_activation:
+        balance = int(post.balances[index])
+        post.balances[index] = 0
+        v = post.validators[index]
+        v.effective_balance = 0
+        v.activation_eligibility_epoch = FAR_FUTURE_EPOCH
+        if balance > 0:
+            post.pending_deposits = list(post.pending_deposits) + [
+                types.PendingDeposit(
+                    pubkey=bytes(v.pubkey),
+                    withdrawal_credentials=bytes(v.withdrawal_credentials),
+                    amount=balance,
+                    signature=b"\xc0" + b"\x00" * 95,  # G2_POINT_AT_INFINITY
+                    slot=0,
+                )
+            ]
+    # Compounding validators bank their excess above 32 ETH.
+    for index, v in enumerate(post.validators):
+        if h.has_compounding_withdrawal_credential(v, spec):
+            h.queue_excess_active_balance(post, index, types, spec)
+    h.invalidate_caches(post)
+    return post
+
+
 UPGRADES = {
     "altair": upgrade_to_altair,
     "bellatrix": upgrade_to_bellatrix,
     "capella": upgrade_to_capella,
     "deneb": upgrade_to_deneb,
+    "electra": upgrade_to_electra,
 }
 
 

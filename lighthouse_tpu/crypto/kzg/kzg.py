@@ -233,9 +233,14 @@ def _unchecked_g1(raw: bytes) -> g1.Affine:
 class Kzg:
     """The reference's ``Kzg`` wrapper (``crypto/kzg/src/lib.rs:32``)."""
 
-    def __init__(self, setup: TrustedSetup):
+    def __init__(self, setup: TrustedSetup, device: bool = False):
+        """``device=True`` routes batch verification's MSMs + 2-pairing
+        through the fused TPU program (``ops/kzg_device.py``) — the
+        reference's c-kzg hot path re-sited onto the accelerator.  The host
+        path stays the golden model and the fallback."""
         self.setup = setup
         self.width = setup.width
+        self.device = device
         self.roots_brp = roots_of_unity_brp(self.width)
         self._root_index = {w: i for i, w in enumerate(self.roots_brp)}
 
@@ -392,6 +397,14 @@ class Kzg:
         self, c_pts, commitments_bytes, zs, ys, p_pts, proofs_bytes
     ) -> bool:
         r_powers = self._compute_r_powers(commitments_bytes, zs, ys, proofs_bytes)
+        if self.device:
+            from ...ops.kzg_device import verify_kzg_proof_batch_device
+
+            return verify_kzg_proof_batch_device(
+                [_g1_to_curve_point(c) for c in c_pts],
+                [_g1_to_curve_point(p) for p in p_pts],
+                r_powers, zs, ys, self.setup.g2_monomial[1],
+            )
         proof_lincomb = g1.msm(p_pts, r_powers)
         proof_z_lincomb = g1.msm(
             p_pts, [r * z % BLS_MODULUS for r, z in zip(r_powers, zs)]

@@ -66,20 +66,33 @@ class ExecutionLayer:
         # finalized/safe hash in production fcU calls so we never tell the EL
         # an unfinalized block is final.
         self.latest_finalized_hash: bytes = b"\x00" * 32
+        self._last_get_payload_response: Dict = {}
 
     # -------------------------------------------------- chain integration
 
     def notify_new_payload(self, payload, *, versioned_hashes=None,
-                           parent_beacon_block_root=None) -> bool:
+                           parent_beacon_block_root=None,
+                           execution_requests=None, fork=None) -> bool:
         """True=VALID, False=INVALID; SYNCING/ACCEPTED are treated
         optimistically (recorded, allowed through) — the reference's
-        optimistic-sync behavior (``PayloadVerificationStatus::Optimistic``)."""
-        fork = _payload_fork(payload)
+        optimistic-sync behavior (``PayloadVerificationStatus::Optimistic``).
+        ``execution_requests``: the block body's ExecutionRequests container
+        (electra — encoded for engine_newPayloadV4); ``fork`` overrides the
+        structural guess (deneb/electra payloads are identical)."""
+        from .engine_api import execution_requests_to_json
+
+        fork = fork or _payload_fork(payload)
+        encoded_requests = (
+            execution_requests_to_json(execution_requests)
+            if execution_requests is not None
+            else None
+        )
         status = self.engine.request(
             lambda api: api.new_payload(
                 payload, fork,
                 versioned_hashes=versioned_hashes,
                 parent_beacon_block_root=parent_beacon_block_root,
+                execution_requests=encoded_requests,
             )
         )
         s = status.get("status")
@@ -121,14 +134,14 @@ class ExecutionLayer:
             ).hex(),
             "suggestedFeeRecipient": "0x" + self.fee_recipient.hex(),
         }
-        if fork in ("capella", "deneb"):
+        if fork in ("capella", "deneb", "electra"):
             from .engine_api import withdrawal_to_json
 
             attributes["withdrawals"] = [
                 withdrawal_to_json(w)
                 for w in h.get_expected_withdrawals(state, types, spec)
             ]
-        if fork == "deneb":
+        if fork in ("deneb", "electra"):
             # EIP-4788: the PARENT beacon block's root = hash_tree_root of
             # the state's latest header (state_root already backfilled by
             # process_slots), NOT header.parent_root (the grandparent).
@@ -148,7 +161,19 @@ class ExecutionLayer:
             raise EngineApiError("engine returned no payloadId")
         got = self.engine.request(lambda api: api.get_payload(payload_id, fork))
         obj = got.get("executionPayload", got)
+        self._last_get_payload_response = got
         return payload_from_json(obj, types, fork)
+
+    def produce_payload_and_requests(self, state, types, spec):
+        """(payload, ExecutionRequests) for electra block production — the
+        requests come from engine_getPayloadV4's executionRequests field."""
+        from .engine_api import execution_requests_from_json
+
+        payload = self.produce_payload(state, types, spec)
+        requests = execution_requests_from_json(
+            self._last_get_payload_response.get("executionRequests"), types
+        )
+        return payload, requests
 
     # ------------------------------------------------------------- status
 

@@ -58,13 +58,21 @@ def validate_token(token: str, secret: bytes, now: Optional[int] = None) -> None
         raise JwtError("malformed token")
     signing_input = parts[0] + b"." + parts[1]
     expect = hmac.new(secret, signing_input, sha256).digest()
-    if not hmac.compare_digest(expect, _b64url_decode(parts[2])):
+    try:
+        sig = _b64url_decode(parts[2])
+    except Exception:
+        raise JwtError("bad base64 in signature")
+    if not hmac.compare_digest(expect, sig):
         raise JwtError("bad signature")
     try:
         claims = json.loads(_b64url_decode(parts[1]))
-    except json.JSONDecodeError:
+        if not isinstance(claims, dict):
+            raise JwtError("claims not an object")
+        iat = int(claims.get("iat", 0))
+    except JwtError:
+        raise
+    except Exception:
         raise JwtError("bad claims")
-    iat = int(claims.get("iat", 0))
     now = int(time.time()) if now is None else now
     if abs(now - iat) > MAX_IAT_DRIFT_SECONDS:
         raise JwtError(f"stale iat {iat} (now {now})")

@@ -26,12 +26,14 @@ SUPPORTED_METHODS = [
     "engine_newPayloadV1",
     "engine_newPayloadV2",
     "engine_newPayloadV3",
+    "engine_newPayloadV4",
     "engine_forkchoiceUpdatedV1",
     "engine_forkchoiceUpdatedV2",
     "engine_forkchoiceUpdatedV3",
     "engine_getPayloadV1",
     "engine_getPayloadV2",
     "engine_getPayloadV3",
+    "engine_getPayloadV4",
 ]
 
 
@@ -65,6 +67,37 @@ def withdrawal_to_json(w) -> Dict[str, str]:
         "address": _d(w.address),
         "amount": _q(w.amount),
     }
+
+
+_REQUEST_FIELDS = (("deposits", 0), ("withdrawals", 1), ("consolidations", 2))
+
+
+def execution_requests_to_json(er) -> List[str]:
+    """ExecutionRequests container -> Prague engine encoding: one DATA item
+    per non-empty request type, ``type_byte || ssz(list)``."""
+    out = []
+    for field, type_byte in _REQUEST_FIELDS:
+        items = list(getattr(er, field))
+        if items:
+            blob = er.fields[field].serialize(items)
+            out.append("0x%02x" % type_byte + blob.hex())
+    return out
+
+
+def execution_requests_from_json(lst, types):
+    """Inverse of :func:`execution_requests_to_json`."""
+    by_type = {t: f for f, t in _REQUEST_FIELDS}
+    kwargs = {f: [] for f, _ in _REQUEST_FIELDS}
+    cls = types.ExecutionRequests
+    for item in lst or []:
+        raw = bytes.fromhex(item[2:] if item.startswith("0x") else item)
+        if not raw:
+            continue
+        field = by_type.get(raw[0])
+        if field is None:
+            raise EngineApiError(f"unknown execution request type {raw[0]}")
+        kwargs[field] = cls.fields[field].deserialize(raw[1:])
+    return cls(**kwargs)
 
 
 def kzg_commitment_to_versioned_hash(commitment: bytes) -> bytes:
@@ -106,6 +139,7 @@ def payload_from_json(obj: Dict[str, Any], types, fork: str):
         "bellatrix": types.ExecutionPayloadBellatrix,
         "capella": types.ExecutionPayloadCapella,
         "deneb": types.ExecutionPayloadDeneb,
+        "electra": types.ExecutionPayloadDeneb,  # structurally identical
     }[fork]
     kwargs = dict(
         parent_hash=bytes.fromhex(obj["parentHash"][2:]),
@@ -123,7 +157,7 @@ def payload_from_json(obj: Dict[str, Any], types, fork: str):
         block_hash=bytes.fromhex(obj["blockHash"][2:]),
         transactions=[bytes.fromhex(tx[2:]) for tx in obj["transactions"]],
     )
-    if fork in ("capella", "deneb"):
+    if fork in ("capella", "deneb", "electra"):
         kwargs["withdrawals"] = [
             types.Withdrawal(
                 index=int(w["index"], 16),
@@ -133,7 +167,7 @@ def payload_from_json(obj: Dict[str, Any], types, fork: str):
             )
             for w in obj.get("withdrawals", [])
         ]
-    if fork == "deneb":
+    if fork in ("deneb", "electra"):
         kwargs["blob_gas_used"] = int(obj.get("blobGasUsed", "0x0"), 16)
         kwargs["excess_blob_gas"] = int(obj.get("excessBlobGas", "0x0"), 16)
     return cls(**kwargs)
@@ -187,9 +221,18 @@ class EngineApiClient:
 
     def new_payload(self, payload, fork: str,
                     versioned_hashes: Optional[List[bytes]] = None,
-                    parent_beacon_block_root: Optional[bytes] = None) -> Dict[str, Any]:
-        """engine_newPayloadV1/V2/V3 by fork; returns the PayloadStatus."""
+                    parent_beacon_block_root: Optional[bytes] = None,
+                    execution_requests: Optional[List[str]] = None) -> Dict[str, Any]:
+        """engine_newPayloadV1-V4 by fork; returns the PayloadStatus.
+        ``execution_requests``: Prague's encoded request list (V4)."""
         pj = payload_to_json(payload)
+        if fork == "electra":
+            return self.rpc("engine_newPayloadV4", [
+                pj,
+                [_d(h) for h in (versioned_hashes or [])],
+                _d(parent_beacon_block_root or b"\x00" * 32),
+                execution_requests or [],
+            ])
         if fork == "deneb":
             return self.rpc("engine_newPayloadV3", [
                 pj,
@@ -221,5 +264,6 @@ class EngineApiClient:
             "bellatrix": "engine_getPayloadV1",
             "capella": "engine_getPayloadV2",
             "deneb": "engine_getPayloadV3",
+            "electra": "engine_getPayloadV4",
         }.get(fork, "engine_getPayloadV3")
         return self.rpc(version, [payload_id])

@@ -103,9 +103,11 @@ class MockEngineServer:
             if method.endswith("V1"):
                 return payload
             out = {"executionPayload": payload, "blockValue": "0x0"}
-            if method.endswith("V3"):
+            if method.endswith("V3") or method.endswith("V4"):
                 out["blobsBundle"] = {"commitments": [], "proofs": [], "blobs": []}
                 out["shouldOverrideBuilder"] = False
+            if method.endswith("V4"):
+                out["executionRequests"] = []
             return out
         raise _RpcError(-32601, f"method not found: {method}")
 

@@ -28,6 +28,7 @@ class LocalNode:
         chain: Optional[BeaconChain] = None,
         max_workers: int = 2,
         bls_backend: Optional[str] = None,
+        enable_slasher: bool = False,
     ):
         if harness is not None:
             chain = harness.chain
@@ -45,7 +46,15 @@ class LocalNode:
         self.endpoint = hub.register(peer_id)
         self.service = NetworkService(self.endpoint)
         self.processor = BeaconProcessor(max_workers=max_workers)
-        self.router = Router(chain=chain, service=self.service, processor=self.processor)
+        self.slasher = None
+        if enable_slasher:
+            from ..slasher import Slasher
+
+            self.slasher = Slasher(chain.types)
+        self.router = Router(
+            chain=chain, service=self.service, processor=self.processor,
+            slasher=self.slasher,
+        )
         self.sync = SyncManager(chain=chain, service=self.service, router=self.router)
         digest = self.router.fork_digest
         fork = type(chain.genesis_state).fork_name
@@ -61,6 +70,13 @@ class LocalNode:
     def publish_block(self, signed_block) -> int:
         topic = topics_mod.GossipTopic(self.router.fork_digest, topics_mod.BEACON_BLOCK)
         return self.service.publish(str(topic), signed_block.as_ssz_bytes())
+
+    def publish_blob_sidecar(self, sidecar) -> int:
+        subnet = int(sidecar.index) % self.chain.spec.max_blobs_per_block
+        topic = topics_mod.GossipTopic(
+            self.router.fork_digest, f"{topics_mod.BLOB_SIDECAR_PREFIX}{subnet}"
+        )
+        return self.service.publish(str(topic), sidecar.as_ssz_bytes())
 
     def publish_attestation(self, attestation) -> int:
         subnet = topics_mod.compute_subnet_for_attestation(

@@ -29,11 +29,13 @@ class Router:
         service: NetworkService,
         processor: Optional[BeaconProcessor] = None,
         sync_manager=None,
+        slasher=None,
     ):
         self.chain = chain
         self.service = service
         self.processor = processor if processor is not None else BeaconProcessor(max_workers=2)
         self.sync = sync_manager
+        self.slasher = slasher
         service.on_gossip = self.on_gossip
         service.on_rpc_request = self.on_rpc_request
         service.on_peer_connected = self.on_peer_connected
@@ -99,6 +101,15 @@ class Router:
                     ),
                 )
             )
+        elif kind.startswith(topics_mod.BLOB_SIDECAR_PREFIX):
+            self.processor.send(
+                WorkEvent(
+                    work_type=W.GOSSIP_BLOB_SIDECAR,
+                    process=lambda _: self._process_gossip_blob(
+                        topic, uncompressed, compressed, sender
+                    ),
+                )
+            )
         elif kind.startswith(topics_mod.BEACON_ATTESTATION_PREFIX) or kind == topics_mod.BEACON_AGGREGATE_AND_PROOF:
             wt = (
                 W.GOSSIP_AGGREGATE
@@ -138,6 +149,10 @@ class Router:
         if seen == "duplicate":
             return
         if seen == "equivocation":
+            # the slasher wants exactly these (double proposal evidence)
+            if self.slasher is not None:
+                self.slasher.on_block(signed)
+                self._drain_slasher()
             self.service.peer_manager.report(
                 sender, PeerAction.LOW_TOLERANCE, "proposer equivocation"
             )
@@ -145,6 +160,10 @@ class Router:
         try:
             chain.process_block(signed)
         except BlockError as e:
+            if "pending availability" in str(e):
+                # Blobs haven't arrived yet — the chain stashed the block in
+                # the DA checker; the blob handler completes the import.
+                return
             if "unknown parent" in str(e) and self.sync is not None:
                 # Don't penalize: we may simply be behind. But do NOT forward
                 # either — an unknown-parent block has passed no validation,
@@ -157,7 +176,41 @@ class Router:
         chain.observed.block_producers.observe(
             int(signed.message.slot), int(signed.message.proposer_index), block_root
         )
+        if self.slasher is not None:
+            self.slasher.on_block(signed)
+            self._drain_slasher()
         self.service.forward(topic, compressed, exclude=sender)
+
+    def _process_gossip_blob(
+        self, topic: str, uncompressed: bytes, compressed: bytes, sender: str
+    ) -> None:
+        """Gossip blob sidecar: verify (inclusion proof + KZG) into the DA
+        checker; if this completes a block waiting on availability, import it
+        (blob_verification.rs + data_availability_checker.rs)."""
+        from ..chain.da import BlobError
+
+        chain = self.chain
+        try:
+            sidecar = chain.types.BlobSidecar.from_ssz_bytes(uncompressed)
+        except Exception:
+            self.service.peer_manager.report(
+                sender, PeerAction.LOW_TOLERANCE, "undecodable blob sidecar"
+            )
+            return
+        try:
+            block_root = chain.da_checker.put_blob(sidecar)
+        except BlobError as e:
+            self.service.peer_manager.report(
+                sender, PeerAction.MID_TOLERANCE, f"bad blob sidecar: {e}"
+            )
+            return
+        self.service.forward(topic, compressed, exclude=sender)
+        ready = chain.da_checker.take_ready_block(block_root)
+        if ready is not None:
+            try:
+                chain.process_block(ready)
+            except BlockError:
+                pass  # unrelated import failure; peers already penalized upstream
 
     def _process_gossip_attestations(self, items: List[tuple]) -> None:
         """Batch-coalesced attestation verification (reference
@@ -231,6 +284,9 @@ class Router:
                 )
                 continue
             chain.apply_attestation(cand)
+            if self.slasher is not None:
+                self.slasher.on_attestation(cand.indexed)
+                self._drain_slasher()
             if is_aggregate:
                 chain.observed.aggregates.observe(
                     int(cand.attestation.data.slot), cand.attestation.hash_tree_root()
@@ -240,6 +296,15 @@ class Router:
                     int(agg.message.aggregator_index),
                 )
             self.service.forward(topic, compressed, exclude=sender)
+
+    def _drain_slasher(self) -> None:
+        """Slashings found by the slasher go straight to the op pool for
+        inclusion in our next proposal (reference slasher_service)."""
+        attester, proposer = self.slasher.drain_slashings()
+        for s in attester:
+            self.chain.op_pool.insert_attester_slashing(s)
+        for s in proposer:
+            self.chain.op_pool.insert_proposer_slashing(s)
 
     # --------------------------------------------------------------- rpc
 

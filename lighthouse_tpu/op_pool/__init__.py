@@ -76,7 +76,12 @@ class OperationPool:
     # ------------------------------------------------------- attestations
 
     def insert_attestation(self, attestation) -> None:
-        key = (int(attestation.data.slot), attestation.data.hash_tree_root())
+        cb = getattr(attestation, "committee_bits", None)
+        key = (
+            int(attestation.data.slot),
+            attestation.data.hash_tree_root()
+            + (bytes(1 if b else 0 for b in cb) if cb is not None else b""),
+        )
         group = self._attestations.get(key)
         if group is None:
             group = self._attestations[key] = _AttestationGroup(data=attestation.data)
@@ -97,18 +102,32 @@ class OperationPool:
         for (slot, _), group in self._attestations.items():
             if not spec.attestation_includable(slot, state_slot):
                 continue
+            is_electra_state = type(state).fork_name == "electra"
             for att in group.aggregates:
+                committee_bits = getattr(att, "committee_bits", None)
+                # container families don't cross the electra boundary:
+                # pre-fork attestations can't ride in electra bodies (and
+                # vice versa) — EIP-7549 changed the container.
+                if (committee_bits is not None) != is_electra_state:
+                    continue
                 try:
-                    committee = h.get_beacon_committee(
-                        state, int(att.data.slot), int(att.data.index), spec
-                    )
+                    if committee_bits is not None:
+                        # electra: indices derived through committee_bits
+                        cover = set(h.get_attesting_indices(
+                            state, att.data, att.aggregation_bits, spec,
+                            committee_bits=committee_bits,
+                        ))
+                    else:
+                        committee = h.get_beacon_committee(
+                            state, int(att.data.slot), int(att.data.index), spec
+                        )
+                        cover = {
+                            int(committee[i])
+                            for i, bit in enumerate(att.aggregation_bits)
+                            if bit and i < len(committee)
+                        }
                 except Exception:
                     continue
-                cover = {
-                    int(committee[i])
-                    for i, bit in enumerate(att.aggregation_bits)
-                    if bit and i < len(committee)
-                }
                 if cover:
                     candidates.append((att, cover))
         picked = max_cover(candidates, limit)
@@ -145,7 +164,17 @@ class OperationPool:
                 break
         attester = []
         covered: Set[int] = set()
+        is_electra_state = type(state).fork_name == "electra"
+        max_attester = (
+            spec.preset.max_attester_slashings_electra
+            if is_electra_state
+            else spec.preset.max_attester_slashings
+        )
         for s in self._attester_slashings:
+            # container families don't cross the electra boundary (EIP-7549
+            # changed IndexedAttestation's limits)
+            if ("Electra" in type(s).__name__) != is_electra_state:
+                continue
             att1 = set(int(i) for i in s.attestation_1.attesting_indices)
             att2 = set(int(i) for i in s.attestation_2.attesting_indices)
             slashable = {
@@ -157,7 +186,7 @@ class OperationPool:
             if slashable - covered:
                 covered |= slashable
                 attester.append(s)
-            if len(attester) >= spec.preset.max_attester_slashings:
+            if len(attester) >= max_attester:
                 break
         return proposer, attester
 

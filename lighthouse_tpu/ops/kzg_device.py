@@ -1,0 +1,115 @@
+"""Batched blob-KZG-proof verification on the device pairing kernel.
+
+The Deneb data-availability hot path (reference
+``beacon_node/beacon_chain/src/kzg_utils.rs:23-36`` →
+``c_kzg::KzgProof::verify_blob_kzg_proof_batch``) reformulated TPU-first:
+the random-linear-combination MSMs (three N-point G1 MSMs + one generator
+multiplication) AND the final 2-pairing all run inside one fused device
+program, batched over the blob axis — the BASELINE.md Deneb target shape is
+6 blobs x 32 blocks = 192 lanes through these MSMs.
+
+Host responsibilities (trusted side, mirroring ops/verify.py): Fiat-Shamir
+challenges, polynomial evaluation over the blob field elements, byte
+parsing/subgroup checks, and the exact ``fe == 1`` verdict.
+
+Verification equation (crypto/kzg/kzg.py _verify_kzg_proof_batch, the host
+golden model this program must agree with exactly):
+
+    e(sum_i [r_i] P_i, [tau]G2) * e(-(sum_i [r_i](C_i - [y_i]G1 + [z_i]P_i)), G2) == 1
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import ec, pairing, tower
+
+N_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+
+@jax.jit
+def _device_kzg_batch(c, p, r_bits, rz_bits, ry_bits, tau, g2gen):
+    """c, p: G1 projective coords (N, 25) x3 (commitments, proofs);
+    r_bits, rz_bits: (N, 256) int32 MSB-first scalars (r_i, r_i*z_i mod R);
+    ry_bits: (256,) — sum_i r_i*y_i mod R; tau, g2gen: affine twist (2, 25) x2.
+    Returns the final-exponentiation output limbs (host-checks == 1)."""
+    c_w = ec.scalar_mul_bits(ec.G1_OPS, c, r_bits)       # [r_i] C_i
+    p_w = ec.scalar_mul_bits(ec.G1_OPS, p, r_bits)       # [r_i] P_i
+    pz_w = ec.scalar_mul_bits(ec.G1_OPS, p, rz_bits)     # [r_i z_i] P_i
+
+    proof_lincomb = ec.tree_sum(ec.G1_OPS, p_w, axis=0)
+    c_lincomb = ec.tree_sum(ec.G1_OPS, c_w, axis=0)
+    pz_lincomb = ec.tree_sum(ec.G1_OPS, pz_w, axis=0)
+
+    gen = tuple(jnp.asarray(x) for x in ec.G1_GEN_LIMBS)
+    gen_ry = ec.scalar_mul_bits(ec.G1_OPS, gen, ry_bits)  # [sum r_i y_i] G1
+
+    rhs = ec.point_add(
+        ec.G1_OPS,
+        ec.point_add(ec.G1_OPS, c_lincomb, ec.point_neg(gen_ry)),
+        pz_lincomb,
+    )
+    p1 = tuple(jnp.stack([a, b]) for a, b in zip(proof_lincomb, ec.point_neg(rhs)))
+    q2 = tuple(jnp.stack([a, b]) for a, b in zip(tau, g2gen))
+    mask = jnp.asarray([True, True])
+    return pairing.multi_pairing_fe(p1, q2, mask)
+
+
+def _bucket(n: int) -> int:
+    for b in N_BUCKETS:
+        if n <= b:
+            return b
+    raise ValueError(f"kzg batch of {n} exceeds max bucket {N_BUCKETS[-1]}")
+
+
+def verify_kzg_proof_batch_device(
+    c_pts: Sequence, p_pts: Sequence, r_powers: Sequence[int],
+    zs: Sequence[int], ys: Sequence[int], g2_tau,
+) -> bool:
+    """Run the device program on parsed host points + scalars.
+
+    ``c_pts``/``p_pts``: host affine G1 (Fq pairs or None for infinity);
+    ``g2_tau``: host Fq2 affine point ([tau]G2 from the trusted setup)."""
+    from ..crypto.bls.params import R
+
+    n = len(c_pts)
+    assert n == len(p_pts) == len(r_powers) == len(zs) == len(ys)
+    nb = _bucket(max(1, n))
+
+    id1 = ec.g1_to_limbs(None)
+    c = [np.tile(np.asarray(x), (nb, 1)) for x in id1]
+    p = [np.tile(np.asarray(x), (nb, 1)) for x in id1]
+    r_bits = np.zeros((nb, 256), np.int32)
+    rz_bits = np.zeros((nb, 256), np.int32)
+    ry = 0
+    for i in range(n):
+        cl = ec.g1_to_limbs(c_pts[i])
+        pl = ec.g1_to_limbs(p_pts[i])
+        for coord in range(3):
+            c[coord][i] = cl[coord]
+            p[coord][i] = pl[coord]
+        r_bits[i] = ec.bits_msb(r_powers[i] % R, 256)
+        rz_bits[i] = ec.bits_msb(r_powers[i] * zs[i] % R, 256)
+        ry = (ry + r_powers[i] * ys[i]) % R
+    ry_bits = ec.bits_msb(ry, 256)
+
+    tau = (tower.fq2_to_limbs(g2_tau[0]), tower.fq2_to_limbs(g2_tau[1]))
+    g2gen = (
+        np.asarray(ec.G2_GEN_LIMBS[0]),
+        np.asarray(ec.G2_GEN_LIMBS[1]),
+    )
+    fe = _device_kzg_batch(
+        tuple(jnp.asarray(a) for a in c),
+        tuple(jnp.asarray(a) for a in p),
+        jnp.asarray(r_bits),
+        jnp.asarray(rz_bits),
+        jnp.asarray(ry_bits),
+        tuple(jnp.asarray(a) for a in tau),
+        tuple(jnp.asarray(a) for a in g2gen),
+    )
+    return pairing.fe_is_one(fe)

@@ -288,13 +288,149 @@ def build_types(preset: Preset) -> SimpleNamespace:
             **_blobkzg,
         }
 
+    # ------------------------------------------------------------- electra
+    # EIP-7549: attestations span all committees of a slot, selected by
+    # committee_bits; EIP-6110/7002/7251: execution-triggered requests ride
+    # in an ExecutionRequests block-body field.
+
+    _electra_agg_limit = P.max_validators_per_committee * P.max_committees_per_slot
+
+    class AttestationElectra(Container):
+        fields = {
+            "aggregation_bits": Bitlist(_electra_agg_limit),
+            "data": AttestationData.ssz_type,
+            "signature": bytes96,
+            "committee_bits": Bitvector(P.max_committees_per_slot),
+        }
+
+    class IndexedAttestationElectra(Container):
+        fields = {
+            "attesting_indices": List(uint64, _electra_agg_limit),
+            "data": AttestationData.ssz_type,
+            "signature": bytes96,
+        }
+
+    class AttesterSlashingElectra(Container):
+        fields = {
+            "attestation_1": IndexedAttestationElectra.ssz_type,
+            "attestation_2": IndexedAttestationElectra.ssz_type,
+        }
+
+    class DepositRequest(Container):
+        fields = {
+            "pubkey": bytes48,
+            "withdrawal_credentials": bytes32,
+            "amount": uint64,
+            "signature": bytes96,
+            "index": uint64,
+        }
+
+    class WithdrawalRequest(Container):
+        fields = {
+            "source_address": bytes20,
+            "validator_pubkey": bytes48,
+            "amount": uint64,
+        }
+
+    class ConsolidationRequest(Container):
+        fields = {
+            "source_address": bytes20,
+            "source_pubkey": bytes48,
+            "target_pubkey": bytes48,
+        }
+
+    class ExecutionRequests(Container):
+        fields = {
+            "deposits": List(DepositRequest.ssz_type, P.max_deposit_requests_per_payload),
+            "withdrawals": List(
+                WithdrawalRequest.ssz_type, P.max_withdrawal_requests_per_payload
+            ),
+            "consolidations": List(
+                ConsolidationRequest.ssz_type, P.max_consolidation_requests_per_payload
+            ),
+        }
+
+    class PendingDeposit(Container):
+        fields = {
+            "pubkey": bytes48,
+            "withdrawal_credentials": bytes32,
+            "amount": uint64,
+            "signature": bytes96,
+            "slot": uint64,
+        }
+
+    class PendingPartialWithdrawal(Container):
+        fields = {
+            "validator_index": uint64,
+            "amount": uint64,
+            "withdrawable_epoch": uint64,
+        }
+
+    class PendingConsolidation(Container):
+        fields = {"source_index": uint64, "target_index": uint64}
+
+    _body_base_electra = dict(_body_base)
+    _body_base_electra["attester_slashings"] = List(
+        AttesterSlashingElectra.ssz_type, P.max_attester_slashings_electra
+    )
+    _body_base_electra["attestations"] = List(
+        AttestationElectra.ssz_type, P.max_attestations_electra
+    )
+
+    class BeaconBlockBodyElectra(Container):
+        fork_name = "electra"
+        fields = {
+            **_body_base_electra,
+            **_sync_agg,
+            # the electra execution payload is structurally deneb's
+            "execution_payload": ExecutionPayloadDeneb.ssz_type,
+            **_blschanges,
+            **_blobkzg,
+            "execution_requests": ExecutionRequests.ssz_type,
+        }
+
     _bodies = {
         "phase0": BeaconBlockBodyPhase0,
         "altair": BeaconBlockBodyAltair,
         "bellatrix": BeaconBlockBodyBellatrix,
         "capella": BeaconBlockBodyCapella,
         "deneb": BeaconBlockBodyDeneb,
+        "electra": BeaconBlockBodyElectra,
     }
+
+    ns.attestation_by_fork = {}  # filled below
+
+    # --------------------------------------------------- deneb blob sidecars
+
+    Blob = ByteVector(32 * P.field_elements_per_blob)
+    # proof depth: list subtree + length mixin + body field tree
+    _commit_depth = max(0, (P.max_blob_commitments_per_block - 1).bit_length())
+    _body_depth = max(
+        0, (len(BeaconBlockBodyDeneb.fields) - 1).bit_length()
+    )
+    KZG_COMMITMENT_INCLUSION_PROOF_DEPTH = _commit_depth + 1 + _body_depth
+
+    class BlobSidecar(Container):
+        """Deneb blob sidecar (reference ``consensus/types/src/blob_sidecar.rs``):
+        the gossip unit carrying one blob + its commitment's merkle inclusion
+        proof against the signed header's body root."""
+
+        fields = {
+            "index": uint64,
+            "blob": Blob,
+            "kzg_commitment": bytes48,
+            "kzg_proof": bytes48,
+            "signed_block_header": SignedBeaconBlockHeader.ssz_type,
+            "kzg_commitment_inclusion_proof": Vector(
+                bytes32, KZG_COMMITMENT_INCLUSION_PROOF_DEPTH
+            ),
+        }
+
+    class BlobIdentifier(Container):
+        fields = {"block_root": bytes32, "index": uint64}
+
+    ns.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH = KZG_COMMITMENT_INCLUSION_PROOF_DEPTH
+    ns.Blob = Blob
 
     _blocks = {}
     _signed_blocks = {}
@@ -415,12 +551,37 @@ def build_types(preset: Preset) -> SimpleNamespace:
             **_capella_tail,
         }
 
+    class BeaconStateElectra(Container):
+        fork_name = "electra"
+        fields = {
+            **_state_pre,
+            **_participation,
+            **_state_justification,
+            **_altair_tail,
+            "latest_execution_payload_header": ExecutionPayloadHeaderDeneb.ssz_type,
+            **_capella_tail,
+            "deposit_requests_start_index": uint64,
+            "deposit_balance_to_consume": uint64,
+            "exit_balance_to_consume": uint64,
+            "earliest_exit_epoch": uint64,
+            "consolidation_balance_to_consume": uint64,
+            "earliest_consolidation_epoch": uint64,
+            "pending_deposits": List(PendingDeposit.ssz_type, P.pending_deposits_limit),
+            "pending_partial_withdrawals": List(
+                PendingPartialWithdrawal.ssz_type, P.pending_partial_withdrawals_limit
+            ),
+            "pending_consolidations": List(
+                PendingConsolidation.ssz_type, P.pending_consolidations_limit
+            ),
+        }
+
     _states = {
         "phase0": BeaconStatePhase0,
         "altair": BeaconStateAltair,
         "bellatrix": BeaconStateBellatrix,
         "capella": BeaconStateCapella,
         "deneb": BeaconStateDeneb,
+        "electra": BeaconStateElectra,
     }
 
     # ------------------------------------------------- aggregation / duties
@@ -478,4 +639,12 @@ def build_types(preset: Preset) -> SimpleNamespace:
     ns.block = _blocks
     ns.signed_block = _signed_blocks
     ns.state = _states
+    for _f in _bodies:
+        ns.attestation_by_fork[_f] = (
+            AttestationElectra if _f == "electra" else Attestation
+        )
+    ns.indexed_attestation_by_fork = {
+        _f: (IndexedAttestationElectra if _f == "electra" else IndexedAttestation)
+        for _f in _bodies
+    }
     return ns

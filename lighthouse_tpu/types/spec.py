@@ -95,6 +95,17 @@ class Preset:
     # Deneb
     max_blob_commitments_per_block: int = 4096
     field_elements_per_blob: int = 4096
+    # Electra (EIP-7251/7549/7002/6110)
+    max_attestations_electra: int = 8
+    max_attester_slashings_electra: int = 1
+    max_deposit_requests_per_payload: int = 8192
+    max_withdrawal_requests_per_payload: int = 16
+    max_consolidation_requests_per_payload: int = 2
+    pending_deposits_limit: int = 2**27
+    pending_partial_withdrawals_limit: int = 2**27
+    pending_consolidations_limit: int = 2**18
+    max_pending_partials_per_withdrawals_sweep: int = 8
+    max_pending_deposits_per_epoch: int = 16
 
 
 MAINNET_PRESET = Preset(
@@ -122,6 +133,10 @@ MINIMAL_PRESET = Preset(
     max_withdrawals_per_payload=4,
     max_validators_per_withdrawals_sweep=16,
     max_blob_commitments_per_block=16,
+    max_deposit_requests_per_payload=4,
+    max_withdrawal_requests_per_payload=2,
+    max_consolidation_requests_per_payload=1,
+    max_pending_partials_per_withdrawals_sweep=2,
 )
 
 # Gnosis runs mainnet preset sizes (gnosis chain differs in ChainSpec values).
@@ -204,6 +219,17 @@ class ChainSpec:
     # Deneb
     max_blobs_per_block: int = 6
     min_epochs_for_blob_sidecars_requests: int = 4096
+    # Electra
+    max_effective_balance_electra: int = 2048 * 10**9
+    min_activation_balance: int = 32 * 10**9
+    min_per_epoch_churn_limit_electra: int = 128 * 10**9
+    max_per_epoch_activation_exit_churn_limit: int = 256 * 10**9
+    min_slashing_penalty_quotient_electra: int = 4096
+    whistleblower_reward_quotient_electra: int = 4096
+    max_blobs_per_block_electra: int = 9
+    full_exit_request_amount: int = 0
+    compounding_withdrawal_prefix: bytes = b"\x02"
+    unset_deposit_requests_start_index: int = FAR_FUTURE_EPOCH
 
     # ------------------------------------------------------------- helpers
 

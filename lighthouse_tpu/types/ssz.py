@@ -79,6 +79,30 @@ def mix_in_length(root: bytes, length: int) -> bytes:
     return hash_two(root, length.to_bytes(32, "little"))
 
 
+def merkle_branch(chunks: Sequence[bytes], limit: Optional[int], index: int) -> list:
+    """Sibling branch (bottom-up) proving ``chunks[index]`` under the
+    merkleize(chunks, limit) root — the proof-generation dual of
+    ``is_valid_merkle_branch`` (reference ``consensus/merkle_proof``)."""
+    count = len(chunks)
+    if limit is None:
+        limit = count
+    depth = max(0, (limit - 1).bit_length())
+    if index >= limit:
+        raise ValueError(f"index {index} out of range for limit {limit}")
+    branch = []
+    layer = list(chunks)
+    for d in range(depth):
+        if len(layer) % 2 == 1:
+            layer.append(ZERO_HASHES[d])
+        sibling = index ^ 1
+        branch.append(layer[sibling] if sibling < len(layer) else ZERO_HASHES[d])
+        buf = b"".join(layer)
+        hashed = _hash_pairs(buf)
+        layer = [hashed[i : i + 32] for i in range(0, len(hashed), 32)]
+        index //= 2
+    return branch
+
+
 def pack_bytes(data: bytes) -> list:
     """Pack bytes into zero-padded 32-byte chunks."""
     if not data:

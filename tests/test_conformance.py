@@ -91,6 +91,53 @@ def test_deposit_data_external_kats():
         assert sig.verify(pk, root), "external deposit signature must verify"
 
 
+def test_apply_deposit_verifies_real_signatures():
+    """apply_deposit must accept a correctly-signed new-validator deposit and
+    silently skip a badly-signed one (regression: Signature(_bytes=...) left
+    the point undecoded, so every new-validator deposit was skipped)."""
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.consensus import helpers as h
+    from lighthouse_tpu.consensus.per_block import apply_deposit
+    from lighthouse_tpu.consensus import signature_sets as sets
+    from lighthouse_tpu.types.spec import DOMAIN_DEPOSIT
+
+    harness = BeaconChainHarness(validator_count=8, fake_crypto=False)
+    state = harness.chain.head_state.copy()
+    spec, types = harness.spec, harness.types
+
+    sk = bls.SecretKey(987654321)
+    wc = b"\x01" + b"\x00" * 11 + bytes(range(20))
+    amount = 32 * 10**9
+    dd = types.DepositData(
+        pubkey=sk.public_key().to_bytes(),
+        withdrawal_credentials=wc,
+        amount=amount,
+        signature=b"\x00" * 96,
+    )
+    root = sets.deposit_signature_message(dd, types, spec)
+    dd.signature = sk.sign(root).to_bytes()
+    deposit = types.Deposit(proof=[b"\x00" * 32] * 33, data=dd)
+
+    n_before = len(state.validators)
+    state.eth1_data.deposit_count = state.eth1_deposit_index + 1
+    apply_deposit(state, deposit, types, spec, verify_proof=False)
+    assert len(state.validators) == n_before + 1, "valid deposit must create the validator"
+    assert bytes(state.validators[-1].pubkey) == sk.public_key().to_bytes()
+
+    # tampered signature: skipped (no failure, no validator)
+    sk2 = bls.SecretKey(13579)
+    dd2 = types.DepositData(
+        pubkey=sk2.public_key().to_bytes(),
+        withdrawal_credentials=wc,
+        amount=amount,
+        signature=dd.signature,  # someone else's signature
+    )
+    deposit2 = types.Deposit(proof=[b"\x00" * 32] * 33, data=dd2)
+    state.eth1_data.deposit_count = state.eth1_deposit_index + 1
+    apply_deposit(state, deposit2, types, spec, verify_proof=False)
+    assert len(state.validators) == n_before + 1, "invalid deposit must be skipped"
+
+
 # ------------------------------------------------------ handler plumbing
 
 

@@ -145,6 +145,61 @@ def test_syncing_payload_imports_optimistically(el_chain):
     assert block_hash in el.optimistic_hashes
 
 
+def test_electra_engine_v4_roundtrip():
+    """An electra chain against the socket EL: production uses
+    engine_getPayloadV4 (with executionRequests) and import sends
+    engine_newPayloadV4."""
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    set_backend("fake")
+    server = MockEngineServer(SECRET).start()
+    try:
+        spec = minimal_spec(
+            altair_fork_epoch=0, bellatrix_fork_epoch=0, capella_fork_epoch=0,
+            deneb_fork_epoch=0, electra_fork_epoch=0,
+        )
+        harness = BeaconChainHarness(validator_count=16, spec=spec, fake_crypto=True)
+        el = ExecutionLayer(url=server.url, jwt_secret=SECRET)
+        harness.chain.execution_engine = el
+        roots = harness.extend_chain(2)
+        assert len(roots) == 2
+        assert server.payloads_seen == 2
+        blk = harness.chain.get_block(roots[-1])
+        assert hasattr(blk.message.body, "execution_requests")
+    finally:
+        server.stop()
+        set_backend("host")
+
+
+def test_execution_requests_encoding_roundtrip():
+    """Prague executionRequests wire encoding round-trips through the
+    container (type_byte || ssz list)."""
+    from lighthouse_tpu.execution_layer.engine_api import (
+        execution_requests_from_json,
+        execution_requests_to_json,
+    )
+    from lighthouse_tpu.types.containers import build_types
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    types = build_types(minimal_spec().preset)
+    er = types.ExecutionRequests(
+        deposits=[types.DepositRequest(
+            pubkey=b"\xaa" * 48, withdrawal_credentials=b"\x01" * 32,
+            amount=32 * 10**9, signature=b"\xbb" * 96, index=7,
+        )],
+        withdrawals=[types.WithdrawalRequest(
+            source_address=b"\xcc" * 20, validator_pubkey=b"\xdd" * 48, amount=0,
+        )],
+        consolidations=[],
+    )
+    encoded = execution_requests_to_json(er)
+    assert len(encoded) == 2  # empty consolidations omitted
+    assert encoded[0].startswith("0x00") and encoded[1].startswith("0x01")
+    back = execution_requests_from_json(encoded, types)
+    assert back.hash_tree_root() == er.hash_tree_root()
+
+
 def test_chain_survives_el_restart(el_chain):
     """EL dies mid-operation; the engine flips offline; after the EL comes
     back on the same port, imports succeed again (engines.rs recovery)."""
