@@ -1,0 +1,8 @@
+"""``python -m lighthouse_tpu`` — the CLI entry (reference: the
+``lighthouse`` binary)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

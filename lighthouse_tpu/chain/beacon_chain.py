@@ -64,15 +64,7 @@ class NaiveAggregationPool:
     def insert(self, attestation) -> None:
         from ..crypto.bls import api as bls
 
-        # electra attestations with identical data (index=0) but different
-        # committee_bits must NOT merge — their aggregation bitlists index
-        # different committees.
-        cb = getattr(attestation, "committee_bits", None)
-        key = (
-            int(attestation.data.slot),
-            attestation.data.hash_tree_root()
-            + (bytes(1 if b else 0 for b in cb) if cb is not None else b""),
-        )
+        key = (int(attestation.data.slot), h.attestation_dedup_key(attestation))
         existing = self._pool.get(key)
         if existing is None:
             self._pool[key] = attestation.copy()
@@ -270,7 +262,21 @@ class BeaconChain:
             except BlobError as e:
                 raise BlockError(f"blob verification failed: {e}") from e
             if status != "available":
-                self.da_checker.put_pending_block(signed_block)
+                # Only proposer-authenticated blocks may park in the capped
+                # pending store — unsigned junk must not be able to evict an
+                # honest block waiting for its blobs.
+                header = self.types.SignedBeaconBlockHeader(
+                    message=self.types.BeaconBlockHeader(
+                        slot=block.slot,
+                        proposer_index=block.proposer_index,
+                        parent_root=block.parent_root,
+                        state_root=block.state_root,
+                        body_root=block.body.hash_tree_root(),
+                    ),
+                    signature=signed_block.signature,
+                )
+                if self.verify_block_header_signature(header):
+                    self.da_checker.put_pending_block(signed_block)
                 raise BlockError(f"pending availability: missing blobs {result}")
             blob_sidecars = result
         else:
@@ -360,7 +366,13 @@ class BeaconChain:
         if proposer >= len(state.validators):
             return False
         epoch = int(header.slot) // self.spec.slots_per_epoch
-        domain = h.get_domain(state, DOMAIN_BEACON_PROPOSER, epoch, self.spec)
+        # Domain from the fork AT THE HEADER'S EPOCH (not the parent state's
+        # fork object) — the parent of the first post-fork block is still
+        # pre-fork, but the proposer signed with the new version.
+        fork_version = self.spec.fork_version_for(self.spec.fork_name_at_epoch(epoch))
+        domain = h.compute_domain(
+            DOMAIN_BEACON_PROPOSER, fork_version, self.genesis_validators_root
+        )
         root = h.compute_signing_root(header.hash_tree_root(), domain)
         try:
             pk = sets.pubkey_cache(bytes(state.validators[proposer].pubkey))

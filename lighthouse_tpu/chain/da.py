@@ -212,7 +212,8 @@ class DataAvailabilityChecker:
             header = sc.signed_block_header.message
             if header.hash_tree_root() != block_root:
                 raise BlobError("sidecar header does not match block")
-            body_cls = self.types.block_body["deneb"]
+            fork = self.spec.fork_name_at_slot(int(header.slot))
+            body_cls = self.types.block_body.get(fork) or self.types.block_body["deneb"]
             if not verify_blob_inclusion_proof(
                 sc, body_cls, self.spec.preset.max_blob_commitments_per_block
             ):

@@ -1,0 +1,277 @@
+"""The ``lighthouse-tpu`` command-line interface.
+
+Equivalent of the reference's ``lighthouse`` binary (``lighthouse/src/main.rs:79-402``
+clap tree): ``beacon_node`` (bn), ``validator_client`` (vc), and
+``account_manager`` (am) subcommands over the same library stack the tests
+drive.  ``python -m lighthouse_tpu <subcommand> --help`` for usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import getpass
+import json
+import logging
+import os
+import signal
+import sys
+import time
+from typing import List, Optional
+
+
+def _spec_for(network: str, interop_validators: Optional[int]):
+    from .types.spec import SPECS
+
+    if network not in SPECS:
+        raise SystemExit(f"unknown network {network!r} (have: {', '.join(SPECS)})")
+    return SPECS[network]()
+
+
+# ------------------------------------------------------------ beacon node
+
+
+def run_beacon_node(args) -> int:
+    from .client import ClientBuilder
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.debug else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    spec = _spec_for(args.network, args.interop_validators)
+    builder = ClientBuilder().with_spec(spec).with_bls_backend(args.bls_backend)
+    if args.interop_validators:
+        builder.with_interop_genesis(
+            args.interop_validators, genesis_time=args.interop_genesis_time
+        )
+    elif args.genesis_state:
+        from .types.containers import build_types
+
+        types = build_types(spec.preset)
+        fork = spec.fork_name_at_epoch(0)
+        with open(args.genesis_state, "rb") as f:
+            builder.with_genesis_state(types.state[fork].from_ssz_bytes(f.read()))
+    else:
+        raise SystemExit("provide --interop-validators N or --genesis-state FILE")
+    if args.datadir:
+        builder.with_datadir(args.datadir)
+    if args.execution_endpoint:
+        from .execution_layer.auth import strip_prefix
+
+        with open(args.execution_jwt) as f:
+            builder.with_execution_layer(args.execution_endpoint, strip_prefix(f.read()))
+    builder.with_http_api(args.http_port)
+    if args.slasher:
+        builder.with_slasher()
+
+    client = builder.build().start()
+    print(f"beacon node up: http API on :{args.http_port}, "
+          f"network={args.network}, backend={args.bls_backend}")
+    _wait_for_shutdown()
+    client.stop()
+    return 0
+
+
+# -------------------------------------------------------- validator client
+
+
+def run_validator_client(args) -> int:
+    from .crypto import keystore as ks
+    from .http_api import BeaconNodeHttpClient
+    from .types.containers import build_types
+    from .validator_client import SlashingProtectionDB, ValidatorClient
+
+    logging.basicConfig(level=logging.INFO)
+    spec = _spec_for(args.network, None)
+    types = build_types(spec.preset)
+
+    password = (
+        open(args.password_file).read().strip()
+        if args.password_file
+        else getpass.getpass("keystore password: ")
+    )
+    keys = []
+    for name in sorted(os.listdir(args.keystore_dir)):
+        if not name.endswith(".json"):
+            continue
+        keystore = ks.load_json(os.path.join(args.keystore_dir, name))
+        if "crypto" not in keystore or "pubkey" not in keystore:
+            continue
+        keys.append(ks.load_keystore_signing_key(keystore, password))
+    if not keys:
+        raise SystemExit(f"no keystores found under {args.keystore_dir}")
+    print(f"loaded {len(keys)} validator keys")
+
+    clients = [BeaconNodeHttpClient(u) for u in args.beacon_nodes.split(",")]
+    genesis = clients[0].genesis()
+    slashing_db = SlashingProtectionDB()
+    if args.slashing_protection_db:
+        from .store.lockbox_store import LockboxStore
+
+        slashing_db = SlashingProtectionDB(
+            store=LockboxStore(args.slashing_protection_db)
+        )
+    vc = ValidatorClient(
+        keys=keys,
+        beacon_nodes=clients,
+        spec=spec,
+        types=types,
+        genesis_validators_root=bytes.fromhex(genesis["genesis_validators_root"][2:]),
+        slashing_db=slashing_db,
+    )
+    print("validator client running (ctrl-c to stop)")
+    try:
+        vc.run_forever(genesis_time=int(genesis["genesis_time"]))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+# -------------------------------------------------------- account manager
+
+
+def run_account(args) -> int:
+    from .crypto import keystore as ks
+
+    os.makedirs(args.base_dir, exist_ok=True)
+    if args.account_cmd == "wallet-create":
+        password = (
+            open(args.password_file).read().strip()
+            if args.password_file
+            else getpass.getpass("wallet password: ")
+        )
+        wallet, _seed = ks.create_wallet(args.name, password)
+        path = os.path.join(args.base_dir, f"wallet-{args.name}.json")
+        ks.save_json(wallet, path)
+        print(f"wallet written to {path}")
+        return 0
+    if args.account_cmd == "validator-create":
+        wallet = ks.load_json(args.wallet)
+        wpass = (
+            open(args.password_file).read().strip()
+            if args.password_file
+            else getpass.getpass("wallet password: ")
+        )
+        kpass = (
+            open(args.keystore_password_file).read().strip()
+            if args.keystore_password_file
+            else getpass.getpass("keystore password: ")
+        )
+        out_dir = os.path.join(args.base_dir, "validators")
+        os.makedirs(out_dir, exist_ok=True)
+        derived = ks.derive_validator_keystores(wallet, wpass, kpass, args.count)
+        for keystore, _sk in derived:
+            path = os.path.join(out_dir, f"keystore-{keystore['pubkey'][:16]}.json")
+            ks.save_json(keystore, path)
+            print(f"validator {keystore['pubkey'][:16]}… -> {path}")
+        ks.save_json(wallet, args.wallet)  # persists nextaccount
+        return 0
+    if args.account_cmd == "validator-list":
+        vdir = os.path.join(args.base_dir, "validators")
+        if not os.path.isdir(vdir):
+            print("no validators")
+            return 0
+        for name in sorted(os.listdir(vdir)):
+            if name.endswith(".json"):
+                obj = ks.load_json(os.path.join(vdir, name))
+                print(f"0x{obj.get('pubkey', '')}  path={obj.get('path', '')}")
+        return 0
+    if args.account_cmd == "slashing-protection-export":
+        from .store.lockbox_store import LockboxStore
+        from .validator_client import SlashingProtectionDB
+
+        db = SlashingProtectionDB(store=LockboxStore(args.db))
+        text = db.export_json(bytes.fromhex(args.genesis_validators_root[2:]))
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"interchange written to {args.out}")
+        return 0
+    if args.account_cmd == "slashing-protection-import":
+        from .store.lockbox_store import LockboxStore
+        from .validator_client import SlashingProtectionDB
+
+        db = SlashingProtectionDB(store=LockboxStore(args.db))
+        n = db.import_json(
+            open(args.interchange).read(),
+            bytes.fromhex(args.genesis_validators_root[2:]),
+        )
+        print(f"imported protection for {n} validators")
+        return 0
+    raise SystemExit(f"unknown account command {args.account_cmd}")
+
+
+# ---------------------------------------------------------------- parser
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="lighthouse-tpu",
+        description="TPU-native Ethereum consensus client",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    bn = sub.add_parser("beacon_node", aliases=["bn"], help="run a beacon node")
+    bn.add_argument("--network", default="mainnet")
+    bn.add_argument("--datadir", default=None)
+    bn.add_argument("--http-port", type=int, default=5052)
+    bn.add_argument("--execution-endpoint", default=None)
+    bn.add_argument("--execution-jwt", default=None)
+    bn.add_argument("--interop-validators", type=int, default=None)
+    bn.add_argument("--interop-genesis-time", type=int, default=None)
+    bn.add_argument("--genesis-state", default=None)
+    bn.add_argument("--slasher", action="store_true")
+    bn.add_argument("--bls-backend", default="jax", choices=["jax", "host", "fake"])
+    bn.add_argument("--debug", action="store_true")
+    bn.set_defaults(func=run_beacon_node)
+
+    vc = sub.add_parser("validator_client", aliases=["vc"], help="run a validator client")
+    vc.add_argument("--network", default="mainnet")
+    vc.add_argument("--beacon-nodes", default="http://127.0.0.1:5052")
+    vc.add_argument("--keystore-dir", required=True)
+    vc.add_argument("--password-file", default=None)
+    vc.add_argument("--slashing-protection-db", default=None)
+    vc.set_defaults(func=run_validator_client)
+
+    am = sub.add_parser("account_manager", aliases=["am", "account"],
+                        help="wallets, validators, slashing protection")
+    am.add_argument("--base-dir", default=os.path.expanduser("~/.lighthouse-tpu"))
+    amsub = am.add_subparsers(dest="account_cmd", required=True)
+    w = amsub.add_parser("wallet-create")
+    w.add_argument("--name", required=True)
+    w.add_argument("--password-file", default=None)
+    v = amsub.add_parser("validator-create")
+    v.add_argument("--wallet", required=True)
+    v.add_argument("--count", type=int, default=1)
+    v.add_argument("--password-file", default=None)
+    v.add_argument("--keystore-password-file", default=None)
+    amsub.add_parser("validator-list")
+    ex = amsub.add_parser("slashing-protection-export")
+    ex.add_argument("--db", required=True)
+    ex.add_argument("--out", required=True)
+    ex.add_argument("--genesis-validators-root", required=True)
+    im = amsub.add_parser("slashing-protection-import")
+    im.add_argument("--db", required=True)
+    im.add_argument("--interchange", required=True)
+    im.add_argument("--genesis-validators-root", required=True)
+    am.set_defaults(func=run_account)
+    return p
+
+
+def _wait_for_shutdown() -> None:
+    stop = {"flag": False}
+
+    def handler(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+    while not stop["flag"]:
+        time.sleep(0.5)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -713,6 +713,18 @@ def get_committee_indices(committee_bits) -> List[int]:
     return [i for i, bit in enumerate(committee_bits) if bit]
 
 
+def attestation_dedup_key(attestation) -> bytes:
+    """Pool dedup/merge key: data root, extended with committee_bits for
+    electra attestations (identical data with different committee_bits index
+    DIFFERENT committees and must never merge).  Single source of truth for
+    the naive pool and the op pool."""
+    cb = getattr(attestation, "committee_bits", None)
+    key = attestation.data.hash_tree_root()
+    if cb is not None:
+        key += bytes(1 if b else 0 for b in cb)
+    return key
+
+
 def get_expected_withdrawals_electra(state, types, spec: ChainSpec):
     """(withdrawals, processed_partial_count): EIP-7002 pending partial
     withdrawals drain first, then the compounding-aware validator sweep."""

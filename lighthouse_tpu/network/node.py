@@ -48,9 +48,12 @@ class LocalNode:
         self.processor = BeaconProcessor(max_workers=max_workers)
         self.slasher = None
         if enable_slasher:
-            from ..slasher import Slasher
+            from ..slasher import Slasher, SlasherConfig
 
-            self.slasher = Slasher(chain.types)
+            self.slasher = Slasher(
+                chain.types,
+                SlasherConfig(slots_per_epoch=chain.spec.slots_per_epoch),
+            )
         self.router = Router(
             chain=chain, service=self.service, processor=self.processor,
             slasher=self.slasher,

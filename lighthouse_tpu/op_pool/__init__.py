@@ -76,12 +76,7 @@ class OperationPool:
     # ------------------------------------------------------- attestations
 
     def insert_attestation(self, attestation) -> None:
-        cb = getattr(attestation, "committee_bits", None)
-        key = (
-            int(attestation.data.slot),
-            attestation.data.hash_tree_root()
-            + (bytes(1 if b else 0 for b in cb) if cb is not None else b""),
-        )
+        key = (int(attestation.data.slot), h.attestation_dedup_key(attestation))
         group = self._attestations.get(key)
         if group is None:
             group = self._attestations[key] = _AttestationGroup(data=attestation.data)
@@ -174,6 +169,12 @@ class OperationPool:
             # container families don't cross the electra boundary (EIP-7549
             # changed IndexedAttestation's limits)
             if ("Electra" in type(s).__name__) != is_electra_state:
+                continue
+            # a mis-oriented pair would fail per_block processing and poison
+            # every produced block — never hand one out
+            if not h.is_slashable_attestation_data(
+                s.attestation_1.data, s.attestation_2.data
+            ):
                 continue
             att1 = set(int(i) for i in s.attestation_1.attesting_indices)
             att2 = set(int(i) for i in s.attestation_2.attesting_indices)

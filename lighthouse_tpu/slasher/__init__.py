@@ -32,9 +32,11 @@ UNSET = -1
 
 
 class SlasherConfig:
-    def __init__(self, history_length: int = 4096, max_validators: int = 1 << 14):
+    def __init__(self, history_length: int = 4096, max_validators: int = 1 << 14,
+                 slots_per_epoch: int = 32):
         self.history_length = history_length
         self.max_validators = max_validators
+        self.slots_per_epoch = slots_per_epoch
 
 
 class SlasherDB:
@@ -88,9 +90,14 @@ class SlasherDB:
                         findings.append({
                             "kind": "double", "validator": v,
                             "prev": self._attestations.get((v, target)),
+                            "new_first": False,  # (a1=prev, a2=new): same target
                         })
                         continue  # double vote recorded; don't overwrite
                 # --- surround checks over the dense window (vectorized)
+                # ``new_first`` orients the slashing container so that
+                # attestation_1 SURROUNDS attestation_2
+                # (is_slashable_attestation_data requires a1.source < a2.source
+                # and a2.target < a1.target).
                 row = self._sources[v]
                 # new surrounds old: old attestations with target in
                 # (source, target) whose source > new source
@@ -103,6 +110,7 @@ class SlasherDB:
                         findings.append({
                             "kind": "surround", "validator": v,
                             "prev": self._attestations.get((v, t_old)),
+                            "new_first": True,  # the new attestation surrounds
                         })
                 # old surrounds new: old attestations with target > new target
                 # whose source < new source (and set)
@@ -114,6 +122,7 @@ class SlasherDB:
                     findings.append({
                         "kind": "surround", "validator": v,
                         "prev": self._attestations.get((v, t_old)),
+                        "new_first": False,  # the old attestation surrounds
                     })
                 if prev_source == UNSET:
                     self._sources[v, col] = source
@@ -150,7 +159,7 @@ class SlasherDB:
             for k in [k for k in self._attestations if k[1] < cutoff]:
                 del self._attestations[k]
             # proposals keyed by slot; keep a matching horizon
-            slot_cutoff = cutoff * 32
+            slot_cutoff = cutoff * self.config.slots_per_epoch
             for k in [k for k in self._proposals if k[0] < slot_cutoff]:
                 del self._proposals[k]
 
@@ -165,9 +174,11 @@ class Slasher:
         self.db = SlasherDB(config)
         self.attester_slashings: List[object] = []
         self.proposer_slashings: List[object] = []
+        self._last_prune_epoch = 0
 
     def on_attestation(self, indexed) -> int:
         """Process one indexed attestation; returns #slashings produced."""
+        self._maybe_prune(int(indexed.data.target.epoch))
         produced = 0
         for finding in self.db.check_attestation(indexed):
             prev = finding.get("prev")
@@ -178,11 +189,20 @@ class Slasher:
                 if "Electra" in type(indexed).__name__
                 else self.types.AttesterSlashing
             )
-            self.attester_slashings.append(
-                cls(attestation_1=prev, attestation_2=indexed)
-            )
+            if finding.get("new_first"):
+                a1, a2 = indexed, prev  # the new attestation surrounds
+            else:
+                a1, a2 = prev, indexed
+            self.attester_slashings.append(cls(attestation_1=a1, attestation_2=a2))
             produced += 1
         return produced
+
+    PRUNE_INTERVAL_EPOCHS = 64
+
+    def _maybe_prune(self, epoch: int) -> None:
+        if epoch >= self._last_prune_epoch + self.PRUNE_INTERVAL_EPOCHS:
+            self.db.prune(epoch)
+            self._last_prune_epoch = epoch
 
     def on_block(self, signed_block_or_header) -> int:
         msg = signed_block_or_header.message

@@ -56,6 +56,8 @@ def test_identical_attestation_not_slashable(harness):
 
 
 def test_new_surrounds_old(harness):
+    from lighthouse_tpu.consensus import helpers as h
+
     slasher = Slasher(harness.types)
     inner = _indexed(harness.types, [2], 3, 4)
     outer = _indexed(harness.types, [2], 1, 6)  # (1,6) surrounds (3,4)
@@ -63,14 +65,23 @@ def test_new_surrounds_old(harness):
     assert slasher.on_attestation(outer) == 1
     slashings, _ = slasher.drain_slashings()
     assert len(slashings) == 1
+    s = slashings[0]
+    # orientation: attestation_1 must SURROUND attestation_2 or the chain's
+    # is_slashable_attestation_data check rejects the slashing
+    assert h.is_slashable_attestation_data(s.attestation_1.data, s.attestation_2.data)
 
 
 def test_old_surrounds_new(harness):
+    from lighthouse_tpu.consensus import helpers as h
+
     slasher = Slasher(harness.types)
     outer = _indexed(harness.types, [9], 1, 6)
     inner = _indexed(harness.types, [9], 3, 4)  # surrounded by (1,6)
     assert slasher.on_attestation(outer) == 0
     assert slasher.on_attestation(inner) == 1
+    slashings, _ = slasher.drain_slashings()
+    s = slashings[0]
+    assert h.is_slashable_attestation_data(s.attestation_1.data, s.attestation_2.data)
 
 
 def test_disjoint_votes_not_slashable(harness):
