@@ -125,7 +125,13 @@ class BeaconChain:
         slot_clock: Optional[SlotClock] = None,
         execution_engine: Optional[MockExecutionEngine] = None,
         kzg=None,
+        anchor_block=None,
     ):
+        """``anchor_block``: checkpoint sync (weak subjectivity) — boot from a
+        finalized (state, block) pair instead of genesis: ``genesis_state``
+        is then the anchor block's post-state, the anchor root plays the
+        genesis-root role in fork choice, and backfill later fills history
+        behind it (reference ``client/src/builder.rs:341-528``)."""
         self.spec = spec
         self.types = types
         if db is not None:
@@ -152,11 +158,20 @@ class BeaconChain:
         )
 
         self.genesis_block_root = genesis_block_root_of(genesis_state)
+        self.anchor_slot = int(genesis_state.slot)  # 0 for a genesis boot
         # Object caches over the store (the reference's snapshot/state caches).
         self._blocks: Dict[bytes, object] = {}
         self._states: Dict[bytes, object] = {}  # post-state by block root
         self._state_class: Dict[bytes, type] = {}
-        self._store_block(self.genesis_block_root, None, genesis_state)
+        if anchor_block is not None:
+            anchor_root = anchor_block.message.hash_tree_root()
+            if anchor_root != self.genesis_block_root:
+                raise ChainError(
+                    "anchor_block does not match the anchor state's latest header"
+                )
+            self._store_block(anchor_root, anchor_block, genesis_state)
+        else:
+            self._store_block(self.genesis_block_root, None, genesis_state)
 
         self.fork_choice = ForkChoice(
             spec=spec,

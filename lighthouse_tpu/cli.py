@@ -19,12 +19,19 @@ import time
 from typing import List, Optional
 
 
-def _spec_for(network: str, interop_validators: Optional[int]):
+def _spec_for(network: str):
     from .types.spec import SPECS
 
     if network not in SPECS:
         raise SystemExit(f"unknown network {network!r} (have: {', '.join(SPECS)})")
     return SPECS[network]()
+
+
+def _read_password(path, prompt: str) -> str:
+    if path:
+        with open(path) as f:
+            return f.read().strip()
+    return getpass.getpass(prompt)
 
 
 # ------------------------------------------------------------ beacon node
@@ -37,7 +44,7 @@ def run_beacon_node(args) -> int:
         level=logging.DEBUG if args.debug else logging.INFO,
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
     )
-    spec = _spec_for(args.network, args.interop_validators)
+    spec = _spec_for(args.network)
     builder = ClientBuilder().with_spec(spec).with_bls_backend(args.bls_backend)
     if args.interop_validators:
         builder.with_interop_genesis(
@@ -55,6 +62,8 @@ def run_beacon_node(args) -> int:
     if args.datadir:
         builder.with_datadir(args.datadir)
     if args.execution_endpoint:
+        if not args.execution_jwt:
+            raise SystemExit("--execution-endpoint requires --execution-jwt FILE")
         from .execution_layer.auth import strip_prefix
 
         with open(args.execution_jwt) as f:
@@ -81,14 +90,10 @@ def run_validator_client(args) -> int:
     from .validator_client import SlashingProtectionDB, ValidatorClient
 
     logging.basicConfig(level=logging.INFO)
-    spec = _spec_for(args.network, None)
+    spec = _spec_for(args.network)
     types = build_types(spec.preset)
 
-    password = (
-        open(args.password_file).read().strip()
-        if args.password_file
-        else getpass.getpass("keystore password: ")
-    )
+    password = _read_password(args.password_file, "keystore password: ")
     keys = []
     for name in sorted(os.listdir(args.keystore_dir)):
         if not name.endswith(".json"):
@@ -134,11 +139,7 @@ def run_account(args) -> int:
 
     os.makedirs(args.base_dir, exist_ok=True)
     if args.account_cmd == "wallet-create":
-        password = (
-            open(args.password_file).read().strip()
-            if args.password_file
-            else getpass.getpass("wallet password: ")
-        )
+        password = _read_password(args.password_file, "wallet password: ")
         wallet, _seed = ks.create_wallet(args.name, password)
         path = os.path.join(args.base_dir, f"wallet-{args.name}.json")
         ks.save_json(wallet, path)
@@ -146,16 +147,8 @@ def run_account(args) -> int:
         return 0
     if args.account_cmd == "validator-create":
         wallet = ks.load_json(args.wallet)
-        wpass = (
-            open(args.password_file).read().strip()
-            if args.password_file
-            else getpass.getpass("wallet password: ")
-        )
-        kpass = (
-            open(args.keystore_password_file).read().strip()
-            if args.keystore_password_file
-            else getpass.getpass("keystore password: ")
-        )
+        wpass = _read_password(args.password_file, "wallet password: ")
+        kpass = _read_password(args.keystore_password_file, "keystore password: ")
         out_dir = os.path.join(args.base_dir, "validators")
         os.makedirs(out_dir, exist_ok=True)
         derived = ks.derive_validator_keystores(wallet, wpass, kpass, args.count)

@@ -133,9 +133,11 @@ class ClientBuilder:
         processor = BeaconProcessor(max_workers=self._max_workers)
         slasher = None
         if self._slasher:
-            from ..slasher import Slasher
+            from ..slasher import Slasher, SlasherConfig
 
-            slasher = Slasher(types)
+            slasher = Slasher(
+                types, SlasherConfig(slots_per_epoch=self._spec.slots_per_epoch)
+            )
         http_server = None
         if self._http_port is not None:
             from ..http_api import HttpApiServer

@@ -13,6 +13,7 @@ import ctypes
 import ctypes.util
 import hashlib
 import json
+import os
 import secrets
 import unicodedata
 import uuid
@@ -224,7 +225,9 @@ def load_keystore_signing_key(keystore: dict, password: str):
 
 
 def save_json(obj: dict, path: str) -> None:
-    with open(path, "w") as f:
+    # key material: owner-only permissions (the reference writes 0600 too)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
         json.dump(obj, f, indent=2)
 
 

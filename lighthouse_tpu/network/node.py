@@ -22,13 +22,14 @@ class LocalNode:
     def __init__(
         self,
         *,
-        hub: Hub,
+        hub: Optional[Hub] = None,
         peer_id: str,
         harness: Optional[BeaconChainHarness] = None,
         chain: Optional[BeaconChain] = None,
         max_workers: int = 2,
         bls_backend: Optional[str] = None,
         enable_slasher: bool = False,
+        endpoint=None,
     ):
         if harness is not None:
             chain = harness.chain
@@ -43,7 +44,13 @@ class LocalNode:
         self.harness = harness
         self.chain = chain
         self.peer_id = peer_id
-        self.endpoint = hub.register(peer_id)
+        # transport seam: in-process hub (simulators) or a provided endpoint
+        # (e.g. TcpEndpoint — two OS processes over sockets)
+        if endpoint is not None:
+            self.endpoint = endpoint
+        else:
+            assert hub is not None, "pass hub= or endpoint="
+            self.endpoint = hub.register(peer_id)
         self.service = NetworkService(self.endpoint)
         self.processor = BeaconProcessor(max_workers=max_workers)
         self.slasher = None
@@ -97,3 +104,5 @@ class LocalNode:
     def shutdown(self) -> None:
         self.service.shutdown()
         self.processor.shutdown()
+        if hasattr(self.endpoint, "close"):
+            self.endpoint.close()  # socket-backed endpoints own OS resources

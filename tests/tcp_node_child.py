@@ -1,0 +1,38 @@
+"""Child process for the two-OS-process TCP sync test: builds a chain,
+serves it on a TcpEndpoint, prints its port + head root as JSON, then waits
+until stdin closes."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lighthouse_tpu.chain import BeaconChainHarness  # noqa: E402
+from lighthouse_tpu.crypto.bls.backends import set_backend  # noqa: E402
+from lighthouse_tpu.network.node import LocalNode  # noqa: E402
+from lighthouse_tpu.network.tcp_transport import TcpEndpoint  # noqa: E402
+
+
+def main() -> int:
+    genesis_time = int(sys.argv[1])
+    n_blocks = int(sys.argv[2])
+    set_backend("fake")
+    harness = BeaconChainHarness(
+        validator_count=16, fake_crypto=True, genesis_time=genesis_time
+    )
+    harness.extend_chain(n_blocks)
+    endpoint = TcpEndpoint("server")
+    node = LocalNode(peer_id="server", harness=harness, endpoint=endpoint)
+    print(json.dumps({
+        "port": endpoint.listen_addr[1],
+        "head": harness.chain.head_root.hex(),
+        "head_slot": harness.chain._blocks_slot(harness.chain.head_root),
+    }), flush=True)
+    sys.stdin.read()  # parent closes stdin to stop us
+    node.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
