@@ -90,11 +90,37 @@ class NaiveAggregationPool:
                 break
         return out
 
-    def get_aggregate(self, slot: int, data_root: bytes):
+    def get_aggregate(self, slot: int, data_root: bytes,
+                      committee_index: Optional[int] = None):
         """Best aggregate for (slot, attestation_data_root) — the
-        ``aggregate_attestation`` API's source (naive_aggregation_pool.rs get)."""
-        att = self._pool.get((int(slot), bytes(data_root)))
-        return None if att is None else att.copy()
+        ``aggregate_attestation`` API's source (naive_aggregation_pool.rs get).
+
+        Electra entries are keyed with committee_bits appended to the data
+        root (attestation_dedup_key), so a plain (slot, data_root) lookup
+        must scan key prefixes — otherwise the API 404s for every
+        post-electra aggregate (round-2 advisor finding).  ``committee_index``
+        (the v2 API's parameter) narrows to one committee; without it the
+        fullest matching aggregate wins."""
+        slot = int(slot)
+        data_root = bytes(data_root)
+        att = self._pool.get((slot, data_root))
+        if att is not None:
+            return att.copy()
+        best = None
+        best_bits = -1
+        for (s, key), cand in self._pool.items():
+            if s != slot or not key.startswith(data_root):
+                continue
+            cb = getattr(cand, "committee_bits", None)
+            if committee_index is not None:
+                if cb is None or not (
+                    committee_index < len(cb) and cb[committee_index]
+                ):
+                    continue
+            nbits = sum(1 for b in cand.aggregation_bits if b)
+            if nbits > best_bits:
+                best, best_bits = cand, nbits
+        return None if best is None else best.copy()
 
     def prune(self, current_slot: int) -> None:
         cutoff = current_slot - self.SLOT_RETENTION
@@ -103,14 +129,32 @@ class NaiveAggregationPool:
 
 class AttestationCandidate:
     """A spec-checked, indexed attestation awaiting signature verification
-    (the unit the gossip batch verifier coalesces)."""
+    (the unit the gossip batch verifier coalesces).  ``state`` is the state
+    the attestation was indexed against (needed to build the aggregate's
+    extra signature sets without re-deriving committees)."""
 
-    __slots__ = ("attestation", "indexed", "signature_set")
+    __slots__ = ("attestation", "indexed", "signature_set", "state")
 
-    def __init__(self, attestation, indexed, signature_set):
+    def __init__(self, attestation, indexed, signature_set, state=None):
         self.attestation = attestation
         self.indexed = indexed
         self.signature_set = signature_set
+        self.state = state
+
+
+class AggregateCandidate:
+    """A spec-checked SignedAggregateAndProof awaiting signature verification.
+
+    Carries the reference's THREE signature sets per aggregate
+    (``attestation_verification/batch.rs:31-135``): selection proof, outer
+    AggregateAndProof signature, inner indexed-attestation set."""
+
+    __slots__ = ("signed_aggregate", "inner", "signature_sets")
+
+    def __init__(self, signed_aggregate, inner: AttestationCandidate, signature_sets):
+        self.signed_aggregate = signed_aggregate
+        self.inner = inner
+        self.signature_sets = signature_sets
 
 
 class BeaconChain:
@@ -178,7 +222,7 @@ class BeaconChain:
             genesis_block_root=self.genesis_block_root,
             genesis_state=genesis_state,
         )
-        self.fork_choice.set_justified_state_provider(self._states.get)
+        self.fork_choice.set_justified_state_provider(self.get_state)
         from ..op_pool import OperationPool
 
         self.head_root = self.genesis_block_root
@@ -215,18 +259,51 @@ class BeaconChain:
         self.db.put_state(state_root, post_state, block_root)
 
     def get_block(self, block_root: bytes):
-        return self._blocks.get(block_root)
+        """Block by root — object cache first, store fallback (the reference
+        can always reach the store when its block cache misses)."""
+        block = self._blocks.get(block_root)
+        if block is None:
+            block = self.db.get_block(block_root)
+        return block
 
     def get_blobs(self, block_root: bytes) -> list:
         """Blob sidecars stored at import (the blob_sidecars API's source)."""
         return list(self._blob_sidecars.get(block_root, []))
 
     def get_state(self, block_root: bytes):
-        return self._states.get(block_root)
+        """Post-state for ``block_root`` — object cache first, then the hot
+        store by the block's claimed state root, then cold-store replay
+        (reference snapshot-cache-miss path, ``beacon_chain.rs:378-504``:
+        a cache miss is a slow path, never an error)."""
+        state = self._states.get(block_root)
+        if state is not None:
+            return state
+        if block_root == self.genesis_block_root:
+            state = self.genesis_state
+        else:
+            block = self.get_block(block_root)
+            if block is None:
+                return None
+            state = self.db.get_hot_state(bytes(block.message.state_root))
+            if state is None:
+                # Finalized history: rebuild from the nearest restore point.
+                # Only canonical-finalized roots exist cold-side, so verify
+                # the block root at that slot matches before trusting it.
+                slot = int(block.message.slot)
+                if self.db.cold_block_root_at_slot(slot) == block_root:
+                    state = self.db.load_cold_state_by_slot(slot)
+        if state is not None:
+            self._states[block_root] = state
+        return state
 
     @property
     def head_state(self):
-        return self._states[self.head_root]
+        state = self.get_state(self.head_root)
+        if state is None:
+            raise ChainError(
+                f"head state for {self.head_root.hex()[:16]} missing from cache and store"
+            )
+        return state
 
     def current_slot(self) -> int:
         now = self.slot_clock.now()
@@ -259,7 +336,7 @@ class BeaconChain:
         if int(block.slot) > current_slot:
             raise BlockError(f"block from future slot {block.slot} (now {current_slot})")
         parent_root = bytes(block.parent_root)
-        parent_state = self._states.get(parent_root)
+        parent_state = self.get_state(parent_root)
         if parent_state is None:
             raise BlockError(f"unknown parent {parent_root.hex()[:16]}")
 
@@ -376,7 +453,7 @@ class BeaconChain:
         from ..types.spec import DOMAIN_BEACON_PROPOSER
 
         header = signed_header.message
-        state = self._states.get(bytes(header.parent_root)) or self.head_state
+        state = self.get_state(bytes(header.parent_root)) or self.head_state
         proposer = int(header.proposer_index)
         if proposer >= len(state.validators):
             return False
@@ -441,7 +518,7 @@ class BeaconChain:
 
         data = attestation.data
         head_root = bytes(data.beacon_block_root)
-        state = self._states.get(head_root)
+        state = self.get_state(head_root)
         if state is None:
             raise AttestationError("attestation references unknown head block")
         base = state
@@ -465,7 +542,83 @@ class BeaconChain:
             sig_set = sets.indexed_attestation_signature_set(base, indexed, self.spec)
         except bls.BlsError as e:
             raise AttestationError(f"malformed attestation signature: {e}") from e
-        return AttestationCandidate(attestation, indexed, sig_set)
+        return AttestationCandidate(attestation, indexed, sig_set, state=base)
+
+    def preverify_aggregate(self, signed_aggregate) -> "AggregateCandidate":
+        """Spec checks for a ``SignedAggregateAndProof`` (reference
+        ``verify_aggregated_attestation_for_gossip``,
+        ``attestation_verification.rs``): the aggregator must be a member of
+        the attestation's committee AND pass the spec ``is_aggregator``
+        selection gate, and THREE signature sets are built — selection proof,
+        outer AggregateAndProof signature, inner indexed attestation — all
+        left unverified for the batch coalescer.  Skipping any of these lets
+        a peer mint wraps around public aggregates to censor honest
+        aggregators (round-2 advisor finding)."""
+        import hashlib
+
+        from ..consensus import signature_sets as sets
+        from ..crypto.bls import api as bls
+
+        msg = signed_aggregate.message
+        attestation = msg.aggregate
+        inner = self.preverify_attestation(attestation)
+        base = inner.state
+        data = attestation.data
+        slot = int(data.slot)
+        aggregator_index = int(msg.aggregator_index)
+        if aggregator_index >= len(base.validators):
+            raise AttestationError("aggregator index out of range")
+        if hasattr(attestation, "committee_bits"):
+            committee_indices = h.get_committee_indices(attestation.committee_bits)
+            if len(committee_indices) != 1:
+                raise AttestationError("electra aggregate must set exactly one committee bit")
+            committee_index = committee_indices[0]
+        else:
+            committee_index = int(data.index)
+        committee = h.get_beacon_committee(base, slot, committee_index, self.spec)
+        if aggregator_index not in {int(i) for i in committee}:
+            raise AttestationError("aggregator is not in the attestation committee")
+        modulo = max(1, len(committee) // self.spec.target_aggregators_per_committee)
+        digest = hashlib.sha256(bytes(msg.selection_proof)).digest()
+        if int.from_bytes(digest[:8], "little") % modulo != 0:
+            raise AttestationError("validator is not a selected aggregator for this slot")
+        try:
+            selection_set = sets.selection_proof_signature_set(
+                base, aggregator_index, slot, msg.selection_proof, self.spec
+            )
+            outer_set = sets.aggregate_and_proof_signature_set(
+                base, signed_aggregate, self.spec
+            )
+        except bls.BlsError as e:
+            raise AttestationError(f"malformed aggregate signature: {e}") from e
+        return AggregateCandidate(
+            signed_aggregate, inner, [selection_set, outer_set, inner.signature_set]
+        )
+
+    def apply_verified_aggregate(self, cand: "AggregateCandidate") -> None:
+        """Apply a signature-verified aggregate candidate: fork choice + pool
+        via the inner attestation, then record (aggregate root, aggregator)
+        in the observed caches.  The ONE place the observe sequence lives —
+        both the gossip router and the HTTP publish path call this."""
+        self.apply_attestation(cand.inner)
+        self.observed.aggregates.observe(
+            int(cand.inner.attestation.data.slot),
+            cand.inner.attestation.hash_tree_root(),
+        )
+        self.observed.aggregators.observe(
+            int(cand.inner.attestation.data.target.epoch),
+            int(cand.signed_aggregate.message.aggregator_index),
+        )
+
+    def process_aggregate(self, signed_aggregate) -> None:
+        """Fully verify and apply one SignedAggregateAndProof (batch-of-one;
+        the gossip router batches many candidates into one device program)."""
+        from ..crypto.bls import api as bls
+
+        cand = self.preverify_aggregate(signed_aggregate)
+        if not bls.verify_signature_sets(cand.signature_sets):
+            raise AttestationError("bad aggregate signature(s)")
+        self.apply_verified_aggregate(cand)
 
     def apply_attestation(self, cand: "AttestationCandidate",
                           is_from_block: bool = False) -> None:
@@ -510,7 +663,7 @@ class BeaconChain:
         """State at ``block_root`` (default: head) advanced with empty slots
         to ``slot``."""
         root = self.head_root if block_root is None else block_root
-        state = self._states.get(root)
+        state = self.get_state(root)
         if state is None:
             raise ChainError(f"unknown block root {root.hex()[:16]}")
         if int(state.slot) > slot:
@@ -668,7 +821,10 @@ class BeaconChain:
     def _blocks_slot(self, block_root: bytes) -> int:
         if block_root == self.genesis_block_root:
             return int(self.genesis_state.slot)
-        return int(self._blocks[block_root].message.slot)
+        block = self.get_block(block_root)
+        if block is None:
+            raise ChainError(f"unknown block {block_root.hex()[:16]}")
+        return int(block.message.slot)
 
     # ----------------------------------------------------------------- head
 
@@ -677,8 +833,8 @@ class BeaconChain:
         old_head = self.head_root
         head = self.fork_choice.get_head(self.current_slot())
         self.head_root = head
-        if head != old_head and head in self._states:
-            st = self._states[head]
+        st = self.get_state(head) if head != old_head else None
+        if st is not None:
             old_epoch = self._blocks_slot(old_head) // self.spec.slots_per_epoch
             new_epoch = self._blocks_slot(head) // self.spec.slots_per_epoch
             self.events.head(
@@ -692,7 +848,7 @@ class BeaconChain:
         # Real ELs track our head (engine_forkchoiceUpdated on head change);
         # the in-proc mock has no such method and is skipped.
         if head != old_head and hasattr(self.execution_engine, "notify_forkchoice_updated"):
-            st2 = self._states.get(head)
+            st2 = self.get_state(head)
             if st2 is not None and hasattr(st2, "latest_execution_payload_header"):
                 f_root_now = self.fork_choice.finalized_checkpoint[1]
                 f_state = self._states.get(f_root_now)

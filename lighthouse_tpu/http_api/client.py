@@ -159,11 +159,19 @@ class BeaconNodeHttpClient:
             return container_from_json(types.AttestationData, data)
         return data
 
-    def aggregate_attestation(self, slot: int, data_root: bytes, types=None):
-        data = self.get(
+    def aggregate_attestation(self, slot: int, data_root: bytes, types=None,
+                              committee_index=None):
+        """``committee_index`` (v2/electra): post-electra all committees share
+        one data root, so the pool needs it to return OUR committee's
+        aggregate — without it an aggregator can be handed another
+        committee's aggregate and fail the BN's committee-membership check."""
+        url = (
             f"/eth/v2/validator/aggregate_attestation"
             f"?attestation_data_root=0x{data_root.hex()}&slot={slot}"
-        )["data"]
+        )
+        if committee_index is not None:
+            url += f"&committee_index={int(committee_index)}"
+        data = self.get(url)["data"]
         if types is not None:
             return container_from_json(types.Attestation, data)
         return data

@@ -859,10 +859,12 @@ def attestation_data(ctx):
 def aggregate_attestation(ctx):
     root_hex = ctx.q1("attestation_data_root")
     slot = ctx.q1("slot")
+    committee_index = ctx.q1("committee_index")  # v2 (electra) parameter
     if root_hex is None or slot is None:
         raise _bad("attestation_data_root and slot are required")
     att = ctx.chain.attestation_pool.get_aggregate(
-        int(slot), bytes.fromhex(root_hex[2:])
+        int(slot), bytes.fromhex(root_hex[2:]),
+        committee_index=None if committee_index is None else int(committee_index),
     )
     if att is None:
         raise _not_found("no aggregate for that data root")
@@ -879,7 +881,7 @@ def aggregate_and_proofs(ctx):
     for i, agg_json in enumerate(ctx.body or []):
         try:
             signed = container_from_json(chain.types.SignedAggregateAndProof, agg_json)
-            chain.process_attestation(signed.message.aggregate)
+            chain.process_aggregate(signed)
         except (AttestationError, KeyError, ValueError) as e:
             failures.append({"index": i, "message": str(e)})
     if failures:

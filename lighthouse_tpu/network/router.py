@@ -251,7 +251,18 @@ class Router:
                 ):
                     continue  # aggregator already aggregated this epoch
             try:
-                cand = chain.preverify_attestation(attestation)
+                if is_aggregate:
+                    # Full aggregate gossip verification: aggregator committee
+                    # membership + is_aggregator + 3 signature sets (selection
+                    # proof, outer sig, indexed att) — never just the inner
+                    # aggregate (round-2 advisor high finding).
+                    cand = chain.preverify_aggregate(agg)
+                    sig_sets = cand.signature_sets
+                    inner = cand.inner
+                else:
+                    cand = chain.preverify_attestation(attestation)
+                    sig_sets = [cand.signature_set]
+                    inner = cand
             except AttestationError as e:
                 if "unknown head block" in str(e):
                     continue  # behind — ignore, don't penalize (reference queues)
@@ -261,40 +272,39 @@ class Router:
                 continue
             if not is_aggregate:
                 vidx = (
-                    int(cand.indexed.attesting_indices[0])
-                    if len(cand.indexed.attesting_indices) == 1
+                    int(inner.indexed.attesting_indices[0])
+                    if len(inner.indexed.attesting_indices) == 1
                     else None
                 )
                 if vidx is not None and chain.observed.attesters.is_known(
                     target_epoch, vidx
                 ):
                     continue  # validator already attested this epoch
-            candidates.append((cand, is_aggregate, agg if is_aggregate else None,
-                               topic, compressed, sender))
+            candidates.append((cand, sig_sets, is_aggregate, topic, compressed, sender))
         if not candidates:
             return
 
-        # ONE device program for the whole drained batch.
-        batch_ok = bls.verify_signature_sets([c[0].signature_set for c in candidates])
-        for cand, is_aggregate, agg, topic, compressed, sender in candidates:
-            ok = batch_ok or bls.verify_signature_sets([cand.signature_set])
+        # ONE device program for the whole drained batch (aggregates
+        # contribute 3 sets each — batch.rs:31-135 semantics).
+        batch_ok = bls.verify_signature_sets(
+            [s for c in candidates for s in c[1]]
+        )
+        for cand, sig_sets, is_aggregate, topic, compressed, sender in candidates:
+            ok = batch_ok or bls.verify_signature_sets(sig_sets)
             if not ok:
                 self.service.peer_manager.report(
                     sender, PeerAction.MID_TOLERANCE, "bad attestation signature"
                 )
                 continue
-            chain.apply_attestation(cand)
-            if self.slasher is not None:
-                self.slasher.on_attestation(cand.indexed)
-                self._drain_slasher()
             if is_aggregate:
-                chain.observed.aggregates.observe(
-                    int(cand.attestation.data.slot), cand.attestation.hash_tree_root()
-                )
-                chain.observed.aggregators.observe(
-                    int(cand.attestation.data.target.epoch),
-                    int(agg.message.aggregator_index),
-                )
+                chain.apply_verified_aggregate(cand)
+                indexed = cand.inner.indexed
+            else:
+                chain.apply_attestation(cand)
+                indexed = cand.indexed
+            if self.slasher is not None:
+                self.slasher.on_attestation(indexed)
+                self._drain_slasher()
             self.service.forward(topic, compressed, exclude=sender)
 
     def _drain_slasher(self) -> None:

@@ -195,7 +195,8 @@ class AttestationService:
                 try:
                     fetched[duty.committee_index] = self.fallback.first_success(
                         lambda c: c.aggregate_attestation(
-                            slot, data.hash_tree_root(), types=self.types
+                            slot, data.hash_tree_root(), types=self.types,
+                            committee_index=duty.committee_index,
                         )
                     )
                 except NoViableBeaconNode:

@@ -109,8 +109,25 @@ class ValidatorStore:
         root = h.compute_signing_root(aggregate_and_proof.hash_tree_root(), domain)
         return self._raw_sign(pubkey, root)
 
-    def sign_voluntary_exit(self, pubkey: bytes, voluntary_exit) -> bytes:
-        domain = self._domain(DOMAIN_VOLUNTARY_EXIT, int(voluntary_exit.epoch))
+    def sign_voluntary_exit(self, pubkey: bytes, voluntary_exit,
+                            current_epoch: int) -> bytes:
+        """EIP-7044: once the CHAIN is at deneb+, exits are perpetually signed
+        over the CAPELLA fork domain regardless of the exit's own epoch — must
+        match the verify side (signature_sets.voluntary_exit_signature_set,
+        which keys off the state's fork), else the BN rejects our own exits
+        (round-2 advisor finding).  ``current_epoch`` is the wall-clock epoch
+        (required — the caller owns the slot clock); an exit may legally carry
+        any past epoch, so the fork decision uses the later of the two."""
+        epoch = int(voluntary_exit.epoch)
+        decision_epoch = max(epoch, int(current_epoch))
+        if self.spec.fork_name_at_epoch(decision_epoch) in ("deneb", "electra"):
+            domain = h.compute_domain(
+                DOMAIN_VOLUNTARY_EXIT,
+                self.spec.capella_fork_version,
+                self.genesis_validators_root,
+            )
+        else:
+            domain = self._domain(DOMAIN_VOLUNTARY_EXIT, epoch)
         root = h.compute_signing_root(voluntary_exit.hash_tree_root(), domain)
         return self._raw_sign(pubkey, root)
 

@@ -141,3 +141,122 @@ def test_equivocating_proposer_penalized():
         assert node.service.peer_manager._peer("peer-b").score < 0
     finally:
         set_backend("host")
+
+
+# --------------------------------------------------------------- aggregates
+
+
+def _mk_signed_aggregate(harness, state, slot, committee_index=0,
+                         aggregator_pos=0, signer_pos=None):
+    """A full SignedAggregateAndProof over the whole committee.  With
+    ``signer_pos`` set, the selection proof + outer signature are produced by
+    a DIFFERENT key than ``aggregator_pos`` claims — a forged wrap."""
+    from lighthouse_tpu.crypto.bls import api as bls
+    from lighthouse_tpu.types.spec import (
+        DOMAIN_AGGREGATE_AND_PROOF,
+        DOMAIN_SELECTION_PROOF,
+    )
+    from lighthouse_tpu.types.ssz import UintType
+
+    chain = harness.chain
+    committee = h.get_beacon_committee(state, slot, committee_index, harness.spec)
+    data = chain.produce_attestation_data(slot, committee_index)
+    epoch = slot // harness.spec.slots_per_epoch
+
+    agg_sig = None
+    for vidx in committee:
+        s = harness.sign_attestation_data(state, data, int(vidx))
+        if agg_sig is None:
+            agg_sig = bls.AggregateSignature.from_bytes(s.to_bytes())
+        else:
+            agg_sig.add_assign(s)
+    attestation = harness.types.Attestation(
+        aggregation_bits=[True] * len(committee), data=data,
+        signature=agg_sig.to_bytes(),
+    )
+
+    aggregator = int(committee[aggregator_pos])
+    signer = aggregator if signer_pos is None else int(committee[signer_pos])
+    sel_domain = harness._domain_at(state, DOMAIN_SELECTION_PROOF, epoch)
+    sel_root = h.compute_signing_root(UintType(8).hash_tree_root(slot), sel_domain)
+    selection_proof = harness._sign(signer, sel_root).to_bytes()
+
+    message = harness.types.AggregateAndProof(
+        aggregator_index=aggregator, aggregate=attestation,
+        selection_proof=selection_proof,
+    )
+    out_domain = harness._domain_at(state, DOMAIN_AGGREGATE_AND_PROOF, epoch)
+    out_root = h.compute_signing_root(message.hash_tree_root(), out_domain)
+    signed = harness.types.SignedAggregateAndProof(
+        message=message, signature=harness._sign(signer, out_root).to_bytes()
+    )
+    return signed, attestation, aggregator
+
+
+def _agg_items(node, signed):
+    topic = str(topics_mod.GossipTopic(
+        node.router.fork_digest, topics_mod.BEACON_AGGREGATE_AND_PROOF
+    ))
+    raw = signed.as_ssz_bytes()
+    return [(topic, raw, compress(raw), "peer-x")]
+
+
+def test_valid_aggregate_verified_and_observed():
+    """Real crypto: a spec-valid SignedAggregateAndProof passes the full
+    3-set verification and records the aggregator as observed."""
+    set_backend("host")
+    harness, node = _mk_node(fake=False)
+    slot = harness.advance_slot()
+    state, _ = harness.chain.state_at_slot(slot)
+    signed, attestation, aggregator = _mk_signed_aggregate(harness, state, slot)
+
+    node.router._process_gossip_attestations(_agg_items(node, signed))
+    epoch = int(attestation.data.target.epoch)
+    assert harness.chain.observed.aggregators.is_known(epoch, aggregator)
+    assert len(harness.chain.attestation_pool._pool) == 1
+
+
+def test_forged_aggregate_wrap_cannot_censor_honest_aggregator():
+    """Round-2 advisor high finding: a peer re-wrapping a public aggregate
+    under a victim's aggregator_index (with signatures it cannot produce)
+    must NOT mark the victim as having aggregated — and the victim's real
+    aggregate must still be accepted afterwards."""
+    set_backend("host")
+    harness, node = _mk_node(fake=False)
+    slot = harness.advance_slot()
+    state, _ = harness.chain.state_at_slot(slot)
+    # Attacker (position 1) wraps the aggregate claiming victim (position 0).
+    forged, attestation, victim = _mk_signed_aggregate(
+        harness, state, slot, aggregator_pos=0, signer_pos=1
+    )
+    node.router._process_gossip_attestations(_agg_items(node, forged))
+    epoch = int(attestation.data.target.epoch)
+    assert not harness.chain.observed.aggregators.is_known(epoch, victim), (
+        "a forged wrap must never mark the victim aggregator as observed"
+    )
+    assert node.service.peer_manager._peer("peer-x").score < 0
+
+    # The victim's genuine aggregate still goes through.
+    genuine, _, _ = _mk_signed_aggregate(harness, state, slot, aggregator_pos=0)
+    node.router._process_gossip_attestations(_agg_items(node, genuine))
+    assert harness.chain.observed.aggregators.is_known(epoch, victim)
+
+
+def test_aggregator_outside_committee_rejected():
+    """An aggregator_index not in the attestation's committee is rejected
+    before any signature work (spec gossip condition)."""
+    set_backend("host")
+    harness, node = _mk_node(fake=False)
+    slot = harness.advance_slot()
+    state, _ = harness.chain.state_at_slot(slot)
+    signed, attestation, _ = _mk_signed_aggregate(harness, state, slot)
+    committee = {int(i) for i in h.get_beacon_committee(state, slot, 0, harness.spec)}
+    outsider = next(i for i in range(harness.validator_count) if i not in committee)
+    signed.message.aggregator_index = outsider
+
+    before = metrics.DEVICE_BATCH_INVOCATIONS.get()
+    node.router._process_gossip_attestations(_agg_items(node, signed))
+    assert metrics.DEVICE_BATCH_INVOCATIONS.get() == before
+    epoch = int(attestation.data.target.epoch)
+    assert not harness.chain.observed.aggregators.is_known(epoch, outsider)
+    assert node.service.peer_manager._peer("peer-x").score < 0
