@@ -14,12 +14,47 @@ import threading
 
 _BUILD_LOCK = threading.Lock()
 _LIB = None
+_HASH_LIB = None
 
 
 def _build_dir() -> str:
     d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "build")
     os.makedirs(d, exist_ok=True)
     return d
+
+
+def _compile_and_load(src_name: str, so_name: str, extra_flags=()) -> ctypes.CDLL:
+    """Compile ``src_name`` (if absent or stale) into ``so_name`` and load it."""
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)), src_name)
+    so = os.path.join(_build_dir(), so_name)
+    if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+        tmp = so + ".tmp"
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src,
+             *extra_flags],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(tmp, so)
+    return ctypes.CDLL(so)
+
+
+def load_hash_pairs() -> ctypes.CDLL:
+    """Compile (if needed) and load the batched SHA-256 pair hasher."""
+    global _HASH_LIB
+    if _HASH_LIB is not None:
+        return _HASH_LIB
+    with _BUILD_LOCK:
+        if _HASH_LIB is not None:
+            return _HASH_LIB
+        lib = _compile_and_load("hash_pairs.cc", "libhashpairs.so",
+                                ["-ldl", "-lpthread"])
+        lib.hash_pairs.restype = ctypes.c_int
+        lib.hash_pairs.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+        ]
+        _HASH_LIB = lib
+        return lib
 
 
 def load_lockbox() -> ctypes.CDLL:
@@ -30,17 +65,7 @@ def load_lockbox() -> ctypes.CDLL:
     with _BUILD_LOCK:
         if _LIB is not None:
             return _LIB
-        src = os.path.join(os.path.dirname(os.path.abspath(__file__)), "lockbox.cc")
-        so = os.path.join(_build_dir(), "liblockbox.so")
-        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
-            tmp = so + ".tmp"
-            subprocess.run(
-                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src],
-                check=True,
-                capture_output=True,
-            )
-            os.replace(tmp, so)
-        lib = ctypes.CDLL(so)
+        lib = _compile_and_load("lockbox.cc", "liblockbox.so")
         lib.lockbox_open.restype = ctypes.c_void_p
         lib.lockbox_open.argtypes = [ctypes.c_char_p]
         lib.lockbox_close.argtypes = [ctypes.c_void_p]

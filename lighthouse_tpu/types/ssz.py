@@ -42,11 +42,47 @@ def _hash_pairs_hashlib(data: bytes) -> bytes:
 
 _hash_pairs = _hash_pairs_hashlib
 
+# Incremental BeaconState tree hashing (types/tree_cache.py); disable with
+# LIGHTHOUSE_TPU_TREE_CACHE=0 (the timing driver's before/after switch).
+import os as _os
+
+_TREE_CACHE_ENABLED = _os.environ.get("LIGHTHOUSE_TPU_TREE_CACHE", "1") != "0"
+
 
 def set_hash_pairs_impl(fn) -> None:
     """Swap the Merkle pair-hash kernel (e.g. for a vectorized implementation)."""
     global _hash_pairs
     _hash_pairs = fn
+
+
+def _try_install_native_hash_pairs() -> bool:
+    """Install the batched C++ SHA-256 (native/hash_pairs.cc) as the Merkle
+    pair-hash kernel.  Python-loop hashlib does ~0.6M hashes/s; the native
+    loop removes the interpreter from the per-hash path (the reference's
+    ethereum_hashing asm/SIMD role).  Returns True on success."""
+    try:
+        import ctypes
+
+        from ..native import load_hash_pairs
+
+        lib = load_hash_pairs()
+
+        def _hash_pairs_native(data: bytes) -> bytes:
+            n = len(data) // 64
+            if n == 0:
+                return b""
+            out = ctypes.create_string_buffer(32 * n)
+            lib.hash_pairs(data, n, out)
+            return out.raw
+
+        set_hash_pairs_impl(_hash_pairs_native)
+        return True
+    except Exception:
+        return False
+
+
+if _os.environ.get("LIGHTHOUSE_TPU_NATIVE_SHA", "1") != "0":
+    _try_install_native_hash_pairs()
 
 
 def hash_two(a: bytes, b: bytes) -> bytes:
@@ -423,6 +459,9 @@ class _ContainerType(SszType):
         self.is_fixed_size = all(t.is_fixed_size for t in self.field_types.values())
         if self.is_fixed_size:
             self.fixed_size = sum(t.fixed_size for t in self.field_types.values())
+        # BeaconState-shaped containers get an incremental tree-hash cache
+        # (the reference's cached_tree_hash/milhouse role).
+        self.cacheable = "validators" in self.field_types and "balances" in self.field_types
 
     def serialize(self, value) -> bytes:
         fixed_parts = []
@@ -476,6 +515,17 @@ class _ContainerType(SszType):
         return self.cls(**kwargs)
 
     def hash_tree_root(self, value) -> bytes:
+        if self.cacheable and _TREE_CACHE_ENABLED:
+            try:
+                from .tree_cache import StateTreeHashCache
+            except ImportError:
+                pass  # degrade to the plain recursive path
+            else:
+                cache = getattr(value, "_thc", None)
+                if cache is None:
+                    cache = StateTreeHashCache(self)
+                    value._thc = cache
+                return cache.root(value)
         return merkleize(
             [t.hash_tree_root(getattr(value, name)) for name, t in self.field_types.items()]
         )

@@ -1,0 +1,136 @@
+"""State-transition timing driver — the role of the reference's
+``lcli transition-blocks --runs N`` / ``skip-slots`` wall-clock loops
+(`lcli/src/transition_blocks.rs`, `lcli/src/skip_slots.rs`) and the
+16,384-validator criterion benches (`consensus/types/benches/benches.rs:11-50`).
+
+Builds an N-validator state (synthetic registry — no real key derivation, the
+transition never checks signatures here), then times:
+
+- full ``hash_tree_root`` (cold cache)
+- re-hash after one balance change (the incremental-cache headline)
+- ``state.copy()``
+- ``process_slots`` across one epoch boundary, hashing every slot (the
+  per-slot hot loop every block import pays)
+
+Toggle the incremental cache with LIGHTHOUSE_TPU_TREE_CACHE=0/1 and the
+native SHA-256 with LIGHTHOUSE_TPU_NATIVE_SHA=0/1 for before/after numbers:
+
+    python scripts/transition_bench.py --validators 16384
+    LIGHTHOUSE_TPU_TREE_CACHE=0 python scripts/transition_bench.py ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_state(n_validators: int):
+    from hashlib import sha256
+
+    from lighthouse_tpu.consensus.genesis import interop_withdrawal_credentials
+    from lighthouse_tpu.types.containers import build_types
+    from lighthouse_tpu.types.spec import FAR_FUTURE_EPOCH, minimal_spec
+
+    spec = minimal_spec(
+        altair_fork_epoch=0, bellatrix_fork_epoch=0, capella_fork_epoch=0,
+        deneb_fork_epoch=None,
+    )
+    types = build_types(spec.preset)
+
+    # Synthetic genesis: fake-but-distinct pubkeys (the transition here never
+    # verifies signatures; key derivation for 16k real keys is minutes).
+    state = types.state["capella"]()
+    state.genesis_time = 1_600_000_000
+    state.genesis_validators_root = b"\x01" * 32
+    state.fork = types.Fork(
+        previous_version=spec.capella_fork_version,
+        current_version=spec.capella_fork_version,
+        epoch=0,
+    )
+    mb = spec.max_effective_balance
+    for i in range(n_validators):
+        pk = sha256(b"pk" + i.to_bytes(8, "little")).digest() + b"\x00" * 16
+        state.validators.append(types.Validator(
+            pubkey=pk[:48],
+            withdrawal_credentials=interop_withdrawal_credentials(pk[:48]),
+            effective_balance=mb,
+            activation_eligibility_epoch=0,
+            activation_epoch=0,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        ))
+        state.balances.append(mb)
+        state.previous_epoch_participation.append(0b111)
+        state.current_epoch_participation.append(0b111)
+        state.inactivity_scores.append(0)
+    state.latest_block_header = types.BeaconBlockHeader(
+        body_root=types.block_body["capella"]().hash_tree_root()
+    )
+    # Synthetic sync committees (the fake pubkeys cannot be aggregated; the
+    # fake-crypto backend below keeps any later period rotation happy).
+    size = spec.preset.sync_committee_size
+    committee = types.SyncCommittee(
+        pubkeys=[bytes(state.validators[i % n_validators].pubkey) for i in range(size)],
+        aggregate_pubkey=bytes(state.validators[0].pubkey),
+    )
+    state.current_sync_committee = committee
+    state.next_sync_committee = committee.copy()
+    return state, types, spec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--validators", type=int, default=16384)
+    ap.add_argument("--slots", type=int, default=None,
+                    help="slots to advance (default: one epoch + 1)")
+    args = ap.parse_args()
+
+    from lighthouse_tpu.consensus.per_slot import process_slots
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+    from lighthouse_tpu.types import ssz as ssz_mod
+
+    set_backend("fake")  # no signature work in this driver
+
+    t0 = time.perf_counter()
+    state, types, spec = build_state(args.validators)
+    build_secs = time.perf_counter() - t0
+    n_slots = args.slots if args.slots is not None else spec.slots_per_epoch + 1
+
+    out = {
+        "validators": args.validators,
+        "tree_cache": ssz_mod._TREE_CACHE_ENABLED,
+        "native_sha": ssz_mod._hash_pairs is not ssz_mod._hash_pairs_hashlib,
+        "build_secs": round(build_secs, 2),
+    }
+
+    t0 = time.perf_counter()
+    root0 = state.hash_tree_root()
+    out["hash_cold_secs"] = round(time.perf_counter() - t0, 4)
+
+    state.balances[1] += 1
+    t0 = time.perf_counter()
+    state.hash_tree_root()
+    out["hash_one_change_secs"] = round(time.perf_counter() - t0, 6)
+
+    t0 = time.perf_counter()
+    work = state.copy()
+    out["copy_secs"] = round(time.perf_counter() - t0, 4)
+
+    t0 = time.perf_counter()
+    work = process_slots(work, int(work.slot) + n_slots, types, spec)
+    dt = time.perf_counter() - t0
+    out["process_slots_secs"] = round(dt, 3)
+    out["slots_per_sec"] = round(n_slots / dt, 2)
+    out["slots"] = n_slots
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
