@@ -12,6 +12,7 @@
 #include <cstdint>
 #include <cstring>
 #include <dlfcn.h>
+#include <mutex>
 #include <thread>
 #include <vector>
 
@@ -25,11 +26,11 @@ typedef unsigned char *(*sha256_fn)(const unsigned char *, size_t,
 
 static sha256_fn g_openssl_sha256 = nullptr;
 static bool g_has_shani = false;
-static bool g_resolved = false;
+static std::once_flag g_resolve_once;
 
-static void resolve_backends() {
-  if (g_resolved) return;
-  g_resolved = true;
+// ctypes releases the GIL, so first calls can race here — call_once makes
+// backend selection safe and visible to all threads.
+static void resolve_backends_impl() {
 #if defined(__x86_64__)
   unsigned a, b, c, d;
   if (__get_cpuid_count(7, 0, &a, &b, &c, &d)) g_has_shani = (b >> 29) & 1;
@@ -200,7 +201,7 @@ static void hash_range(const uint8_t *in, uint8_t *out, uint64_t begin,
 }
 
 extern "C" int hash_pairs(const uint8_t *in, uint64_t nblocks, uint8_t *out) {
-  resolve_backends();
+  std::call_once(g_resolve_once, resolve_backends_impl);
   const uint64_t kParallelThreshold = 8192;
   unsigned hw = std::thread::hardware_concurrency();
   if (nblocks < kParallelThreshold || hw < 2) {

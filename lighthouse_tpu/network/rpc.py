@@ -23,15 +23,22 @@ from . import snappy_codec
 from .snappy_codec import _read_varint, _write_varint  # shared varint
 
 PROTOCOL_PREFIX = "/eth2/beacon_chain/req"
+ENCODING_SUFFIX = "ssz_snappy"
 
-STATUS = "status/1"
-GOODBYE = "goodbye/1"
-BLOCKS_BY_RANGE = "beacon_blocks_by_range/2"
-BLOCKS_BY_ROOT = "beacon_blocks_by_root/2"
-BLOBS_BY_RANGE = "blob_sidecars_by_range/1"
-BLOBS_BY_ROOT = "blob_sidecars_by_root/1"
-PING = "ping/1"
-METADATA = "metadata/2"
+
+def _pid(name_version: str) -> str:
+    """Full spec protocol id (reference ``protocol.rs`` ``ProtocolId``)."""
+    return f"{PROTOCOL_PREFIX}/{name_version}/{ENCODING_SUFFIX}"
+
+
+STATUS = _pid("status/1")
+GOODBYE = _pid("goodbye/1")
+BLOCKS_BY_RANGE = _pid("beacon_blocks_by_range/2")
+BLOCKS_BY_ROOT = _pid("beacon_blocks_by_root/2")
+BLOBS_BY_RANGE = _pid("blob_sidecars_by_range/1")
+BLOBS_BY_ROOT = _pid("blob_sidecars_by_root/1")
+PING = _pid("ping/1")
+METADATA = _pid("metadata/2")
 
 SUCCESS = 0
 INVALID_REQUEST = 1

@@ -36,10 +36,14 @@ def message_id(uncompressed: bytes) -> bytes:
 
 
 class NetworkService:
-    def __init__(self, endpoint: Endpoint, peer_manager: Optional[PeerManager] = None):
+    def __init__(self, endpoint: Endpoint, peer_manager: Optional[PeerManager] = None,
+                 rate_limiter=None):
+        from .rate_limiter import RPCRateLimiter
+
         self.endpoint = endpoint
         self.peer_id = endpoint.peer_id
         self.peer_manager = peer_manager if peer_manager is not None else PeerManager()
+        self.rate_limiter = rate_limiter if rate_limiter is not None else RPCRateLimiter()
         self.subscriptions: set = set()
         self._seen: "OrderedDict[bytes, None]" = OrderedDict()
         self._seen_lock = threading.Lock()
@@ -199,12 +203,29 @@ class NetworkService:
 
     def _on_rpc_request(self, env: Envelope) -> None:
         from .peer_manager import PeerAction
+        from .rate_limiter import RateLimitExceeded, request_cost
 
         try:
             request = rpc_mod.decode_request(env.protocol, env.data)
         except (rpc_mod.RpcError, Exception):
             self.peer_manager.report(env.sender, PeerAction.LOW_TOLERANCE, "bad rpc request")
             chunk = rpc_mod.encode_response_chunk(rpc_mod.INVALID_REQUEST, b"bad request")
+            self._send_response(env.sender, env.request_id, [chunk])
+            return
+        # DoS protection (reference rpc/rate_limiter.rs): cost-weighted
+        # token buckets per (peer, protocol) before any chain work.
+        try:
+            self.rate_limiter.allow(
+                env.sender, env.protocol, request_cost(env.protocol, request)
+            )
+        except RateLimitExceeded as e:
+            self.peer_manager.report(
+                env.sender,
+                PeerAction.LOW_TOLERANCE if e.fatal else PeerAction.HIGH_TOLERANCE,
+                "rpc rate limit",
+            )
+            code = rpc_mod.INVALID_REQUEST if e.fatal else rpc_mod.RESOURCE_UNAVAILABLE
+            chunk = rpc_mod.encode_response_chunk(code, b"rate limited")
             self._send_response(env.sender, env.request_id, [chunk])
             return
         chunks: List[bytes] = []

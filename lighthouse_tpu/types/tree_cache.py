@@ -253,6 +253,44 @@ class _ValidatorListCache:
         return mix_in_length(body, n)
 
 
+class _ElementMemoListCache:
+    """Cache for append-mostly lists of container elements (eth1_data_votes,
+    historical_summaries, phase0 pending attestations): per-index root memo
+    keyed by element IDENTITY — these lists only ever append fresh objects or
+    reset wholesale, never mutate an element in place — plus the incremental
+    tree over element roots."""
+
+    def __init__(self, elem_type, limit_elems: int):
+        self.elem_type = elem_type
+        self.tree = _LeafTree(max(1, limit_elems))
+        self.objs: List[object] = []
+        self.roots: Optional[np.ndarray] = None  # (n, 32) uint8
+
+    def root(self, values) -> bytes:
+        n = len(values)
+        if self.roots is None or len(self.roots) != n:
+            old_objs, old_roots = self.objs, self.roots
+            roots = np.zeros((n, 32), dtype=np.uint8)
+            keep = min(n, len(old_objs)) if old_roots is not None else 0
+            if keep:
+                roots[:keep] = old_roots[:keep]
+            self.objs = list(values)
+            self.roots = roots
+            for i, v in enumerate(values):
+                if i < keep and v is old_objs[i]:
+                    continue
+                self.roots[i] = np.frombuffer(
+                    self.elem_type.hash_tree_root(v), dtype=np.uint8)
+        else:
+            for i, v in enumerate(values):
+                if v is not self.objs[i]:
+                    self.objs[i] = v
+                    self.roots[i] = np.frombuffer(
+                        self.elem_type.hash_tree_root(v), dtype=np.uint8)
+        body = self.tree.update(self.roots)
+        return mix_in_length(body, n)
+
+
 class _IdentityMemoCache:
     """Root memo for container fields that are REPLACED, never mutated in
     place (sync committees: a fresh object is assigned each period,
@@ -302,6 +340,9 @@ class StateTreeHashCache:
                 return _RootListCache(t.limit, mix_length=True)
             if name == "validators":
                 return _ValidatorListCache(t.elem, t.limit)
+            if name in ("eth1_data_votes", "historical_summaries",
+                        "previous_epoch_attestations", "current_epoch_attestations"):
+                return _ElementMemoListCache(t.elem, t.limit)
             return None
         if isinstance(t, Vector) and t.length >= 64:
             if isinstance(t.elem, UintType):
@@ -327,6 +368,9 @@ class StateTreeHashCache:
     def __deepcopy__(self, memo):
         # state.copy() deep-copies the whole object graph; cloning the cache
         # arrays keeps the copy incremental from the parent's position.
+        # Cloning runs under the source lock: a concurrent hash_tree_root
+        # mid-update must not be snapshotted half-written (new leaves with
+        # the old root would make the clone silently serve stale roots).
         import copy as _copy
         import threading
 
@@ -334,17 +378,20 @@ class StateTreeHashCache:
         clone.type = self.type
         clone._lock = threading.Lock()
         clone.caches = {}
-        for name, cache in self.caches.items():
-            c = _copy.copy(cache)
-            if isinstance(cache, (_BasicListCache, _RootListCache)):
-                c.tree = _copy.copy(cache.tree)
-                c.tree.leaves = None if cache.tree.leaves is None else cache.tree.leaves.copy()
-                c.tree.layers = [l.copy() for l in cache.tree.layers]
-            elif isinstance(cache, _ValidatorListCache):
-                c.tree = _copy.copy(cache.tree)
-                c.tree.leaves = None if cache.tree.leaves is None else cache.tree.leaves.copy()
-                c.tree.layers = [l.copy() for l in cache.tree.layers]
-                c.fingerprints = list(cache.fingerprints)
-                c.roots = None if cache.roots is None else cache.roots.copy()
-            clone.caches[name] = c
+        with self._lock:
+            for name, cache in self.caches.items():
+                c = _copy.copy(cache)
+                if hasattr(cache, "tree"):
+                    c.tree = _copy.copy(cache.tree)
+                    c.tree.leaves = (
+                        None if cache.tree.leaves is None else cache.tree.leaves.copy()
+                    )
+                    c.tree.layers = [l.copy() for l in cache.tree.layers]
+                if isinstance(cache, _ValidatorListCache):
+                    c.fingerprints = list(cache.fingerprints)
+                    c.roots = None if cache.roots is None else cache.roots.copy()
+                elif isinstance(cache, _ElementMemoListCache):
+                    c.objs = list(cache.objs)
+                    c.roots = None if cache.roots is None else cache.roots.copy()
+                clone.caches[name] = c
         return clone

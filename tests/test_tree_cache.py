@@ -148,3 +148,22 @@ def test_native_hash_pairs_matches_hashlib():
 
     buf = os.urandom(64 * 33)
     assert ssz_mod._hash_pairs(buf) == ssz_mod._hash_pairs_hashlib(buf)
+
+
+def test_composite_list_caches_track_changes(setup):
+    """eth1_data_votes (identity-memo composite cache): append, reset, and
+    replacement all re-root correctly."""
+    spec, types, state = setup
+    st = state.copy()
+    st.hash_tree_root()
+    st.eth1_data_votes.append(types.Eth1Data(
+        deposit_root=b"\x01" * 32, deposit_count=5, block_hash=b"\x02" * 32))
+    assert st.hash_tree_root() == uncached_root(st)
+    st.eth1_data_votes.append(types.Eth1Data(
+        deposit_root=b"\x03" * 32, deposit_count=6, block_hash=b"\x04" * 32))
+    assert st.hash_tree_root() == uncached_root(st)
+    st.eth1_data_votes[0] = types.Eth1Data(
+        deposit_root=b"\x05" * 32, deposit_count=7, block_hash=b"\x06" * 32)
+    assert st.hash_tree_root() == uncached_root(st)
+    st.eth1_data_votes = []  # period reset
+    assert st.hash_tree_root() == uncached_root(st)

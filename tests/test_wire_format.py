@@ -1,0 +1,152 @@
+"""Wire-format honesty (VERDICT r2 item 4): the TCP envelope is a fixed
+binary header and the payload bytes on the wire are the spec ssz_snappy
+encodings; RPC protocol ids are the full spec ids; oversized / malformed
+input is rejected; the token-bucket rate limiter throttles and penalizes."""
+
+import pytest
+
+from lighthouse_tpu.network import rpc as rpc_mod
+from lighthouse_tpu.network import snappy_codec
+from lighthouse_tpu.network.rate_limiter import (
+    Quota,
+    RateLimitExceeded,
+    RPCRateLimiter,
+    request_cost,
+)
+from lighthouse_tpu.network.tcp_transport import (
+    TcpTransportError,
+    _decode,
+    _encode,
+)
+from lighthouse_tpu.network.transport import Envelope
+
+
+def test_spec_protocol_ids():
+    assert rpc_mod.STATUS == "/eth2/beacon_chain/req/status/1/ssz_snappy"
+    assert rpc_mod.BLOCKS_BY_RANGE == "/eth2/beacon_chain/req/beacon_blocks_by_range/2/ssz_snappy"
+    assert rpc_mod.BLOBS_BY_ROOT == "/eth2/beacon_chain/req/blob_sidecars_by_root/1/ssz_snappy"
+
+
+def test_envelope_roundtrip_all_kinds():
+    for env in (
+        Envelope(kind="hello", sender="n0"),
+        Envelope(kind="gossip", sender="n1",
+                 topic="/eth2/01020304/beacon_block/ssz_snappy", data=b"\x00\x01payload"),
+        Envelope(kind="rpc_request", sender="n2", protocol=rpc_mod.STATUS,
+                 request_id=7, data=b"req-bytes"),
+        Envelope(kind="rpc_response", sender="n3", request_id=9, data=b""),
+    ):
+        frame = _encode(env)
+        decoded = _decode(frame[4:])
+        assert decoded == env
+
+
+def test_wire_carries_raw_ssz_snappy_not_json():
+    """The bytes on the wire contain the snappy-framed SSZ verbatim (no
+    base64/JSON re-encoding) — a spec-speaking peer could parse them."""
+    status = rpc_mod.Status(
+        fork_digest=b"\x01\x02\x03\x04", finalized_root=b"\x05" * 32,
+        finalized_epoch=3, head_root=b"\x06" * 32, head_slot=99,
+    )
+    body = rpc_mod.encode_request(rpc_mod.STATUS, status)
+    frame = _encode(Envelope(kind="rpc_request", sender="n0",
+                             protocol=rpc_mod.STATUS, request_id=1, data=body))
+    assert body in frame, "request payload must appear verbatim on the wire"
+    assert b"base64" not in frame and b"{" not in frame.split(body)[0]
+    # and that payload is itself varint || snappy-framed SSZ
+    decoded = rpc_mod.decode_request(rpc_mod.STATUS, body)
+    assert decoded == status
+
+
+def test_gossip_payload_is_snappy_compressed_ssz():
+    raw = b"block-ssz-bytes" * 10
+    compressed = snappy_codec.compress(raw)
+    frame = _encode(Envelope(kind="gossip", sender="n0",
+                             topic="/eth2/00000000/beacon_block/ssz_snappy",
+                             data=compressed))
+    assert compressed in frame
+    assert snappy_codec.decompress(compressed) == raw
+
+
+def test_malformed_envelopes_rejected():
+    with pytest.raises(TcpTransportError):
+        _decode(b"\xff\x00")  # unknown kind
+    with pytest.raises(TcpTransportError):
+        _decode(b"")  # truncated header
+    good = _encode(Envelope(kind="gossip", sender="n0", topic="t", data=b"xyz"))[4:]
+    with pytest.raises(TcpTransportError):
+        _decode(good[:-1])  # truncated payload
+    with pytest.raises(TcpTransportError):
+        _decode(good + b"\x00")  # trailing junk
+
+
+# ------------------------------------------------------------ rate limiter
+
+
+def test_rate_limiter_throttles_and_replenishes():
+    t = [0.0]
+    rl = RPCRateLimiter({rpc_mod.PING: Quota(2, 10.0)}, clock=lambda: t[0])
+    rl.allow("p1", rpc_mod.PING)
+    rl.allow("p1", rpc_mod.PING)
+    with pytest.raises(RateLimitExceeded) as ei:
+        rl.allow("p1", rpc_mod.PING)
+    assert not ei.value.fatal
+    # other peers have their own buckets
+    rl.allow("p2", rpc_mod.PING)
+    # replenish: 10s restores the full bucket
+    t[0] = 10.0
+    rl.allow("p1", rpc_mod.PING)
+
+
+def test_rate_limiter_cost_weighted_and_fatal_oversize():
+    t = [0.0]
+    rl = RPCRateLimiter({rpc_mod.BLOCKS_BY_RANGE: Quota(64, 10.0)}, clock=lambda: t[0])
+    req = rpc_mod.BlocksByRangeRequest(start_slot=0, count=60)
+    assert request_cost(rpc_mod.BLOCKS_BY_RANGE, req) == 60
+    rl.allow("p1", rpc_mod.BLOCKS_BY_RANGE, 60)
+    with pytest.raises(RateLimitExceeded):
+        rl.allow("p1", rpc_mod.BLOCKS_BY_RANGE, 60)  # bucket nearly empty
+    with pytest.raises(RateLimitExceeded) as ei:
+        rl.allow("p1", rpc_mod.BLOCKS_BY_RANGE, 65)  # can NEVER fit
+    assert ei.value.fatal
+
+
+def test_service_rate_limit_penalizes_spammer():
+    """End-to-end over the in-process hub: a peer hammering Status gets
+    RESOURCE_UNAVAILABLE chunks and a score penalty."""
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+    from lighthouse_tpu.network.node import LocalNode
+    from lighthouse_tpu.network.transport import Hub
+
+    set_backend("fake")
+    try:
+        harness = BeaconChainHarness(validator_count=16, fake_crypto=True)
+        hub = Hub()
+        node = LocalNode(hub=hub, peer_id="srv", harness=harness)
+        spammer = hub.register("spammer")
+        hub.connect("srv", "spammer")
+
+        body = rpc_mod.encode_request(rpc_mod.PING, rpc_mod.Ping(0))
+        for i in range(10):
+            node.service.endpoint.inbound.put(Envelope(
+                kind="rpc_request", sender="spammer", protocol=rpc_mod.PING,
+                request_id=100 + i, data=body,
+            ))
+        import time
+
+        deadline = time.time() + 5
+        limited = False
+        while time.time() < deadline and not limited:
+            try:
+                env = spammer.inbound.get(timeout=0.5)
+            except Exception:
+                break
+            if env.kind == "rpc_response" and env.data:
+                result = env.data[0]
+                if result == rpc_mod.RESOURCE_UNAVAILABLE:
+                    limited = True
+        assert limited, "spammer never saw a rate-limit response"
+        assert node.service.peer_manager.score("spammer") < 0
+    finally:
+        set_backend("host")
