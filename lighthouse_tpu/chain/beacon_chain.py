@@ -127,6 +127,98 @@ class NaiveAggregationPool:
         self._pool = {k: v for k, v in self._pool.items() if k[0] >= cutoff}
 
 
+class NaiveSyncContributionPool:
+    """Aggregate sync-committee messages into per-subcommittee contributions
+    and contributions into block sync aggregates (reference
+    ``naive_aggregation_pool.rs``'s SyncContribution flavor +
+    ``op_pool``'s sync-contribution handling)."""
+
+    SLOT_RETENTION = 8
+
+    def __init__(self, types, spec: ChainSpec):
+        self.types = types
+        self.spec = spec
+        # (slot, block_root, subcommittee) -> SyncCommitteeContribution
+        self._pool: Dict[Tuple[int, bytes, int], object] = {}
+
+    def _sub_size(self) -> int:
+        return self.spec.preset.sync_committee_size // self.spec.sync_committee_subnet_count
+
+    def insert_signature(self, slot: int, block_root: bytes, subcommittee: int,
+                         position_in_subcommittee: int, signature: bytes) -> None:
+        """Merge one already-verified committee member signature."""
+        from ..consensus.signature_sets import _sig as cached_sig
+        from ..crypto.bls import api as bls
+
+        key = (int(slot), bytes(block_root), int(subcommittee))
+        existing = self._pool.get(key)
+        if existing is None:
+            bits = [False] * self._sub_size()
+            bits[position_in_subcommittee] = True
+            self._pool[key] = self.types.SyncCommitteeContribution(
+                slot=slot,
+                beacon_block_root=bytes(block_root),
+                subcommittee_index=subcommittee,
+                aggregation_bits=bits,
+                signature=bytes(signature),
+            )
+            return
+        if existing.aggregation_bits[position_in_subcommittee]:
+            return  # already aggregated
+        # cached parses: G2 decompression dominates pool merges otherwise
+        agg = bls.AggregateSignature.from_signature(cached_sig(bytes(existing.signature)))
+        agg.add_assign(cached_sig(bytes(signature)))
+        existing.aggregation_bits[position_in_subcommittee] = True
+        existing.signature = agg.to_bytes()
+
+    def insert_contribution(self, contribution) -> None:
+        """Merge an already-verified (multi-bit) contribution if it has more
+        participants than what we hold (best-wins, like the reference pool)."""
+        key = (
+            int(contribution.slot),
+            bytes(contribution.beacon_block_root),
+            int(contribution.subcommittee_index),
+        )
+        existing = self._pool.get(key)
+        if existing is None or (
+            sum(contribution.aggregation_bits) > sum(existing.aggregation_bits)
+        ):
+            self._pool[key] = contribution.copy()
+
+    def get_contribution(self, slot: int, block_root: bytes, subcommittee: int):
+        c = self._pool.get((int(slot), bytes(block_root), int(subcommittee)))
+        return None if c is None else c.copy()
+
+    def best_sync_aggregate(self, slot: int, block_root: bytes):
+        """Combine per-subcommittee contributions into a block's
+        ``SyncAggregate`` over ``block_root`` signed at ``slot``."""
+        from ..consensus.signature_sets import _sig as cached_sig
+        from ..crypto.bls import api as bls
+
+        size = self.spec.preset.sync_committee_size
+        bits = [False] * size
+        agg = bls.AggregateSignature.infinity()
+        sub_size = self._sub_size()
+        found = False
+        for sub in range(self.spec.sync_committee_subnet_count):
+            c = self._pool.get((int(slot), bytes(block_root), sub))
+            if c is None:
+                continue
+            found = True
+            for i, b in enumerate(c.aggregation_bits):
+                if b:
+                    bits[sub * sub_size + i] = True
+            agg.add_assign(cached_sig(bytes(c.signature)))
+        return self.types.SyncAggregate(
+            sync_committee_bits=bits,
+            sync_committee_signature=agg.to_bytes() if found else b"\xc0" + b"\x00" * 95,
+        )
+
+    def prune(self, current_slot: int) -> None:
+        cutoff = current_slot - self.SLOT_RETENTION
+        self._pool = {k: v for k, v in self._pool.items() if k[0] >= cutoff}
+
+
 class AttestationCandidate:
     """A spec-checked, indexed attestation awaiting signature verification
     (the unit the gossip batch verifier coalesces).  ``state`` is the state
@@ -227,6 +319,7 @@ class BeaconChain:
 
         self.head_root = self.genesis_block_root
         self.attestation_pool = NaiveAggregationPool()
+        self.sync_contribution_pool = NaiveSyncContributionPool(types, spec)
         self.op_pool = OperationPool()
         self.observed_block_roots: set = set()
         self._migrated_slot = 0
@@ -595,6 +688,106 @@ class BeaconChain:
             signed_aggregate, inner, [selection_set, outer_set, inner.signature_set]
         )
 
+    # ------------------------------------------------ sync committee duty
+
+    def _sync_committee_positions(self, state, validator_index: int) -> List[int]:
+        pk = bytes(state.validators[validator_index].pubkey)
+        return [
+            i for i, p in enumerate(state.current_sync_committee.pubkeys)
+            if bytes(p) == pk
+        ]
+
+    def process_sync_committee_message(self, msg) -> None:
+        """Verify and pool one ``SyncCommitteeMessage`` (reference
+        ``sync_committee_verification.rs`` gossip checks: committee
+        membership + signature over the block root)."""
+        from ..consensus import signature_sets as sets
+        from ..crypto.bls import api as bls
+
+        state = self.head_state
+        vidx = int(msg.validator_index)
+        if vidx >= len(state.validators):
+            raise AttestationError("sync message validator index out of range")
+        positions = self._sync_committee_positions(state, vidx)
+        if not positions:
+            raise AttestationError("validator is not in the current sync committee")
+        sig_set = sets.sync_committee_message_set(
+            state, vidx, bytes(msg.beacon_block_root), int(msg.slot),
+            msg.signature, self.spec,
+        )
+        if not bls.verify_signature_sets([sig_set]):
+            raise AttestationError("bad sync committee message signature")
+        sub_size = self.sync_contribution_pool._sub_size()
+        for pos in positions:
+            self.sync_contribution_pool.insert_signature(
+                int(msg.slot), bytes(msg.beacon_block_root),
+                pos // sub_size, pos % sub_size, bytes(msg.signature),
+            )
+
+    def process_signed_contribution(self, signed_contribution) -> None:
+        """Verify and pool a ``SignedContributionAndProof`` — the full gossip
+        rule set (reference ``verify_sync_committee_contribution``): the
+        aggregator must be in the contribution's subcommittee AND pass the
+        sync-aggregator selection gate; THREE signature sets verify in one
+        batch (selection proof, outer signature, contribution participants)."""
+        import hashlib
+
+        from ..consensus import signature_sets as sets
+        from ..crypto.bls import api as bls
+        from ..types.spec import DOMAIN_SYNC_COMMITTEE
+
+        state = self.head_state
+        msg = signed_contribution.message
+        contribution = msg.contribution
+        aggregator = int(msg.aggregator_index)
+        slot = int(contribution.slot)
+        sub = int(contribution.subcommittee_index)
+        if sub >= self.spec.sync_committee_subnet_count:
+            raise AttestationError("subcommittee index out of range")
+        if aggregator >= len(state.validators):
+            raise AttestationError("aggregator index out of range")
+        sub_size = self.sync_contribution_pool._sub_size()
+        positions = self._sync_committee_positions(state, aggregator)
+        if not any(p // sub_size == sub for p in positions):
+            raise AttestationError("aggregator is not in the contribution's subcommittee")
+        modulo = max(1, sub_size // self.spec.target_aggregators_per_sync_subcommittee)
+        digest = hashlib.sha256(bytes(msg.selection_proof)).digest()
+        if int.from_bytes(digest[:8], "little") % modulo != 0:
+            raise AttestationError("validator is not a selected sync aggregator")
+
+        committee = state.current_sync_committee
+        participants = [
+            sets.pubkey_cache(bytes(committee.pubkeys[sub * sub_size + i]))
+            for i, bit in enumerate(contribution.aggregation_bits)
+            if bit
+        ]
+        if not participants:
+            raise AttestationError("empty sync contribution")
+        epoch = slot // self.spec.slots_per_epoch
+        domain = h.get_domain(state, DOMAIN_SYNC_COMMITTEE, epoch, self.spec)
+        signing_root = h.compute_signing_root(
+            bytes(contribution.beacon_block_root), domain
+        )
+        try:
+            sig_sets = [
+                sets.sync_selection_proof_signature_set(
+                    state, aggregator, slot, sub, msg.selection_proof,
+                    self.types, self.spec,
+                ),
+                sets.contribution_and_proof_signature_set(
+                    state, signed_contribution, self.spec
+                ),
+                bls.SignatureSet(
+                    sets._sig(bytes(contribution.signature)),
+                    signing_root, participants,
+                ),
+            ]
+        except bls.BlsError as e:
+            raise AttestationError(f"malformed contribution signature: {e}") from e
+        if not bls.verify_signature_sets(sig_sets):
+            raise AttestationError("bad sync contribution signature(s)")
+        self.sync_contribution_pool.insert_contribution(contribution)
+
     def apply_verified_aggregate(self, cand: "AggregateCandidate") -> None:
         """Apply a signature-verified aggregate candidate: fork choice + pool
         via the inner attestation, then record (aggregate root, aggregator)
@@ -730,6 +923,15 @@ class BeaconChain:
             voluntary_exits=self.op_pool.get_voluntary_exits(state, types, spec),
         )
         if hasattr(body_cls, "fields") and "sync_aggregate" in body_cls.fields:
+            if sync_aggregate is None:
+                # The pool's contributions for the PREVIOUS slot over the
+                # parent root are exactly what a block at ``slot`` carries
+                # (produce_block_on_state → op_pool sync contributions).
+                pooled = self.sync_contribution_pool.best_sync_aggregate(
+                    max(slot, 1) - 1, parent_root
+                )
+                if any(pooled.sync_committee_bits):
+                    sync_aggregate = pooled
             if sync_aggregate is None:
                 from ..crypto.bls import api as bls
 
@@ -933,6 +1135,7 @@ class BeaconChain:
         self.fork_choice.update_time(slot)
         self.recompute_head()
         self.attestation_pool.prune(slot)
+        self.sync_contribution_pool.prune(slot)
         self.op_pool.prune(self.head_state, self.spec, current_slot=slot)
         self.observed.prune(self.fork_choice.finalized_checkpoint[0],
                             self.spec.slots_per_epoch)

@@ -136,7 +136,13 @@ class ClientBuilder:
             from ..slasher import Slasher, SlasherConfig
 
             slasher = Slasher(
-                types, SlasherConfig(slots_per_epoch=self._spec.slots_per_epoch)
+                types,
+                SlasherConfig(slots_per_epoch=self._spec.slots_per_epoch),
+                # durable history on the node's lockbox store (reference:
+                # SlasherDB over LMDB) — a restart still holds every recorded
+                # attestation within the history window; memory-only without
+                # a datadir
+                store=db.hot if db is not None else None,
             )
         http_server = None
         if self._http_port is not None:

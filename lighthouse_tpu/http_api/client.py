@@ -126,6 +126,41 @@ class BeaconNodeHttpClient:
     def submit_voluntary_exit(self, signed_exit) -> None:
         self.post("/eth/v1/beacon/pool/voluntary_exits", to_json(signed_exit))
 
+    def submit_sync_committee_messages(self, messages) -> None:
+        self.post(
+            "/eth/v1/beacon/pool/sync_committees",
+            [to_json(m) for m in messages],
+        )
+
+    def sync_duties(self, epoch: int, indices: List[int]) -> dict:
+        return self.post(
+            f"/eth/v1/validator/duties/sync/{epoch}",
+            [str(i) for i in indices],
+        )
+
+    def sync_committee_contribution(self, slot: int, subcommittee_index: int,
+                                    beacon_block_root: bytes, types=None):
+        data = self.get(
+            f"/eth/v1/validator/sync_committee_contribution"
+            f"?slot={slot}&subcommittee_index={subcommittee_index}"
+            f"&beacon_block_root=0x{bytes(beacon_block_root).hex()}"
+        )["data"]
+        if types is not None:
+            return container_from_json(types.SyncCommitteeContribution, data)
+        return data
+
+    def publish_contribution_and_proofs(self, signed_contributions) -> None:
+        self.post(
+            "/eth/v1/validator/contribution_and_proofs",
+            [to_json(c) for c in signed_contributions],
+        )
+
+    def liveness(self, epoch: int, indices: List[int]) -> List[dict]:
+        return self.post(
+            f"/eth/v1/validator/liveness/{epoch}",
+            [str(i) for i in indices],
+        )["data"]
+
     # ------------------------------------------------------------ validator
 
     def proposer_duties(self, epoch: int) -> dict:

@@ -650,6 +650,84 @@ def pool_attestations_post(ctx):
     return None
 
 
+@route("POST", "/eth/v1/beacon/pool/sync_committees", P0)
+def pool_sync_committees_post(ctx):
+    """Submit ``SyncCommitteeMessage``s (the VC's slot+1/3 sync duty)."""
+    from ..chain.beacon_chain import AttestationError
+
+    chain = ctx.chain
+    failures = []
+    for i, msg_json in enumerate(ctx.body or []):
+        try:
+            msg = container_from_json(chain.types.SyncCommitteeMessage, msg_json)
+            chain.process_sync_committee_message(msg)
+        except (AttestationError, KeyError, ValueError) as e:
+            failures.append({"index": i, "message": str(e)})
+    if failures:
+        raise ApiError(400, json.dumps({
+            "code": 400,
+            "message": "error processing sync committee messages",
+            "failures": failures,
+        }))
+    return None
+
+
+@route("GET", "/eth/v1/validator/sync_committee_contribution", P0)
+def sync_committee_contribution(ctx):
+    slot = ctx.q1("slot")
+    sub = ctx.q1("subcommittee_index")
+    root_hex = ctx.q1("beacon_block_root")
+    if slot is None or sub is None or root_hex is None:
+        raise _bad("slot, subcommittee_index and beacon_block_root are required")
+    c = ctx.chain.sync_contribution_pool.get_contribution(
+        int(slot), bytes.fromhex(root_hex[2:]), int(sub)
+    )
+    if c is None:
+        raise _not_found("no contribution for that subcommittee")
+    return {"data": to_json(c)}
+
+
+@route("POST", "/eth/v1/validator/contribution_and_proofs", P0)
+def contribution_and_proofs(ctx):
+    from ..chain.beacon_chain import AttestationError
+
+    chain = ctx.chain
+    failures = []
+    for i, c_json in enumerate(ctx.body or []):
+        try:
+            signed = container_from_json(
+                chain.types.SignedContributionAndProof, c_json
+            )
+            chain.process_signed_contribution(signed)
+        except (AttestationError, KeyError, ValueError) as e:
+            failures.append({"index": i, "message": str(e)})
+    if failures:
+        raise ApiError(400, json.dumps({
+            "code": 400,
+            "message": "error processing contributions",
+            "failures": failures,
+        }))
+    return None
+
+
+@route("POST", "/eth/v1/validator/liveness/{epoch}", P0)
+def validator_liveness(ctx):
+    """Per-validator liveness for ``epoch`` from the observed-attester cache
+    — the doppelganger service's data source (reference
+    ``http_api/src/lib.rs`` liveness endpoint backed by the chain's
+    observed caches)."""
+    epoch = int(ctx.params["epoch"])
+    chain = ctx.chain
+    out = []
+    for raw in (ctx.body or []):
+        idx = int(raw)
+        out.append({
+            "index": str(idx),
+            "is_live": bool(chain.observed.attesters.is_known(epoch, idx)),
+        })
+    return {"data": out}
+
+
 @route("GET", "/eth/v1/beacon/pool/attestations")
 def pool_attestations_get(ctx):
     atts = list(ctx.chain.attestation_pool._pool.values())

@@ -216,6 +216,7 @@ class ChainSpec:
     target_aggregators_per_committee: int = 16
     attestation_subnet_count: int = 64
     sync_committee_subnet_count: int = 4
+    target_aggregators_per_sync_subcommittee: int = 16
     # Deneb
     max_blobs_per_block: int = 6
     min_epochs_for_blob_sidecars_requests: int = 4096

@@ -22,8 +22,10 @@ from .services import (
     AttestationService,
     BeaconNodeFallback,
     BlockService,
+    DoppelgangerService,
     DutiesService,
     NoViableBeaconNode,
+    SyncCommitteeService,
 )
 from .slashing_protection import SlashingProtectionDB, SlashingProtectionError
 from .validator_store import ValidatorStore
@@ -67,7 +69,19 @@ class ValidatorClient:
         self.blocks = BlockService(
             store=self.store, duties=self.duties, fallback=self.fallback, types=types
         )
+        self.sync_committee = SyncCommitteeService(
+            store=self.store, duties=self.duties, fallback=self.fallback, types=types
+        )
+        self.doppelganger: Optional[DoppelgangerService] = None
         self._last_duties_epoch: Optional[int] = None
+
+    def enable_doppelganger_protection(self, start_epoch: int) -> None:
+        """Block ALL signing until liveness checks prove no other instance is
+        running our keys (reference ``doppelganger_service.rs``)."""
+        self.doppelganger = DoppelgangerService(
+            store=self.store, duties=self.duties, fallback=self.fallback,
+            start_epoch=start_epoch,
+        )
 
     # ------------------------------------------------------------ manual
 
@@ -82,14 +96,28 @@ class ValidatorClient:
         epoch = slot // self.spec.slots_per_epoch
         if self._last_duties_epoch != epoch:
             self.update_duties(epoch)
+            if self.doppelganger is not None:
+                self.doppelganger.check(epoch)
+        if not self.store.signing_enabled:
+            # Doppelganger gate down: perform NO duties (the whole point),
+            # but keep polling duties/liveness above.
+            return {
+                "slot": slot, "proposed": None, "attestations": 0,
+                "aggregates": 0, "sync_messages": 0, "sync_contributions": 0,
+                "doppelganger_blocked": True,
+            }
         proposed = self.blocks.propose(slot)
         attested = self.attester.attest(slot)
+        sync_messages = self.sync_committee.produce_messages(slot)
         aggregated = self.attester.aggregate(slot)
+        sync_contributions = self.sync_committee.aggregate(slot)
         return {
             "slot": slot,
             "proposed": proposed.hex() if proposed else None,
             "attestations": attested,
             "aggregates": aggregated,
+            "sync_messages": sync_messages,
+            "sync_contributions": sync_contributions,
         }
 
     # ---------------------------------------------------------- real time
@@ -119,10 +147,21 @@ class ValidatorClient:
             epoch = slot // self.spec.slots_per_epoch
             if self._last_duties_epoch != epoch:
                 safely("duties update", self.update_duties, epoch)
+                if self.doppelganger is not None:
+                    safely("doppelganger check", self.doppelganger.check, epoch)
+            if not self.store.signing_enabled:
+                # doppelganger gate down: no duties at all — running them
+                # would even pollute the slashing DB with roots that were
+                # never signed (check_and_insert precedes the signing gate)
+                time.sleep(max(0.0, slot_start + sps - time.time()))
+                done += 1
+                continue
             safely("propose", self.blocks.propose, slot)
             time.sleep(max(0.0, slot_start + sps / 3 - time.time()))
             safely("attest", self.attester.attest, slot)
+            safely("sync messages", self.sync_committee.produce_messages, slot)
             time.sleep(max(0.0, slot_start + 2 * sps / 3 - time.time()))
             safely("aggregate", self.attester.aggregate, slot)
+            safely("sync contributions", self.sync_committee.aggregate, slot)
             time.sleep(max(0.0, slot_start + sps - time.time()))
             done += 1

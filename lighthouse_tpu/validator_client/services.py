@@ -134,6 +134,189 @@ class DutiesService:
         return self._proposers.get(epoch, {}).get(slot)
 
 
+class SyncDuty:
+    __slots__ = ("pubkey", "validator_index", "positions")
+
+    def __init__(self, d: dict):
+        self.pubkey = bytes.fromhex(d["pubkey"][2:])
+        self.validator_index = int(d["validator_index"])
+        self.positions = [int(p) for p in d["validator_sync_committee_indices"]]
+
+
+class SyncCommitteeService:
+    """Sync-committee duties (reference ``sync_committee_service.rs``):
+    broadcast ``SyncCommitteeMessage``s over the head root at slot+1/3, and
+    for elected sync aggregators, fetch + wrap + publish
+    ``SignedContributionAndProof`` at slot+2/3."""
+
+    def __init__(self, *, store: ValidatorStore, duties: DutiesService,
+                 fallback: BeaconNodeFallback, types):
+        self.store = store
+        self.duties = duties
+        self.fallback = fallback
+        self.types = types
+        self._sync_duties: Dict[int, List[SyncDuty]] = {}  # period -> duties
+
+    def _period(self, epoch: int) -> int:
+        return epoch // self.store.spec.preset.epochs_per_sync_committee_period
+
+    def update_duties(self, epoch: int) -> None:
+        period = self._period(epoch)
+        if period in self._sync_duties:
+            return
+        indices = self.duties.resolve_indices()
+        if not indices:
+            # Don't cache emptiness: indices may simply not be resolvable yet
+            # (BN syncing, validators pending) — retry on the next call
+            # instead of skipping the whole ~27h period.
+            return
+        resp = self.fallback.first_success(
+            lambda c: c.sync_duties(epoch, sorted(indices.values()))
+        )
+        self._sync_duties[period] = [SyncDuty(d) for d in resp["data"]]
+        for old in [p for p in self._sync_duties if p + 2 < period]:
+            del self._sync_duties[old]
+
+    def _duties_now(self, slot: int) -> List[SyncDuty]:
+        epoch = slot // self.store.spec.slots_per_epoch
+        self.update_duties(epoch)
+        return self._sync_duties.get(self._period(epoch), [])
+
+    def produce_messages(self, slot: int) -> int:
+        """Sign the current head root per sync duty and submit; returns count
+        (the slot+1/3 half of the service)."""
+        duties = self._duties_now(slot)
+        if not duties:
+            return 0
+        head_root = self.fallback.first_success(lambda c: c.block_root("head"))
+        messages = []
+        for duty in duties:
+            try:
+                sig = self.store.sign_sync_committee_message(
+                    duty.pubkey, slot, head_root
+                )
+            except Exception:
+                continue  # missing key
+            messages.append(self.types.SyncCommitteeMessage(
+                slot=slot,
+                beacon_block_root=head_root,
+                validator_index=duty.validator_index,
+                signature=sig,
+            ))
+        if messages:
+            self.fallback.first_success(
+                lambda c: c.submit_sync_committee_messages(messages)
+            )
+        return len(messages)
+
+    def aggregate(self, slot: int) -> int:
+        """For subcommittees where a duty is an elected sync aggregator:
+        fetch the pool contribution and publish the signed wrap (the
+        slot+2/3 half)."""
+        spec = self.store.spec
+        duties = self._duties_now(slot)
+        if not duties:
+            return 0
+        sub_size = spec.preset.sync_committee_size // spec.sync_committee_subnet_count
+        head_root = self.fallback.first_success(lambda c: c.block_root("head"))
+        published = []
+        fetched: Dict[int, Optional[object]] = {}
+        for duty in duties:
+            for sub in sorted({p // sub_size for p in duty.positions}):
+                proof = self.store.sync_selection_proof(
+                    duty.pubkey, slot, sub, self.types
+                )
+                if not self.store.is_sync_aggregator(proof):
+                    continue
+                if sub not in fetched:
+                    try:
+                        fetched[sub] = self.fallback.first_success(
+                            lambda c: c.sync_committee_contribution(
+                                slot, sub, head_root, types=self.types
+                            )
+                        )
+                    except NoViableBeaconNode:
+                        fetched[sub] = None
+                contribution = fetched[sub]
+                if contribution is None:
+                    continue
+                message = self.types.ContributionAndProof(
+                    aggregator_index=duty.validator_index,
+                    contribution=contribution,
+                    selection_proof=proof,
+                )
+                sig = self.store.sign_contribution_and_proof(duty.pubkey, message)
+                published.append(self.types.SignedContributionAndProof(
+                    message=message, signature=sig
+                ))
+        if published:
+            self.fallback.first_success(
+                lambda c: c.publish_contribution_and_proofs(published)
+            )
+        return len(published)
+
+
+class DoppelgangerService:
+    """Doppelganger protection (reference ``doppelganger_service.rs:1-13``):
+    on startup, REFUSE all signing until our validators have shown no
+    liveness on the network for ``DETECTION_EPOCHS`` full epochs — if another
+    instance is attesting with our keys, signing would self-slash.
+
+    The gate wraps the validator store: ``signing_enabled`` starts False and
+    flips only after clean checks; a detection latches permanently until the
+    operator intervenes."""
+
+    DETECTION_EPOCHS = 2
+
+    def __init__(self, *, store: ValidatorStore, duties: DutiesService,
+                 fallback: BeaconNodeFallback, start_epoch: int):
+        self.store = store
+        self.duties = duties
+        self.fallback = fallback
+        self.start_epoch = start_epoch
+        self.detected: List[int] = []  # validator indices seen live elsewhere
+        self.complete = False  # satisfied: checks stop permanently
+        self._clean_epochs: set = set()
+        store.signing_enabled = False
+
+    def check(self, current_epoch: int) -> bool:
+        """Run a liveness round; returns True once signing is enabled.
+        Call once per epoch (the reference polls at 3/4 of the last slot).
+        Once satisfied, checks stop for good — after the gate lifts, OUR OWN
+        duties show up as liveness and must not re-latch the block."""
+        if self.complete:
+            return True
+        if self.detected:
+            return False
+        if current_epoch <= self.start_epoch:
+            return False  # the startup epoch itself is never clean evidence
+        indices = sorted(self.duties.resolve_indices().values())
+        if not indices:
+            # Indices not resolvable yet (BN syncing, validators pending):
+            # keep the gate DOWN — 'unknown' must never mean 'safe'.
+            return False
+        # Check the *previous* epoch: it is complete, so absence is meaningful.
+        # The startup epoch itself never counts — another instance may have
+        # attested in it before we started watching.
+        epoch_to_check = current_epoch - 1
+        if epoch_to_check <= self.start_epoch:
+            return False
+        data = self.fallback.first_success(
+            lambda c: c.liveness(epoch_to_check, indices)
+        )
+        live = [int(d["index"]) for d in data if d["is_live"]]
+        if live:
+            self.detected = live
+            self.store.signing_enabled = False
+            return False
+        self._clean_epochs.add(epoch_to_check)
+        if len(self._clean_epochs) >= self.DETECTION_EPOCHS:
+            self.store.signing_enabled = True
+            self.complete = True
+            return True
+        return False
+
+
 class AttestationService:
     """Produce + publish attestations at slot+1/3, aggregates at slot+2/3
     (reference ``attestation_service.rs`` spawn_attestation_tasks)."""

@@ -16,9 +16,11 @@ from ..types.spec import (
     DOMAIN_AGGREGATE_AND_PROOF,
     DOMAIN_BEACON_ATTESTER,
     DOMAIN_BEACON_PROPOSER,
+    DOMAIN_CONTRIBUTION_AND_PROOF,
     DOMAIN_RANDAO,
     DOMAIN_SELECTION_PROOF,
     DOMAIN_SYNC_COMMITTEE,
+    DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
     DOMAIN_VOLUNTARY_EXIT,
     ChainSpec,
 )
@@ -26,6 +28,10 @@ from ..types.ssz import UintType
 from .slashing_protection import SlashingProtectionDB
 
 uint64 = UintType(8)
+
+
+class DoppelgangerBlocked(Exception):
+    """Signing refused: doppelganger protection has not cleared yet."""
 
 
 class ValidatorStore:
@@ -45,6 +51,9 @@ class ValidatorStore:
             sk.public_key().to_bytes(): sk for sk in keys
         }
         self._fake = fake_signatures
+        # Doppelganger gate: DoppelgangerService flips this to False at
+        # startup and back to True only after clean liveness epochs.
+        self.signing_enabled = True
         if fake_signatures:
             from ..crypto.bls import curve, serde
 
@@ -64,6 +73,10 @@ class ValidatorStore:
         return h.compute_domain(domain_type, fork_version, self.genesis_validators_root)
 
     def _raw_sign(self, pubkey: bytes, signing_root: bytes) -> bytes:
+        if not self.signing_enabled:
+            raise DoppelgangerBlocked(
+                "signing disabled: doppelganger protection has not cleared"
+            )
         if self._fake:
             return self._canned
         sk = self._by_pubkey.get(bytes(pubkey))
@@ -147,3 +160,35 @@ class ValidatorStore:
         modulo = max(1, committee_length // self.spec.target_aggregators_per_committee)
         digest = hashlib.sha256(selection_proof).digest()
         return int.from_bytes(digest[:8], "little") % modulo == 0
+
+    # ------------------------------------------------------ sync committee
+
+    def sync_selection_proof(self, pubkey: bytes, slot: int,
+                             subcommittee_index: int, types) -> bytes:
+        """Sign ``SyncAggregatorSelectionData`` (the sync-duty analog of the
+        attestation selection proof)."""
+        data = types.SyncAggregatorSelectionData(
+            slot=slot, subcommittee_index=subcommittee_index
+        )
+        epoch = slot // self.spec.slots_per_epoch
+        domain = self._domain(DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch)
+        root = h.compute_signing_root(data.hash_tree_root(), domain)
+        return self._raw_sign(pubkey, root)
+
+    def is_sync_aggregator(self, selection_proof: bytes) -> bool:
+        """spec ``is_sync_committee_aggregator``."""
+        import hashlib
+
+        sub_size = (
+            self.spec.preset.sync_committee_size
+            // self.spec.sync_committee_subnet_count
+        )
+        modulo = max(1, sub_size // self.spec.target_aggregators_per_sync_subcommittee)
+        digest = hashlib.sha256(selection_proof).digest()
+        return int.from_bytes(digest[:8], "little") % modulo == 0
+
+    def sign_contribution_and_proof(self, pubkey: bytes, message) -> bytes:
+        epoch = int(message.contribution.slot) // self.spec.slots_per_epoch
+        domain = self._domain(DOMAIN_CONTRIBUTION_AND_PROOF, epoch)
+        root = h.compute_signing_root(message.hash_tree_root(), domain)
+        return self._raw_sign(pubkey, root)

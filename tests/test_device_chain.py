@@ -1,0 +1,101 @@
+"""End-to-end chain tests through the DEVICE verification path (VERDICT r2
+item 5): block import runs with the jax BLS backend active — every signature
+set funnels through the fused batched multi-pairing program
+(``ops/verify.py``), the production configuration ``client/__init__.py``
+selects — and Deneb blob DA runs through the device KZG engine
+(``ops/kzg_device.py``).  CPU-jax here, exactly like the driver's
+``dryrun_multichip``; the programs are the same ones jitted on TPU.
+
+Reference analog: the backend-swap contract of ``crypto/bls/src/lib.rs:84-139``
+exercised at the chain level, not just the kernel level."""
+
+import dataclasses
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.crypto.bls.backends import backend_name, set_backend
+from lighthouse_tpu.crypto.kzg.kzg import Kzg, TrustedSetup
+from lighthouse_tpu.types.spec import MINIMAL_PRESET, minimal_spec
+
+WIDTH = 64  # small blobs keep host-side poly math fast
+PRESET = dataclasses.replace(MINIMAL_PRESET, field_elements_per_blob=WIDTH)
+
+
+def _blob(i: int) -> bytes:
+    return b"".join(((i * WIDTH + j) % 251).to_bytes(32, "big") for j in range(WIDTH))
+
+
+def _count_device_calls(monkeypatch):
+    """Count invocations of the device batch-verify program."""
+    import lighthouse_tpu.ops.verify as ov
+
+    calls = {"n": 0, "sets": 0}
+    real = ov.verify_signature_sets_device
+
+    def counting(sets, seed=None):
+        calls["n"] += 1
+        calls["sets"] += len(sets)
+        return real(sets, seed=seed)
+
+    monkeypatch.setattr(ov, "verify_signature_sets_device", counting)
+    # the backend shim imports the symbol per call, so patching the module
+    # attribute is sufficient
+    return calls
+
+
+def test_block_import_through_device_backend(monkeypatch):
+    """Real-crypto block production -> process_block with the jax backend:
+    the bulk signature verification of the import pipeline runs on the
+    device program, and the chain head advances."""
+    set_backend("jax")
+    try:
+        assert backend_name() == "jax"
+        calls = _count_device_calls(monkeypatch)
+        harness = BeaconChainHarness(validator_count=8, fake_crypto=False)
+        roots = harness.extend_chain(2, attest=True)
+        assert harness.chain.head_root == roots[-1]
+        assert calls["n"] > 0, "no signature set went through the device program"
+        assert calls["sets"] >= 4, "expected proposal+randao (+attestations) sets"
+    finally:
+        set_backend("host")
+
+
+def test_blob_block_import_through_device_kzg(monkeypatch):
+    """Deneb block with blobs: DA verification through the fused device
+    MSM+pairing KZG program AND block signatures through the jax backend —
+    the full production device path in one import."""
+    import lighthouse_tpu.ops.kzg_device as kd
+
+    kzg_calls = {"n": 0}
+    real_kzg = kd.verify_kzg_proof_batch_device
+
+    def counting_kzg(*a, **kw):
+        kzg_calls["n"] += 1
+        return real_kzg(*a, **kw)
+
+    monkeypatch.setattr(kd, "verify_kzg_proof_batch_device", counting_kzg)
+
+    set_backend("jax")
+    try:
+        setup = TrustedSetup.insecure_dev_setup(width=WIDTH)
+        spec = minimal_spec(
+            preset=PRESET,
+            altair_fork_epoch=0, bellatrix_fork_epoch=0,
+            capella_fork_epoch=0, deneb_fork_epoch=0,
+        )
+        harness = BeaconChainHarness(
+            validator_count=8, spec=spec, fake_crypto=False,
+            kzg=Kzg(setup, device=True),
+        )
+        harness.advance_slot()
+        # two blobs: a single blob short-circuits to the host single-proof
+        # path; the device program is the BATCH path
+        signed, sidecars = harness.produce_signed_block_with_blobs(
+            [_blob(3), _blob(4)]
+        )
+        root = harness.chain.process_block_with_blobs(signed, sidecars)
+        assert harness.chain.get_block(root) is not None
+        assert kzg_calls["n"] > 0, "blob DA did not use the device KZG program"
+    finally:
+        set_backend("host")
