@@ -320,6 +320,9 @@ class BeaconChain:
         self.head_root = self.genesis_block_root
         self.attestation_pool = NaiveAggregationPool()
         self.sync_contribution_pool = NaiveSyncContributionPool(types, spec)
+        from .light_client import LightClientServerCache
+
+        self.lc_cache = LightClientServerCache(types, spec)
         self.op_pool = OperationPool()
         self.observed_block_roots: set = set()
         self._migrated_slot = 0
@@ -505,6 +508,7 @@ class BeaconChain:
         )
         self._store_block(block_root, signed_block, state)
         self.observed_block_roots.add(block_root)
+        self._update_light_client_cache(signed_block, parent_root, parent_state)
         if blob_sidecars:
             self._blob_sidecars[block_root] = list(blob_sidecars)
             for sc in blob_sidecars:
@@ -688,6 +692,41 @@ class BeaconChain:
             signed_aggregate, inner, [selection_set, outer_set, inner.signature_set]
         )
 
+    # ------------------------------------------------------- light client
+
+    def _update_light_client_cache(self, signed_block, parent_root: bytes,
+                                   parent_state) -> None:
+        """Produce LC objects from an imported block (reference
+        ``light_client_server_cache.rs`` recompute_and_cache_updates)."""
+        from .light_client import block_to_lc_header  # noqa: F401 (cycle guard)
+
+        parent_block = self.get_block(parent_root)
+        if parent_block is None:
+            if parent_root != self.genesis_block_root:
+                return
+            header = self.genesis_state.latest_block_header.copy()
+            header.state_root = self.genesis_state.hash_tree_root()
+            parent_block = header
+        f_root = bytes(parent_state.finalized_checkpoint.root)
+        finalized_block = self.get_block(f_root) if any(f_root) else None
+        try:
+            self.lc_cache.on_block_imported(
+                block=signed_block,
+                parent_block=parent_block,
+                parent_state=parent_state,
+                finalized_block=finalized_block,
+            )
+        except Exception:
+            pass  # LC production must never break block import
+
+    def produce_light_client_bootstrap(self, block_root: bytes):
+        """Bootstrap for a (finalized) block root, built on demand."""
+        block = self.get_block(block_root)
+        state = self.get_state(block_root)
+        if block is None or state is None:
+            return None
+        return self.lc_cache.produce_bootstrap(state, block)
+
     # ------------------------------------------------ sync committee duty
 
     def _sync_committee_positions(self, state, validator_index: int) -> List[int]:
@@ -704,6 +743,14 @@ class BeaconChain:
         from ..consensus import signature_sets as sets
         from ..crypto.bls import api as bls
 
+        current_slot = self.current_slot()
+        if not (current_slot - 1 <= int(msg.slot) <= current_slot + 1):
+            # spec gossip rule: the message slot must be current (±1 here for
+            # clock skew); without this, validly-signed far-future messages
+            # would pool forever (prune keeps future keys)
+            raise AttestationError(
+                f"sync message slot {msg.slot} outside the current-slot window"
+            )
         state = self.head_state
         vidx = int(msg.validator_index)
         if vidx >= len(state.validators):
@@ -741,6 +788,11 @@ class BeaconChain:
         contribution = msg.contribution
         aggregator = int(msg.aggregator_index)
         slot = int(contribution.slot)
+        current_slot = self.current_slot()
+        if not (current_slot - 1 <= slot <= current_slot + 1):
+            raise AttestationError(
+                f"contribution slot {slot} outside the current-slot window"
+            )
         sub = int(contribution.subcommittee_index)
         if sub >= self.spec.sync_committee_subnet_count:
             raise AttestationError("subcommittee index out of range")

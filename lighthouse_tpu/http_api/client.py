@@ -155,6 +155,36 @@ class BeaconNodeHttpClient:
             [to_json(c) for c in signed_contributions],
         )
 
+    def light_client_bootstrap(self, block_root: bytes, types=None):
+        data = self.get(
+            f"/eth/v1/beacon/light_client/bootstrap/0x{bytes(block_root).hex()}"
+        )["data"]
+        if types is not None:
+            return container_from_json(types.LightClientBootstrap, data)
+        return data
+
+    def light_client_updates(self, start_period: int, count: int, types=None):
+        entries = self.get(
+            f"/eth/v1/beacon/light_client/updates"
+            f"?start_period={start_period}&count={count}"
+        )
+        if types is not None:
+            return [container_from_json(types.LightClientUpdate, e["data"])
+                    for e in entries]
+        return entries
+
+    def light_client_finality_update(self, types=None):
+        data = self.get("/eth/v1/beacon/light_client/finality_update")["data"]
+        if types is not None:
+            return container_from_json(types.LightClientFinalityUpdate, data)
+        return data
+
+    def light_client_optimistic_update(self, types=None):
+        data = self.get("/eth/v1/beacon/light_client/optimistic_update")["data"]
+        if types is not None:
+            return container_from_json(types.LightClientOptimisticUpdate, data)
+        return data
+
     def liveness(self, epoch: int, indices: List[int]) -> List[dict]:
         return self.post(
             f"/eth/v1/validator/liveness/{epoch}",

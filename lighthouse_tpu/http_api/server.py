@@ -989,6 +989,41 @@ def prepare_proposer(ctx):
 # ------------------------------------------------------------ config routes
 
 
+@route("GET", "/eth/v1/beacon/light_client/bootstrap/{block_root}")
+def lc_bootstrap(ctx):
+    root = bytes.fromhex(ctx.params["block_root"][2:])
+    bootstrap = ctx.chain.produce_light_client_bootstrap(root)
+    if bootstrap is None:
+        raise _not_found("no light-client bootstrap for that root")
+    return {"version": "altair", "data": to_json(bootstrap)}
+
+
+@route("GET", "/eth/v1/beacon/light_client/updates")
+def lc_updates(ctx):
+    start = ctx.q1("start_period")
+    count = ctx.q1("count")
+    if start is None or count is None:
+        raise _bad("start_period and count are required")
+    updates = ctx.chain.lc_cache.get_updates(int(start), int(count))
+    return [{"version": "altair", "data": to_json(u)} for u in updates]
+
+
+@route("GET", "/eth/v1/beacon/light_client/finality_update")
+def lc_finality_update(ctx):
+    u = ctx.chain.lc_cache.latest_finality_update
+    if u is None:
+        raise _not_found("no finality update available")
+    return {"version": "altair", "data": to_json(u)}
+
+
+@route("GET", "/eth/v1/beacon/light_client/optimistic_update")
+def lc_optimistic_update(ctx):
+    u = ctx.chain.lc_cache.latest_optimistic_update
+    if u is None:
+        raise _not_found("no optimistic update available")
+    return {"version": "altair", "data": to_json(u)}
+
+
 @route("GET", "/eth/v1/config/spec")
 def config_spec(ctx):
     spec = ctx.chain.spec

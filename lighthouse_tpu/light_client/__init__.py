@@ -1,0 +1,178 @@
+"""Light client: the verifying consumer of the LC server's objects.
+
+The altair sync-protocol state machine (spec ``sync-protocol.md``; the
+reference ships the types + server while Siren/helios consume them — here the
+consumer lives in-repo so the served objects are verified end-to-end):
+
+- ``LightClientStore.bootstrap`` checks the current-sync-committee branch
+  against a TRUSTED block root.
+- ``process_update`` verifies the sync aggregate (2/3 supermajority of the
+  known committee over the attested header), the finality branch, and the
+  next-sync-committee branch, then advances finalized/optimistic heads and
+  rotates committees across periods.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..consensus import helpers as h
+from ..consensus.signature_sets import pubkey_cache
+from ..crypto.bls import api as bls
+from ..types.spec import DOMAIN_SYNC_COMMITTEE, ChainSpec
+from ..chain.light_client import FINALITY_BRANCH_DEPTH, SYNC_COMMITTEE_BRANCH_DEPTH
+from ..consensus.per_block import is_valid_merkle_branch
+
+CURRENT_SYNC_COMMITTEE_INDEX = 22  # field index in the ≤32-field state
+NEXT_SYNC_COMMITTEE_INDEX = 23
+FINALIZED_ROOT_INDEX = 20 * 2 + 1  # checkpoint.root under finalized_checkpoint
+
+
+class LightClientError(Exception):
+    pass
+
+
+class LightClientStore:
+    """Minimal spec LC store: finalized + optimistic headers, current/next
+    sync committees, period rotation."""
+
+    def __init__(self, types, spec: ChainSpec,
+                 genesis_validators_root: bytes):
+        self.types = types
+        self.spec = spec
+        self.genesis_validators_root = bytes(genesis_validators_root)
+        self.finalized_header = None
+        self.optimistic_header = None
+        self.current_sync_committee = None
+        self.next_sync_committee = None
+        self.committee_period = 0
+
+    # ----------------------------------------------------------- bootstrap
+
+    def bootstrap(self, trusted_block_root: bytes, bootstrap) -> None:
+        header_root = bootstrap.header.beacon.hash_tree_root()
+        if header_root != bytes(trusted_block_root):
+            raise LightClientError("bootstrap header does not match trusted root")
+        if not is_valid_merkle_branch(
+            bootstrap.current_sync_committee.hash_tree_root(),
+            bootstrap.current_sync_committee_branch,
+            SYNC_COMMITTEE_BRANCH_DEPTH,
+            CURRENT_SYNC_COMMITTEE_INDEX,
+            bytes(bootstrap.header.beacon.state_root),
+        ):
+            raise LightClientError("invalid current-sync-committee branch")
+        self.finalized_header = bootstrap.header.copy()
+        self.optimistic_header = bootstrap.header.copy()
+        self.current_sync_committee = bootstrap.current_sync_committee.copy()
+        self.committee_period = self._period(int(bootstrap.header.beacon.slot))
+
+    # -------------------------------------------------------------- updates
+
+    def _period(self, slot: int) -> int:
+        return (int(slot) // self.spec.slots_per_epoch) \
+            // self.spec.preset.epochs_per_sync_committee_period
+
+    def _verify_sync_aggregate(self, attested_header, sync_aggregate,
+                               signature_slot: int) -> int:
+        """Verify; returns the signature period (for committee rotation)."""
+        bits = list(sync_aggregate.sync_committee_bits)
+        if sum(bits) * 3 < len(bits) * 2:
+            raise LightClientError("insufficient sync committee participation")
+        sig_period = self._period(max(int(signature_slot), 1) - 1)
+        if self.current_sync_committee is None:
+            raise LightClientError("store not bootstrapped")
+        if sig_period == self.committee_period:
+            committee = self.current_sync_committee
+        elif sig_period == self.committee_period + 1 and self.next_sync_committee is not None:
+            committee = self.next_sync_committee
+        else:
+            raise LightClientError(
+                f"update period {sig_period} not applicable "
+                f"(store at {self.committee_period})"
+            )
+        participants = [
+            pubkey_cache(bytes(committee.pubkeys[i]))
+            for i, bit in enumerate(bits) if bit
+        ]
+        prev_slot = max(int(signature_slot), 1) - 1
+        epoch = prev_slot // self.spec.slots_per_epoch
+        fork_version = self.spec.fork_version_for(self.spec.fork_name_at_epoch(epoch))
+        domain = h.compute_domain(
+            DOMAIN_SYNC_COMMITTEE, fork_version, self.genesis_validators_root
+        )
+        signing_root = h.compute_signing_root(
+            attested_header.beacon.hash_tree_root(), domain
+        )
+        sig_set = bls.SignatureSet(
+            bls.Signature.from_bytes(bytes(sync_aggregate.sync_committee_signature)),
+            signing_root, participants,
+        )
+        if not bls.verify_signature_sets([sig_set]):
+            raise LightClientError("invalid sync aggregate signature")
+        return sig_period
+
+    def process_update(self, update) -> None:
+        """Full ``LightClientUpdate``: rotates the committee period and, when
+        the update carries finality (non-zero branch), advances the
+        finalized head."""
+        sig_period = self._verify_sync_aggregate(
+            update.attested_header, update.sync_aggregate, int(update.signature_slot)
+        )
+        has_finality = any(any(b) for b in update.finality_branch)
+        if has_finality and not is_valid_merkle_branch(
+            bytes(update.finalized_header.beacon.hash_tree_root()),
+            update.finality_branch,
+            FINALITY_BRANCH_DEPTH,
+            FINALIZED_ROOT_INDEX,
+            bytes(update.attested_header.beacon.state_root),
+        ):
+            raise LightClientError("invalid finality branch")
+        if not is_valid_merkle_branch(
+            update.next_sync_committee.hash_tree_root(),
+            update.next_sync_committee_branch,
+            SYNC_COMMITTEE_BRANCH_DEPTH,
+            NEXT_SYNC_COMMITTEE_INDEX,
+            bytes(update.attested_header.beacon.state_root),
+        ):
+            raise LightClientError("invalid next-sync-committee branch")
+
+        # Committee rotation keyed on the verified SIGNATURE period: an
+        # update signed by the NEXT committee proves that period is live.
+        if sig_period == self.committee_period + 1:
+            self.current_sync_committee = self.next_sync_committee
+            self.next_sync_committee = None
+            self.committee_period += 1
+        attested_period = self._period(int(update.attested_header.beacon.slot))
+        if attested_period == self.committee_period and self.next_sync_committee is None:
+            self.next_sync_committee = update.next_sync_committee.copy()
+
+        if has_finality and int(update.finalized_header.beacon.slot) > int(
+            self.finalized_header.beacon.slot
+        ):
+            self.finalized_header = update.finalized_header.copy()
+        if int(update.attested_header.beacon.slot) > int(self.optimistic_header.beacon.slot):
+            self.optimistic_header = update.attested_header.copy()
+
+    def process_finality_update(self, update) -> None:
+        self._verify_sync_aggregate(
+            update.attested_header, update.sync_aggregate, int(update.signature_slot)
+        )
+        if not is_valid_merkle_branch(
+            bytes(update.finalized_header.beacon.hash_tree_root()),
+            update.finality_branch,
+            FINALITY_BRANCH_DEPTH,
+            FINALIZED_ROOT_INDEX,
+            bytes(update.attested_header.beacon.state_root),
+        ):
+            raise LightClientError("invalid finality branch")
+        if int(update.finalized_header.beacon.slot) > int(self.finalized_header.beacon.slot):
+            self.finalized_header = update.finalized_header.copy()
+        if int(update.attested_header.beacon.slot) > int(self.optimistic_header.beacon.slot):
+            self.optimistic_header = update.attested_header.copy()
+
+    def process_optimistic_update(self, update) -> None:
+        self._verify_sync_aggregate(
+            update.attested_header, update.sync_aggregate, int(update.signature_slot)
+        )
+        if int(update.attested_header.beacon.slot) > int(self.optimistic_header.beacon.slot):
+            self.optimistic_header = update.attested_header.copy()

@@ -79,7 +79,11 @@ class LocalNode:
 
     def publish_block(self, signed_block) -> int:
         topic = topics_mod.GossipTopic(self.router.fork_digest, topics_mod.BEACON_BLOCK)
-        return self.service.publish(str(topic), signed_block.as_ssz_bytes())
+        n = self.service.publish(str(topic), signed_block.as_ssz_bytes())
+        # A locally-produced block may have queued LC updates at import —
+        # publish them now rather than waiting for the next gossip block.
+        self.router._publish_light_client_updates()
+        return n
 
     def publish_blob_sidecar(self, sidecar) -> int:
         subnet = int(sidecar.index) % self.chain.spec.max_blobs_per_block

@@ -180,6 +180,22 @@ class Router:
             self.slasher.on_block(signed)
             self._drain_slasher()
         self.service.forward(topic, compressed, exclude=sender)
+        self._publish_light_client_updates()
+
+    def _publish_light_client_updates(self) -> None:
+        """Gossip newly-produced LC finality/optimistic updates (reference:
+        the LC server publishes on the two light_client topics)."""
+        fin, opt = self.chain.lc_cache.take_new_updates()
+        if fin is not None:
+            t = topics_mod.GossipTopic(
+                self.fork_digest, topics_mod.LIGHT_CLIENT_FINALITY_UPDATE
+            )
+            self.service.publish(str(t), fin.as_ssz_bytes())
+        if opt is not None:
+            t = topics_mod.GossipTopic(
+                self.fork_digest, topics_mod.LIGHT_CLIENT_OPTIMISTIC_UPDATE
+            )
+            self.service.publish(str(t), opt.as_ssz_bytes())
 
     def _process_gossip_blob(
         self, topic: str, uncompressed: bytes, compressed: bytes, sender: str

@@ -104,6 +104,7 @@ class SyncManager:
                     try:
                         signed = self._decode_block_chunk(payload)
                         chain.process_block(signed)
+                        self.router._publish_light_client_updates()
                     except BlockError as e:
                         self.service.peer_manager.report(
                             peer, PeerAction.LOW_TOLERANCE, f"bad sync block: {e}"
@@ -147,5 +148,6 @@ class SyncManager:
         for block in reversed(ancestry):
             try:
                 chain.process_block(block)
+                self.router._publish_light_client_updates()
             except BlockError:
                 return

@@ -628,6 +628,52 @@ def build_types(preset: Preset) -> SimpleNamespace:
     class SignedContributionAndProof(Container):
         fields = {"message": ContributionAndProof.ssz_type, "signature": bytes96}
 
+    # ------------------------------------------------- light client protocol
+    # Reference: consensus/types/src/light_client_{bootstrap,update,...}.rs.
+    # Headers are the altair (beacon-only) format; the capella+ execution
+    # header extension is additive and not yet carried (the sync-committee
+    # and finality proofs below are complete without it).
+
+    class LightClientHeader(Container):
+        fields = {"beacon": BeaconBlockHeader.ssz_type}
+
+    _sc_branch = Vector(bytes32, 5)  # depth of a 32-leaf state container
+    _fin_branch = Vector(bytes32, 6)  # finalized root: one level deeper
+
+    class LightClientBootstrap(Container):
+        fields = {
+            "header": LightClientHeader.ssz_type,
+            "current_sync_committee": SyncCommittee.ssz_type,
+            "current_sync_committee_branch": _sc_branch,
+        }
+
+    class LightClientUpdate(Container):
+        fields = {
+            "attested_header": LightClientHeader.ssz_type,
+            "next_sync_committee": SyncCommittee.ssz_type,
+            "next_sync_committee_branch": _sc_branch,
+            "finalized_header": LightClientHeader.ssz_type,
+            "finality_branch": _fin_branch,
+            "sync_aggregate": SyncAggregate.ssz_type,
+            "signature_slot": uint64,
+        }
+
+    class LightClientFinalityUpdate(Container):
+        fields = {
+            "attested_header": LightClientHeader.ssz_type,
+            "finalized_header": LightClientHeader.ssz_type,
+            "finality_branch": _fin_branch,
+            "sync_aggregate": SyncAggregate.ssz_type,
+            "signature_slot": uint64,
+        }
+
+    class LightClientOptimisticUpdate(Container):
+        fields = {
+            "attested_header": LightClientHeader.ssz_type,
+            "sync_aggregate": SyncAggregate.ssz_type,
+            "signature_slot": uint64,
+        }
+
     # ------------------------------------------------------------- exports
 
     for k, v in dict(locals()).items():

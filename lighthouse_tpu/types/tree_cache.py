@@ -354,7 +354,10 @@ class StateTreeHashCache:
             return _BasicListCache(1, t.limit, mix_length=True)
         return None
 
-    def root(self, state) -> bytes:
+    def field_roots(self, state) -> List[bytes]:
+        """Per-field roots (the state container's Merkle leaves) — shared
+        with the light-client branch builder so proofs reuse the
+        incremental caches instead of re-merkleizing the state."""
         with self._lock:
             leaves = []
             for name, t in self.type.field_types.items():
@@ -363,7 +366,10 @@ class StateTreeHashCache:
                     leaves.append(cache.root(getattr(state, name)))
                 else:
                     leaves.append(t.hash_tree_root(getattr(state, name)))
-            return _ssz.merkleize(leaves)
+            return leaves
+
+    def root(self, state) -> bytes:
+        return _ssz.merkleize(self.field_roots(state))
 
     def __deepcopy__(self, memo):
         # state.copy() deep-copies the whole object graph; cloning the cache
