@@ -323,6 +323,8 @@ class BeaconChain:
         from .light_client import LightClientServerCache
 
         self.lc_cache = LightClientServerCache(types, spec)
+        self.builder = None  # external MEV relay client (set by the builder)
+        self.builder_pubkey = None  # operator-pinned relay identity (optional)
         self.op_pool = OperationPool()
         self.observed_block_roots: set = set()
         self._migrated_slot = 0
@@ -928,6 +930,7 @@ class BeaconChain:
         parent_root: Optional[bytes] = None,
         pre_state=None,
         blob_kzg_commitments: Optional[List[bytes]] = None,
+        payload_header=None,
     ):
         """Assemble an unsigned block on the current head (or on
         ``parent_root`` — how tests build forks); reference
@@ -963,7 +966,10 @@ class BeaconChain:
             state, spec, types
         )
 
-        body_cls = types.block_body[fork]
+        # MEV path: a builder payload HEADER yields a blinded block
+        # (reference produce_block's BlindedPayload variant).
+        blinded = payload_header is not None
+        body_cls = types.blinded_block_body[fork] if blinded else types.block_body[fork]
         body_kwargs = dict(
             randao_reveal=randao_reveal,
             eth1_data=state.eth1_data.copy(),
@@ -992,6 +998,8 @@ class BeaconChain:
                     sync_committee_signature=bls.INFINITY_SIGNATURE,
                 )
             body_kwargs["sync_aggregate"] = sync_aggregate
+        if "execution_payload_header" in body_cls.fields:
+            body_kwargs["execution_payload_header"] = payload_header
         if "execution_payload" in body_cls.fields:
             if fork == "electra" and hasattr(
                 self.execution_engine, "produce_payload_and_requests"
@@ -1017,7 +1025,7 @@ class BeaconChain:
                 deposits=[], withdrawals=[], consolidations=[]
             )
 
-        block_cls = types.block[fork]
+        block_cls = types.blinded_block[fork] if blinded else types.block[fork]
         block = block_cls(
             slot=slot,
             proposer_index=proposer,
@@ -1029,7 +1037,8 @@ class BeaconChain:
         # Dry-run the block on the state to compute the post-state root
         # (reference: per_block_processing(VerifyRandao) dry run; signatures
         # are the caller's and randao is verified at import).
-        signed_cls = types.signed_block[fork]
+        signed_cls = (types.signed_blinded_block[fork] if blinded
+                      else types.signed_block[fork])
         wrapper = signed_cls(message=block, signature=b"\x00" * 96)
         from ..consensus.per_block import per_block_processing
 
@@ -1044,6 +1053,111 @@ class BeaconChain:
         )
         block.state_root = state.hash_tree_root()
         return block, bytes(block.state_root)
+
+    # ------------------------------------------------------- MEV / builder
+
+    def produce_blinded_block(self, slot: int, randao_reveal: bytes,
+                              graffiti: bytes = b"\x00" * 32):
+        """Builder-path production (reference ``produce_block`` blinded
+        variant): fetch a bid from the configured relay, verify the bid
+        signature and header consistency, build a BLINDED block around the
+        header.  Raises ``ChainError`` when no usable bid exists — the
+        caller (HTTP route / VC) falls back to local production."""
+        from ..consensus.per_block import is_merge_transition_complete
+        from ..crypto.bls import api as bls
+        from ..execution_layer.builder_client import (
+            BuilderError,
+            builder_signing_root,
+        )
+
+        if self.builder is None:
+            raise ChainError("no builder configured")
+        state, parent_root = self.state_at_slot(slot)
+        if not hasattr(state, "latest_execution_payload_header") or (
+            not is_merge_transition_complete(state)
+        ):
+            raise ChainError("builder path requires post-merge execution")
+        parent_hash = bytes(state.latest_execution_payload_header.block_hash)
+        proposer = h.get_beacon_proposer_index(state, self.spec)
+        pubkey = bytes(state.validators[proposer].pubkey)
+        try:
+            fork, signed_bid = self.builder.get_header(slot, parent_hash, pubkey,
+                                                       self.types)
+        except BuilderError as e:
+            raise ChainError(f"builder get_header failed: {e}") from e
+        if signed_bid is None:
+            raise ChainError("builder returned no bid")
+        bid = signed_bid.message
+        if int(bid.value) == 0:
+            raise ChainError("builder bid has zero value")
+        if bytes(bid.header.parent_hash) != parent_hash:
+            raise ChainError("builder bid builds on the wrong parent")
+        if self.builder_pubkey is not None and (
+            bytes(bid.pubkey) != bytes(self.builder_pubkey)
+        ):
+            # Without a pinned identity the signature below only proves
+            # internal consistency (bid.pubkey is attacker-chosen over plain
+            # http); pinning is how the operator makes it an AUTH check.
+            raise ChainError("builder bid signed by an unexpected relay key")
+        sig_set = bls.SignatureSet.single_pubkey(
+            bls.Signature.from_bytes(bytes(signed_bid.signature)),
+            bls.PublicKey.from_bytes(bytes(bid.pubkey)),
+            builder_signing_root(bid.hash_tree_root(), self.spec),
+        )
+        if not bls.verify_signature_sets([sig_set]):
+            raise ChainError("builder bid signature invalid")
+        fork_name = type(state).fork_name
+        if fork_name == "electra":
+            # the electra builder flow additionally carries execution
+            # requests in the bid — not implemented; local production wins
+            raise ChainError("builder path not supported for electra yet")
+        blob_commitments = list(getattr(bid, "blob_kzg_commitments", []) or [])
+        return self.produce_block(
+            slot, randao_reveal, graffiti=graffiti,
+            parent_root=parent_root, pre_state=state,
+            payload_header=bid.header.copy(),
+            blob_kzg_commitments=blob_commitments or None,
+        )
+
+    def unblind_and_import(self, signed_blinded_block):
+        """POST /eth/v1/beacon/blinded_blocks: reveal the payload at the
+        relay, reconstruct the full block (same root — the header summarizes
+        the payload), import it.  Returns (block_root, signed_full_block)."""
+        from ..consensus.per_block import execution_payload_to_header
+        from ..execution_layer.builder_client import BuilderError
+
+        if self.builder is None:
+            raise ChainError("no builder configured")
+        fork = type(signed_blinded_block.message).fork_name
+        try:
+            payload = self.builder.submit_blinded_block(
+                signed_blinded_block, self.types
+            )
+        except BuilderError as e:
+            raise BlockError(f"builder failed to reveal payload: {e}") from e
+        header = signed_blinded_block.message.body.execution_payload_header
+        rebuilt = execution_payload_to_header(payload, self.types, fork)
+        if rebuilt.hash_tree_root() != header.hash_tree_root():
+            raise BlockError("revealed payload does not match the signed header")
+        blinded = signed_blinded_block.message
+        body_kwargs = {}
+        for name in blinded.body.fields:
+            if name == "execution_payload_header":
+                body_kwargs["execution_payload"] = payload
+            else:
+                body_kwargs[name] = getattr(blinded.body, name)
+        full = self.types.block[fork](
+            slot=blinded.slot,
+            proposer_index=blinded.proposer_index,
+            parent_root=blinded.parent_root,
+            state_root=blinded.state_root,
+            body=self.types.block_body[fork](**body_kwargs),
+        )
+        signed_full = self.types.signed_block[fork](
+            message=full, signature=signed_blinded_block.signature
+        )
+        root = self.process_block(signed_full)
+        return root, signed_full
 
     def produce_attestation_data(self, slot: int, committee_index: int):
         """Reference ``produce_unaggregated_attestation:1759`` — the data all

@@ -131,16 +131,23 @@ def per_block_processing(
     process_block_header(state, block, types, spec, verify_block_root)
 
     fork = type(state).fork_name
+    # Blinded bodies (MEV) carry the payload header in place of the payload;
+    # the same per-fork dispatch applies with the header standing in.
+    _payload_or_header = (
+        block.body.execution_payload
+        if hasattr(block.body, "execution_payload")
+        else getattr(block.body, "execution_payload_header", None)
+    )
     if fork == "capella":
         # capella gates withdrawals+payload on execution being enabled; deneb+
         # drops the gate (merge long complete) — spec process_block per fork.
         if is_execution_enabled(state, block.body):
-            process_withdrawals(state, block.body.execution_payload, types, spec)
+            process_withdrawals(state, _payload_or_header, types, spec)
             process_execution_payload(state, block.body, types, spec, payload_verifier)
     elif fork in ("deneb", "electra"):
-        process_withdrawals(state, block.body.execution_payload, types, spec)
+        process_withdrawals(state, _payload_or_header, types, spec)
         process_execution_payload(state, block.body, types, spec, payload_verifier)
-    elif hasattr(block.body, "execution_payload") and is_execution_enabled(state, block.body):
+    elif _payload_or_header is not None and is_execution_enabled(state, block.body):
         process_execution_payload(state, block.body, types, spec, payload_verifier)
 
     process_randao(state, block, spec, verify=verify_individual)
@@ -598,7 +605,8 @@ def is_merge_transition_complete(state) -> bool:
 
 
 def is_merge_transition_block(state, body) -> bool:
-    payload = body.execution_payload
+    payload = (body.execution_payload if hasattr(body, "execution_payload")
+               else body.execution_payload_header)
     return not is_merge_transition_complete(state) and payload != type(payload)()
 
 
@@ -618,9 +626,16 @@ def process_withdrawals(state, payload, types, spec: ChainSpec) -> None:
     else:
         expected = h.get_expected_withdrawals(state, types, spec)
         processed_partials = 0
-    got = list(payload.withdrawals)
-    if got != expected:
-        raise BlockProcessingError("withdrawals: payload does not match expected set")
+    if hasattr(payload, "withdrawals"):
+        if list(payload.withdrawals) != expected:
+            raise BlockProcessingError("withdrawals: payload does not match expected set")
+    else:
+        # Blinded body: the header commits to the withdrawals by root only.
+        from ..types.ssz import List as SszList
+
+        wd_list = SszList(types.Withdrawal.ssz_type, spec.preset.max_withdrawals_per_payload)
+        if bytes(payload.withdrawals_root) != wd_list.hash_tree_root(expected):
+            raise BlockProcessingError("withdrawals: header root does not match expected set")
     for w in expected:
         h.decrease_balance(state, w.validator_index, w.amount)
     if processed_partials:
@@ -639,6 +654,24 @@ def process_withdrawals(state, payload, types, spec: ChainSpec) -> None:
 
 
 def process_execution_payload(state, body, types, spec: ChainSpec, payload_verifier=None) -> None:
+    if not hasattr(body, "execution_payload"):
+        # Blinded body (MEV path, reference process_execution_payload over
+        # BlindedPayload): the header stands in for the payload — the same
+        # consistency checks apply, minus the engine call (the payload is
+        # unknown until the relay reveals it).
+        header = body.execution_payload_header
+        if is_merge_transition_complete(state):
+            if bytes(header.parent_hash) != bytes(
+                state.latest_execution_payload_header.block_hash
+            ):
+                raise BlockProcessingError("blinded payload: parent hash mismatch")
+        epoch = h.get_current_epoch(state, spec)
+        if bytes(header.prev_randao) != bytes(h.get_randao_mix(state, epoch, spec)):
+            raise BlockProcessingError("blinded payload: prev_randao mismatch")
+        if header.timestamp != compute_timestamp_at_slot(state, state.slot, spec):
+            raise BlockProcessingError("blinded payload: bad timestamp")
+        state.latest_execution_payload_header = header.copy()
+        return
     payload = body.execution_payload
     if is_merge_transition_complete(state):
         if bytes(payload.parent_hash) != bytes(state.latest_execution_payload_header.block_hash):
@@ -660,7 +693,16 @@ def process_execution_payload(state, body, types, spec: ChainSpec, payload_verif
         if not payload_verifier(payload):
             raise BlockProcessingError("payload: execution engine rejected payload")
 
-    fork = type(state).fork_name
+    state.latest_execution_payload_header = execution_payload_to_header(
+        payload, types, type(state).fork_name
+    )
+
+
+def execution_payload_to_header(payload, types, fork: str):
+    """Summarize a payload as its header; by construction
+    ``header.hash_tree_root() == payload.hash_tree_root()`` — the identity
+    the MEV blinded-block flow relies on (the proposer's signature over the
+    blinded block is valid for the unblinded one)."""
     hdr_cls = {
         "bellatrix": types.ExecutionPayloadHeaderBellatrix,
         "capella": types.ExecutionPayloadHeaderCapella,
@@ -677,4 +719,4 @@ def process_execution_payload(state, body, types, spec: ChainSpec, payload_verif
             kwargs[name] = t.hash_tree_root(payload.withdrawals)
         else:
             kwargs[name] = getattr(payload, name)
-    state.latest_execution_payload_header = hdr_cls(**kwargs)
+    return hdr_cls(**kwargs)

@@ -126,6 +126,28 @@ class BeaconNodeHttpClient:
     def submit_voluntary_exit(self, signed_exit) -> None:
         self.post("/eth/v1/beacon/pool/voluntary_exits", to_json(signed_exit))
 
+    def produce_blinded_block(self, slot: int, randao_reveal: bytes,
+                              graffiti: Optional[bytes] = None) -> dict:
+        path = (f"/eth/v1/validator/blinded_blocks/{slot}"
+                f"?randao_reveal=0x{bytes(randao_reveal).hex()}")
+        if graffiti:
+            path += f"&graffiti=0x{bytes(graffiti).hex()}"
+        return self.get(path)
+
+    def publish_blinded_block(self, signed_blinded_block) -> None:
+        fork = type(signed_blinded_block.message).fork_name
+        self.post(
+            "/eth/v2/beacon/blinded_blocks",
+            to_json(signed_blinded_block),
+            headers={"Eth-Consensus-Version": fork},
+        )
+
+    def register_validator(self, signed_registrations) -> None:
+        self.post(
+            "/eth/v1/validator/register_validator",
+            [to_json(r) for r in signed_registrations],
+        )
+
     def submit_sync_committee_messages(self, messages) -> None:
         self.post(
             "/eth/v1/beacon/pool/sync_committees",

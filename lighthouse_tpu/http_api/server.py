@@ -903,6 +903,11 @@ def duties_sync(ctx):
 
 @route("GET", "/eth/v3/validator/blocks/{slot}", P0)
 def produce_block_v3(ctx):
+    """v3 production: builder path when a relay is configured and bids
+    (reference ``produce_block.rs`` local-vs-builder choice — builder first,
+    local fallback on any failure)."""
+    from ..chain.beacon_chain import ChainError
+
     chain = ctx.chain
     slot = int(ctx.params["slot"])
     reveal = ctx.q1("randao_reveal")
@@ -912,14 +917,97 @@ def produce_block_v3(ctx):
     kwargs = {}
     if graffiti:
         kwargs["graffiti"] = bytes.fromhex(graffiti[2:]).ljust(32, b"\x00")
-    block, _ = chain.produce_block(slot, bytes.fromhex(reveal[2:]), **kwargs)
+    blinded = False
+    block = None
+    if chain.builder is not None and ctx.q1("builder_boost_factor") != "0":
+        try:
+            block, _ = chain.produce_blinded_block(
+                slot, bytes.fromhex(reveal[2:]), **kwargs
+            )
+            blinded = True
+        except ChainError:
+            block = None  # fall back to local production
+    if block is None:
+        block, _ = chain.produce_block(slot, bytes.fromhex(reveal[2:]), **kwargs)
     return {
         "version": type(block).fork_name,
-        "execution_payload_blinded": False,
+        "execution_payload_blinded": blinded,
         "execution_payload_value": "0",
         "consensus_block_value": "0",
         "data": to_json(block),
     }
+
+
+@route("GET", "/eth/v1/validator/blinded_blocks/{slot}", P0)
+def produce_blinded_block_route(ctx):
+    chain = ctx.chain
+    slot = int(ctx.params["slot"])
+    reveal = ctx.q1("randao_reveal")
+    if reveal is None:
+        raise _bad("randao_reveal is required")
+    graffiti = ctx.q1("graffiti")
+    kwargs = {}
+    if graffiti:
+        kwargs["graffiti"] = bytes.fromhex(graffiti[2:]).ljust(32, b"\x00")
+    from ..chain.beacon_chain import ChainError
+
+    try:
+        block, _ = chain.produce_blinded_block(
+            slot, bytes.fromhex(reveal[2:]), **kwargs
+        )
+    except ChainError as e:
+        raise _bad(f"blinded production failed: {e}")
+    return {"version": type(block).fork_name, "data": to_json(block)}
+
+
+@route("POST", "/eth/v1/beacon/blinded_blocks", P0)
+@route("POST", "/eth/v2/beacon/blinded_blocks", P0)
+def publish_blinded_block(ctx):
+    from ..chain.beacon_chain import BlockError, ChainError
+
+    chain = ctx.chain
+    version = None
+    for k in ("Eth-Consensus-Version", "eth-consensus-version"):
+        if ctx.headers.get(k):
+            version = ctx.headers.get(k).lower()
+            break
+    if version is None:
+        version = chain.spec.fork_name_at_slot(int(ctx.body["message"]["slot"]))
+    cls = chain.types.signed_blinded_block.get(version)
+    if cls is None:
+        raise _bad(f"unknown consensus version {version!r}")
+    try:
+        signed = container_from_json(cls, ctx.body)
+    except (KeyError, TypeError, ValueError) as e:
+        raise _bad(f"malformed SignedBlindedBeaconBlock: {e}")
+    try:
+        _root, signed_full = chain.unblind_and_import(signed)
+    except (BlockError, ChainError) as e:
+        raise _bad(f"invalid blinded block: {e}")
+    publish = getattr(ctx.server, "publish_block_fn", None)
+    if publish is not None:
+        publish(signed_full)
+    return None
+
+
+@route("POST", "/eth/v1/validator/register_validator", P0)
+def register_validator(ctx):
+    """Forward fee-recipient registrations to the configured relay
+    (reference ``register_validators`` passthrough); a no-op without one."""
+    chain = ctx.chain
+    if chain.builder is None:
+        return None
+    regs = [
+        container_from_json(chain.types.SignedValidatorRegistrationV1, r)
+        for r in (ctx.body or [])
+    ]
+    from ..execution_layer.builder_client import BuilderError
+
+    try:
+        chain.builder.register_validators(regs)
+    except BuilderError as e:
+        raise ApiError(502, json.dumps({"code": 502, "message": str(e)}))
+    return None
 
 
 @route("GET", "/eth/v1/validator/attestation_data", P0)

@@ -460,6 +460,100 @@ def build_types(preset: Preset) -> SimpleNamespace:
         _blocks[_fork] = _blk
         _signed_blocks[_fork] = _sblk
 
+    # ------------------------------------------------- blinded blocks (MEV)
+    # Reference ``consensus/types``: BlindedPayload variants — the body
+    # carries the execution payload HEADER; the builder reveals the payload
+    # only after the proposer signs (builder_client / blinded production).
+
+    _payload_headers = {
+        "bellatrix": ExecutionPayloadHeaderBellatrix,
+        "capella": ExecutionPayloadHeaderCapella,
+        "deneb": ExecutionPayloadHeaderDeneb,
+        "electra": ExecutionPayloadHeaderDeneb,  # structurally deneb's
+    }
+    _blinded_bodies = {}
+    _blinded_blocks = {}
+    _signed_blinded_blocks = {}
+    for _fork, _body in _bodies.items():
+        if "execution_payload" not in _body.fields:
+            continue
+        _bf = {}
+        for _fname, _ftype in _body.fields.items():
+            if _fname == "execution_payload":
+                _bf["execution_payload_header"] = _payload_headers[_fork].ssz_type
+            else:
+                _bf[_fname] = _ftype
+        _bbody = type(
+            f"BlindedBeaconBlockBody{_fork.capitalize()}",
+            (Container,),
+            {"fork_name": _fork, "fields": _bf},
+        )
+        _bblk = type(
+            f"BlindedBeaconBlock{_fork.capitalize()}",
+            (Container,),
+            {
+                "fork_name": _fork,
+                "fields": {
+                    "slot": uint64,
+                    "proposer_index": uint64,
+                    "parent_root": bytes32,
+                    "state_root": bytes32,
+                    "body": _bbody.ssz_type,
+                },
+            },
+        )
+        _sbblk = type(
+            f"SignedBlindedBeaconBlock{_fork.capitalize()}",
+            (Container,),
+            {
+                "fork_name": _fork,
+                "fields": {"message": _bblk.ssz_type, "signature": bytes96},
+            },
+        )
+        _blinded_bodies[_fork] = _bbody
+        _blinded_blocks[_fork] = _bblk
+        _signed_blinded_blocks[_fork] = _sbblk
+
+    # ------------------------------------------------ builder API (relay)
+    # Reference ``beacon_node/builder_client`` + eth2 builder-specs types.
+
+    class ValidatorRegistrationV1(Container):
+        fields = {
+            "fee_recipient": bytes20,
+            "gas_limit": uint64,
+            "timestamp": uint64,
+            "pubkey": bytes48,
+        }
+
+    class SignedValidatorRegistrationV1(Container):
+        fields = {
+            "message": ValidatorRegistrationV1.ssz_type,
+            "signature": bytes96,
+        }
+
+    _builder_bids = {}
+    _signed_builder_bids = {}
+    for _fork, _hdr in _payload_headers.items():
+        _bid_fields = {"header": _hdr.ssz_type}
+        if _fork in ("deneb", "electra"):
+            _bid_fields["blob_kzg_commitments"] = List(
+                bytes48, P.max_blob_commitments_per_block
+            )
+        _bid_fields["value"] = uint256
+        _bid_fields["pubkey"] = bytes48
+        _bid = type(
+            f"BuilderBid{_fork.capitalize()}",
+            (Container,),
+            {"fork_name": _fork, "fields": _bid_fields},
+        )
+        _sbid = type(
+            f"SignedBuilderBid{_fork.capitalize()}",
+            (Container,),
+            {"fork_name": _fork, "fields": {"message": _bid.ssz_type, "signature": bytes96}},
+        )
+        _builder_bids[_fork] = _bid
+        _signed_builder_bids[_fork] = _sbid
+
     # -------------------------------------------------------------- states
 
     _state_pre = {
@@ -684,6 +778,12 @@ def build_types(preset: Preset) -> SimpleNamespace:
     ns.block_body = _bodies
     ns.block = _blocks
     ns.signed_block = _signed_blocks
+    ns.blinded_block_body = _blinded_bodies
+    ns.blinded_block = _blinded_blocks
+    ns.signed_blinded_block = _signed_blinded_blocks
+    ns.payload_header = {f: h for f, h in _payload_headers.items()}
+    ns.builder_bid = _builder_bids
+    ns.signed_builder_bid = _signed_builder_bids
     ns.state = _states
     for _f in _bodies:
         ns.attestation_by_fork[_f] = (

@@ -33,6 +33,7 @@ DOMAIN_SYNC_COMMITTEE = bytes.fromhex("07000000")
 DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF = bytes.fromhex("08000000")
 DOMAIN_CONTRIBUTION_AND_PROOF = bytes.fromhex("09000000")
 DOMAIN_BLS_TO_EXECUTION_CHANGE = bytes.fromhex("0A000000")
+DOMAIN_APPLICATION_BUILDER = bytes.fromhex("00000001")
 DOMAIN_APPLICATION_MASK = bytes.fromhex("00000001")
 
 # Altair participation flag indices / weights.

@@ -408,28 +408,56 @@ class BlockService:
 
     def __init__(self, *, store: ValidatorStore, duties: DutiesService,
                  fallback: BeaconNodeFallback, types,
-                 graffiti: bytes = b"lighthouse-tpu".ljust(32, b"\x00")):
+                 graffiti: bytes = b"lighthouse-tpu".ljust(32, b"\x00"),
+                 builder_proposals: bool = False):
         self.store = store
         self.duties = duties
         self.fallback = fallback
         self.types = types
         self.graffiti = graffiti
+        self.builder_proposals = builder_proposals
 
     def propose(self, slot: int) -> Optional[bytes]:
         """Produce, sign (slashing-gated) and publish a block if it is our
-        duty; returns the block root or None."""
+        duty; returns the block root or None.  With ``builder_proposals``,
+        try the blinded/MEV path first and fall back to local production
+        (reference ``block_service.rs`` blinded-vs-full)."""
         spec = self.store.spec
         pubkey = self.duties.proposer_at_slot(slot, spec)
         if pubkey is None:
             return None
         epoch = slot // spec.slots_per_epoch
         reveal = self.store.randao_reveal(pubkey, epoch)
+        if self.builder_proposals:
+            try:
+                return self._propose_blinded(slot, pubkey, reveal)
+            except (ApiClientError, NoViableBeaconNode, KeyError, ValueError):
+                pass  # builder path unavailable: local production below
         resp = self.fallback.first_success(
             lambda c: c.produce_block(slot, reveal, graffiti=self.graffiti)
         )
         fork = resp["version"]
+        if resp.get("execution_payload_blinded"):
+            # A builder-enabled BN may serve a BLINDED body from v3 — sign
+            # and publish it down the blinded path (spec v3 contract).
+            block = container_from_json(self.types.blinded_block[fork], resp["data"])
+            sig = self.store.sign_block(pubkey, block)
+            signed = self.types.signed_blinded_block[fork](message=block, signature=sig)
+            self.fallback.first_success(lambda c: c.publish_blinded_block(signed))
+            return block.hash_tree_root()
         block = container_from_json(self.types.block[fork], resp["data"])
         sig = self.store.sign_block(pubkey, block)  # slashing DB veto point
         signed = self.types.signed_block[fork](message=block, signature=sig)
         self.fallback.first_success(lambda c: c.publish_block(signed))
+        return block.hash_tree_root()
+
+    def _propose_blinded(self, slot: int, pubkey: bytes, reveal: bytes) -> bytes:
+        resp = self.fallback.first_success(
+            lambda c: c.produce_blinded_block(slot, reveal, graffiti=self.graffiti)
+        )
+        fork = resp["version"]
+        block = container_from_json(self.types.blinded_block[fork], resp["data"])
+        sig = self.store.sign_block(pubkey, block)  # same slashing veto
+        signed = self.types.signed_blinded_block[fork](message=block, signature=sig)
+        self.fallback.first_success(lambda c: c.publish_blinded_block(signed))
         return block.hash_tree_root()
