@@ -324,6 +324,7 @@ class BeaconChain:
 
         self.lc_cache = LightClientServerCache(types, spec)
         self.builder = None  # external MEV relay client (set by the builder)
+        self.eth1_service = None  # deposit follower + eth1 voting (optional)
         self.builder_pubkey = None  # operator-pinned relay identity (optional)
         self.op_pool = OperationPool()
         self.observed_block_roots: set = set()
@@ -446,9 +447,10 @@ class BeaconChain:
             from .da import BlobError
 
             try:
-                status, result = self.da_checker.check_availability(
-                    signed_block, sidecars=sidecars
-                )
+                with metrics.BLOCK_DA_CHECK_SECONDS.time():
+                    status, result = self.da_checker.check_availability(
+                        signed_block, sidecars=sidecars
+                    )
             except BlobError as e:
                 raise BlockError(f"blob verification failed: {e}") from e
             if status != "available":
@@ -508,7 +510,8 @@ class BeaconChain:
             payload_verification_status=payload_status,
             block_delay_seconds=block_delay_seconds,
         )
-        self._store_block(block_root, signed_block, state)
+        with metrics.BLOCK_STORE_WRITE_SECONDS.time():
+            self._store_block(block_root, signed_block, state)
         self.observed_block_roots.add(block_root)
         self._update_light_client_cache(signed_block, parent_root, parent_state)
         if blob_sidecars:
@@ -969,15 +972,33 @@ class BeaconChain:
         # MEV path: a builder payload HEADER yields a blinded block
         # (reference produce_block's BlindedPayload variant).
         blinded = payload_header is not None
+        # Eth1 vote + required deposits (reference eth1_chain.rs): without a
+        # follower, repeat the state's current eth1_data and carry none.
+        eth1_data = state.eth1_data.copy()
+        deposits = []
+        if self.eth1_service is not None:
+            try:
+                eth1_data = self.eth1_service.eth1_vote(state)
+                # will THIS vote flip state.eth1_data? (process_eth1_data
+                # runs before process_operations in the transition)
+                period_slots = (spec.preset.epochs_per_eth1_voting_period
+                                * spec.slots_per_epoch)
+                same = sum(1 for v in state.eth1_data_votes if v == eth1_data) + 1
+                effective = eth1_data if same * 2 > period_slots else state.eth1_data
+                deposits = self.eth1_service.deposits_for_block(state, effective)
+            except Exception:
+                eth1_data = state.eth1_data.copy()
+                deposits = []
+
         body_cls = types.blinded_block_body[fork] if blinded else types.block_body[fork]
         body_kwargs = dict(
             randao_reveal=randao_reveal,
-            eth1_data=state.eth1_data.copy(),
+            eth1_data=eth1_data,
             graffiti=graffiti,
             proposer_slashings=proposer_slashings,
             attester_slashings=attester_slashings,
             attestations=attestations,
-            deposits=[],
+            deposits=deposits,
             voluntary_exits=self.op_pool.get_voluntary_exits(state, types, spec),
         )
         if hasattr(body_cls, "fields") and "sync_aggregate" in body_cls.fields:
@@ -1087,6 +1108,12 @@ class BeaconChain:
             raise ChainError(f"builder get_header failed: {e}") from e
         if signed_bid is None:
             raise ChainError("builder returned no bid")
+        if fork != type(state).fork_name:
+            # a wrong-fork header would poison the state header field and
+            # surface later as a non-ChainError, defeating the fallback
+            raise ChainError(
+                f"builder bid fork {fork!r} != state fork {type(state).fork_name!r}"
+            )
         bid = signed_bid.message
         if int(bid.value) == 0:
             raise ChainError("builder bid has zero value")
@@ -1198,6 +1225,10 @@ class BeaconChain:
 
     def recompute_head(self) -> bytes:
         """Reference ``canonical_head.rs:496`` ``recompute_head_at_slot``."""
+        with metrics.HEAD_RECOMPUTE_SECONDS.time():
+            return self._recompute_head_inner()
+
+    def _recompute_head_inner(self) -> bytes:
         old_head = self.head_root
         head = self.fork_choice.get_head(self.current_slot())
         self.head_root = head

@@ -195,6 +195,108 @@ def run_account(args) -> int:
 # ---------------------------------------------------------------- parser
 
 
+def run_database_manager(args) -> int:
+    """``lighthouse db`` equivalent (reference ``database_manager/``):
+    inspect / version / compact an on-disk node database."""
+    from .store.kv import DBColumn
+    from .store.lockbox_store import LockboxStore
+
+    path = os.path.join(args.datadir, "chain.db")
+    if not os.path.exists(path):
+        print(f"no database at {path}", file=sys.stderr)
+        return 1
+    store = LockboxStore(path)
+    try:
+        if args.db_cmd == "version":
+            import struct
+
+            raw = store.get(DBColumn.BEACON_META, b"schema")
+            version = struct.unpack(">Q", raw)[0] if raw else None
+            print(json.dumps({"path": path, "schema_version": version}))
+        elif args.db_cmd == "inspect":
+            counts = {}
+            names = {
+                getattr(DBColumn, n): n for n in dir(DBColumn) if not n.startswith("_")
+            }
+            for column in names:
+                n_keys = sum(1 for _ in store.iter_column(column))
+                if n_keys:
+                    counts[names[column]] = n_keys
+            print(json.dumps({"path": path, "keys_per_column": counts}))
+        elif args.db_cmd == "compact":
+            store.compact()
+            print(json.dumps({"path": path, "compacted": True}))
+    finally:
+        store.close()
+    return 0
+
+
+def run_lcli(args) -> int:
+    """Dev swiss-army knife (reference ``lcli/``): state-transition timing
+    loops, root computation, SSZ inspection."""
+    from .types.containers import build_types
+
+    if args.lcli_cmd == "transition-bench":
+        import subprocess
+
+        cmd = [sys.executable,
+               os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                            "scripts", "transition_bench.py"),
+               "--validators", str(args.validators)]
+        if args.slots:
+            cmd += ["--slots", str(args.slots)]
+        for _ in range(args.runs):
+            subprocess.run(cmd, check=True)
+        return 0
+
+    if args.lcli_cmd == "skip-slots":
+        spec = _spec_for(args.network)
+        types = build_types(spec.preset)
+        from .consensus.per_slot import process_slots
+
+        with open(args.pre_state, "rb") as f:
+            data = f.read()
+        state = types.state[args.fork].from_ssz_bytes(data)
+        t0 = time.perf_counter()
+        state = process_slots(state, int(state.slot) + args.slots, types, spec)
+        dt = time.perf_counter() - t0
+        print(json.dumps({"slots": args.slots, "seconds": round(dt, 3),
+                          "state_root": "0x" + state.hash_tree_root().hex()}))
+        if args.output:
+            with open(args.output, "wb") as f:
+                f.write(state.as_ssz_bytes())
+        return 0
+
+    if args.lcli_cmd in ("state-root", "block-root"):
+        spec = _spec_for(args.network)
+        types = build_types(spec.preset)
+        with open(args.file, "rb") as f:
+            data = f.read()
+        registry = types.state if args.lcli_cmd == "state-root" else types.signed_block
+        obj = registry[args.fork].from_ssz_bytes(data)
+        root = (obj.hash_tree_root() if args.lcli_cmd == "state-root"
+                else obj.message.hash_tree_root())
+        print(json.dumps({"root": "0x" + root.hex()}))
+        return 0
+
+    if args.lcli_cmd == "parse-ssz":
+        spec = _spec_for(args.network)
+        types = build_types(spec.preset)
+        from .http_api.serde import to_json
+
+        cls = getattr(types, args.type_name, None)
+        if cls is None:
+            cls = types.signed_block.get(args.type_name) or types.state.get(args.type_name)
+        if cls is None:
+            print(f"unknown type {args.type_name!r}", file=sys.stderr)
+            return 1
+        with open(args.file, "rb") as f:
+            obj = cls.from_ssz_bytes(f.read())
+        print(json.dumps(to_json(obj), indent=2))
+        return 0
+    return 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="lighthouse-tpu",
@@ -246,6 +348,37 @@ def build_parser() -> argparse.ArgumentParser:
     im.add_argument("--interchange", required=True)
     im.add_argument("--genesis-validators-root", required=True)
     am.set_defaults(func=run_account)
+
+    db = sub.add_parser("database_manager", aliases=["db"],
+                        help="inspect/compact a node database")
+    dbsub = db.add_subparsers(dest="db_cmd", required=True)
+    for name in ("version", "inspect", "compact"):
+        d = dbsub.add_parser(name)
+        d.add_argument("--datadir", required=True)
+    db.set_defaults(func=run_database_manager)
+
+    lcli = sub.add_parser("lcli", help="dev tools (transition timing, roots, ssz)")
+    lsub = lcli.add_subparsers(dest="lcli_cmd", required=True)
+    tb = lsub.add_parser("transition-bench")
+    tb.add_argument("--validators", type=int, default=16384)
+    tb.add_argument("--slots", type=int, default=None)
+    tb.add_argument("--runs", type=int, default=1)
+    sk = lsub.add_parser("skip-slots")
+    sk.add_argument("--network", default="minimal")
+    sk.add_argument("--fork", default="capella")
+    sk.add_argument("--pre-state", required=True)
+    sk.add_argument("--slots", type=int, required=True)
+    sk.add_argument("--output", default=None)
+    for name in ("state-root", "block-root"):
+        r = lsub.add_parser(name)
+        r.add_argument("--network", default="minimal")
+        r.add_argument("--fork", default="capella")
+        r.add_argument("file")
+    ps = lsub.add_parser("parse-ssz")
+    ps.add_argument("--network", default="minimal")
+    ps.add_argument("type_name")
+    ps.add_argument("file")
+    lcli.set_defaults(func=run_lcli)
     return p
 
 

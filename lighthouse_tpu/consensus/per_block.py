@@ -670,6 +670,14 @@ def process_execution_payload(state, body, types, spec: ChainSpec, payload_verif
             raise BlockProcessingError("blinded payload: prev_randao mismatch")
         if header.timestamp != compute_timestamp_at_slot(state, state.slot, spec):
             raise BlockProcessingError("blinded payload: bad timestamp")
+        if hasattr(body, "blob_kzg_commitments"):
+            max_blobs = (
+                spec.max_blobs_per_block_electra
+                if type(state).fork_name == "electra"
+                else spec.max_blobs_per_block
+            )
+            if len(body.blob_kzg_commitments) > max_blobs:
+                raise BlockProcessingError("blinded payload: too many blob commitments")
         state.latest_execution_payload_header = header.copy()
         return
     payload = body.execution_payload
@@ -703,12 +711,7 @@ def execution_payload_to_header(payload, types, fork: str):
     ``header.hash_tree_root() == payload.hash_tree_root()`` — the identity
     the MEV blinded-block flow relies on (the proposer's signature over the
     blinded block is valid for the unblinded one)."""
-    hdr_cls = {
-        "bellatrix": types.ExecutionPayloadHeaderBellatrix,
-        "capella": types.ExecutionPayloadHeaderCapella,
-        "deneb": types.ExecutionPayloadHeaderDeneb,
-        "electra": types.ExecutionPayloadHeaderDeneb,
-    }[fork]
+    hdr_cls = types.payload_header[fork]
     kwargs = {}
     for name in hdr_cls.fields:
         if name == "transactions_root":

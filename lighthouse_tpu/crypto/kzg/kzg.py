@@ -193,6 +193,12 @@ class TrustedSetup:
             if validate and not curve.in_g2(pt):
                 raise KzgError("G2 setup point not in subgroup")
             g2_pts.append(pt)
+        # The ceremony file stores Lagrange points in NATURAL root order;
+        # evaluation-form math here uses the bit-reversed ordering, so the
+        # loader applies the permutation exactly like c-kzg's
+        # load_trusted_setup (caught by the vendored-official-setup KAT:
+        # proofs verified under the dev setup but not the real file).
+        g1_pts = bit_reversal_permutation(g1_pts)
         return cls(g1_lagrange=g1_pts, g2_monomial=g2_pts, width=len(g1_pts))
 
     @classmethod

@@ -99,12 +99,7 @@ class BuilderHttpClient:
             "POST", "/eth/v1/builder/blinded_blocks",
             to_json(signed_blinded_block),
         )
-        payload_cls = {
-            "bellatrix": types.ExecutionPayloadBellatrix,
-            "capella": types.ExecutionPayloadCapella,
-            "deneb": types.ExecutionPayloadDeneb,
-            "electra": types.ExecutionPayloadDeneb,
-        }[fork]
+        payload_cls = types.execution_payload[fork]
         try:
             return container_from_json(payload_cls, resp["data"])
         except (KeyError, TypeError, ValueError) as e:

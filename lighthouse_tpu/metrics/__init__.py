@@ -222,3 +222,34 @@ DEVICE_BATCH_INVOCATIONS = counter(
 )
 HTTP_REQUESTS = counter("http_api_requests_total", "Beacon API requests")
 HTTP_REQUEST_SECONDS = histogram("http_api_request_seconds", "Beacon API request time")
+
+# Device batch pipeline stages (reference metrics.rs:247-271 batch setup /
+# verify timers) — exactly what TPU perf debugging needs: where a slow batch
+# spends its time (host marshalling vs dispatch vs device execution).
+DEVICE_BATCH_SETUP_SECONDS = histogram(
+    "device_batch_setup_seconds",
+    "host-side batch marshalling (validation, hash-to-curve, limb packing)",
+)
+DEVICE_DISPATCH_SECONDS = histogram(
+    "device_batch_dispatch_seconds",
+    "async program dispatch (returns before execution completes)",
+)
+DEVICE_BLOCK_UNTIL_READY_SECONDS = histogram(
+    "device_batch_block_until_ready_seconds",
+    "wait for device results (the actual device execution window)",
+)
+DEVICE_VERDICT_SECONDS = histogram(
+    "device_batch_verdict_seconds",
+    "host-side verdict (W-at-infinity check + final-exp-is-one)",
+)
+
+# Additional block import stages (reference metrics.rs:40-161 has ~15).
+BLOCK_DA_CHECK_SECONDS = histogram(
+    "beacon_block_da_check_seconds", "blob availability check inside import"
+)
+BLOCK_STORE_WRITE_SECONDS = histogram(
+    "beacon_block_store_write_seconds", "block+state persistence inside import"
+)
+HEAD_RECOMPUTE_SECONDS = histogram(
+    "beacon_head_recompute_seconds", "fork-choice get_head + head swap"
+)

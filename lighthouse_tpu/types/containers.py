@@ -782,6 +782,12 @@ def build_types(preset: Preset) -> SimpleNamespace:
     ns.blinded_block = _blinded_blocks
     ns.signed_blinded_block = _signed_blinded_blocks
     ns.payload_header = {f: h for f, h in _payload_headers.items()}
+    ns.execution_payload = {
+        "bellatrix": ExecutionPayloadBellatrix,
+        "capella": ExecutionPayloadCapella,
+        "deneb": ExecutionPayloadDeneb,
+        "electra": ExecutionPayloadDeneb,  # structurally deneb's
+    }
     ns.builder_bid = _builder_bids
     ns.signed_builder_bid = _signed_builder_bids
     ns.state = _states

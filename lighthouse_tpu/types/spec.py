@@ -155,6 +155,8 @@ class ChainSpec:
     seconds_per_slot: int = 12
     genesis_delay: int = 604800
     min_genesis_time: int = 1606824000
+    eth1_follow_distance: int = 2048
+    seconds_per_eth1_block: int = 14
     min_genesis_active_validator_count: int = 16384
     min_attestation_inclusion_delay: int = 1
     min_seed_lookahead: int = 1

@@ -1,0 +1,90 @@
+"""Operator tooling CLI (reference ``database_manager/`` + ``lcli/``):
+db version/inspect/compact and lcli root/ssz/skip-slot tools."""
+
+import json
+
+import pytest
+
+from lighthouse_tpu import cli
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.crypto.bls.backends import set_backend
+
+
+@pytest.fixture()
+def state_file(tmp_path):
+    set_backend("fake")
+    harness = BeaconChainHarness(validator_count=8, fake_crypto=True)
+    path = tmp_path / "state.ssz"
+    state = harness.chain.head_state
+    path.write_bytes(state.as_ssz_bytes())
+    yield str(path), state, harness
+    set_backend("host")
+
+
+def test_lcli_state_root(state_file, capsys):
+    path, state, harness = state_file
+    fork = type(state).fork_name
+    rc = cli.main(["lcli", "state-root", "--network", "minimal",
+                   "--fork", fork, path])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["root"] == "0x" + state.hash_tree_root().hex()
+
+
+def test_lcli_skip_slots(state_file, tmp_path, capsys):
+    path, state, harness = state_file
+    fork = type(state).fork_name
+    out_path = str(tmp_path / "post.ssz")
+    rc = cli.main(["lcli", "skip-slots", "--network", "minimal",
+                   "--fork", fork, "--pre-state", path,
+                   "--slots", "2", "--output", out_path])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["slots"] == 2
+    from lighthouse_tpu.types.containers import build_types
+
+    types = build_types(harness.spec.preset)
+    post = types.state[fork].from_ssz_bytes(open(out_path, "rb").read())
+    assert int(post.slot) == int(state.slot) + 2
+    assert "0x" + post.hash_tree_root().hex() == out["state_root"]
+
+
+def test_lcli_parse_ssz(state_file, capsys):
+    path, state, harness = state_file
+    block = harness.produce_signed_block(slot=harness.advance_slot())
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".ssz", delete=False) as f:
+        f.write(block.message.body.eth1_data.as_ssz_bytes())
+        p = f.name
+    rc = cli.main(["lcli", "parse-ssz", "--network", "minimal", "Eth1Data", p])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert "deposit_root" in out
+
+
+def test_db_manager_roundtrip(tmp_path, capsys):
+    from lighthouse_tpu.store.kv import DBColumn
+    from lighthouse_tpu.store.lockbox_store import LockboxStore
+
+    datadir = tmp_path / "node"
+    datadir.mkdir()
+    store = LockboxStore(str(datadir / "chain.db"))
+    import struct
+
+    store.put(DBColumn.BEACON_META, b"schema", struct.pack(">Q", 1))
+    store.put(DBColumn.BEACON_BLOCK, b"k" * 32, b"v")
+    store.close()
+
+    rc = cli.main(["db", "version", "--datadir", str(datadir)])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out.strip())["schema_version"] == 1
+
+    rc = cli.main(["db", "inspect", "--datadir", str(datadir)])
+    assert rc == 0
+    counts = json.loads(capsys.readouterr().out.strip())["keys_per_column"]
+    assert counts.get("BEACON_BLOCK") == 1
+
+    rc = cli.main(["db", "compact", "--datadir", str(datadir)])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out.strip())["compacted"] is True

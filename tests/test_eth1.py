@@ -1,0 +1,189 @@
+"""Eth1 deposit follower (reference ``beacon_node/eth1`` + ``genesis``):
+deposit cache proofs verify under the spec check, blocks carry required
+deposits that actually activate validators, eth1-data voting follows the
+period rules, and deposit-triggered genesis assembles a valid state."""
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.consensus import helpers as h
+from lighthouse_tpu.consensus.genesis import (
+    interop_secret_key,
+    interop_withdrawal_credentials,
+)
+from lighthouse_tpu.consensus.per_block import is_valid_merkle_branch
+from lighthouse_tpu.consensus.signature_sets import deposit_signature_message
+from lighthouse_tpu.crypto.bls.backends import set_backend
+from lighthouse_tpu.eth1 import DepositCache, Eth1GenesisService, Eth1Service
+from lighthouse_tpu.types.containers import build_types
+from lighthouse_tpu.types.spec import minimal_spec
+
+DEPOSIT_DEPTH = 32
+
+
+def _deposit_data(types, spec, index: int, amount=32_000_000_000):
+    sk = interop_secret_key(index)
+    pk = sk.public_key().to_bytes()
+    data = types.DepositData(
+        pubkey=pk,
+        withdrawal_credentials=interop_withdrawal_credentials(pk),
+        amount=amount,
+    )
+    root = deposit_signature_message(data, types, spec)
+    data.signature = sk.sign(root).to_bytes()
+    return data
+
+
+class MockEth1Provider:
+    """In-process provider: one eth1 block per deposit batch."""
+
+    def __init__(self, types, spec):
+        self.types = types
+        self.spec = spec
+        self._cache = DepositCache(types)
+        self.blocks = []
+
+    def add_deposits(self, datas, timestamp: int):
+        for d in datas:
+            self._cache.insert_log(len(self._cache), d)
+        self.blocks.append({
+            "number": len(self.blocks),
+            "hash": bytes([len(self.blocks) + 1]) * 32,
+            "timestamp": timestamp,
+            "deposit_count": len(self._cache),
+            "deposit_root": self._cache.deposit_root(),
+        })
+
+    def eth1_blocks(self):
+        return list(self.blocks)
+
+    def deposit_logs(self, start, end):
+        return self._cache._deposit_data[start:end]
+
+
+@pytest.fixture()
+def rig():
+    set_backend("host")
+    harness = BeaconChainHarness(validator_count=8, fake_crypto=False)
+    provider = MockEth1Provider(harness.types, harness.spec)
+    service = Eth1Service(provider=provider, types=harness.types, spec=harness.spec)
+    harness.chain.eth1_service = service
+    yield harness, provider, service
+    harness.chain.eth1_service = None
+    set_backend("host")
+
+
+def test_deposit_proofs_verify(rig):
+    harness, provider, service = rig
+    types, spec = harness.types, harness.spec
+    cache = DepositCache(types)
+    datas = [_deposit_data(types, spec, i) for i in range(5)]
+    for d in datas:
+        cache.insert_log(len(cache), d)
+    root = cache.deposit_root()
+    for i, dep in enumerate(cache.get_deposits(0, 5, 5)):
+        assert is_valid_merkle_branch(
+            dep.data.hash_tree_root(), dep.proof, DEPOSIT_DEPTH + 1, i, root
+        ), f"deposit {i} proof invalid under the spec check"
+
+
+def test_block_carries_deposits_and_activates_validator(rig):
+    """A new on-chain deposit flows: provider -> cache -> block -> state
+    (the validator registry grows)."""
+    harness, provider, service = rig
+    chain = harness.chain
+    types, spec = harness.types, harness.spec
+    n0 = len(chain.head_state.validators)
+
+    # the provider's deposit tree mirrors the chain: the 8 genesis deposits
+    # first (state.eth1_deposit_index is already past them), then a NEW 9th
+    # depositor appears on eth1
+    old_ts = int(chain.head_state.genesis_time) - \
+        spec.seconds_per_eth1_block * spec.eth1_follow_distance - 1000
+    provider.add_deposits(
+        [_deposit_data(types, spec, i) for i in range(n0)], timestamp=old_ts - 10
+    )
+    new_deposit = _deposit_data(types, spec, 100)
+    provider.add_deposits([new_deposit], timestamp=old_ts)
+    service.update()
+
+    # force the state's eth1_data to the provider's tip so the deposit
+    # becomes REQUIRED (the voting path is exercised separately below)
+    b = provider.blocks[-1]
+    slot = harness.advance_slot()
+    state, parent_root = chain.state_at_slot(slot)
+    state.eth1_data = types.Eth1Data(
+        deposit_root=b["deposit_root"], deposit_count=b["deposit_count"],
+        block_hash=b["hash"],
+    )
+    deposits = service.deposits_for_block(state)
+    assert len(deposits) == 1
+
+    from lighthouse_tpu.consensus.per_block import apply_deposit
+
+    apply_deposit(state, deposits[0], types, spec)
+    assert len(state.validators) == n0 + 1
+    assert bytes(state.validators[-1].pubkey) == bytes(new_deposit.pubkey)
+
+
+def test_eth1_vote_prefers_majority_then_latest(rig):
+    harness, provider, service = rig
+    types, spec = harness.types, harness.spec
+    state = harness.chain.head_state.copy()
+    period_start = service._voting_period_start_time(state)
+    in_window = period_start - spec.seconds_per_eth1_block * spec.eth1_follow_distance - 10
+    # candidates must carry at least the state's deposit_count (8 at genesis)
+    provider.add_deposits(
+        [_deposit_data(types, spec, i) for i in range(8)], timestamp=in_window
+    )
+    provider.add_deposits([], timestamp=in_window + 1)
+    service.update()
+
+    # no ballots yet: newest in-window candidate wins
+    vote = service.eth1_vote(state)
+    assert bytes(vote.block_hash) == provider.blocks[-1]["hash"]
+
+    # ballots for the OLDER candidate dominate: majority wins
+    older = provider.blocks[-2]
+    state.eth1_data_votes = [
+        types.Eth1Data(deposit_root=older["deposit_root"],
+                       deposit_count=older["deposit_count"],
+                       block_hash=older["hash"])
+    ] * 3
+    vote = service.eth1_vote(state)
+    assert bytes(vote.block_hash) == older["hash"]
+
+    # out-of-window junk ballots are ignored
+    state.eth1_data_votes = [
+        types.Eth1Data(deposit_root=b"\x77" * 32, deposit_count=99,
+                       block_hash=b"\x88" * 32)
+    ] * 5
+    vote = service.eth1_vote(state)
+    assert bytes(vote.block_hash) == provider.blocks[-1]["hash"]
+
+
+def test_deposit_triggered_genesis():
+    set_backend("host")
+    spec = minimal_spec(altair_fork_epoch=0, bellatrix_fork_epoch=0,
+                        capella_fork_epoch=0, deneb_fork_epoch=None)
+    spec.min_genesis_active_validator_count = 4
+    spec.min_genesis_time = 1_500_000_000
+    types = build_types(spec.preset)
+    provider = MockEth1Provider(types, spec)
+    svc = Eth1GenesisService(provider=provider, types=types, spec=spec)
+
+    assert svc.try_genesis() is None  # no deposits yet
+    provider.add_deposits(
+        [_deposit_data(types, spec, i) for i in range(3)],
+        timestamp=1_500_000_100,
+    )
+    assert svc.try_genesis() is None  # below the minimum count
+    provider.add_deposits(
+        [_deposit_data(types, spec, 3)], timestamp=1_500_000_200
+    )
+    state = svc.try_genesis()
+    assert state is not None
+    assert len(state.validators) == 4
+    assert int(state.genesis_time) == 1_500_000_200 + spec.genesis_delay
+    # deposit root in the genesis eth1_data matches the cache
+    assert bytes(state.eth1_data.deposit_root) == provider._cache.deposit_root()
