@@ -325,6 +325,9 @@ class BeaconChain:
         self.lc_cache = LightClientServerCache(types, spec)
         self.builder = None  # external MEV relay client (set by the builder)
         self.eth1_service = None  # deposit follower + eth1 voting (optional)
+        from .validator_monitor import ValidatorMonitor
+
+        self.validator_monitor = ValidatorMonitor(spec)
         self.builder_pubkey = None  # operator-pinned relay identity (optional)
         self.op_pool = OperationPool()
         self.observed_block_roots: set = set()
@@ -403,6 +406,10 @@ class BeaconChain:
                 f"head state for {self.head_root.hex()[:16]} missing from cache and store"
             )
         return state
+
+    def head_slot(self) -> int:
+        """Slot of the current head block (the notifier/monitoring figure)."""
+        return self._blocks_slot(self.head_root)
 
     def current_slot(self) -> int:
         now = self.slot_clock.now()
@@ -529,6 +536,9 @@ class BeaconChain:
         for att in block.body.attestations:
             try:
                 indexed = h.get_indexed_attestation(state, att, self.types, self.spec)
+                self.validator_monitor.on_attestation_included(
+                    int(att.data.target.epoch), indexed.attesting_indices
+                )
                 self.fork_choice.on_attestation(
                     current_slot=current_slot,
                     attestation_slot=int(att.data.slot),
@@ -540,6 +550,9 @@ class BeaconChain:
                 )
             except InvalidAttestation:
                 continue  # attestations for unknown forks don't block import
+        self.validator_monitor.on_block_imported(
+            int(block.slot), int(block.proposer_index)
+        )
 
         with metrics.BLOCK_FORK_CHOICE_SECONDS.time():
             self.recompute_head()
@@ -1336,6 +1349,7 @@ class BeaconChain:
         self.op_pool.prune(self.head_state, self.spec, current_slot=slot)
         self.observed.prune(self.fork_choice.finalized_checkpoint[0],
                             self.spec.slots_per_epoch)
+        self.validator_monitor.prune(slot // self.spec.slots_per_epoch)
         f_slot = self.fork_choice.finalized_checkpoint[0] * self.spec.slots_per_epoch
         self.da_checker.prune(f_slot)
         # Blob retention horizon (spec MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS):

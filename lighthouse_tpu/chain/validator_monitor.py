@@ -1,0 +1,98 @@
+"""Per-validator performance monitor.
+
+Equivalent of the reference's ``beacon_chain/src/validator_monitor.rs``
+(2.1k LoC): operators register the indices they care about; the monitor
+watches on-chain inclusion (did my validator's attestation land in a block?
+did my proposal land?), keeps per-epoch hit/miss state, and surfaces both a
+summary (the notifier line / ``/lighthouse/ui/validator_metrics`` analog)
+and Prometheus series.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Set
+
+from .. import metrics
+
+MONITOR_HISTORY_EPOCHS = 16
+
+MONITORED_ATTESTATION_HITS = metrics.counter(
+    "validator_monitor_attestation_included_total",
+    "on-chain attestation inclusions for monitored validators",
+)
+MONITORED_BLOCKS = metrics.counter(
+    "validator_monitor_blocks_proposed_total",
+    "on-chain proposals by monitored validators",
+)
+MONITORED_COUNT = metrics.gauge(
+    "validator_monitor_validators", "number of monitored validators",
+)
+
+
+class ValidatorMonitor:
+    def __init__(self, spec):
+        self.spec = spec
+        self.monitored: Set[int] = set()
+        self._lock = threading.Lock()
+        # target epoch -> monitored validators whose attestation was included
+        self._included: Dict[int, Set[int]] = {}
+        # slot -> monitored proposer
+        self._proposed: Dict[int, int] = {}
+
+    def register(self, indices: Iterable[int]) -> None:
+        with self._lock:
+            self.monitored.update(int(i) for i in indices)
+            MONITORED_COUNT.set(len(self.monitored))
+
+    # ------------------------------------------------------------- feeding
+
+    def on_attestation_included(self, target_epoch: int,
+                                attesting_indices: Iterable[int]) -> None:
+        """Called per attestation in an imported block."""
+        if not self.monitored:
+            return
+        hits = self.monitored.intersection(int(i) for i in attesting_indices)
+        if not hits:
+            return
+        with self._lock:
+            seen = self._included.setdefault(int(target_epoch), set())
+            new = hits - seen
+            seen.update(new)
+        if new:
+            MONITORED_ATTESTATION_HITS.inc(len(new))
+
+    def on_block_imported(self, slot: int, proposer_index: int) -> None:
+        if int(proposer_index) in self.monitored:
+            with self._lock:
+                self._proposed[int(slot)] = int(proposer_index)
+            MONITORED_BLOCKS.inc()
+
+    # ------------------------------------------------------------- queries
+
+    def summary(self, epoch: int) -> dict:
+        """Hit/miss summary for ``epoch`` (meaningful once epoch+1 ends —
+        inclusion can lag a full epoch)."""
+        with self._lock:
+            included = sorted(self._included.get(int(epoch), set()))
+            missed = sorted(self.monitored.difference(included))
+            proposals = sorted(
+                s for s, p in self._proposed.items()
+                if s // self.spec.slots_per_epoch == int(epoch)
+            )
+        return {
+            "epoch": int(epoch),
+            "monitored": len(self.monitored),
+            "attestation_included": included,
+            "attestation_missed": missed,
+            "proposal_slots": proposals,
+        }
+
+    def prune(self, current_epoch: int) -> None:
+        cutoff = int(current_epoch) - MONITOR_HISTORY_EPOCHS
+        with self._lock:
+            for e in [e for e in self._included if e < cutoff]:
+                del self._included[e]
+            slot_cutoff = cutoff * self.spec.slots_per_epoch
+            for s in [s for s in self._proposed if s < slot_cutoff]:
+                del self._proposed[s]

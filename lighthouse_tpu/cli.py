@@ -44,7 +44,12 @@ def run_beacon_node(args) -> int:
         level=logging.DEBUG if args.debug else logging.INFO,
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
     )
-    spec = _spec_for(args.network)
+    if getattr(args, "testnet_dir", None):
+        from .network_config import Eth2NetworkConfig
+
+        spec = Eth2NetworkConfig.from_testnet_dir(args.testnet_dir).spec
+    else:
+        spec = _spec_for(args.network)
     builder = ClientBuilder().with_spec(spec).with_bls_backend(args.bls_backend)
     if args.interop_validators:
         builder.with_interop_genesis(
@@ -71,6 +76,8 @@ def run_beacon_node(args) -> int:
     builder.with_http_api(args.http_port)
     if args.slasher:
         builder.with_slasher()
+    if getattr(args, "monitoring_endpoint", None):
+        builder.with_monitoring(args.monitoring_endpoint)
 
     client = builder.build().start()
     print(f"beacon node up: http API on :{args.http_port}, "
@@ -306,6 +313,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     bn = sub.add_parser("beacon_node", aliases=["bn"], help="run a beacon node")
     bn.add_argument("--network", default="mainnet")
+    bn.add_argument("--testnet-dir", default=None,
+                    help="directory holding a config.yaml network definition")
+    bn.add_argument("--monitoring-endpoint", default=None,
+                    help="push node stats to this client-stats URL every 60s")
     bn.add_argument("--datadir", default=None)
     bn.add_argument("--http-port", type=int, default=5052)
     bn.add_argument("--execution-endpoint", default=None)

@@ -79,6 +79,14 @@ class ClientBuilder:
         self._http_port = port
         return self
 
+    def with_monitoring(self, endpoint: str,
+                        update_period: float = 60.0) -> "ClientBuilder":
+        """Push node stats to a remote client-stats endpoint (reference
+        ``common/monitoring_api`` / the --monitoring-endpoint flag)."""
+        self._monitoring_endpoint = endpoint
+        self._monitoring_period = update_period
+        return self
+
     def with_slasher(self, enabled: bool = True) -> "ClientBuilder":
         self._slasher = enabled
         return self
@@ -149,8 +157,17 @@ class ClientBuilder:
             from ..http_api import HttpApiServer
 
             http_server = HttpApiServer(chain, processor=processor, port=self._http_port)
+        monitoring = None
+        if getattr(self, "_monitoring_endpoint", None):
+            from ..monitoring import MonitoringService
+
+            monitoring = MonitoringService(
+                endpoint=self._monitoring_endpoint, chain=chain,
+                update_period=getattr(self, "_monitoring_period", 60.0),
+            )
         return Client(
-            chain=chain, processor=processor, http_server=http_server, slasher=slasher
+            chain=chain, processor=processor, http_server=http_server,
+            slasher=slasher, monitoring=monitoring,
         )
 
 
@@ -158,11 +175,13 @@ class Client:
     """The assembled node: owns the service threads and their shutdown
     (task_executor semantics — every service stops on ``stop()``)."""
 
-    def __init__(self, *, chain, processor, http_server=None, slasher=None):
+    def __init__(self, *, chain, processor, http_server=None, slasher=None,
+                 monitoring=None):
         self.chain = chain
         self.processor = processor
         self.http_server = http_server
         self.slasher = slasher
+        self.monitoring = monitoring
         self._shutdown = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -171,6 +190,8 @@ class Client:
     def start(self) -> "Client":
         if self.http_server is not None:
             self.http_server.start()
+        if self.monitoring is not None:
+            self.monitoring.start()
         timer = threading.Thread(target=self._slot_timer, name="slot-timer", daemon=True)
         timer.start()
         self._threads.append(timer)
@@ -195,7 +216,7 @@ class Client:
     def _notify(self) -> None:
         chain = self.chain
         slot = chain.current_slot()
-        head_slot = chain._blocks_slot(chain.head_root)
+        head_slot = chain.head_slot()
         f_epoch, _ = chain.finalized_checkpoint()
         distance = max(0, slot - head_slot)
         status = "synced" if distance <= 1 else f"behind ({distance} slots)"
@@ -206,6 +227,8 @@ class Client:
 
     def stop(self) -> None:
         self._shutdown.set()
+        if self.monitoring is not None:
+            self.monitoring.stop()
         if self.http_server is not None:
             self.http_server.stop()
         self.processor.shutdown()

@@ -562,6 +562,29 @@ def process_bls_to_execution_change(state, signed_change, types, spec: ChainSpec
 # --------------------------------------------------------- sync aggregate
 
 
+def sync_participant_reward(state, spec: ChainSpec) -> int:
+    """Spec per-participant sync reward — the ONE definition shared by the
+    transition and the rewards APIs (chain/rewards.py)."""
+    total_active_increments = (
+        h.get_total_active_balance(state, spec) // spec.effective_balance_increment
+    )
+    total_base_rewards = (
+        h.get_base_reward_per_increment(state, spec) * total_active_increments
+    )
+    max_participant_rewards = (
+        total_base_rewards * SYNC_REWARD_WEIGHT // WEIGHT_DENOMINATOR
+        // spec.slots_per_epoch
+    )
+    return max_participant_rewards // spec.preset.sync_committee_size
+
+
+def sync_proposer_reward_per_bit(state, spec: ChainSpec) -> int:
+    return (
+        sync_participant_reward(state, spec) * PROPOSER_WEIGHT
+        // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+    )
+
+
 def process_sync_aggregate(state, aggregate, slot: int, spec: ChainSpec, verify: bool) -> None:
     if verify:
         s = sets.sync_aggregate_signature_set(state, aggregate, slot, None, spec)
@@ -572,17 +595,8 @@ def process_sync_aggregate(state, aggregate, slot: int, spec: ChainSpec, verify:
         elif not s.verify():
             raise BlockProcessingError("sync aggregate: bad signature")
 
-    total_active_increments = (
-        h.get_total_active_balance(state, spec) // spec.effective_balance_increment
-    )
-    total_base_rewards = h.get_base_reward_per_increment(state, spec) * total_active_increments
-    max_participant_rewards = (
-        total_base_rewards * SYNC_REWARD_WEIGHT // WEIGHT_DENOMINATOR // spec.slots_per_epoch
-    )
-    participant_reward = max_participant_rewards // spec.preset.sync_committee_size
-    proposer_reward = (
-        participant_reward * PROPOSER_WEIGHT // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
-    )
+    participant_reward = sync_participant_reward(state, spec)
+    proposer_reward = sync_proposer_reward_per_bit(state, spec)
     proposer_index = h.get_beacon_proposer_index(state, spec)
     index_map = _pubkey_index_map(state)
     for i, bit in enumerate(aggregate.sync_committee_bits):

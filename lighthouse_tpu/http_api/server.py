@@ -1077,6 +1077,105 @@ def prepare_proposer(ctx):
 # ------------------------------------------------------------ config routes
 
 
+def _validator_indices(state, raw_ids):
+    """Beacon-API validator ids: indices or 0x-pubkeys -> index list (400 on
+    junk), None when the body is empty (= all validators)."""
+    if not raw_ids:
+        return None
+    out = []
+    pk_to_idx = None
+    for item in raw_ids:
+        s = str(item)
+        if s.startswith("0x"):
+            if pk_to_idx is None:
+                pk_to_idx = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
+            try:
+                idx = pk_to_idx.get(bytes.fromhex(s[2:]))
+            except ValueError:
+                raise _bad(f"invalid pubkey {s!r}")
+            if idx is None:
+                raise _bad(f"unknown validator {s!r}")
+            out.append(idx)
+        else:
+            try:
+                idx = int(s)
+            except ValueError:
+                raise _bad(f"invalid validator id {s!r}")
+            if not (0 <= idx < len(state.validators)):
+                raise _bad(f"unknown validator index {idx}")
+            out.append(idx)
+    return out
+
+
+@route("POST", "/eth/v1/beacon/rewards/attestations/{epoch}", P1)
+def rewards_attestations(ctx):
+    """Attestation rewards for ``epoch`` (reference attestation_rewards.rs):
+    computed on a state in epoch+1, whose previous-epoch participation IS
+    epoch's."""
+    from ..chain import rewards as rewards_mod
+
+    chain = ctx.chain
+    epoch = int(ctx.params["epoch"])
+    spe = chain.spec.slots_per_epoch
+    # Rewards for E need previous-epoch participation of E INCLUDING late
+    # inclusions, i.e. the state at the END of epoch E+1 (reference
+    # attestation_rewards.rs); resolve_state serves historical slots too.
+    target_slot = min((epoch + 2) * spe - 1,
+                      max(int(chain.head_state.slot), (epoch + 1) * spe))
+    state, _ = ctx.resolve_state(str(target_slot))
+    ids = _validator_indices(state, ctx.body)
+    try:
+        data = rewards_mod.attestation_rewards(state, chain.spec, ids)
+    except ValueError as e:
+        raise _bad(str(e))
+    return {"execution_optimistic": False, "finalized": False, "data": data}
+
+
+@route("GET", "/eth/v1/beacon/rewards/blocks/{block_id}", P1)
+def rewards_blocks(ctx):
+    from ..chain import rewards as rewards_mod
+
+    root = ctx.resolve_block_root(ctx.params["block_id"])
+    data = rewards_mod.block_rewards(ctx.chain, root)
+    if data is None:
+        raise _not_found("block or its states unavailable")
+    return {"execution_optimistic": False, "finalized": False, "data": data}
+
+
+@route("POST", "/eth/v1/beacon/rewards/sync_committee/{block_id}", P1)
+def rewards_sync_committee(ctx):
+    from ..chain import rewards as rewards_mod
+    from ..consensus.per_slot import process_slots
+
+    chain = ctx.chain
+    root = ctx.resolve_block_root(ctx.params["block_id"])
+    block = chain.get_block(root)
+    if block is None:
+        raise _not_found("unknown block")
+    pre = chain.get_state(bytes(block.message.parent_root))
+    if pre is None:
+        raise _not_found("parent state unavailable")
+    pre = pre.copy()
+    if int(pre.slot) < int(block.message.slot):
+        pre = process_slots(pre, int(block.message.slot), chain.types, chain.spec)
+    ids = _validator_indices(pre, ctx.body)
+    data = rewards_mod.sync_committee_rewards(pre, block, chain.spec, ids)
+    return {"execution_optimistic": False, "finalized": False, "data": data}
+
+
+@route("POST", "/lighthouse/ui/validator_monitor", P1)
+def validator_monitor_register(ctx):
+    """Register validator indices with the monitor (reference:
+    --validator-monitor flags + the lighthouse UI endpoints)."""
+    ctx.chain.validator_monitor.register(int(i) for i in (ctx.body or []))
+    return None
+
+
+@route("GET", "/lighthouse/ui/validator_monitor/{epoch}", P1)
+def validator_monitor_summary(ctx):
+    return {"data": ctx.chain.validator_monitor.summary(int(ctx.params["epoch"]))}
+
+
 @route("GET", "/eth/v1/beacon/light_client/bootstrap/{block_root}")
 def lc_bootstrap(ctx):
     root = bytes.fromhex(ctx.params["block_root"][2:])

@@ -1,0 +1,85 @@
+"""Remote monitoring push service.
+
+Equivalent of the reference's ``common/monitoring_api`` (605 LoC;
+``src/lib.rs:18-19`` — POST process/beacon-node stats to a beaconcha.in-style
+client-stats endpoint every 60 s).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Callable, Optional
+
+from .. import metrics
+
+DEFAULT_UPDATE_PERIOD_SECS = 60.0
+CLIENT_NAME = "lighthouse-tpu"
+
+
+def collect_beacon_stats(chain) -> dict:
+    """The beaconcha.in client-stats "beaconnode" process payload."""
+    f_epoch, _ = chain.finalized_checkpoint()
+    head_slot = chain.head_slot()
+    return {
+        "version": 1,
+        "timestamp": int(time.time() * 1000),
+        "process": "beaconnode",
+        "client_name": CLIENT_NAME,
+        "sync_beacon_head_slot": int(head_slot),
+        "sync_eth2_synced": True,
+        "slasher_active": False,
+        "finalized_epoch": int(f_epoch),
+        "signature_sets_verified": int(metrics.SIGNATURE_SETS_VERIFIED.get()),
+        "device_batches": int(metrics.DEVICE_BATCH_INVOCATIONS.get()),
+    }
+
+
+class MonitoringService:
+    """Periodic POST of node stats to ``endpoint`` (the reference's
+    ``monitoring-endpoint`` flag)."""
+
+    def __init__(self, *, endpoint: str, chain,
+                 update_period: float = DEFAULT_UPDATE_PERIOD_SECS,
+                 collector: Optional[Callable[[object], dict]] = None):
+        self.endpoint = endpoint.rstrip("/")
+        self.chain = chain
+        self.update_period = update_period
+        self.collector = collector or collect_beacon_stats
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_error: Optional[str] = None
+        self.sends = 0
+
+    def send_once(self) -> bool:
+        body = json.dumps([self.collector(self.chain)]).encode()
+        req = urllib.request.Request(
+            self.endpoint, data=body, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5.0):
+                pass
+            self.sends += 1
+            self.last_error = None
+            return True
+        except OSError as e:
+            # monitoring must never hurt the node: record and carry on
+            self.last_error = str(e)
+            return False
+
+    def start(self) -> "MonitoringService":
+        def loop():
+            while not self._stop.wait(self.update_period):
+                self.send_once()
+
+        self._thread = threading.Thread(target=loop, name="monitoring", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

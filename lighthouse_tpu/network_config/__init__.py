@@ -1,0 +1,179 @@
+"""Embedded network configurations + YAML config loading.
+
+Equivalent of the reference's ``common/eth2_network_config`` (embedded
+mainnet/testnet ``config.yaml`` + bootnodes, built from
+``eth2_config::Eth2Config``) and the runtime-YAML side of ``ChainSpec``
+(`consensus/types/src/chain_spec.rs` ``from_yaml``): a node can boot from
+`--network mainnet|minimal` (embedded) or ``--testnet-dir`` holding a spec
+``config.yaml``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import yaml
+
+from ..types.spec import MAINNET_PRESET, MINIMAL_PRESET, ChainSpec, minimal_spec
+
+# YAML key (consensus-specs configs/*.yaml) -> ChainSpec field
+_YAML_FIELDS = {
+    "SECONDS_PER_SLOT": ("seconds_per_slot", int),
+    "GENESIS_DELAY": ("genesis_delay", int),
+    "MIN_GENESIS_TIME": ("min_genesis_time", int),
+    "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT": ("min_genesis_active_validator_count", int),
+    "ETH1_FOLLOW_DISTANCE": ("eth1_follow_distance", int),
+    "SECONDS_PER_ETH1_BLOCK": ("seconds_per_eth1_block", int),
+    "GENESIS_FORK_VERSION": ("genesis_fork_version", bytes),
+    "ALTAIR_FORK_VERSION": ("altair_fork_version", bytes),
+    "ALTAIR_FORK_EPOCH": ("altair_fork_epoch", int),
+    "BELLATRIX_FORK_VERSION": ("bellatrix_fork_version", bytes),
+    "BELLATRIX_FORK_EPOCH": ("bellatrix_fork_epoch", int),
+    "CAPELLA_FORK_VERSION": ("capella_fork_version", bytes),
+    "CAPELLA_FORK_EPOCH": ("capella_fork_epoch", int),
+    "DENEB_FORK_VERSION": ("deneb_fork_version", bytes),
+    "DENEB_FORK_EPOCH": ("deneb_fork_epoch", int),
+    "ELECTRA_FORK_VERSION": ("electra_fork_version", bytes),
+    "ELECTRA_FORK_EPOCH": ("electra_fork_epoch", int),
+    "CHURN_LIMIT_QUOTIENT": ("churn_limit_quotient", int),
+    "SHARD_COMMITTEE_PERIOD": ("shard_committee_period", int),
+    "MIN_VALIDATOR_WITHDRAWABILITY_DELAY": ("min_validator_withdrawability_delay", int),
+}
+
+FAR_FUTURE_EPOCH_YAML = 2**64 - 1
+
+
+def spec_from_yaml(text: str) -> ChainSpec:
+    """Build a ``ChainSpec`` from a consensus-specs ``config.yaml``
+    (reference ``ChainSpec::from_yaml``).  Unknown keys are ignored (the
+    spec config files carry many constants the preset already fixes)."""
+    obj = yaml.safe_load(text) or {}
+    preset_base = str(obj.get("PRESET_BASE", "mainnet")).strip("'\"")
+    preset = MINIMAL_PRESET if preset_base == "minimal" else MAINNET_PRESET
+    base = (
+        minimal_spec() if preset_base == "minimal"
+        else ChainSpec(preset=preset, config_name=str(obj.get("CONFIG_NAME", preset_base)))
+    )
+    overrides = {}
+    for key, (field, conv) in _YAML_FIELDS.items():
+        if key not in obj:
+            continue
+        raw = obj[key]
+        if conv is bytes:
+            if isinstance(raw, int):
+                # yaml parses 0x-prefixed scalars as integers
+                overrides[field] = raw.to_bytes(4, "big")
+            else:
+                s = str(raw)
+                overrides[field] = bytes.fromhex(s[2:] if s.startswith("0x") else s)
+        else:
+            value = int(raw)
+            if field.endswith("_fork_epoch") and value == FAR_FUTURE_EPOCH_YAML:
+                overrides[field] = None  # not scheduled
+            else:
+                overrides[field] = value
+    overrides["config_name"] = str(obj.get("CONFIG_NAME", base.config_name))
+    return dataclasses.replace(base, **overrides)
+
+
+def spec_to_yaml(spec: ChainSpec) -> str:
+    """Round-trip serialization (the ``/eth/v1/config/spec`` subset the
+    reference writes back out)."""
+    lines = [f"PRESET_BASE: '{'minimal' if spec.preset is MINIMAL_PRESET else 'mainnet'}'",
+             f"CONFIG_NAME: '{spec.config_name}'"]
+    for key, (field, conv) in _YAML_FIELDS.items():
+        value = getattr(spec, field)
+        if conv is bytes:
+            lines.append(f"{key}: 0x{value.hex()}")
+        elif value is None:
+            lines.append(f"{key}: {FAR_FUTURE_EPOCH_YAML}")
+        else:
+            lines.append(f"{key}: {value}")
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------- embedded network presets
+# The reference embeds config+genesis+bootnodes per supported network
+# (common/eth2_network_config/built_in_network_configs).  Genesis states are
+# fetched via checkpoint sync in this stack; configs + bootnodes embed here.
+
+_MAINNET_CONFIG_YAML = """
+PRESET_BASE: 'mainnet'
+CONFIG_NAME: 'mainnet'
+MIN_GENESIS_ACTIVE_VALIDATOR_COUNT: 16384
+MIN_GENESIS_TIME: 1606824000
+GENESIS_FORK_VERSION: 0x00000000
+GENESIS_DELAY: 604800
+ALTAIR_FORK_VERSION: 0x01000000
+ALTAIR_FORK_EPOCH: 74240
+BELLATRIX_FORK_VERSION: 0x02000000
+BELLATRIX_FORK_EPOCH: 144896
+CAPELLA_FORK_VERSION: 0x03000000
+CAPELLA_FORK_EPOCH: 194048
+DENEB_FORK_VERSION: 0x04000000
+DENEB_FORK_EPOCH: 269568
+ELECTRA_FORK_VERSION: 0x05000000
+ELECTRA_FORK_EPOCH: 18446744073709551615
+SECONDS_PER_SLOT: 12
+SECONDS_PER_ETH1_BLOCK: 14
+MIN_VALIDATOR_WITHDRAWABILITY_DELAY: 256
+SHARD_COMMITTEE_PERIOD: 256
+ETH1_FOLLOW_DISTANCE: 2048
+CHURN_LIMIT_QUOTIENT: 65536
+"""
+
+_MINIMAL_CONFIG_YAML = """
+PRESET_BASE: 'minimal'
+CONFIG_NAME: 'minimal'
+MIN_GENESIS_ACTIVE_VALIDATOR_COUNT: 64
+MIN_GENESIS_TIME: 1578009600
+GENESIS_FORK_VERSION: 0x00000001
+GENESIS_DELAY: 300
+SECONDS_PER_SLOT: 6
+SECONDS_PER_ETH1_BLOCK: 14
+ETH1_FOLLOW_DISTANCE: 16
+CHURN_LIMIT_QUOTIENT: 32
+SHARD_COMMITTEE_PERIOD: 64
+MIN_VALIDATOR_WITHDRAWABILITY_DELAY: 256
+"""
+
+EMBEDDED_CONFIGS: Dict[str, str] = {
+    "mainnet": _MAINNET_CONFIG_YAML,
+    "minimal": _MINIMAL_CONFIG_YAML,
+}
+
+# libp2p-era ENR bootnodes would go here; this stack's transport dials
+# host:port peers directly (CLI --peer), so bootnodes are (host, port) pairs.
+EMBEDDED_BOOTNODES: Dict[str, List[str]] = {
+    "mainnet": [],
+    "minimal": [],
+}
+
+
+class Eth2NetworkConfig:
+    """A network bundle (reference ``Eth2NetworkConfig``): spec + bootnodes,
+    from an embedded preset or a testnet directory."""
+
+    def __init__(self, spec: ChainSpec, bootnodes: Optional[List[str]] = None):
+        self.spec = spec
+        self.bootnodes = list(bootnodes or [])
+
+    @classmethod
+    def constant(cls, name: str) -> "Eth2NetworkConfig":
+        if name not in EMBEDDED_CONFIGS:
+            raise KeyError(f"unknown network {name!r} (have: {sorted(EMBEDDED_CONFIGS)})")
+        return cls(spec_from_yaml(EMBEDDED_CONFIGS[name]),
+                   EMBEDDED_BOOTNODES.get(name, []))
+
+    @classmethod
+    def from_testnet_dir(cls, path: str) -> "Eth2NetworkConfig":
+        import os
+
+        with open(os.path.join(path, "config.yaml")) as f:
+            spec = spec_from_yaml(f.read())
+        bootnodes: List[str] = []
+        boot_path = os.path.join(path, "boot_enr.yaml")
+        if os.path.exists(boot_path):
+            bootnodes = [str(b) for b in (yaml.safe_load(open(boot_path)) or [])]
+        return cls(spec, bootnodes)

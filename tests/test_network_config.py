@@ -1,0 +1,109 @@
+"""Embedded network configs + YAML spec loading (reference
+``common/eth2_network_config`` / ``ChainSpec::from_yaml``) and the remote
+monitoring push service (``common/monitoring_api``)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from lighthouse_tpu.network_config import (
+    EMBEDDED_CONFIGS,
+    Eth2NetworkConfig,
+    spec_from_yaml,
+    spec_to_yaml,
+)
+
+
+def test_embedded_mainnet_matches_known_schedule():
+    cfg = Eth2NetworkConfig.constant("mainnet")
+    spec = cfg.spec
+    assert spec.seconds_per_slot == 12
+    assert spec.altair_fork_epoch == 74240
+    assert spec.capella_fork_epoch == 194048
+    assert spec.deneb_fork_version == bytes.fromhex("04000000")
+    assert spec.electra_fork_epoch is None  # FAR_FUTURE in the config
+    assert spec.preset.sync_committee_size == 512
+
+
+def test_embedded_minimal():
+    spec = Eth2NetworkConfig.constant("minimal").spec
+    assert spec.seconds_per_slot == 6
+    assert spec.preset.sync_committee_size == 32
+    assert spec.min_genesis_active_validator_count == 64
+
+
+def test_yaml_round_trip():
+    spec = Eth2NetworkConfig.constant("mainnet").spec
+    text = spec_to_yaml(spec)
+    spec2 = spec_from_yaml(text)
+    assert spec2.altair_fork_epoch == spec.altair_fork_epoch
+    assert spec2.deneb_fork_version == spec.deneb_fork_version
+    assert spec2.electra_fork_epoch is None
+    assert spec2.seconds_per_slot == spec.seconds_per_slot
+
+
+def test_testnet_dir_loading(tmp_path):
+    (tmp_path / "config.yaml").write_text(
+        "PRESET_BASE: 'minimal'\nCONFIG_NAME: 'devnet-7'\n"
+        "SECONDS_PER_SLOT: 3\nALTAIR_FORK_EPOCH: 1\n"
+        "ALTAIR_FORK_VERSION: 0x01000099\n"
+    )
+    (tmp_path / "boot_enr.yaml").write_text("- 127.0.0.1:9000\n")
+    cfg = Eth2NetworkConfig.from_testnet_dir(str(tmp_path))
+    assert cfg.spec.config_name == "devnet-7"
+    assert cfg.spec.seconds_per_slot == 3
+    assert cfg.spec.altair_fork_version == bytes.fromhex("01000099")
+    assert cfg.bootnodes == ["127.0.0.1:9000"]
+
+
+def test_unknown_network_rejected():
+    with pytest.raises(KeyError):
+        Eth2NetworkConfig.constant("nonet")
+
+
+# --------------------------------------------------------------- monitoring
+
+
+def test_monitoring_service_pushes_stats():
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+    from lighthouse_tpu.monitoring import MonitoringService
+
+    received = []
+
+    class Sink(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            received.append(json.loads(self.rfile.read(length)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    set_backend("fake")
+    try:
+        harness = BeaconChainHarness(validator_count=8, fake_crypto=True)
+        harness.extend_chain(2)
+        url = f"http://127.0.0.1:{server.server_address[1]}/api/v1/client/metrics"
+        svc = MonitoringService(endpoint=url, chain=harness.chain)
+        assert svc.send_once()
+        assert svc.sends == 1
+        payload = received[0][0]
+        assert payload["process"] == "beaconnode"
+        assert payload["sync_beacon_head_slot"] == 2
+        # a dead endpoint must not raise
+        svc_dead = MonitoringService(
+            endpoint="http://127.0.0.1:1/nothing", chain=harness.chain
+        )
+        assert not svc_dead.send_once()
+        assert svc_dead.last_error
+    finally:
+        set_backend("host")
+        server.shutdown()
+        server.server_close()
