@@ -754,12 +754,10 @@ class BeaconChain:
             if bytes(p) == pk
         ]
 
-    def process_sync_committee_message(self, msg) -> None:
-        """Verify and pool one ``SyncCommitteeMessage`` (reference
-        ``sync_committee_verification.rs`` gossip checks: committee
-        membership + signature over the block root)."""
+    def _preverify_sync_message(self, msg, state):
+        """Spec checks + signature-set construction for one message; the
+        batch entry point verifies many sets in ONE backend call."""
         from ..consensus import signature_sets as sets
-        from ..crypto.bls import api as bls
 
         current_slot = self.current_slot()
         if not (current_slot - 1 <= int(msg.slot) <= current_slot + 1):
@@ -769,7 +767,6 @@ class BeaconChain:
             raise AttestationError(
                 f"sync message slot {msg.slot} outside the current-slot window"
             )
-        state = self.head_state
         vidx = int(msg.validator_index)
         if vidx >= len(state.validators):
             raise AttestationError("sync message validator index out of range")
@@ -780,8 +777,9 @@ class BeaconChain:
             state, vidx, bytes(msg.beacon_block_root), int(msg.slot),
             msg.signature, self.spec,
         )
-        if not bls.verify_signature_sets([sig_set]):
-            raise AttestationError("bad sync committee message signature")
+        return positions, sig_set
+
+    def _pool_sync_message(self, msg, positions) -> None:
         sub_size = self.sync_contribution_pool._sub_size()
         for pos in positions:
             self.sync_contribution_pool.insert_signature(
@@ -789,12 +787,58 @@ class BeaconChain:
                 pos // sub_size, pos % sub_size, bytes(msg.signature),
             )
 
-    def process_signed_contribution(self, signed_contribution) -> None:
-        """Verify and pool a ``SignedContributionAndProof`` — the full gossip
+    def process_sync_committee_message(self, msg) -> None:
+        """Verify and pool one ``SyncCommitteeMessage`` (reference
+        ``sync_committee_verification.rs`` gossip checks: committee
+        membership + signature over the block root)."""
+        from ..crypto.bls import api as bls
+
+        positions, sig_set = self._preverify_sync_message(msg, self.head_state)
+        if not bls.verify_signature_sets([sig_set]):
+            raise AttestationError("bad sync committee message signature")
+        self._pool_sync_message(msg, positions)
+
+    def process_sync_committee_messages(self, messages) -> List[Optional[str]]:
+        """Batch path (the POST pool/sync_committees route): all signature
+        sets verify in ONE backend call — the reference coalesces sync
+        messages through the processor the same way as attestations; on a
+        batch failure, fall back per item.  Returns one error string or
+        None per message."""
+        from ..crypto.bls import api as bls
+
+        state = self.head_state
+        prepared = []
+        results: List[Optional[str]] = []
+        for msg in messages:
+            try:
+                positions, sig_set = self._preverify_sync_message(msg, state)
+                prepared.append((msg, positions, sig_set))
+                results.append(None)
+            except AttestationError as e:
+                prepared.append(None)
+                results.append(str(e))
+        live = [p for p in prepared if p is not None]
+        if not live:
+            return results
+        batch_ok = bls.verify_signature_sets([p[2] for p in live])
+        for i, p in enumerate(prepared):
+            if p is None:
+                continue
+            msg, positions, sig_set = p
+            ok = batch_ok or bls.verify_signature_sets([sig_set])
+            if not ok:
+                results[i] = "bad sync committee message signature"
+                continue
+            self._pool_sync_message(msg, positions)
+        return results
+
+    def _preverify_signed_contribution(self, signed_contribution):
+        """Spec checks for a ``SignedContributionAndProof`` — the full gossip
         rule set (reference ``verify_sync_committee_contribution``): the
         aggregator must be in the contribution's subcommittee AND pass the
-        sync-aggregator selection gate; THREE signature sets verify in one
-        batch (selection proof, outer signature, contribution participants)."""
+        sync-aggregator selection gate; THREE signature sets (selection
+        proof, outer signature, contribution participants) are returned
+        unverified for the batch entry points."""
         import hashlib
 
         from ..consensus import signature_sets as sets
@@ -854,9 +898,45 @@ class BeaconChain:
             ]
         except bls.BlsError as e:
             raise AttestationError(f"malformed contribution signature: {e}") from e
+        return contribution, sig_sets
+
+    def process_signed_contribution(self, signed_contribution) -> None:
+        from ..crypto.bls import api as bls
+
+        contribution, sig_sets = self._preverify_signed_contribution(signed_contribution)
         if not bls.verify_signature_sets(sig_sets):
             raise AttestationError("bad sync contribution signature(s)")
         self.sync_contribution_pool.insert_contribution(contribution)
+
+    def process_signed_contributions(self, signed_contributions) -> List[Optional[str]]:
+        """Batch path for POST contribution_and_proofs: every contribution's
+        3 signature sets verify in ONE backend call, with the per-item
+        fidelity fallback.  Returns one error string or None per item."""
+        from ..crypto.bls import api as bls
+
+        prepared = []
+        results: List[Optional[str]] = []
+        for signed in signed_contributions:
+            try:
+                prepared.append(self._preverify_signed_contribution(signed))
+                results.append(None)
+            except AttestationError as e:
+                prepared.append(None)
+                results.append(str(e))
+        live = [p for p in prepared if p is not None]
+        if not live:
+            return results
+        batch_ok = bls.verify_signature_sets([s for p in live for s in p[1]])
+        for i, p in enumerate(prepared):
+            if p is None:
+                continue
+            contribution, sig_sets = p
+            ok = batch_ok or bls.verify_signature_sets(sig_sets)
+            if not ok:
+                results[i] = "bad sync contribution signature(s)"
+                continue
+            self.sync_contribution_pool.insert_contribution(contribution)
+        return results
 
     def apply_verified_aggregate(self, cand: "AggregateCandidate") -> None:
         """Apply a signature-verified aggregate candidate: fork choice + pool

@@ -130,11 +130,30 @@ def run_validator_client(args) -> int:
         genesis_validators_root=bytes.fromhex(genesis["genesis_validators_root"][2:]),
         slashing_db=slashing_db,
     )
+    keymanager = None
+    if getattr(args, "keymanager_port", None) is not None:
+        from .validator_client.keymanager import KeymanagerServer
+
+        keymanager = KeymanagerServer(
+            store=vc.store,
+            genesis_validators_root=vc.store.genesis_validators_root,
+            port=args.keymanager_port,
+        ).start()
+        token_path = os.path.join(args.keystore_dir, "api-token.txt")
+        # owner-only: the token grants key deletion/import (reference writes
+        # api-token.txt 0600)
+        fd = os.open(token_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(keymanager.token)
+        print(f"keymanager API on {keymanager.url} (token in {token_path})")
     print("validator client running (ctrl-c to stop)")
     try:
         vc.run_forever(genesis_time=int(genesis["genesis_time"]))
     except KeyboardInterrupt:
         pass
+    finally:
+        if keymanager is not None:
+            keymanager.stop()
     return 0
 
 
@@ -304,6 +323,76 @@ def run_lcli(args) -> int:
     return 1
 
 
+def _parse_pubkey(s: str) -> bytes:
+    raw = s[2:] if s.startswith("0x") else s
+    try:
+        pk = bytes.fromhex(raw)
+    except ValueError:
+        raise SystemExit(f"invalid pubkey {s!r}")
+    if len(pk) != 48:
+        raise SystemExit(f"pubkey must be 48 bytes: {s!r}")
+    return pk
+
+
+def run_validator_manager(args) -> int:
+    """``lighthouse validator_manager`` equivalent: manage a RUNNING VC's
+    keys over its keymanager API (reference ``validator_manager/``)."""
+    from .validator_client.keymanager import KeymanagerClient
+
+    token = args.token
+    if args.token_file:
+        with open(args.token_file) as f:
+            token = f.read().strip()
+    if not token:
+        raise SystemExit("provide --token or --token-file")
+    client = KeymanagerClient(args.vc_url, token)
+
+    if args.vm_cmd == "list":
+        for row in client.list_keystores():
+            print(row["validating_pubkey"])
+        for row in client.list_remotekeys():
+            print(f"{row['pubkey']} (remote: {row['url']})")
+        return 0
+    if args.vm_cmd == "import":
+        from .crypto import keystore as ks
+
+        password = _read_password(args.password_file, "keystore password: ")
+        keystores = []
+        for name in sorted(os.listdir(args.keystores_dir)):
+            if name.endswith(".json"):
+                keystores.append(ks.load_json(os.path.join(args.keystores_dir, name)))
+        if not keystores:
+            raise SystemExit(f"no keystores under {args.keystores_dir}")
+        protection = None
+        if args.slashing_protection:
+            with open(args.slashing_protection) as f:
+                protection = f.read()
+        statuses = client.import_keystores(
+            keystores, [password] * len(keystores), protection
+        )
+        for ks_obj, st in zip(keystores, statuses):
+            print(f"0x{ks_obj.get('pubkey', '')[:16]}…: {st['status']}")
+        return 0 if all(s["status"] == "imported" for s in statuses) else 1
+    if args.vm_cmd == "delete":
+        resp = client.delete_keystores([_parse_pubkey(p) for p in args.pubkeys])
+        for p, st in zip(args.pubkeys, resp["data"]):
+            print(f"{p}: {st['status']}")
+        if args.slashing_protection_out:
+            with open(args.slashing_protection_out, "w") as f:
+                f.write(resp["slashing_protection"])
+            print(f"slashing protection exported to {args.slashing_protection_out}")
+        return 0
+    if args.vm_cmd == "import-remote":
+        statuses = client.import_remotekeys(
+            [{"pubkey": "0x" + _parse_pubkey(p).hex(), "url": args.signer_url}
+             for p in args.pubkeys]
+        )
+        for p, st in zip(args.pubkeys, statuses):
+            print(f"{p}: {st['status']}")
+        return 0
+    return 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="lighthouse-tpu",
@@ -335,6 +424,8 @@ def build_parser() -> argparse.ArgumentParser:
     vc.add_argument("--keystore-dir", required=True)
     vc.add_argument("--password-file", default=None)
     vc.add_argument("--slashing-protection-db", default=None)
+    vc.add_argument("--keymanager-port", type=int, default=None,
+                    help="serve the keymanager API on this port")
     vc.set_defaults(func=run_validator_client)
 
     am = sub.add_parser("account_manager", aliases=["am", "account"],
@@ -390,6 +481,25 @@ def build_parser() -> argparse.ArgumentParser:
     ps.add_argument("type_name")
     ps.add_argument("file")
     lcli.set_defaults(func=run_lcli)
+
+    vm = sub.add_parser("validator_manager", aliases=["vm"],
+                        help="manage a running VC's keys over the keymanager API")
+    vm.add_argument("--vc-url", default="http://127.0.0.1:5062")
+    vm.add_argument("--token", default=None)
+    vm.add_argument("--token-file", default=None)
+    vmsub = vm.add_subparsers(dest="vm_cmd", required=True)
+    vmsub.add_parser("list")
+    vi = vmsub.add_parser("import")
+    vi.add_argument("--keystores-dir", required=True)
+    vi.add_argument("--password-file", default=None)
+    vi.add_argument("--slashing-protection", default=None)
+    vd = vmsub.add_parser("delete")
+    vd.add_argument("pubkeys", nargs="+")
+    vd.add_argument("--slashing-protection-out", default=None)
+    vr = vmsub.add_parser("import-remote")
+    vr.add_argument("pubkeys", nargs="+")
+    vr.add_argument("--signer-url", required=True)
+    vm.set_defaults(func=run_validator_manager)
     return p
 
 

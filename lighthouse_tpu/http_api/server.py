@@ -657,12 +657,21 @@ def pool_sync_committees_post(ctx):
 
     chain = ctx.chain
     failures = []
+    messages = []
+    slots = []  # original body index per decoded message
     for i, msg_json in enumerate(ctx.body or []):
         try:
-            msg = container_from_json(chain.types.SyncCommitteeMessage, msg_json)
-            chain.process_sync_committee_message(msg)
-        except (AttestationError, KeyError, ValueError) as e:
+            messages.append(
+                container_from_json(chain.types.SyncCommitteeMessage, msg_json)
+            )
+            slots.append(i)
+        except (KeyError, ValueError) as e:
             failures.append({"index": i, "message": str(e)})
+    # ONE batched verification for the whole submission (a per-message
+    # pairing would put a full committee's POST past client timeouts).
+    for i, err in zip(slots, chain.process_sync_committee_messages(messages)):
+        if err is not None:
+            failures.append({"index": i, "message": err})
     if failures:
         raise ApiError(400, json.dumps({
             "code": 400,
@@ -693,14 +702,20 @@ def contribution_and_proofs(ctx):
 
     chain = ctx.chain
     failures = []
+    signed_list = []
+    idxs = []
     for i, c_json in enumerate(ctx.body or []):
         try:
-            signed = container_from_json(
+            signed_list.append(container_from_json(
                 chain.types.SignedContributionAndProof, c_json
-            )
-            chain.process_signed_contribution(signed)
-        except (AttestationError, KeyError, ValueError) as e:
+            ))
+            idxs.append(i)
+        except (KeyError, ValueError) as e:
             failures.append({"index": i, "message": str(e)})
+    # ONE batched verification (3 sets per contribution) per submission.
+    for i, err in zip(idxs, chain.process_signed_contributions(signed_list)):
+        if err is not None:
+            failures.append({"index": i, "message": err})
     if failures:
         raise ApiError(400, json.dumps({
             "code": 400,

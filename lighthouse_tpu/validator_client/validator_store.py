@@ -51,6 +51,7 @@ class ValidatorStore:
             sk.public_key().to_bytes(): sk for sk in keys
         }
         self._fake = fake_signatures
+        self._remote: Dict[bytes, object] = {}  # pubkey -> remote signer
         # Doppelganger gate: DoppelgangerService flips this to False at
         # startup and back to True only after clean liveness epochs.
         self.signing_enabled = True
@@ -61,10 +62,37 @@ class ValidatorStore:
 
     @property
     def pubkeys(self) -> List[bytes]:
-        return list(self._by_pubkey)
+        return list(self._by_pubkey) + list(self._remote)
 
     def has_key(self, pubkey: bytes) -> bool:
-        return bytes(pubkey) in self._by_pubkey
+        return bytes(pubkey) in self._by_pubkey or bytes(pubkey) in self._remote
+
+    # -------------------------------------------------------- key lifecycle
+    # (reference initialized_validators.rs + signing_method.rs: local
+    # keystores and Web3Signer remotes behind one signing facade)
+
+    def add_key(self, secret_key) -> bytes:
+        pk = secret_key.public_key().to_bytes()
+        self._by_pubkey[pk] = secret_key
+        return pk
+
+    def remove_local_key(self, pubkey: bytes) -> bool:
+        return self._by_pubkey.pop(bytes(pubkey), None) is not None
+
+    def remove_remote_key(self, pubkey: bytes) -> bool:
+        return self._remote.pop(bytes(pubkey), None) is not None
+
+    def remove_key(self, pubkey: bytes) -> bool:
+        """Remove in either backing (CLI convenience; the keymanager API's
+        typed DELETE endpoints use the specific removers)."""
+        local = self.remove_local_key(pubkey)
+        remote = self.remove_remote_key(pubkey)
+        return local or remote
+
+    def add_remote_key(self, pubkey: bytes, signer) -> None:
+        """Register a Web3Signer-backed key: ``signer.sign(pubkey, root)``
+        produces the signature bytes remotely (signing_method.rs:80-91)."""
+        self._remote[bytes(pubkey)] = signer
 
     # ------------------------------------------------------------- signing
 
@@ -79,6 +107,9 @@ class ValidatorStore:
             )
         if self._fake:
             return self._canned
+        remote = self._remote.get(bytes(pubkey))
+        if remote is not None:
+            return remote.sign(bytes(pubkey), signing_root)
         sk = self._by_pubkey.get(bytes(pubkey))
         if sk is None:
             raise KeyError(f"no key for pubkey {bytes(pubkey).hex()[:16]}")

@@ -1,0 +1,123 @@
+"""VC keymanager API + validator_manager CLI + Web3Signer remote signing
+(reference validator_client/src/http_api, validator_manager/,
+signing_method.rs + testing/web3signer_tests)."""
+
+import json
+
+import pytest
+
+from lighthouse_tpu.consensus.genesis import interop_secret_key
+from lighthouse_tpu.crypto import keystore as ks
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.validator_client.keymanager import (
+    KeymanagerClient,
+    KeymanagerServer,
+)
+from lighthouse_tpu.validator_client.validator_store import ValidatorStore
+from lighthouse_tpu.validator_client.web3signer import (
+    MockWeb3Signer,
+    Web3SignerClient,
+)
+
+GVR = b"\x42" * 32
+
+
+@pytest.fixture()
+def rig():
+    spec = minimal_spec(altair_fork_epoch=0, bellatrix_fork_epoch=0,
+                        capella_fork_epoch=0, deneb_fork_epoch=None)
+    store = ValidatorStore(
+        keys=[interop_secret_key(0)], spec=spec, genesis_validators_root=GVR
+    )
+    server = KeymanagerServer(store=store, genesis_validators_root=GVR).start()
+    client = KeymanagerClient(server.url, server.token)
+    yield store, server, client
+    server.stop()
+
+
+def _mk_keystore(index: int, password: str):
+    wallet, _ = ks.create_wallet(f"w{index}", "walletpass")
+    derived = ks.derive_validator_keystores(wallet, "walletpass", password, 1)
+    return derived[0][0]
+
+
+def test_keymanager_auth_required(rig):
+    store, server, client = rig
+    bad = KeymanagerClient(server.url, "wrong-token")
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        bad.list_keystores()
+    assert ei.value.code == 401
+
+
+def test_keystore_lifecycle_over_api(rig):
+    store, server, client = rig
+    assert len(client.list_keystores()) == 1
+
+    keystore = _mk_keystore(1, "pw1")
+    statuses = client.import_keystores([keystore], ["pw1"])
+    assert statuses[0]["status"] == "imported"
+    listed = client.list_keystores()
+    assert len(listed) == 2
+    new_pk = bytes.fromhex(keystore["pubkey"])
+    assert store.has_key(new_pk)
+
+    resp = client.delete_keystores([new_pk])
+    assert resp["data"][0]["status"] == "deleted"
+    assert not store.has_key(new_pk)
+    # deleting again reports not_found; protection history is exported
+    resp2 = client.delete_keystores([new_pk])
+    assert resp2["data"][0]["status"] == "not_found"
+    assert json.loads(resp2["slashing_protection"])["metadata"]
+
+
+def test_remote_keys_sign_byte_identical_to_local(rig):
+    """The reference web3signer test contract: remote signature ==
+    local signature for the same signing root."""
+    store, server, client = rig
+    sk = interop_secret_key(7)
+    pk = sk.public_key().to_bytes()
+    signer = MockWeb3Signer([sk]).start()
+    try:
+        statuses = client.import_remotekeys(
+            [{"pubkey": "0x" + pk.hex(), "url": signer.url}]
+        )
+        assert statuses[0]["status"] == "imported"
+        assert store.has_key(pk)
+        root = b"\x13" * 32
+        remote_sig = store._raw_sign(pk, root)
+        assert remote_sig == sk.sign(root).to_bytes()
+        assert signer.sign_requests == 1
+        rows = client.list_remotekeys()
+        assert rows and rows[0]["url"] == signer.url
+    finally:
+        signer.stop()
+
+
+def test_validator_manager_cli_roundtrip(rig, tmp_path, capsys):
+    from lighthouse_tpu import cli
+
+    store, server, client = rig
+    kdir = tmp_path / "keystores"
+    kdir.mkdir()
+    keystore = _mk_keystore(2, "pw2")
+    (kdir / "keystore-a.json").write_text(json.dumps(keystore))
+    (tmp_path / "pw.txt").write_text("pw2")
+    (tmp_path / "token.txt").write_text(server.token)
+
+    rc = cli.main([
+        "validator_manager", "--vc-url", server.url,
+        "--token-file", str(tmp_path / "token.txt"),
+        "import", "--keystores-dir", str(kdir),
+        "--password-file", str(tmp_path / "pw.txt"),
+    ])
+    assert rc == 0
+    assert store.has_key(bytes.fromhex(keystore["pubkey"]))
+
+    rc = cli.main([
+        "vm", "--vc-url", server.url, "--token", server.token, "list",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "0x" + keystore["pubkey"] in out
