@@ -78,6 +78,12 @@ def run_beacon_node(args) -> int:
         builder.with_slasher()
     if getattr(args, "monitoring_endpoint", None):
         builder.with_monitoring(args.monitoring_endpoint)
+    if args.listen_port is not None or args.peers or args.boot_nodes:
+        builder.with_network(
+            listen_port=args.listen_port or 0,
+            peers=[p for p in (args.peers or "").split(",") if p],
+            boot_nodes=[b for b in (args.boot_nodes or "").split(",") if b],
+        )
 
     client = builder.build().start()
     print(f"beacon node up: http API on :{args.http_port}, "
@@ -393,6 +399,42 @@ def run_validator_manager(args) -> int:
     return 1
 
 
+def run_watch(args) -> int:
+    """Chain analytics service (reference ``watch/``): poll a BN, serve
+    aggregates."""
+    from .http_api import BeaconNodeHttpClient
+    from .watch import WatchDB, WatchServer, WatchUpdater
+
+    spec = _spec_for(args.network)
+    db = WatchDB(args.db)
+    updater = WatchUpdater(
+        client=BeaconNodeHttpClient(args.beacon_node), db=db, spec=spec
+    )
+    server = WatchServer(db, port=args.port).start()
+    print(f"watch serving on {server.url}, polling {args.beacon_node}")
+    try:
+        while True:
+            try:
+                n = updater.update()
+                if n:
+                    print(f"ingested {n} slots (highest {db.highest_slot()})")
+            except Exception as e:
+                print(f"update failed: {e}")
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        server.stop()
+        db.close()
+    return 0
+
+
+def run_boot_node(args) -> int:
+    """Standalone discovery bootstrapper (reference ``boot_node/``)."""
+    from .network.boot_node import run_forever
+
+    run_forever(args.listen_address, args.port)
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="lighthouse-tpu",
@@ -406,6 +448,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="directory holding a config.yaml network definition")
     bn.add_argument("--monitoring-endpoint", default=None,
                     help="push node stats to this client-stats URL every 60s")
+    bn.add_argument("--listen-port", type=int, default=None,
+                    help="join the p2p network, listening on this TCP port")
+    bn.add_argument("--peers", default=None,
+                    help="comma-separated host:port static peers to dial")
+    bn.add_argument("--boot-nodes", default=None,
+                    help="comma-separated host:port boot nodes for discovery")
     bn.add_argument("--datadir", default=None)
     bn.add_argument("--http-port", type=int, default=5052)
     bn.add_argument("--execution-endpoint", default=None)
@@ -500,6 +548,19 @@ def build_parser() -> argparse.ArgumentParser:
     vr.add_argument("pubkeys", nargs="+")
     vr.add_argument("--signer-url", required=True)
     vm.set_defaults(func=run_validator_manager)
+
+    watch = sub.add_parser("watch", help="chain analytics: poll a BN, serve aggregates")
+    watch.add_argument("--network", default="mainnet")
+    watch.add_argument("--beacon-node", default="http://127.0.0.1:5052")
+    watch.add_argument("--db", default="watch.sqlite")
+    watch.add_argument("--port", type=int, default=5059)
+    watch.add_argument("--interval", type=float, default=12.0)
+    watch.set_defaults(func=run_watch)
+
+    boot = sub.add_parser("boot_node", help="run a peer-introduction boot node")
+    boot.add_argument("--listen-address", default="0.0.0.0")
+    boot.add_argument("--port", type=int, default=9100)
+    boot.set_defaults(func=run_boot_node)
     return p
 
 

@@ -79,6 +79,16 @@ class ClientBuilder:
         self._http_port = port
         return self
 
+    def with_network(self, *, listen_port: int = 0, listen_address: str = "0.0.0.0",
+                     peers=None, boot_nodes=None) -> "ClientBuilder":
+        """Join the p2p fabric over TCP: listen, dial static peers and boot
+        nodes, discover the rest (reference: the network stage of
+        builder.rs wiring lighthouse_network + router + sync)."""
+        self._net_listen = (listen_address, listen_port)
+        self._net_peers = list(peers or [])
+        self._net_boot_nodes = list(boot_nodes or [])
+        return self
+
     def with_monitoring(self, endpoint: str,
                         update_period: float = 60.0) -> "ClientBuilder":
         """Push node stats to a remote client-stats endpoint (reference
@@ -157,6 +167,19 @@ class ClientBuilder:
             from ..http_api import HttpApiServer
 
             http_server = HttpApiServer(chain, processor=processor, port=self._http_port)
+        network_node = None
+        if getattr(self, "_net_listen", None) is not None:
+            from ..network.node import LocalNode
+            from ..network.tcp_transport import TcpEndpoint
+            import secrets as _secrets
+
+            host, port = self._net_listen
+            endpoint_obj = TcpEndpoint(
+                f"bn-{_secrets.token_hex(4)}", host=host, port=port
+            )
+            network_node = LocalNode(
+                peer_id=endpoint_obj.peer_id, chain=chain, endpoint=endpoint_obj,
+            )
         monitoring = None
         if getattr(self, "_monitoring_endpoint", None):
             from ..monitoring import MonitoringService
@@ -165,10 +188,13 @@ class ClientBuilder:
                 endpoint=self._monitoring_endpoint, chain=chain,
                 update_period=getattr(self, "_monitoring_period", 60.0),
             )
-        return Client(
+        client = Client(
             chain=chain, processor=processor, http_server=http_server,
-            slasher=slasher, monitoring=monitoring,
+            slasher=slasher, monitoring=monitoring, network_node=network_node,
         )
+        client._static_peers = list(getattr(self, "_net_peers", []))
+        client._boot_nodes = list(getattr(self, "_net_boot_nodes", []))
+        return client
 
 
 class Client:
@@ -176,12 +202,15 @@ class Client:
     (task_executor semantics — every service stops on ``stop()``)."""
 
     def __init__(self, *, chain, processor, http_server=None, slasher=None,
-                 monitoring=None):
+                 monitoring=None, network_node=None):
         self.chain = chain
         self.processor = processor
         self.http_server = http_server
         self.slasher = slasher
         self.monitoring = monitoring
+        self.network_node = network_node
+        self._static_peers: List[str] = []
+        self._boot_nodes: List[str] = []
         self._shutdown = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -192,6 +221,19 @@ class Client:
             self.http_server.start()
         if self.monitoring is not None:
             self.monitoring.start()
+        if self.network_node is not None:
+            for addr in self._static_peers + self._boot_nodes:
+                try:
+                    h, _, p = addr.rpartition(":")
+                    self.network_node.endpoint.dial(h, int(p), timeout=5.0)
+                except Exception as e:
+                    log.warning("dial %s failed: %s", addr, e)
+            try:
+                n = self.network_node.discover_peers()
+                if n:
+                    log.info("discovered %d peers", n)
+            except Exception as e:
+                log.warning("peer discovery failed: %s", e)
         timer = threading.Thread(target=self._slot_timer, name="slot-timer", daemon=True)
         timer.start()
         self._threads.append(timer)
@@ -227,6 +269,11 @@ class Client:
 
     def stop(self) -> None:
         self._shutdown.set()
+        if self.network_node is not None:
+            try:
+                self.network_node.shutdown()
+            except Exception:
+                pass
         if self.monitoring is not None:
             self.monitoring.stop()
         if self.http_server is not None:

@@ -12,6 +12,7 @@ from typing import Optional
 from ..chain import BeaconChain, BeaconChainHarness
 from ..scheduler import BeaconProcessor
 from . import topics as topics_mod
+from .peer_manager import PeerAction
 from .router import Router
 from .service import NetworkService
 from .sync import SyncManager
@@ -74,6 +75,54 @@ class LocalNode:
             self.service.subscribe(
                 str(topics_mod.attestation_subnet_topic(digest, subnet))
             )
+
+    # ----------------------------------------------------------- discovery
+
+    def discover_peers(self, max_new: int = 8) -> int:
+        """One discovery round (the FINDNODE sweep a discv5 node runs):
+        ask every connected peer — boot nodes included — for the listen
+        addresses they know, dial the unknown ones.  Returns #dialed.
+        Requires a socket-backed endpoint (TcpEndpoint)."""
+        from . import rpc as rpc_mod
+
+        endpoint = self.endpoint
+        if not hasattr(endpoint, "dial"):
+            return 0  # in-process hub: topology is explicit
+        known_addrs = set(endpoint.known_peer_addrs().values())
+        known_addrs.add(tuple(endpoint.listen_addr))
+        dialed = 0
+        for peer in list(endpoint.connected_peers()):
+            try:
+                chunks = self.service.request(
+                    peer, rpc_mod.PEER_EXCHANGE,
+                    rpc_mod.PeerExchangeRequest(max_peers=64),
+                )
+            except rpc_mod.RpcError:
+                continue
+            for result, payload, _ctx in chunks:
+                if result != rpc_mod.SUCCESS:
+                    continue
+                try:
+                    entries = rpc_mod.decode_peer_entries(payload)
+                except Exception:
+                    # one malformed answer must not veto the whole round
+                    self.service.peer_manager.report(
+                        peer, PeerAction.LOW_TOLERANCE, "bad peer-exchange payload"
+                    )
+                    continue
+                for entry in entries:
+                    addr = (entry.host, entry.port)
+                    if entry.peer_id == self.peer_id or addr in known_addrs:
+                        continue
+                    try:
+                        endpoint.dial(entry.host, entry.port, timeout=3.0)
+                        known_addrs.add(addr)
+                        dialed += 1
+                    except Exception:
+                        continue  # stale address: skip
+                    if dialed >= max_new:
+                        return dialed
+        return dialed
 
     # ------------------------------------------------------------ publish
 

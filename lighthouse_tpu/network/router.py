@@ -350,7 +350,16 @@ class Router:
             return self._serve_blocks_by_range(request, sender)
         if protocol == rpc_mod.BLOCKS_BY_ROOT:
             return self._serve_blocks_by_root(request, sender)
+        if protocol == rpc_mod.PEER_EXCHANGE:
+            return self._serve_peer_exchange(request, sender)
         return [rpc_mod.encode_response_chunk(rpc_mod.INVALID_REQUEST, b"unknown protocol")]
+
+    def _serve_peer_exchange(self, req, sender: str) -> List[bytes]:
+        """Share known listen addresses of our other peers (the discovery
+        analog of a discv5 FINDNODE answer)."""
+        return [rpc_mod.serve_peer_exchange(
+            self.service.endpoint, sender, req.max_peers
+        )]
 
     def _block_chunk(self, signed_block) -> bytes:
         epoch = int(signed_block.message.slot) // self.chain.spec.slots_per_epoch

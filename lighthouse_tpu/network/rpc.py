@@ -39,6 +39,9 @@ BLOBS_BY_RANGE = _pid("blob_sidecars_by_range/1")
 BLOBS_BY_ROOT = _pid("blob_sidecars_by_root/1")
 PING = _pid("ping/1")
 METADATA = _pid("metadata/2")
+# Not a consensus-spec protocol: this transport's discovery analog (the role
+# discv5 plays for the reference) — peers exchange known listen addresses.
+PEER_EXCHANGE = _pid("peer_exchange/1")
 
 SUCCESS = 0
 INVALID_REQUEST = 1
@@ -157,6 +160,63 @@ class BlocksByRootRequest:
         return cls([data[i : i + 32] for i in range(0, len(data), 32)])
 
 
+@dataclass
+class PeerExchangeRequest:
+    max_peers: int
+
+    def to_bytes(self) -> bytes:
+        return struct.pack("<Q", self.max_peers)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "PeerExchangeRequest":
+        return cls(struct.unpack("<Q", data)[0])
+
+
+@dataclass
+class PeerEntry:
+    peer_id: str
+    host: str
+    port: int
+
+
+def encode_peer_entries(entries) -> bytes:
+    out = bytearray(struct.pack(">H", len(entries)))
+    for e in entries:
+        pid = e.peer_id.encode()
+        host = e.host.encode()
+        out += struct.pack(">B", len(pid)) + pid
+        out += struct.pack(">B", len(host)) + host
+        out += struct.pack(">H", e.port)
+    return bytes(out)
+
+
+def decode_peer_entries(data: bytes):
+    (count,) = struct.unpack_from(">H", data, 0)
+    pos = 2
+    out = []
+    for _ in range(count):
+        (plen,) = struct.unpack_from(">B", data, pos); pos += 1
+        pid = data[pos:pos + plen].decode(); pos += plen
+        (hlen,) = struct.unpack_from(">B", data, pos); pos += 1
+        host = data[pos:pos + hlen].decode(); pos += hlen
+        (port,) = struct.unpack_from(">H", data, pos); pos += 2
+        out.append(PeerEntry(pid, host, port))
+    return out
+
+
+def serve_peer_exchange(endpoint, sender: str, max_peers) -> bytes:
+    """One answer for both the router and the boot node: known listen
+    addresses, excluding the requester, capped."""
+    addrs = (endpoint.known_peer_addrs()
+             if hasattr(endpoint, "known_peer_addrs") else {})
+    entries = [
+        PeerEntry(pid, host, port)
+        for pid, (host, port) in addrs.items()
+        if pid != sender
+    ][: max(0, min(int(max_peers), 64))]
+    return encode_response_chunk(SUCCESS, encode_peer_entries(entries))
+
+
 REQUEST_TYPES = {
     STATUS: Status,
     GOODBYE: Goodbye,
@@ -164,6 +224,7 @@ REQUEST_TYPES = {
     METADATA: type(None),  # metadata request has an empty body
     BLOCKS_BY_RANGE: BlocksByRangeRequest,
     BLOCKS_BY_ROOT: BlocksByRootRequest,
+    PEER_EXCHANGE: PeerExchangeRequest,
 }
 
 

@@ -128,6 +128,8 @@ class TcpEndpoint:
         self.on_connect: Optional[Callable[[str], None]] = None
         self.on_disconnect: Optional[Callable[[str], None]] = None
         self._conns: Dict[str, socket.socket] = {}
+        # peer id -> (host, listen_port) for re-dialing / peer exchange
+        self.peer_listen_addrs: Dict[str, Tuple[str, int]] = {}
         # per-connection write mutex: sendall from multiple threads must not
         # interleave partial frames on the stream
         self._write_locks: Dict[str, threading.Lock] = {}
@@ -151,11 +153,38 @@ class TcpEndpoint:
 
     # ------------------------------------------------------------- dialing
 
+    def _hello(self) -> "Envelope":
+        # data carries OUR listen port (u16 be): the ephemeral socket port a
+        # peer sees is useless for dialing us back or for peer exchange
+        return Envelope(kind="hello", sender=self.peer_id,
+                        data=struct.pack(">H", self.listen_addr[1]))
+
+    def _record_peer_addr(self, peer: str, sock: socket.socket,
+                          hello: "Envelope") -> None:
+        if len(hello.data) >= 2:
+            (listen_port,) = struct.unpack(">H", hello.data[:2])
+            self._store_peer_addr(peer, (sock.getpeername()[0], listen_port))
+
+    MAX_KNOWN_ADDRS = 1024  # bound the address book under peer churn
+
+    def known_peer_addrs(self) -> Dict[str, Tuple[str, int]]:
+        """Snapshot of known peer listen addresses (safe to iterate —
+        handshake threads mutate the underlying dict under the lock)."""
+        with self._lock:
+            return dict(self.peer_listen_addrs)
+
+    def _store_peer_addr(self, peer: str, addr: Tuple[str, int]) -> None:
+        with self._lock:
+            self.peer_listen_addrs.pop(peer, None)
+            self.peer_listen_addrs[peer] = addr
+            while len(self.peer_listen_addrs) > self.MAX_KNOWN_ADDRS:
+                self.peer_listen_addrs.pop(next(iter(self.peer_listen_addrs)))
+
     def dial(self, host: str, port: int, timeout: float = 5.0) -> str:
         """Connect to a remote endpoint; returns its peer id."""
         sock = socket.create_connection((host, port), timeout=timeout)
         sock.settimeout(timeout)
-        sock.sendall(_encode(Envelope(kind="hello", sender=self.peer_id)))
+        sock.sendall(_encode(self._hello()))
         payload = _read_frame(sock)
         if payload is None:
             raise TcpTransportError("peer closed during handshake")
@@ -163,6 +192,8 @@ class TcpEndpoint:
         if hello.kind != "hello":
             raise TcpTransportError(f"bad handshake frame kind {hello.kind!r}")
         sock.settimeout(None)
+        # the address we DIALED is authoritative for this peer
+        self._store_peer_addr(hello.sender, (host, port))
         self._register_conn(hello.sender, sock)
         return hello.sender
 
@@ -187,11 +218,12 @@ class TcpEndpoint:
             if hello.kind != "hello":
                 sock.close()
                 return
-            sock.sendall(_encode(Envelope(kind="hello", sender=self.peer_id)))
+            sock.sendall(_encode(self._hello()))
             sock.settimeout(None)
         except (OSError, TcpTransportError):
             sock.close()
             return
+        self._record_peer_addr(hello.sender, sock, hello)
         self._register_conn(hello.sender, sock)
 
     def _register_conn(self, peer: str, sock: socket.socket) -> None:

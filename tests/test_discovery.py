@@ -1,0 +1,139 @@
+"""Peer discovery over the TCP transport (the discv5/boot_node role):
+listen addresses ride the handshake, peers answer peer-exchange, a fresh
+node bootstraps the full topology from one boot node."""
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.crypto.bls.backends import set_backend
+from lighthouse_tpu.network import rpc as rpc_mod
+from lighthouse_tpu.network.boot_node import BootNode
+from lighthouse_tpu.network.node import LocalNode
+from lighthouse_tpu.network.tcp_transport import TcpEndpoint
+
+GENESIS_TIME = 1_600_000_000
+
+
+def _tcp_node(peer_id: str):
+    harness = BeaconChainHarness(
+        validator_count=16, fake_crypto=True, genesis_time=GENESIS_TIME
+    )
+    endpoint = TcpEndpoint(peer_id)
+    node = LocalNode(peer_id=peer_id, harness=harness, endpoint=endpoint)
+    return node
+
+
+@pytest.fixture(autouse=True)
+def _fake_backend():
+    set_backend("fake")
+    yield
+    set_backend("host")
+
+
+def test_peer_exchange_roundtrip_codec():
+    entries = [rpc_mod.PeerEntry("n1", "127.0.0.1", 9000),
+               rpc_mod.PeerEntry("n2", "10.0.0.2", 12345)]
+    decoded = rpc_mod.decode_peer_entries(rpc_mod.encode_peer_entries(entries))
+    assert decoded == entries
+
+
+def test_bootstrap_via_boot_node():
+    """Three nodes each dial ONLY the boot node; one discovery round makes
+    them dial each other (the discv5 bootstrap story)."""
+    boot = BootNode()
+    nodes = [_tcp_node(f"d{i}") for i in range(3)]
+    try:
+        host, port = boot.listen_addr
+        for n in nodes:
+            n.endpoint.dial(host, port)
+        # every node knows only the boot node so far
+        for n in nodes:
+            assert n.endpoint.connected_peers() == {"boot"}
+        dialed = [n.discover_peers() for n in nodes]
+        assert sum(dialed) > 0
+        import time
+
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if all(len(n.endpoint.connected_peers()) == 3 for n in nodes):
+                break
+            time.sleep(0.1)
+        for n in nodes:
+            peers = n.endpoint.connected_peers()
+            assert len(peers) == 3, f"{n.peer_id} only connected to {peers}"
+    finally:
+        for n in nodes:
+            n.shutdown()
+        boot.stop()
+
+
+def test_discovered_peers_sync_chain():
+    """Discovery is end-to-end useful: a fresh node that finds a synced peer
+    via the boot node range-syncs the chain from it."""
+    boot = BootNode()
+    synced = _tcp_node("synced")
+    fresh = _tcp_node("fresh")
+    try:
+        synced.harness.extend_chain(6)
+        for _ in range(6):
+            fresh.harness.advance_slot()  # same wall clock; no blocks
+        host, port = boot.listen_addr
+        synced.endpoint.dial(host, port)
+        fresh.endpoint.dial(host, port)
+        assert fresh.discover_peers() >= 1
+        import time
+
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if fresh.chain.head_root == synced.chain.head_root:
+                break
+            # status exchange on connect triggers range sync; nudge it
+            if fresh.sync is not None and hasattr(fresh.sync, "on_peer_status"):
+                pass
+            time.sleep(0.2)
+        assert fresh.chain.head_root == synced.chain.head_root, (
+            "fresh node did not sync from the discovered peer"
+        )
+    finally:
+        synced.shutdown()
+        fresh.shutdown()
+        boot.stop()
+
+
+def test_client_builder_joins_network():
+    """A ClientBuilder-assembled node joins the fabric via a boot node and
+    syncs to an existing TCP node — the CLI `bn --boot-nodes` path."""
+    from lighthouse_tpu.client import ClientBuilder
+
+    boot = BootNode()
+    synced = _tcp_node("synced-cb")
+    client = None
+    try:
+        synced.harness.extend_chain(4)
+        synced.endpoint.dial(*boot.listen_addr)
+        genesis_state = synced.harness.chain.genesis_state
+        client = (
+            ClientBuilder()
+            .with_spec(synced.harness.spec)
+            .with_genesis_state(genesis_state)
+            .with_bls_backend("fake")
+            .with_network(boot_nodes=[f"{boot.listen_addr[0]}:{boot.listen_addr[1]}"])
+            .build()
+        )
+        # manual clock on the synced side; the client's SystemTimeSlotClock is
+        # far past genesis_time=1.6e9, so future-slot checks pass
+        client.start()
+        import time
+
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if client.chain.head_root == synced.chain.head_root:
+                break
+            time.sleep(0.2)
+        assert client.chain.head_root == synced.chain.head_root
+        assert "synced-cb" in client.network_node.endpoint.connected_peers()
+    finally:
+        if client is not None:
+            client.stop()
+        synced.shutdown()
+        boot.stop()
