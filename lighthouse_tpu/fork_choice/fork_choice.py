@@ -238,18 +238,25 @@ class ForkChoice:
     # ------------------------------------------------------------------ time
 
     def update_time(self, current_slot: int) -> None:
-        """Reference: ``fork_choice.rs:1104`` ``update_time`` — per-slot tick:
-        dequeue prior-slot attestations; at epoch boundaries promote unrealized
-        checkpoints (spec ``on_tick_per_slot``)."""
-        while self.current_slot < current_slot:
-            self.current_slot += 1
-            self.proposer_boost_root = None
-            if self.current_slot % self.spec.slots_per_epoch == 0:
-                self._update_checkpoints(
-                    self.unrealized_justified_checkpoint,
-                    self.unrealized_finalized_checkpoint,
-                )
-            self._process_queued_attestations()
+        """Reference: ``fork_choice.rs:1104`` ``update_time`` (spec
+        ``on_tick_per_slot``), computed as ONE jump: per-slot iteration is
+        equivalent because checkpoint promotion is a monotone max of the
+        (unchanged) unrealized values and the queued-attestation dequeue at
+        the final slot subsumes every intermediate dequeue.  The naive loop
+        walks 10M+ slots on a wall-clock node booting from an old anchor —
+        a multi-second stall inside block import."""
+        if current_slot <= self.current_slot:
+            return
+        spe = self.spec.slots_per_epoch
+        crossed_epoch = current_slot // spe > self.current_slot // spe
+        self.current_slot = current_slot
+        self.proposer_boost_root = None
+        if crossed_epoch:
+            self._update_checkpoints(
+                self.unrealized_justified_checkpoint,
+                self.unrealized_finalized_checkpoint,
+            )
+        self._process_queued_attestations()
 
     def _process_queued_attestations(self) -> None:
         remaining = []

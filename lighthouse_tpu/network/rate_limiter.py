@@ -45,8 +45,10 @@ def request_cost(protocol: str, request) -> float:
     """Token cost of one request (the reference's expected_responses)."""
     if protocol == rpc_mod.BLOCKS_BY_RANGE or protocol == rpc_mod.BLOBS_BY_RANGE:
         return max(1, int(getattr(request, "count", 1)))
-    if protocol == rpc_mod.BLOCKS_BY_ROOT or protocol == rpc_mod.BLOBS_BY_ROOT:
+    if protocol == rpc_mod.BLOCKS_BY_ROOT:
         return max(1, len(getattr(request, "roots", ()) or ()))
+    if protocol == rpc_mod.BLOBS_BY_ROOT:
+        return max(1, len(getattr(request, "ids", ()) or ()))
     return 1.0
 
 

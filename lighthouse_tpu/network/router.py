@@ -179,7 +179,8 @@ class Router:
         if self.slasher is not None:
             self.slasher.on_block(signed)
             self._drain_slasher()
-        self.service.forward(topic, compressed, exclude=sender)
+        self.service.forward(topic, compressed, exclude=sender,
+                             uncompressed=uncompressed)
         self._publish_light_client_updates()
 
     def _publish_light_client_updates(self) -> None:
@@ -220,7 +221,8 @@ class Router:
                 sender, PeerAction.MID_TOLERANCE, f"bad blob sidecar: {e}"
             )
             return
-        self.service.forward(topic, compressed, exclude=sender)
+        self.service.forward(topic, compressed, exclude=sender,
+                             uncompressed=uncompressed)
         ready = chain.da_checker.take_ready_block(block_root)
         if ready is not None:
             try:
@@ -350,9 +352,56 @@ class Router:
             return self._serve_blocks_by_range(request, sender)
         if protocol == rpc_mod.BLOCKS_BY_ROOT:
             return self._serve_blocks_by_root(request, sender)
+        if protocol == rpc_mod.BLOBS_BY_RANGE:
+            return self._serve_blobs_by_range(request, sender)
+        if protocol == rpc_mod.BLOBS_BY_ROOT:
+            return self._serve_blobs_by_root(request, sender)
         if protocol == rpc_mod.PEER_EXCHANGE:
             return self._serve_peer_exchange(request, sender)
         return [rpc_mod.encode_response_chunk(rpc_mod.INVALID_REQUEST, b"unknown protocol")]
+
+    def _blob_chunk(self, sidecar) -> bytes:
+        slot = int(sidecar.signed_block_header.message.slot)
+        epoch = slot // self.chain.spec.slots_per_epoch
+        version = self.chain.spec.fork_version_for(self.chain.spec.fork_name_at_epoch(epoch))
+        context = h.compute_fork_digest(
+            version, bytes(self.chain.genesis_state.genesis_validators_root)
+        )
+        return rpc_mod.encode_response_chunk(
+            rpc_mod.SUCCESS, sidecar.as_ssz_bytes(), context_bytes=context
+        )
+
+    def _serve_blobs_by_range(self, req, sender: str) -> List[bytes]:
+        """Reference ``rpc_methods.rs`` handle_blobs_by_range_request:
+        per-slot sidecars in ascending (slot, index) order."""
+        if req.count > rpc_mod.MAX_REQUEST_BLOCKS:
+            self.service.peer_manager.report(sender, PeerAction.LOW_TOLERANCE, "oversize range")
+            return [rpc_mod.encode_response_chunk(rpc_mod.INVALID_REQUEST, b"count too large")]
+        chain = self.chain
+        chunks: List[bytes] = []
+        prev_root = None
+        for slot in range(req.start_slot, req.start_slot + req.count):
+            root = chain.block_root_at_slot(slot) or chain.db.cold_block_root_at_slot(slot)
+            if root is None or root == prev_root:
+                continue
+            prev_root = root
+            for sidecar in sorted(chain.get_blobs(root), key=lambda s: int(s.index)):
+                # a skip slot resolves to an EARLIER block; its sidecars are
+                # outside the requested range and must not be served
+                if int(sidecar.signed_block_header.message.slot) != slot:
+                    continue
+                chunks.append(self._blob_chunk(sidecar))
+        return chunks
+
+    def _serve_blobs_by_root(self, req, sender: str) -> List[bytes]:
+        if len(req.ids) > rpc_mod.MAX_REQUEST_BLOCKS:
+            return [rpc_mod.encode_response_chunk(rpc_mod.INVALID_REQUEST, b"too many ids")]
+        chunks = []
+        for root, index in req.ids:
+            for sidecar in self.chain.get_blobs(root):
+                if int(sidecar.index) == index:
+                    chunks.append(self._blob_chunk(sidecar))
+        return chunks
 
     def _serve_peer_exchange(self, req, sender: str) -> List[bytes]:
         """Share known listen addresses of our other peers (the discovery

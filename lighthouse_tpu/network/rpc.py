@@ -161,6 +161,39 @@ class BlocksByRootRequest:
 
 
 @dataclass
+class BlobsByRangeRequest:
+    start_slot: int
+    count: int
+
+    def to_bytes(self) -> bytes:
+        return struct.pack("<QQ", self.start_slot, self.count)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "BlobsByRangeRequest":
+        start, count = struct.unpack("<QQ", data)
+        return cls(start, count)
+
+
+@dataclass
+class BlobsByRootRequest:
+    """List of (block_root, index) blob identifiers (spec BlobIdentifier)."""
+
+    ids: List[Tuple[bytes, int]]
+
+    def to_bytes(self) -> bytes:
+        return b"".join(r + struct.pack("<Q", i) for r, i in self.ids)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "BlobsByRootRequest":
+        if len(data) % 40:
+            raise RpcError("blob identifiers must be 40 bytes each")
+        return cls([
+            (data[i:i + 32], struct.unpack_from("<Q", data, i + 32)[0])
+            for i in range(0, len(data), 40)
+        ])
+
+
+@dataclass
 class PeerExchangeRequest:
     max_peers: int
 
@@ -224,6 +257,8 @@ REQUEST_TYPES = {
     METADATA: type(None),  # metadata request has an empty body
     BLOCKS_BY_RANGE: BlocksByRangeRequest,
     BLOCKS_BY_ROOT: BlocksByRootRequest,
+    BLOBS_BY_RANGE: BlobsByRangeRequest,
+    BLOBS_BY_ROOT: BlobsByRootRequest,
     PEER_EXCHANGE: PeerExchangeRequest,
 }
 

@@ -29,6 +29,17 @@ from .transport import Endpoint, Envelope
 MESSAGE_DOMAIN_VALID_SNAPPY = b"\x01\x00\x00\x00"
 SEEN_CACHE_SIZE = 16384
 
+# Gossipsub-shaped dissemination (reference vendored gossipsub: behaviour.rs
+# mesh maintenance + IHAVE/IWANT lazy gossip).  Eager push goes to at most
+# MESH_DEGREE peers per topic; up to LAZY_DEGREE others get an IHAVE with the
+# message id and pull what they miss with IWANT.  With few peers everything
+# degenerates to the old flood — same delivery, bounded amplification at
+# scale.
+MESH_DEGREE = 8  # gossipsub D
+LAZY_DEGREE = 6  # gossip_lazy
+MCACHE_SIZE = 512  # message cache entries servable via IWANT
+IWANT_RETRY_SECS = 5.0  # re-pull window when an advertiser never delivers
+
 
 def message_id(uncompressed: bytes) -> bytes:
     """Spec gossip message-id for snappy-decodable messages."""
@@ -46,6 +57,8 @@ class NetworkService:
         self.rate_limiter = rate_limiter if rate_limiter is not None else RPCRateLimiter()
         self.subscriptions: set = set()
         self._seen: "OrderedDict[bytes, None]" = OrderedDict()
+        self._mcache: "OrderedDict[bytes, Tuple[str, bytes]]" = OrderedDict()
+        self._iwant_pending: "OrderedDict[bytes, float]" = OrderedDict()
         self._seen_lock = threading.Lock()
         self._req_lock = threading.Lock()
         self._next_request_id = 1
@@ -101,26 +114,66 @@ class NetworkService:
                 self._seen.popitem(last=False)
             return True
 
-    def publish(self, topic: str, uncompressed: bytes) -> int:
-        """Publish locally-originated data; returns #peers reached."""
-        from . import snappy_codec
+    def _cache_message(self, mid: bytes, topic: str, compressed: bytes) -> None:
+        with self._seen_lock:
+            self._mcache[mid] = (topic, compressed)
+            while len(self._mcache) > MCACHE_SIZE:
+                self._mcache.popitem(last=False)
 
-        self._mark_seen(message_id(uncompressed))
-        data = snappy_codec.compress(uncompressed)
-        env = Envelope(kind="gossip", sender=self.peer_id, topic=str(topic), data=data)
+    def mesh_peers(self, topic: str, candidates) -> Tuple[list, list]:
+        """(mesh, lazy) split: a stable per-(node, topic) choice of at most
+        MESH_DEGREE full-message peers; up to LAZY_DEGREE of the rest get
+        IHAVE.  OUR peer id is mixed into the ranking — a global order would
+        make every node pick the same top peers and starve the tail; per-node
+        orders give the random-graph connectivity gossipsub meshes rely on."""
+        me = self.peer_id.encode()
+        ranked = sorted(
+            candidates,
+            key=lambda p: hashlib.sha256(me + p.encode() + topic.encode()).digest(),
+        )
+        return ranked[:MESH_DEGREE], ranked[MESH_DEGREE:MESH_DEGREE + LAZY_DEGREE]
+
+    def _disseminate(self, topic: str, mid: bytes, compressed: bytes,
+                     exclude: Optional[str]) -> int:
+        self._cache_message(mid, topic, compressed)
+        peers = [p for p in self.peer_manager.connected_peers() if p != exclude]
+        mesh, lazy = self.mesh_peers(topic, peers)
+        env = Envelope(kind="gossip", sender=self.peer_id, topic=topic, data=compressed)
         n = 0
-        for peer in self.peer_manager.connected_peers():
+        for peer in mesh:
             if self.endpoint.send(peer, env):
                 n += 1
+        if lazy:
+            ihave = Envelope(kind="ihave", sender=self.peer_id, topic=topic, data=mid)
+            for peer in lazy:
+                self.endpoint.send(peer, ihave)
         return n
 
-    def forward(self, topic: str, compressed: bytes, exclude: str) -> int:
-        env = Envelope(kind="gossip", sender=self.peer_id, topic=str(topic), data=compressed)
-        n = 0
-        for peer in self.peer_manager.connected_peers():
-            if peer != exclude and self.endpoint.send(peer, env):
-                n += 1
-        return n
+    def publish(self, topic: str, uncompressed: bytes) -> int:
+        """Publish locally-originated data; returns #peers eagerly reached."""
+        from . import snappy_codec
+
+        mid = message_id(uncompressed)
+        self._mark_seen(mid)
+        return self._disseminate(
+            str(topic), mid, snappy_codec.compress(uncompressed), exclude=None
+        )
+
+    def forward(self, topic: str, compressed: bytes, exclude: str,
+                uncompressed: Optional[bytes] = None) -> int:
+        """Forward validated gossip.  Callers that hold the uncompressed
+        bytes (the router always does) pass them to avoid re-decompressing
+        multi-MB payloads on the propagation hot path."""
+        from . import snappy_codec
+
+        if uncompressed is None:
+            try:
+                uncompressed = snappy_codec.decompress(compressed)
+            except snappy_codec.SnappyError:
+                return 0
+        return self._disseminate(
+            str(topic), message_id(uncompressed), compressed, exclude=exclude
+        )
 
     # ---------------------------------------------------------------- rpc
 
@@ -170,6 +223,10 @@ class NetworkService:
             try:
                 if env.kind == "gossip":
                     self._on_gossip(env)
+                elif env.kind == "ihave":
+                    self._on_ihave(env)
+                elif env.kind == "iwant":
+                    self._on_iwant(env)
                 elif env.kind == "rpc_request":
                     self._on_rpc_request(env)
                 elif env.kind == "rpc_response":
@@ -192,7 +249,10 @@ class NetworkService:
         except snappy_codec.SnappyError:
             self.peer_manager.report(env.sender, PeerAction.LOW_TOLERANCE, "bad snappy")
             return
-        if not self._mark_seen(message_id(uncompressed)):
+        mid = message_id(uncompressed)
+        with self._seen_lock:
+            self._iwant_pending.pop(mid, None)  # pull satisfied (if any)
+        if not self._mark_seen(mid):
             return
         if self.on_gossip is None:
             return
@@ -200,6 +260,42 @@ class NetworkService:
         # ``forward`` itself on acceptance — mirrors the reference's
         # propagate-after-validation flow.
         self.on_gossip(env.topic, uncompressed, env.data, env.sender)
+
+    def _on_ihave(self, env: Envelope) -> None:
+        """Lazy-gossip advert: pull the message if we haven't seen it
+        (gossipsub handle_ihave → IWANT)."""
+        mid = env.data
+        if len(mid) != 20 or env.topic not in self.subscriptions:
+            return
+        now = time.monotonic()
+        with self._seen_lock:
+            if mid in self._seen or mid in self._mcache:
+                return
+            pending_at = self._iwant_pending.get(mid)
+            if pending_at is not None and now - pending_at < IWANT_RETRY_SECS:
+                return  # an earlier pull is still in flight
+            # (re)pull: a prior advertiser may have disconnected or evicted
+            # the entry before answering — later IHAVEs must be able to retry
+            self._iwant_pending.pop(mid, None)
+            self._iwant_pending[mid] = now
+            while len(self._iwant_pending) > MCACHE_SIZE:
+                self._iwant_pending.popitem(last=False)
+        self.endpoint.send(
+            env.sender,
+            Envelope(kind="iwant", sender=self.peer_id, topic=env.topic, data=mid),
+        )
+
+    def _on_iwant(self, env: Envelope) -> None:
+        """Serve a cached message to a puller (gossipsub handle_iwant)."""
+        with self._seen_lock:
+            entry = self._mcache.get(env.data)
+        if entry is None:
+            return
+        topic, compressed = entry
+        self.endpoint.send(
+            env.sender,
+            Envelope(kind="gossip", sender=self.peer_id, topic=topic, data=compressed),
+        )
 
     def _on_rpc_request(self, env: Envelope) -> None:
         from .peer_manager import PeerAction

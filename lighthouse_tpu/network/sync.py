@@ -103,7 +103,7 @@ class SyncManager:
                         continue
                     try:
                         signed = self._decode_block_chunk(payload)
-                        chain.process_block(signed)
+                        self._import_with_blobs(peer, signed)
                         self.router._publish_light_client_updates()
                     except BlockError as e:
                         self.service.peer_manager.report(
@@ -112,6 +112,34 @@ class SyncManager:
                         return
         finally:
             self.state = SyncState.SYNCED
+
+    def _import_with_blobs(self, peer: str, signed) -> None:
+        """Import a synced block, fetching its blob sidecars over
+        BlobsByRoot first when the body carries commitments (reference
+        ``network_context.rs`` block+blob coupling)."""
+        chain = self.chain
+        commitments = getattr(signed.message.body, "blob_kzg_commitments", None)
+        if not commitments:
+            chain.process_block(signed)
+            return
+        block_root = signed.message.hash_tree_root()
+        ids = [(block_root, i) for i in range(len(commitments))]
+        try:
+            chunks = self.service.request(
+                peer, rpc_mod.BLOBS_BY_ROOT,
+                rpc_mod.BlobsByRootRequest(ids=ids), timeout=10.0,
+            )
+        except rpc_mod.RpcError as e:
+            raise BlockError(f"peer did not serve blobs: {e}") from e
+        sidecars = []
+        for result, payload, _ctx in chunks:
+            if result != rpc_mod.SUCCESS:
+                continue
+            try:
+                sidecars.append(chain.types.BlobSidecar.from_ssz_bytes(payload))
+            except Exception as e:
+                raise BlockError(f"undecodable blob sidecar: {e}") from e
+        chain.process_block_with_blobs(signed, sidecars)
 
     # ------------------------------------------------------ parent lookup
 

@@ -39,7 +39,8 @@ from .transport import Envelope
 
 MAX_FRAME = 64 * 1024 * 1024
 
-_KIND_TO_WIRE = {"hello": 0, "gossip": 1, "rpc_request": 2, "rpc_response": 3}
+_KIND_TO_WIRE = {"hello": 0, "gossip": 1, "rpc_request": 2, "rpc_response": 3,
+                 "ihave": 4, "iwant": 5}
 _WIRE_TO_KIND = {v: k for k, v in _KIND_TO_WIRE.items()}
 
 

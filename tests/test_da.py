@@ -156,7 +156,10 @@ def test_blob_gossip_completes_pending_block(setup):
             assert wait_until(lambda: hb.chain.get_block(root) is not None), (
                 "blobs must complete the deferred import"
             )
-            assert [int(s.index) for s in hb.chain.get_blobs(root)] == [0, 1]
+            # sidecar storage lands a hair after block visibility: poll
+            assert wait_until(
+                lambda: [int(s.index) for s in hb.chain.get_blobs(root)] == [0, 1]
+            ), "imported blob block must expose its sidecars"
         finally:
             na.shutdown()
             nb.shutdown()
@@ -211,3 +214,55 @@ def test_device_kzg_batch_matches_host(setup):
     bad = [proofs[1], proofs[0], proofs[2]]
     assert not host.verify_blob_kzg_proof_batch(blobs, comms, bad)
     assert not dev.verify_blob_kzg_proof_batch(blobs, comms, bad)
+
+
+def test_range_sync_fetches_blobs(setup):
+    """A fresh node range-syncing a chain that CONTAINS blob blocks pulls
+    sidecars over BlobsByRoot and imports with availability intact
+    (reference network_context.rs block+blob coupling)."""
+    from lighthouse_tpu.network.node import LocalNode
+    from lighthouse_tpu.network.transport import Hub
+
+    set_backend("fake")
+    try:
+        spec = minimal_spec(
+            preset=PRESET,
+            altair_fork_epoch=0, bellatrix_fork_epoch=0,
+            capella_fork_epoch=0, deneb_fork_epoch=0,
+        )
+        mk = lambda: BeaconChainHarness(
+            validator_count=16, spec=spec, fake_crypto=True, kzg=Kzg(setup)
+        )
+        ha, hb = mk(), mk()
+        # chain with a blob block in the middle
+        ha.extend_chain(2)
+        ha.advance_slot()
+        signed, sidecars = ha.produce_signed_block_with_blobs([_blob(3), _blob(4)])
+        ha.chain.process_block_with_blobs(signed, sidecars)
+        blob_root = signed.message.hash_tree_root()
+        ha.extend_chain(2)
+        for _ in range(5):
+            hb.advance_slot()  # same wall clock on the fresh side
+
+        hub = Hub()
+        na = LocalNode(hub=hub, peer_id="a2", harness=ha)
+        nb = LocalNode(hub=hub, peer_id="b2", harness=hb)
+        try:
+            hub.connect("a2", "b2")  # status exchange triggers range sync
+            import time
+
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if hb.chain.head_root == ha.chain.head_root:
+                    break
+                time.sleep(0.1)
+            assert hb.chain.head_root == ha.chain.head_root, "sync did not complete"
+            assert hb.chain.get_block(blob_root) is not None
+            assert [int(s.index) for s in hb.chain.get_blobs(blob_root)] == [0, 1], (
+                "synced node must hold the blob sidecars it fetched"
+            )
+        finally:
+            na.shutdown()
+            nb.shutdown()
+    finally:
+        set_backend("host")

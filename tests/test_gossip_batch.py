@@ -260,3 +260,68 @@ def test_aggregator_outside_committee_rejected():
     epoch = int(attestation.data.target.epoch)
     assert not harness.chain.observed.aggregators.is_known(epoch, outsider)
     assert node.service.peer_manager._peer("peer-x").score < 0
+
+
+# ------------------------------------------------------------ gossip mesh
+
+
+def test_mesh_split_is_bounded_and_stable():
+    from lighthouse_tpu.network.service import LAZY_DEGREE, MESH_DEGREE, NetworkService
+
+    harness, node = _mk_node(fake=True)
+    svc = node.service
+    peers = [f"p{i:02d}" for i in range(20)]
+    mesh, lazy = svc.mesh_peers("topic-a", peers)
+    assert len(mesh) == MESH_DEGREE and len(lazy) == LAZY_DEGREE
+    assert set(mesh).isdisjoint(lazy)
+    # stable: the same split every call
+    assert svc.mesh_peers("topic-a", peers) == (mesh, lazy)
+    # different topics pick different meshes (load spreading)
+    mesh_b, _ = svc.mesh_peers("topic-b", peers)
+    assert mesh_b != mesh
+
+
+def test_lazy_peers_pull_via_iwant():
+    """A 12-node full mesh: the publisher eagerly pushes to at most D peers;
+    every node still converges on the block (IHAVE -> IWANT pull)."""
+    from lighthouse_tpu.network.service import MESH_DEGREE
+
+    set_backend("fake")
+    try:
+        hub = Hub()
+        harnesses = []
+        nodes = []
+        for i in range(12):
+            hs = BeaconChainHarness(
+                validator_count=16, fake_crypto=True, genesis_time=GENESIS_TIME
+            )
+            harnesses.append(hs)
+            nodes.append(LocalNode(hub=hub, peer_id=f"m{i:02d}", harness=hs))
+        try:
+            for i in range(12):
+                for j in range(i + 1, 12):
+                    hub.connect(f"m{i:02d}", f"m{j:02d}")
+            for hs in harnesses:
+                hs.advance_slot()
+            signed = harnesses[0].produce_signed_block(slot=1)
+            root = signed.message.hash_tree_root()
+            harnesses[0].chain.process_block(signed)
+            sent = nodes[0].publish_block(signed)
+            assert sent <= MESH_DEGREE, (
+                f"publisher eagerly pushed to {sent} peers (flood, not mesh)"
+            )
+            import time
+
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if all(h.chain.get_block(root) is not None for h in harnesses):
+                    break
+                time.sleep(0.1)
+            missing = [n.peer_id for n, h in zip(nodes, harnesses)
+                       if h.chain.get_block(root) is None]
+            assert not missing, f"nodes never received the block: {missing}"
+        finally:
+            for n in nodes:
+                n.shutdown()
+    finally:
+        set_backend("host")
