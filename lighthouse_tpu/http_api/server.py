@@ -63,6 +63,20 @@ def parse_root_or_slot(s: str) -> Tuple[Optional[bytes], Optional[int]]:
         raise _bad(f"invalid block/state id {s!r}")
 
 
+class SszResponse:
+    """A handler's SSZ (application/octet-stream) answer — the server writes
+    the raw bytes with Eth-Consensus-Version plus any extra headers (the
+    beacon-API spec carries finality metadata as headers on SSZ answers)."""
+
+    __slots__ = ("data", "version", "headers")
+
+    def __init__(self, data: bytes, version: Optional[str] = None,
+                 headers: Optional[Dict[str, str]] = None):
+        self.data = data
+        self.version = version
+        self.headers = headers or {}
+
+
 class Context:
     """Everything a route handler needs."""
 
@@ -78,6 +92,31 @@ class Context:
     def q1(self, name: str, default: Optional[str] = None) -> Optional[str]:
         vals = self.query.get(name)
         return vals[0] if vals else default
+
+    @property
+    def wants_ssz(self) -> bool:
+        """True when the client PREFERS application/octet-stream (q-values
+        honored: an explicit lower/zero q on octet-stream keeps JSON)."""
+        accept = self.headers.get("Accept", "") or ""
+        q_octet = q_json = None
+        for part in accept.split(","):
+            fields = part.strip().split(";")
+            mtype = fields[0].strip().lower()
+            q = 1.0
+            for f in fields[1:]:
+                f = f.strip()
+                if f.startswith("q="):
+                    try:
+                        q = float(f[2:])
+                    except ValueError:
+                        q = 0.0
+            if mtype == "application/octet-stream":
+                q_octet = q
+            elif mtype in ("application/json", "*/*"):
+                q_json = max(q_json or 0.0, q)
+        if q_octet is None or q_octet <= 0:
+            return False
+        return q_json is None or q_octet >= q_json
 
     # ------------------------------------------------------- id resolution
 
@@ -543,8 +582,15 @@ def beacon_header(ctx):
 @route("GET", "/eth/v2/beacon/blocks/{block_id}")
 def beacon_block(ctx):
     root, block = ctx.resolve_block(ctx.params["block_id"])
+    fork = type(block.message).fork_name
+    if ctx.wants_ssz:
+        meta = _finality_meta(ctx, root)
+        return SszResponse(block.as_ssz_bytes(), fork, headers={
+            "Eth-Execution-Optimistic": str(meta.get("execution_optimistic", False)).lower(),
+            "Eth-Finalized": str(meta.get("finalized", False)).lower(),
+        })
     out = {
-        "version": type(block.message).fork_name,
+        "version": fork,
         "data": to_json(block),
     }
     out.update(_finality_meta(ctx, root))
@@ -578,8 +624,31 @@ def beacon_blob_sidecars(ctx):
     return {"data": [to_json(s) for s in sidecars]}
 
 
+def _decode_ssz_signed_block(ctx, body: bytes, registry) -> Any:
+    """SSZ block upload: version from the consensus-version header, else
+    derived from the slot at its fixed offset (message offset word ++
+    96-byte signature ++ slot u64 = bytes 100..108) — the same decision the
+    JSON path makes; never guess-and-swallow across forks."""
+    types, spec = ctx.chain.types, ctx.chain.spec
+    version = ctx.headers.get("Eth-Consensus-Version")  # case-insensitive get
+    if version is None:
+        if len(body) < 108:
+            raise _bad("SSZ block too short")
+        slot = int.from_bytes(body[100:108], "little")
+        version = spec.fork_name_at_slot(slot)
+    cls = registry.get(str(version).lower())
+    if cls is None:
+        raise _bad(f"unknown consensus version {version!r}")
+    try:
+        return cls.from_ssz_bytes(bytes(body))
+    except (ValueError, IndexError) as e:
+        raise _bad(f"malformed SSZ block: {e}")
+
+
 def _signed_block_from_json(ctx, body) -> Any:
     types, spec = ctx.chain.types, ctx.chain.spec
+    if isinstance(body, (bytes, bytearray)):
+        return _decode_ssz_signed_block(ctx, bytes(body), types.signed_block)
     version = None
     for k in ("Eth-Consensus-Version", "eth-consensus-version"):
         if ctx.headers.get(k):
@@ -618,9 +687,15 @@ def publish_block_v1(ctx):
     return _import_and_publish_block(ctx, _signed_block_from_json(ctx, ctx.body))
 
 
+publish_block_v1._accepts_ssz = True
+
+
 @route("POST", "/eth/v2/beacon/blocks", P0)
 def publish_block_v2(ctx):
     return _import_and_publish_block(ctx, _signed_block_from_json(ctx, ctx.body))
+
+
+publish_block_v2._accepts_ssz = True
 
 
 # -------------------------------------------------------------- pool routes
@@ -978,23 +1053,24 @@ def produce_blinded_block_route(ctx):
 @route("POST", "/eth/v1/beacon/blinded_blocks", P0)
 @route("POST", "/eth/v2/beacon/blinded_blocks", P0)
 def publish_blinded_block(ctx):
-    from ..chain.beacon_chain import BlockError, ChainError
+    from ..chain.beacon_chain import BlockError, ChainError  # noqa: F401
 
     chain = ctx.chain
-    version = None
-    for k in ("Eth-Consensus-Version", "eth-consensus-version"):
-        if ctx.headers.get(k):
-            version = ctx.headers.get(k).lower()
-            break
-    if version is None:
-        version = chain.spec.fork_name_at_slot(int(ctx.body["message"]["slot"]))
-    cls = chain.types.signed_blinded_block.get(version)
-    if cls is None:
-        raise _bad(f"unknown consensus version {version!r}")
-    try:
-        signed = container_from_json(cls, ctx.body)
-    except (KeyError, TypeError, ValueError) as e:
-        raise _bad(f"malformed SignedBlindedBeaconBlock: {e}")
+    if isinstance(ctx.body, (bytes, bytearray)):
+        signed = _decode_ssz_signed_block(
+            ctx, bytes(ctx.body), chain.types.signed_blinded_block
+        )
+    else:
+        version = ctx.headers.get("Eth-Consensus-Version")
+        if version is None:
+            version = chain.spec.fork_name_at_slot(int(ctx.body["message"]["slot"]))
+        cls = chain.types.signed_blinded_block.get(str(version).lower())
+        if cls is None:
+            raise _bad(f"unknown consensus version {version!r}")
+        try:
+            signed = container_from_json(cls, ctx.body)
+        except (KeyError, TypeError, ValueError) as e:
+            raise _bad(f"malformed SignedBlindedBeaconBlock: {e}")
     try:
         _root, signed_full = chain.unblind_and_import(signed)
     except (BlockError, ChainError) as e:
@@ -1003,6 +1079,9 @@ def publish_blinded_block(ctx):
     if publish is not None:
         publish(signed_full)
     return None
+
+
+publish_blinded_block._accepts_ssz = True
 
 
 @route("POST", "/eth/v1/validator/register_validator", P0)
@@ -1285,8 +1364,11 @@ def config_deposit_contract(ctx):
 @route("GET", "/eth/v2/debug/beacon/states/{state_id}")
 def debug_state(ctx):
     state, _ = ctx.resolve_state(ctx.params["state_id"])
+    fork = type(state).fork_name
+    if ctx.wants_ssz:
+        return SszResponse(state.as_ssz_bytes(), fork)
     return {
-        "version": type(state).fork_name,
+        "version": fork,
         "execution_optimistic": False,
         "finalized": False,
         "data": to_json(state),
@@ -1374,15 +1456,36 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 priority, fn, params = m
                 if raw:
-                    try:
-                        body = json.loads(raw)
-                    except json.JSONDecodeError:
-                        self._write_json(400, {"code": 400, "message": "invalid JSON"})
-                        return
+                    ctype = (self.headers.get("Content-Type") or "").lower()
+                    if "application/octet-stream" in ctype:
+                        if not getattr(fn, "_accepts_ssz", False):
+                            self._write_json(415, {
+                                "code": 415,
+                                "message": "this route does not accept application/octet-stream",
+                            })
+                            return
+                        body = raw  # SSZ upload: the handler decodes
+                    else:
+                        try:
+                            body = json.loads(raw)
+                        except json.JSONDecodeError:
+                            self._write_json(400, {"code": 400, "message": "invalid JSON"})
+                            return
                 ctx = Context(self.api, params, parse_qs(parsed.query), body, self.headers)
                 try:
                     result = self.api.spawner.blocking_json_task(priority, lambda: fn(ctx))
-                    self._write_json(200, result)
+                    if isinstance(result, SszResponse):
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/octet-stream")
+                        if result.version:
+                            self.send_header("Eth-Consensus-Version", result.version)
+                        for hk, hv in result.headers.items():
+                            self.send_header(hk, hv)
+                        self.send_header("Content-Length", str(len(result.data)))
+                        self.end_headers()
+                        self.wfile.write(result.data)
+                    else:
+                        self._write_json(200, result)
                 except ValueError as e:
                     # Malformed user-supplied ints/hex parse straight to
                     # ValueError — a contract 400.  Other exception types stay

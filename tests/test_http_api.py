@@ -250,3 +250,52 @@ def test_events_sse(served):
     assert any("event: block" in f for f in received)
     block_frames = [f for f in received if "event: block" in f]
     assert f'"0x{harness.head_root.hex()}"' in block_frames[-1]
+
+
+# ---------------------------------------------------- SSZ content negotiation
+
+
+def test_ssz_block_and_state_negotiation(served):
+    """Accept: application/octet-stream returns the raw SSZ with the
+    consensus-version header, round-trippable into the same object; SSZ
+    uploads publish through the octet-stream content type (reference
+    content negotiation on the block/state routes)."""
+    import urllib.request
+
+    harness, server, client = served
+    harness.extend_chain(1)
+    head = harness.chain.get_block(harness.chain.head_root)
+    fork = type(head.message).fork_name
+
+    req = urllib.request.Request(
+        f"{server.url}/eth/v2/beacon/blocks/head",
+        headers={"Accept": "application/octet-stream"},
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        assert resp.headers["Content-Type"] == "application/octet-stream"
+        assert resp.headers["Eth-Consensus-Version"] == fork
+        raw = resp.read()
+    decoded = harness.types.signed_block[fork].from_ssz_bytes(raw)
+    assert decoded.message.hash_tree_root() == harness.chain.head_root
+
+    req = urllib.request.Request(
+        f"{server.url}/eth/v2/debug/beacon/states/head",
+        headers={"Accept": "application/octet-stream"},
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        raw_state = resp.read()
+    st = harness.types.state[fork].from_ssz_bytes(raw_state)
+    assert st.hash_tree_root() == harness.chain.head_state.hash_tree_root()
+
+    # SSZ publish: produce + sign the next block, POST the raw bytes
+    signed = harness.produce_signed_block(slot=harness.advance_slot())
+    req = urllib.request.Request(
+        f"{server.url}/eth/v2/beacon/blocks",
+        data=signed.as_ssz_bytes(),
+        method="POST",
+        headers={"Content-Type": "application/octet-stream",
+                 "Eth-Consensus-Version": type(signed.message).fork_name},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.status == 200
+    assert harness.chain.head_root == signed.message.hash_tree_root()
