@@ -1053,7 +1053,7 @@ def produce_blinded_block_route(ctx):
 @route("POST", "/eth/v1/beacon/blinded_blocks", P0)
 @route("POST", "/eth/v2/beacon/blinded_blocks", P0)
 def publish_blinded_block(ctx):
-    from ..chain.beacon_chain import BlockError, ChainError  # noqa: F401
+    from ..chain.beacon_chain import BlockError, ChainError
 
     chain = ctx.chain
     if isinstance(ctx.body, (bytes, bytearray)):

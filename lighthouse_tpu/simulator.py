@@ -1,0 +1,150 @@
+"""In-process multi-node simulator.
+
+Equivalent of the reference's ``testing/simulator`` (``basic-sim`` /
+``fallback-sim``: N in-process beacon nodes + validator clients on one
+runtime, liveness checks per epoch — ``checks.rs`` asserts finalization and
+sync participation).  Nodes gossip over the in-process hub fabric; each node
+owns a disjoint share of the validator keys and performs its duties locally,
+publishing blocks and attestations to the others.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .chain import BeaconChainHarness
+from .consensus import helpers as h
+from .network.node import LocalNode
+from .network.transport import Hub
+
+
+class SimNode:
+    def __init__(self, *, index: int, hub: Hub, validator_count: int,
+                 keys: List[int], genesis_time: int, spec=None):
+        self.index = index
+        self.harness = BeaconChainHarness(
+            validator_count=validator_count, fake_crypto=True,
+            genesis_time=genesis_time, spec=spec,
+        )
+        self.keys = set(keys)  # validator indices this node runs
+        self.node = LocalNode(
+            hub=hub, peer_id=f"sim{index}", harness=self.harness
+        )
+
+    @property
+    def chain(self):
+        return self.harness.chain
+
+    def run_duties(self, slot: int) -> Dict[str, int]:
+        """One slot of duties for OUR validators: propose if ours, attest
+        with our committee members (published over gossip)."""
+        harness, chain = self.harness, self.chain
+        spec = harness.spec
+        out = {"proposed": 0, "attested": 0}
+        state, parent_root = chain.state_at_slot(slot)
+        proposer = h.get_beacon_proposer_index(state, spec)
+        if proposer in self.keys:
+            signed = harness.produce_signed_block(slot=slot)
+            chain.process_block(signed)
+            self.node.publish_block(signed)
+            out["proposed"] = 1
+        # committees are epoch-deterministic on the advanced state
+        epoch = slot // spec.slots_per_epoch
+        committees = h.get_committee_count_per_slot(state, epoch, spec)
+        for index in range(committees):
+            committee = h.get_beacon_committee(state, slot, index, spec)
+            data = chain.produce_attestation_data(slot, index)
+            for pos, vidx in enumerate(committee):
+                if int(vidx) not in self.keys:
+                    continue
+                bits = [False] * len(committee)
+                bits[pos] = True
+                sig = harness.sign_attestation_data(state, data, int(vidx))
+                att = harness.types.Attestation(
+                    aggregation_bits=bits, data=data, signature=sig.to_bytes()
+                )
+                try:
+                    chain.process_attestation(att)
+                except Exception:
+                    continue
+                self.node.publish_attestation(att)
+                out["attested"] += 1
+        return out
+
+    def shutdown(self) -> None:
+        # sever the fabric links too: live peers must stop delivering into a
+        # dead node's inbound queue (unbounded growth otherwise)
+        for peer in list(self.node.endpoint.connected_peers()):
+            self.node.endpoint.hub.disconnect(self.node.peer_id, peer)
+        self.node.shutdown()
+
+
+class Simulator:
+    """N nodes, full mesh, validators partitioned round-robin."""
+
+    def __init__(self, *, node_count: int = 3, validator_count: int = 16,
+                 genesis_time: int = 1_600_000_000, spec=None):
+        self.hub = Hub()
+        self.nodes: List[SimNode] = []
+        shares: List[List[int]] = [[] for _ in range(node_count)]
+        for v in range(validator_count):
+            shares[v % node_count].append(v)
+        for i in range(node_count):
+            self.nodes.append(SimNode(
+                index=i, hub=self.hub, validator_count=validator_count,
+                keys=shares[i], genesis_time=genesis_time, spec=spec,
+            ))
+        for i in range(node_count):
+            for j in range(i + 1, node_count):
+                self.hub.connect(f"sim{i}", f"sim{j}")
+
+    def run_slot(self) -> int:
+        """Advance every clock one slot and run all duties; returns the slot.
+        Raises if gossip fails to converge the heads (a divergence would
+        otherwise burn the whole run before the final check reports it)."""
+        slot = None
+        for n in self.nodes:
+            slot = n.harness.advance_slot()
+        for n in self.nodes:
+            n.run_duties(slot)
+        if not self.wait_converged():
+            raise AssertionError(f"heads failed to converge at slot {slot}")
+        return slot
+
+    def run_epochs(self, epochs: int) -> None:
+        spe = self.nodes[0].harness.spec.slots_per_epoch
+        for _ in range(epochs * spe):
+            self.run_slot()
+
+    def wait_converged(self, timeout: float = 10.0) -> bool:
+        """Wait until every node agrees on the head (gossip settled)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            heads = {n.chain.head_root for n in self.nodes}
+            if len(heads) == 1:
+                return True
+            for n in self.nodes:
+                n.node.wait_idle()
+            # all idle yet diverged: don't busy-spin until the deadline
+            time.sleep(0.05)
+        return len({n.chain.head_root for n in self.nodes}) == 1
+
+    # ------------------------------------------------------------- checks
+
+    def check_finalization(self, min_epoch: int) -> None:
+        """The reference's per-epoch liveness check (checks.rs)."""
+        for n in self.nodes:
+            f_epoch, _ = n.chain.finalized_checkpoint()
+            assert f_epoch >= min_epoch, (
+                f"node {n.index} finalized epoch {f_epoch} < {min_epoch}"
+            )
+
+    def check_heads_agree(self) -> None:
+        heads = {n.chain.head_root for n in self.nodes}
+        assert len(heads) == 1, f"heads diverged: {len(heads)} distinct"
+
+    def shutdown(self) -> None:
+        for n in self.nodes:
+            n.shutdown()
