@@ -117,6 +117,10 @@ class ClientBuilder:
         from ..crypto.bls.backends import set_backend
 
         set_backend(self._bls_backend)  # node assembly selects the device path
+        if os.environ.get("LIGHTHOUSE_TPU_DEVICE_SHA") == "1":
+            from ..ops.sha256_device import install_device_hash
+
+            install_device_hash()  # bulk Merkle layers on the device VPU
         types = build_types(self._spec.preset)
 
         db = None
