@@ -369,8 +369,39 @@ class BeaconChain:
         return block
 
     def get_blobs(self, block_root: bytes) -> list:
-        """Blob sidecars stored at import (the blob_sidecars API's source)."""
-        return list(self._blob_sidecars.get(block_root, []))
+        """Blob sidecars stored at import or backfill (memory first, store
+        fallback — the blob_sidecars API's and blob RPC's source)."""
+        mem = self._blob_sidecars.get(block_root)
+        if mem is not None:
+            return list(mem)
+        return self.db.get_blobs(block_root)
+
+    def store_backfilled_blobs(self, signed_block, sidecars) -> None:
+        """Persist sidecars for a hash-chain-verified BACKFILLED block.
+
+        Full verification, not just commitment equality: exact index
+        coverage, commitment match against the verified block, and the KZG
+        batch proof (a copied commitment over garbage blob bytes must not
+        be served).  Raises ``BlockError`` on any failure."""
+        commitments = list(
+            getattr(signed_block.message.body, "blob_kzg_commitments", []) or []
+        )
+        block_root = signed_block.message.hash_tree_root()
+        got = sorted(sidecars, key=lambda s: int(s.index))
+        if [int(s.index) for s in got] != list(range(len(commitments))):
+            raise BlockError("backfilled sidecars do not cover indices exactly")
+        for sc in got:
+            if bytes(sc.kzg_commitment) != bytes(commitments[int(sc.index)]):
+                raise BlockError("backfilled sidecar commitment mismatch")
+        if self.kzg is None:
+            raise BlockError("no KZG engine: cannot verify backfilled blobs")
+        if not self.kzg.verify_blob_kzg_proof_batch(
+            [bytes(sc.blob) for sc in got],
+            [bytes(sc.kzg_commitment) for sc in got],
+            [bytes(sc.kzg_proof) for sc in got],
+        ):
+            raise BlockError("backfilled blob KZG verification failed")
+        self.db.put_blobs(block_root, got)
 
     def get_state(self, block_root: bytes):
         """Post-state for ``block_root`` — object cache first, then the hot
@@ -1443,6 +1474,11 @@ class BeaconChain:
                 self._blob_sidecars.pop(root, None)
             elif int(self._blocks[root].message.slot) < horizon_slot:
                 self._blob_sidecars.pop(root, None)
+        # store-side retention (backfilled sidecars live in the DB only)
+        try:
+            self.db.prune_blobs(horizon_slot)
+        except Exception:
+            pass  # retention pruning must never break the slot tick
 
     # ------------------------------------------------------------- queries
 

@@ -82,6 +82,7 @@ class BackfillSync:
                     )
                     return filled
                 chain.db.put_block(root, signed)
+                self._backfill_blobs(peer, root, signed)
                 self.expected_parent = bytes(signed.message.parent_root)
                 self.oldest_slot = int(signed.message.slot)
                 filled += 1
@@ -92,3 +93,57 @@ class BackfillSync:
             if not progressed:
                 break
         return filled
+
+    def _backfill_blobs(self, peer: str, block_root: bytes, signed) -> None:
+        """Fetch sidecars for a hash-chain-verified backfilled block inside
+        the blob retention window (reference: backfill requests blobs
+        alongside blocks post-Deneb).  Verification and persistence live at
+        the chain layer (``store_backfilled_blobs``: exact index coverage,
+        commitment equality against the verified block, KZG batch proof)."""
+        chain = self.chain
+        commitments = getattr(signed.message.body, "blob_kzg_commitments", None)
+        if not commitments:
+            return
+        horizon = chain.current_slot() - (
+            chain.spec.min_epochs_for_blob_sidecars_requests
+            * chain.spec.slots_per_epoch
+        )
+        if int(signed.message.slot) < horizon:
+            return  # outside retention: blocks only (spec behavior)
+        try:
+            chunks = self.service.request(
+                peer, rpc_mod.BLOBS_BY_ROOT,
+                rpc_mod.BlobsByRootRequest(
+                    ids=[(block_root, i) for i in range(len(commitments))]
+                ),
+                timeout=10.0,
+            )
+        except rpc_mod.RpcError:
+            self.service.peer_manager.report(
+                peer, PeerAction.HIGH_TOLERANCE, "backfill blobs unavailable"
+            )
+            return
+        sidecars = []
+        for result, payload, _ctx in chunks:
+            if result != rpc_mod.SUCCESS:
+                continue
+            try:
+                sidecars.append(chain.types.BlobSidecar.from_ssz_bytes(payload))
+            except Exception:
+                self.service.peer_manager.report(
+                    peer, PeerAction.LOW_TOLERANCE, "undecodable backfill sidecar"
+                )
+                return
+        from ..chain.beacon_chain import BlockError
+
+        try:
+            # chain-layer verification: exact index coverage, commitment
+            # equality, KZG batch proof; persisted in the DB where retention
+            # pruning governs it
+            chain.store_backfilled_blobs(signed, sidecars)
+        except BlockError as e:
+            # incomplete or invalid: penalize and leave unstored so another
+            # peer can be asked (re-running backfill re-requests this span)
+            self.service.peer_manager.report(
+                peer, PeerAction.MID_TOLERANCE, f"backfill blobs rejected: {e}"
+            )

@@ -129,8 +129,11 @@ def install_device_hash(threshold_blocks: int = 8192) -> None:
     host_impl = ssz_mod._hash_pairs
 
     def hybrid(data: bytes) -> bytes:
-        if len(data) // 64 >= threshold_blocks:
+        n = len(data) // 64
+        if threshold_blocks <= n <= N_BUCKETS[-1]:
             return hash_pairs_device(data)
+        # below threshold OR above the largest bucket: the host kernel
+        # (oversize layers must never crash hash_tree_root)
         return host_impl(data)
 
     ssz_mod.set_hash_pairs_impl(hybrid)

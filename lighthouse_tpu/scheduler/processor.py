@@ -37,6 +37,24 @@ from .work import (
 )
 
 
+from .. import metrics as _gm
+
+# Per-work-class series on /metrics (reference: the beacon_processor's
+# per-queue event counters, task_executor's per-task metrics).
+WORK_EVENTS_RECEIVED = _gm.counter(
+    "beacon_processor_work_events_received_total",
+    "work events enqueued, by work class",
+)
+WORK_EVENTS_PROCESSED = _gm.counter(
+    "beacon_processor_work_events_processed_total",
+    "work events completed, by work class",
+)
+WORK_EVENTS_DROPPED = _gm.counter(
+    "beacon_processor_work_events_dropped_total",
+    "work events dropped (full queue or worker panic), by work class",
+)
+
+
 @dataclass
 class ProcessorMetrics:
     received: Dict[str, int] = field(default_factory=dict)
@@ -47,6 +65,13 @@ class ProcessorMetrics:
 
     def bump(self, table: Dict[str, int], key: str, n: int = 1) -> None:
         table[key] = table.get(key, 0) + n
+        # mirror the three event tables onto the Prometheus registry
+        if table is self.received:
+            WORK_EVENTS_RECEIVED.inc(n, work=key)
+        elif table is self.processed:
+            WORK_EVENTS_PROCESSED.inc(n, work=key)
+        elif table is self.dropped:
+            WORK_EVENTS_DROPPED.inc(n, work=key)
 
 
 class BeaconProcessor:

@@ -138,6 +138,44 @@ class HotColdDB:
     def delete_block(self, block_root: bytes) -> None:
         self.hot.delete(DBColumn.BEACON_BLOCK, block_root)
 
+    # --------------------------------------------------------------- blobs
+
+    def put_blobs(self, block_root: bytes, sidecars) -> None:
+        """Persist a block's full sidecar set (index-ascending)."""
+        payload = b"".join(
+            len(raw).to_bytes(4, "big") + raw
+            for raw in (sc.as_ssz_bytes() for sc in sidecars)
+        )
+        self.hot.put(DBColumn.BLOB_SIDECAR, block_root, payload)
+
+    def get_blobs(self, block_root: bytes) -> list:
+        raw = self.hot.get(DBColumn.BLOB_SIDECAR, block_root)
+        if raw is None:
+            return []
+        out = []
+        pos = 0
+        while pos < len(raw):
+            n = int.from_bytes(raw[pos:pos + 4], "big")
+            pos += 4
+            out.append(self.types.BlobSidecar.from_ssz_bytes(raw[pos:pos + n]))
+            pos += n
+        return out
+
+    def delete_blobs(self, block_root: bytes) -> None:
+        self.hot.delete(DBColumn.BLOB_SIDECAR, block_root)
+
+    def prune_blobs(self, horizon_slot: int) -> int:
+        """Drop stored sidecars older than the retention horizon; returns
+        the number of blocks pruned (spec MIN_EPOCHS_FOR_BLOB_SIDECARS...)."""
+        pruned = 0
+        for key, raw in list(self.hot.iter_column(DBColumn.BLOB_SIDECAR)):
+            n = int.from_bytes(raw[:4], "big")
+            sc = self.types.BlobSidecar.from_ssz_bytes(raw[4:4 + n])
+            if int(sc.signed_block_header.message.slot) < horizon_slot:
+                self.hot.delete(DBColumn.BLOB_SIDECAR, key)
+                pruned += 1
+        return pruned
+
     # ---------------------------------------------------------- hot states
 
     def put_state(self, state_root: bytes, state, latest_block_root: bytes) -> None:

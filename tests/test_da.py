@@ -266,3 +266,66 @@ def test_range_sync_fetches_blobs(setup):
             nb.shutdown()
     finally:
         set_backend("host")
+
+
+def test_backfill_fetches_blobs_in_retention_window(setup):
+    """Checkpoint-synced node backfills a blob block: sidecars come over
+    BlobsByRoot, authenticated by commitment equality against the
+    hash-chain-verified block."""
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain, genesis_block_root_of
+    from lighthouse_tpu.network.backfill import BackfillSync
+    from lighthouse_tpu.network.node import LocalNode
+    from lighthouse_tpu.network.transport import Hub
+
+    set_backend("fake")
+    try:
+        spec = minimal_spec(
+            preset=PRESET,
+            altair_fork_epoch=0, bellatrix_fork_epoch=0,
+            capella_fork_epoch=0, deneb_fork_epoch=0,
+        )
+        ha = BeaconChainHarness(
+            validator_count=16, spec=spec, fake_crypto=True, kzg=Kzg(setup)
+        )
+        # history: 2 plain blocks, then a blob block, then 2 more
+        ha.extend_chain(2)
+        ha.advance_slot()
+        signed, sidecars = ha.produce_signed_block_with_blobs([_blob(5)])
+        ha.chain.process_block_with_blobs(signed, sidecars)
+        blob_root = signed.message.hash_tree_root()
+        ha.extend_chain(2)
+
+        # checkpoint-boot a fresh node from the current head
+        anchor_root = ha.chain.head_root
+        anchor_block = ha.chain.get_block(anchor_root)
+        anchor_state = ha.chain.get_state(anchor_root).copy()
+        from lighthouse_tpu.chain.slot_clock import ManualSlotClock
+
+        chain_b = BeaconChain(
+            genesis_state=anchor_state,
+            types=ha.types, spec=spec,
+            slot_clock=ManualSlotClock(
+                int(anchor_state.genesis_time), spec.seconds_per_slot
+            ),
+            kzg=Kzg(setup),
+            anchor_block=anchor_block,
+        )
+        chain_b.slot_clock.set_slot(int(anchor_state.slot))
+        hub = Hub()
+        na = LocalNode(hub=hub, peer_id="bf-a", harness=ha)
+        nb = LocalNode(hub=hub, peer_id="bf-b", chain=chain_b)
+        try:
+            hub.connect("bf-a", "bf-b")
+            backfill = BackfillSync(chain=chain_b, service=nb.service)
+            filled = backfill.backfill_from("bf-a")
+            assert filled == 4  # blocks 1..4 behind the anchor at slot 5
+            assert chain_b.db.get_block(blob_root) is not None
+            got = chain_b.get_blobs(blob_root)
+            assert [int(s.index) for s in got] == [0], (
+                "backfill must fetch the blob sidecars in the retention window"
+            )
+        finally:
+            na.shutdown()
+            nb.shutdown()
+    finally:
+        set_backend("host")
