@@ -51,7 +51,9 @@ def run_beacon_node(args) -> int:
     else:
         spec = _spec_for(args.network)
     builder = ClientBuilder().with_spec(spec).with_bls_backend(args.bls_backend)
-    if args.interop_validators:
+    if getattr(args, "checkpoint_sync_url", None):
+        builder.with_checkpoint_sync(args.checkpoint_sync_url)
+    elif args.interop_validators:
         builder.with_interop_genesis(
             args.interop_validators, genesis_time=args.interop_genesis_time
         )
@@ -63,7 +65,8 @@ def run_beacon_node(args) -> int:
         with open(args.genesis_state, "rb") as f:
             builder.with_genesis_state(types.state[fork].from_ssz_bytes(f.read()))
     else:
-        raise SystemExit("provide --interop-validators N or --genesis-state FILE")
+        raise SystemExit("provide --checkpoint-sync-url URL, "
+                         "--interop-validators N or --genesis-state FILE")
     if args.datadir:
         builder.with_datadir(args.datadir)
     if args.execution_endpoint:
@@ -454,6 +457,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="comma-separated host:port static peers to dial")
     bn.add_argument("--boot-nodes", default=None,
                     help="comma-separated host:port boot nodes for discovery")
+    bn.add_argument("--checkpoint-sync-url", default=None,
+                    help="boot from this trusted node's finalized checkpoint")
     bn.add_argument("--datadir", default=None)
     bn.add_argument("--http-port", type=int, default=5052)
     bn.add_argument("--execution-endpoint", default=None)

@@ -58,6 +58,31 @@ class BeaconNodeHttpClient:
     def get(self, path: str) -> Any:
         return self._request("GET", path)
 
+    def get_ssz(self, path: str):
+        """GET with ``Accept: application/octet-stream``; returns
+        ``(raw_bytes, consensus_version)`` — the checkpoint-sync fetch shape.
+        Errors surface as ``ApiClientError`` like every other method."""
+        req = urllib.request.Request(
+            self.base_url + path,
+            headers={"Accept": "application/octet-stream"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                ctype = (resp.headers.get("Content-Type") or "").lower()
+                if "application/octet-stream" not in ctype:
+                    raise ApiClientError(
+                        resp.status,
+                        f"server answered {ctype!r}, not SSZ — it does not "
+                        "support octet-stream on this route",
+                    )
+                return resp.read(), resp.headers.get("Eth-Consensus-Version")
+        except urllib.error.HTTPError as e:
+            try:
+                msg = e.read().decode(errors="replace")
+            except Exception:
+                msg = str(e)
+            raise ApiClientError(e.code, msg) from None
+
     def post(self, path: str, body: Any = None,
              headers: Optional[Dict[str, str]] = None) -> Any:
         return self._request("POST", path, body, headers)
