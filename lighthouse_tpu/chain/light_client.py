@@ -15,10 +15,10 @@ block whose sync aggregate has participants yields
 
 Bootstraps are built on demand from any stored finalized block/state.
 
-Branch depths are the altair..deneb gindices (state containers ≤32 fields:
-current/next sync committee at field 22/23 under a depth-5 tree, finalized
-root one level deeper).  Electra moves to 64-field gindices — electra states
-are currently skipped (served objects remain pre-electra format).
+Branch depths follow the state's field count: ≤32 fields (altair..deneb) is
+a depth-5 tree with the finalized root one level deeper; electra's 37-field
+state is depth 6/7 and is served with the electra LC container variants
+(the fork-era registry ``types.light_client``).
 """
 
 from __future__ import annotations
@@ -29,6 +29,17 @@ from ..types import ssz as ssz_mod
 
 SYNC_COMMITTEE_BRANCH_DEPTH = 5
 FINALITY_BRANCH_DEPTH = 6
+
+
+def state_depth(state) -> int:
+    """Merkle depth of the state container's field tree (5 through deneb,
+    6 for electra's 37 fields)."""
+    return max(0, (len(state.ssz_type.field_types) - 1).bit_length())
+
+
+def lc_era(state) -> str:
+    """Which LC container era the state's layout requires."""
+    return "electra" if state_depth(state) > SYNC_COMMITTEE_BRANCH_DEPTH else "altair"
 
 
 def state_field_roots(state) -> List[bytes]:
@@ -48,13 +59,10 @@ def _field_branch(state, field_name: str, roots: Optional[List[bytes]] = None):
     names = list(t.field_types)
     if field_name not in t.field_types:
         return None  # pre-altair state: no sync committees
-    if len(names) > (1 << SYNC_COMMITTEE_BRANCH_DEPTH):
-        return None  # electra+ layout: depth-6 gindices not yet served
+    depth = state_depth(state)
     if roots is None:
         roots = state_field_roots(state)
-    return ssz_mod.merkle_branch(
-        roots, 1 << SYNC_COMMITTEE_BRANCH_DEPTH, names.index(field_name)
-    )
+    return ssz_mod.merkle_branch(roots, 1 << depth, names.index(field_name))
 
 
 def sync_committee_branch(state, field_name: str,
@@ -67,16 +75,12 @@ def finality_branch(state, roots: Optional[List[bytes]] = None):
     the checkpoint's own epoch-sibling leaf + the state-level branch."""
     t = state.ssz_type
     names = list(t.field_types)
-    if len(names) > (1 << SYNC_COMMITTEE_BRANCH_DEPTH):
-        return None
     cp = state.finalized_checkpoint
     epoch_leaf = ssz_mod.uint64.hash_tree_root(int(cp.epoch))
     if roots is None:
         roots = state_field_roots(state)
     state_level = ssz_mod.merkle_branch(
-        roots,
-        1 << SYNC_COMMITTEE_BRANCH_DEPTH,
-        names.index("finalized_checkpoint"),
+        roots, 1 << state_depth(state), names.index("finalized_checkpoint")
     )
     # Checkpoint = (epoch, root): root is leaf index 1, sibling = epoch leaf.
     return [epoch_leaf] + state_level
@@ -133,7 +137,8 @@ class LightClientServerCache:
         # incremental; recomputing per branch would double the cost).
         roots = state_field_roots(parent_state)
 
-        optimistic = self.types.LightClientOptimisticUpdate(
+        lc = self.types.light_client[lc_era(parent_state)]
+        optimistic = lc["optimistic_update"](
             attested_header=attested_header,
             sync_aggregate=sync_aggregate.copy(),
             signature_slot=signature_slot,
@@ -148,7 +153,7 @@ class LightClientServerCache:
 
         fin_branch = finality_branch(parent_state, roots)
         if fin_branch is not None and finalized_block is not None:
-            finality = self.types.LightClientFinalityUpdate(
+            finality = lc["finality_update"](
                 attested_header=attested_header,
                 finalized_header=block_to_lc_header(self.types, finalized_block),
                 finality_branch=fin_branch,
@@ -177,12 +182,12 @@ class LightClientServerCache:
                 has_finality = True
             else:
                 fin_header = self.types.LightClientHeader()
-                fin_br = [b"\x00" * 32] * FINALITY_BRANCH_DEPTH
+                fin_br = [b"\x00" * 32] * (state_depth(parent_state) + 1)
                 has_finality = False
             period = self._period(int(parent_block.message.slot)
                                   if hasattr(parent_block, "message")
                                   else int(parent_block.slot))
-            update = self.types.LightClientUpdate(
+            update = lc["update"](
                 attested_header=attested_header,
                 next_sync_committee=parent_state.next_sync_committee.copy(),
                 next_sync_committee_branch=nsc_branch,
@@ -209,7 +214,8 @@ class LightClientServerCache:
         branch = sync_committee_branch(state, "current_sync_committee")
         if branch is None:
             return None
-        return self.types.LightClientBootstrap(
+        era = lc_era(state)
+        return self.types.light_client[era]["bootstrap"](
             header=block_to_lc_header(self.types, block),
             current_sync_committee=state.current_sync_committee.copy(),
             current_sync_committee_branch=branch,

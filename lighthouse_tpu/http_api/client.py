@@ -70,8 +70,10 @@ class BeaconNodeHttpClient:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 ctype = (resp.headers.get("Content-Type") or "").lower()
                 if "application/octet-stream" not in ctype:
+                    # 406 Not Acceptable: an HTTP-200 with the wrong type is
+                    # still a failed negotiation from the caller's view
                     raise ApiClientError(
-                        resp.status,
+                        406,
                         f"server answered {ctype!r}, not SSZ — it does not "
                         "support octet-stream on this route",
                     )
@@ -202,12 +204,18 @@ class BeaconNodeHttpClient:
             [to_json(c) for c in signed_contributions],
         )
 
+    @staticmethod
+    def _lc_era(branch) -> str:
+        # 6/7-element branches are the electra (64-leaf state) era
+        return "electra" if len(branch) >= 6 else "altair"
+
     def light_client_bootstrap(self, block_root: bytes, types=None):
         data = self.get(
             f"/eth/v1/beacon/light_client/bootstrap/0x{bytes(block_root).hex()}"
         )["data"]
         if types is not None:
-            return container_from_json(types.LightClientBootstrap, data)
+            era = self._lc_era(data["current_sync_committee_branch"])
+            return container_from_json(types.light_client[era]["bootstrap"], data)
         return data
 
     def light_client_updates(self, start_period: int, count: int, types=None):
@@ -216,14 +224,24 @@ class BeaconNodeHttpClient:
             f"?start_period={start_period}&count={count}"
         )
         if types is not None:
-            return [container_from_json(types.LightClientUpdate, e["data"])
-                    for e in entries]
+            return [
+                container_from_json(
+                    types.light_client[
+                        self._lc_era(e["data"]["next_sync_committee_branch"])
+                    ]["update"],
+                    e["data"],
+                )
+                for e in entries
+            ]
         return entries
 
     def light_client_finality_update(self, types=None):
         data = self.get("/eth/v1/beacon/light_client/finality_update")["data"]
         if types is not None:
-            return container_from_json(types.LightClientFinalityUpdate, data)
+            era = "electra" if len(data["finality_branch"]) >= 7 else "altair"
+            return container_from_json(
+                types.light_client[era]["finality_update"], data
+            )
         return data
 
     def light_client_optimistic_update(self, types=None):

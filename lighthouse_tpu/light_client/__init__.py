@@ -20,12 +20,13 @@ from ..consensus import helpers as h
 from ..consensus.signature_sets import pubkey_cache
 from ..crypto.bls import api as bls
 from ..types.spec import DOMAIN_SYNC_COMMITTEE, ChainSpec
-from ..chain.light_client import FINALITY_BRANCH_DEPTH, SYNC_COMMITTEE_BRANCH_DEPTH
 from ..consensus.per_block import is_valid_merkle_branch
 
-CURRENT_SYNC_COMMITTEE_INDEX = 22  # field index in the ≤32-field state
+CURRENT_SYNC_COMMITTEE_INDEX = 22  # state field indices (all forks)
 NEXT_SYNC_COMMITTEE_INDEX = 23
-FINALIZED_ROOT_INDEX = 20 * 2 + 1  # checkpoint.root under finalized_checkpoint
+FINALIZED_ROOT_SUBINDEX = 20 * 2 + 1  # checkpoint.root under finalized_checkpoint
+# depths derive from the received branch lengths: 5/6 through deneb,
+# 6/7 for electra's 64-leaf state layout
 
 
 class LightClientError(Exception):
@@ -56,7 +57,7 @@ class LightClientStore:
         if not is_valid_merkle_branch(
             bootstrap.current_sync_committee.hash_tree_root(),
             bootstrap.current_sync_committee_branch,
-            SYNC_COMMITTEE_BRANCH_DEPTH,
+            len(bootstrap.current_sync_committee_branch),
             CURRENT_SYNC_COMMITTEE_INDEX,
             bytes(bootstrap.header.beacon.state_root),
         ):
@@ -119,18 +120,20 @@ class LightClientStore:
             update.attested_header, update.sync_aggregate, int(update.signature_slot)
         )
         has_finality = any(any(b) for b in update.finality_branch)
+        fin_depth = len(update.finality_branch)
         if has_finality and not is_valid_merkle_branch(
             bytes(update.finalized_header.beacon.hash_tree_root()),
             update.finality_branch,
-            FINALITY_BRANCH_DEPTH,
-            FINALIZED_ROOT_INDEX,
+            fin_depth,
+            FINALIZED_ROOT_SUBINDEX,  # 2*20+1 in every era (leaf position
+                                      # is depth-independent)
             bytes(update.attested_header.beacon.state_root),
         ):
             raise LightClientError("invalid finality branch")
         if not is_valid_merkle_branch(
             update.next_sync_committee.hash_tree_root(),
             update.next_sync_committee_branch,
-            SYNC_COMMITTEE_BRANCH_DEPTH,
+            len(update.next_sync_committee_branch),
             NEXT_SYNC_COMMITTEE_INDEX,
             bytes(update.attested_header.beacon.state_root),
         ):
@@ -157,11 +160,13 @@ class LightClientStore:
         self._verify_sync_aggregate(
             update.attested_header, update.sync_aggregate, int(update.signature_slot)
         )
+        fin_depth = len(update.finality_branch)
         if not is_valid_merkle_branch(
             bytes(update.finalized_header.beacon.hash_tree_root()),
             update.finality_branch,
-            FINALITY_BRANCH_DEPTH,
-            FINALIZED_ROOT_INDEX,
+            fin_depth,
+            FINALIZED_ROOT_SUBINDEX,  # 2*20+1 in every era (leaf position
+                                      # is depth-independent)
             bytes(update.attested_header.beacon.state_root),
         ):
             raise LightClientError("invalid finality branch")

@@ -768,6 +768,39 @@ def build_types(preset: Preset) -> SimpleNamespace:
             "signature_slot": uint64,
         }
 
+    # Electra: the state grows past 32 fields (64 leaves), so the sync
+    # committee / finality gindices gain one level (spec electra
+    # light-client changes: branch depths 6 and 7).
+    _sc_branch_electra = Vector(bytes32, 6)
+    _fin_branch_electra = Vector(bytes32, 7)
+
+    class LightClientBootstrapElectra(Container):
+        fields = {
+            "header": LightClientHeader.ssz_type,
+            "current_sync_committee": SyncCommittee.ssz_type,
+            "current_sync_committee_branch": _sc_branch_electra,
+        }
+
+    class LightClientUpdateElectra(Container):
+        fields = {
+            "attested_header": LightClientHeader.ssz_type,
+            "next_sync_committee": SyncCommittee.ssz_type,
+            "next_sync_committee_branch": _sc_branch_electra,
+            "finalized_header": LightClientHeader.ssz_type,
+            "finality_branch": _fin_branch_electra,
+            "sync_aggregate": SyncAggregate.ssz_type,
+            "signature_slot": uint64,
+        }
+
+    class LightClientFinalityUpdateElectra(Container):
+        fields = {
+            "attested_header": LightClientHeader.ssz_type,
+            "finalized_header": LightClientHeader.ssz_type,
+            "finality_branch": _fin_branch_electra,
+            "sync_aggregate": SyncAggregate.ssz_type,
+            "signature_slot": uint64,
+        }
+
     # ------------------------------------------------------------- exports
 
     for k, v in dict(locals()).items():
@@ -778,6 +811,22 @@ def build_types(preset: Preset) -> SimpleNamespace:
     ns.block_body = _bodies
     ns.block = _blocks
     ns.signed_block = _signed_blocks
+    # Per-era LC container sets (keyed by the DEPTH era, selected from the
+    # state's field count at production time).
+    ns.light_client = {
+        "altair": {
+            "bootstrap": LightClientBootstrap,
+            "update": LightClientUpdate,
+            "finality_update": LightClientFinalityUpdate,
+            "optimistic_update": LightClientOptimisticUpdate,
+        },
+        "electra": {
+            "bootstrap": LightClientBootstrapElectra,
+            "update": LightClientUpdateElectra,
+            "finality_update": LightClientFinalityUpdateElectra,
+            "optimistic_update": LightClientOptimisticUpdate,  # no branch
+        },
+    }
     ns.blinded_block_body = _blinded_bodies
     ns.blinded_block = _blinded_blocks
     ns.signed_blinded_block = _signed_blinded_blocks

@@ -139,3 +139,57 @@ def test_lc_http_routes(harness):
         assert ups
     finally:
         server.stop()
+
+
+def test_electra_lc_era_end_to_end():
+    """Electra's 37-field state (depth 6/7 gindices): branches verify, the
+    server produces the electra container variants, and the verifying store
+    follows the chain."""
+    import dataclasses
+
+    from lighthouse_tpu.chain.light_client import lc_era, state_depth
+    from lighthouse_tpu.types.spec import MINIMAL_PRESET
+
+    set_backend("fake")
+    try:
+        preset = dataclasses.replace(MINIMAL_PRESET, epochs_per_sync_committee_period=2)
+        spec = minimal_spec(preset=preset, altair_fork_epoch=0,
+                            bellatrix_fork_epoch=0, capella_fork_epoch=0,
+                            deneb_fork_epoch=0, electra_fork_epoch=0)
+        hs = BeaconChainHarness(validator_count=16, spec=spec, fake_crypto=True)
+        chain = hs.chain
+        state = chain.head_state
+        assert type(state).fork_name == "electra"
+        assert state_depth(state) == 6 and lc_era(state) == "electra"
+
+        root = state.hash_tree_root()
+        br = sync_committee_branch(state, "current_sync_committee")
+        assert len(br) == 6
+        assert is_valid_merkle_branch(
+            state.current_sync_committee.hash_tree_root(), br, 6, 22, root
+        )
+        fb = finality_branch(state)
+        assert len(fb) == 7
+        assert is_valid_merkle_branch(
+            bytes(state.finalized_checkpoint.root), fb, 7, 20 * 2 + 1, root
+        )
+
+        spe = spec.slots_per_epoch
+        hs.extend_chain(spe * 5)
+        _, f_root = chain.finalized_checkpoint()
+        bootstrap = chain.produce_light_client_bootstrap(f_root)
+        assert type(bootstrap).__name__ == "LightClientBootstrapElectra"
+        store = LightClientStore(hs.types, spec, chain.genesis_validators_root)
+        store.bootstrap(f_root, bootstrap)
+
+        hs.extend_chain(spe * 3)
+        updates = chain.lc_cache.get_updates(
+            store._period(int(store.finalized_header.beacon.slot)), 8
+        )
+        assert updates and type(updates[0]).__name__ == "LightClientUpdateElectra"
+        before = int(store.finalized_header.beacon.slot)
+        for u in updates:
+            store.process_update(u)
+        assert int(store.finalized_header.beacon.slot) > before
+    finally:
+        set_backend("host")
