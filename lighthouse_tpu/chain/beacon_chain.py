@@ -325,6 +325,9 @@ class BeaconChain:
         self.lc_cache = LightClientServerCache(types, spec)
         self.builder = None  # external MEV relay client (set by the builder)
         self.eth1_service = None  # deposit follower + eth1 voting (optional)
+        # state-advance cache: (head_root, slot, advanced_state)
+        self._advanced: Optional[Tuple[bytes, int, object]] = None
+        self._advance_hits = 0
         from .validator_monitor import ValidatorMonitor
 
         self.validator_monitor = ValidatorMonitor(spec)
@@ -1035,8 +1038,16 @@ class BeaconChain:
 
     def state_at_slot(self, slot: int, block_root: Optional[bytes] = None):
         """State at ``block_root`` (default: head) advanced with empty slots
-        to ``slot``."""
+        to ``slot`` — served from the pre-advanced cache when the
+        state-advance timer already did the work (reference
+        ``state_advance_timer.rs``: the expensive epoch-boundary advance
+        happens at tail-of-slot, not on the production/attestation path)."""
         root = self.head_root if block_root is None else block_root
+        cached = self._advanced
+        if cached is not None and cached[0] == root and cached[1] == slot:
+            self._advance_hits += 1
+            # defensive copy: callers mutate production pre-states
+            return cached[2].copy(), root
         state = self.get_state(root)
         if state is None:
             raise ChainError(f"unknown block root {root.hex()[:16]}")
@@ -1047,6 +1058,27 @@ class BeaconChain:
         state = state.copy()
         state = process_slots(state, slot, self.types, self.spec)
         return state, root
+
+    def prepare_next_slot(self) -> bool:
+        """Pre-advance the head state to the NEXT slot (the tail-of-slot
+        job the reference's state_advance_timer runs): block production and
+        attestation at the next slot then start from a cached state instead
+        of paying the advance — the epoch-boundary case is the one that
+        matters (full epoch processing).  Returns True when work was done."""
+        next_slot = self.current_slot() + 1
+        head_root = self.head_root
+        cached = self._advanced
+        if cached is not None and cached[0] == head_root and cached[1] == next_slot:
+            return False
+        state = self.get_state(head_root)
+        if state is None or int(state.slot) >= next_slot:
+            return False
+        with metrics.STATE_ADVANCE_SECONDS.time():
+            advanced = process_slots(
+                state.copy(), next_slot, self.types, self.spec
+            )
+        self._advanced = (head_root, next_slot, advanced)
+        return True
 
     def produce_block(
         self,

@@ -324,12 +324,31 @@ class Client:
         """Per-slot tick + notifier line (reference ``timer`` crate +
         ``notifier.rs``)."""
         clock = self.chain.slot_clock
+        sps = self.chain.spec.seconds_per_slot
         while not self._shutdown.is_set():
             wait = clock.duration_to_next_slot()
             if wait is None:
-                wait = self.chain.spec.seconds_per_slot
-            if self._shutdown.wait(timeout=wait + 0.05):
+                wait = sps
+            # tail-of-slot: pre-advance the head state for the NEXT slot
+            # (reference state_advance_timer fires at 3/4 of the slot)
+            head_wait = max(0.0, wait - sps / 4)
+            if self._shutdown.wait(timeout=head_wait + 0.01):
                 return
+            slot_before = self.chain.current_slot()
+            try:
+                self.chain.prepare_next_slot()
+            except Exception as e:
+                log.warning("state pre-advance failed: %s", e)
+            if self.chain.current_slot() == slot_before:
+                # normal case: the advance finished inside the slot — wait
+                # out the remainder.  If it OVERRAN the boundary, fall
+                # through and tick immediately (the new slot must not lose
+                # its head recompute/pruning to a full-slot sleep).
+                remaining = clock.duration_to_next_slot()
+                if remaining is None:
+                    remaining = sps - head_wait  # pre-genesis: keep 1 tick/slot
+                if self._shutdown.wait(timeout=remaining + 0.05):
+                    return
             try:
                 self.chain.per_slot_task()
                 self._notify()

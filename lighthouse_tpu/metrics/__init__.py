@@ -253,3 +253,7 @@ BLOCK_STORE_WRITE_SECONDS = histogram(
 HEAD_RECOMPUTE_SECONDS = histogram(
     "beacon_head_recompute_seconds", "fork-choice get_head + head swap"
 )
+STATE_ADVANCE_SECONDS = histogram(
+    "beacon_state_advance_seconds",
+    "tail-of-slot head-state pre-advance (state_advance_timer role)",
+)

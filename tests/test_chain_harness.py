@@ -194,3 +194,41 @@ class TestRealCrypto:
         harness = BeaconChainHarness(validator_count=16, fake_crypto=False)
         roots = harness.extend_chain(1, attest=False, sync_participation=True)
         assert harness.head_root == roots[-1]
+
+
+def test_state_advance_cache():
+    """state_advance_timer role (reference state_advance_timer.rs): the
+    pre-advanced head state serves production/attestation without re-paying
+    the advance, invalidates on head change, and never leaks mutations."""
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+
+    set_backend("fake")
+    try:
+        harness = BeaconChainHarness(validator_count=16, fake_crypto=True)
+        chain = harness.chain
+        harness.extend_chain(2)
+        next_slot = chain.current_slot() + 1
+
+        assert chain.prepare_next_slot() is True
+        assert chain.prepare_next_slot() is False  # idempotent per (head, slot)
+        hits0 = chain._advance_hits
+        st1, root1 = chain.state_at_slot(next_slot)
+        assert chain._advance_hits == hits0 + 1
+        assert int(st1.slot) == next_slot
+        # the cached copy is defensive: mutate and re-fetch
+        st1.balances[0] += 7
+        st2, _ = chain.state_at_slot(next_slot)
+        assert int(st2.balances[0]) != int(st1.balances[0])
+        # equivalence with the uncached computation
+        chain._advanced = None
+        st3, _ = chain.state_at_slot(next_slot)
+        assert st2.hash_tree_root() == st3.hash_tree_root()
+
+        # head change invalidates (new head root keys the cache)
+        chain.prepare_next_slot()
+        harness.extend_chain(1)
+        hits1 = chain._advance_hits
+        chain.state_at_slot(chain.current_slot() + 1)
+        assert chain._advance_hits == hits1  # no stale hit after head moved
+    finally:
+        set_backend("host")
