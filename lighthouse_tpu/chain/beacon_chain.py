@@ -328,6 +328,9 @@ class BeaconChain:
         # state-advance cache: (head_root, slot, advanced_state)
         self._advanced: Optional[Tuple[bytes, int, object]] = None
         self._advance_hits = 0
+        # validator index -> fee recipient (reference proposer_prep_service /
+        # prepare_beacon_proposer; consumed by payload production)
+        self.proposer_preparations: Dict[int, bytes] = {}
         from .validator_monitor import ValidatorMonitor
 
         self.validator_monitor = ValidatorMonitor(spec)
@@ -1178,17 +1181,20 @@ class BeaconChain:
         if "execution_payload_header" in body_cls.fields:
             body_kwargs["execution_payload_header"] = payload_header
         if "execution_payload" in body_cls.fields:
+            fee_recipient = self.proposer_preparations.get(proposer)
             if fork == "electra" and hasattr(
                 self.execution_engine, "produce_payload_and_requests"
             ):
                 payload, requests = self.execution_engine.produce_payload_and_requests(
-                    state, types, spec
+                    state, types, spec, suggested_fee_recipient=fee_recipient
                 )
                 body_kwargs["execution_payload"] = payload
                 body_kwargs["execution_requests"] = requests
             else:
+                # the prepared recipient rides the payload attributes (the
+                # EL's block hash commits to it)
                 body_kwargs["execution_payload"] = self.execution_engine.produce_payload(
-                    state, types, spec
+                    state, types, spec, suggested_fee_recipient=fee_recipient
                 )
         if "bls_to_execution_changes" in body_cls.fields:
             body_kwargs["bls_to_execution_changes"] = (

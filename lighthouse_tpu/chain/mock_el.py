@@ -27,7 +27,8 @@ class MockExecutionEngine:
 
     # ------------------------------------------------------------- produce
 
-    def produce_payload(self, state, types, spec: ChainSpec):
+    def produce_payload(self, state, types, spec: ChainSpec,
+                        suggested_fee_recipient=None):
         """Build the payload for a block on ``state`` (already advanced to the
         block's slot).  The analog of engine_getPayload against the mock EL."""
         fork = type(state).fork_name
@@ -47,7 +48,7 @@ class MockExecutionEngine:
         ).digest()
         kwargs = dict(
             parent_hash=parent_hash,
-            fee_recipient=b"\x00" * 20,
+            fee_recipient=bytes(suggested_fee_recipient or b"\x00" * 20),
             state_root=b"\x00" * 32,
             receipts_root=b"\x00" * 32,
             logs_bloom=b"\x00" * 256,

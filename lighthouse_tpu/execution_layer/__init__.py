@@ -119,20 +119,26 @@ class ExecutionLayer:
             )
         )
 
-    def produce_payload(self, state, types, spec):
+    def produce_payload(self, state, types, spec,
+                        suggested_fee_recipient=None):
         """The real getPayload flow: forkchoiceUpdated(head, attributes) →
         payloadId → getPayload (``lib.rs`` get_payload; the mock engine slot
-        implements the same method signature in-proc)."""
+        implements the same method signature in-proc).
+        ``suggested_fee_recipient``: the prepared per-proposer recipient
+        (prepare_beacon_proposer) — it must ride the payload ATTRIBUTES (the
+        EL's block hash commits to it; rewriting after the fact would brick
+        the payload)."""
         fork = type(state).fork_name
         parent_hash = bytes(state.latest_execution_payload_header.block_hash)
         if not is_merge_transition_complete(state):
             parent_hash = b"\x00" * 32
+        recipient = suggested_fee_recipient or self.fee_recipient
         attributes = {
             "timestamp": hex(compute_timestamp_at_slot(state, state.slot, spec)),
             "prevRandao": "0x" + h.get_randao_mix(
                 state, h.get_current_epoch(state, spec), spec
             ).hex(),
-            "suggestedFeeRecipient": "0x" + self.fee_recipient.hex(),
+            "suggestedFeeRecipient": "0x" + bytes(recipient).hex(),
         }
         if fork in ("capella", "deneb", "electra"):
             from .engine_api import withdrawal_to_json
@@ -164,12 +170,15 @@ class ExecutionLayer:
         self._last_get_payload_response = got
         return payload_from_json(obj, types, fork)
 
-    def produce_payload_and_requests(self, state, types, spec):
+    def produce_payload_and_requests(self, state, types, spec,
+                                     suggested_fee_recipient=None):
         """(payload, ExecutionRequests) for electra block production — the
         requests come from engine_getPayloadV4's executionRequests field."""
         from .engine_api import execution_requests_from_json
 
-        payload = self.produce_payload(state, types, spec)
+        payload = self.produce_payload(
+            state, types, spec, suggested_fee_recipient=suggested_fee_recipient
+        )
         requests = execution_requests_from_json(
             self._last_get_payload_response.get("executionRequests"), types
         )

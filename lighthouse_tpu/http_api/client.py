@@ -250,6 +250,9 @@ class BeaconNodeHttpClient:
             return container_from_json(types.LightClientOptimisticUpdate, data)
         return data
 
+    def prepare_beacon_proposer(self, preparations: List[dict]) -> None:
+        self.post("/eth/v1/validator/prepare_beacon_proposer", preparations)
+
     def liveness(self, epoch: int, indices: List[int]) -> List[dict]:
         return self.post(
             f"/eth/v1/validator/liveness/{epoch}",

@@ -1165,6 +1165,19 @@ def sync_subscriptions(ctx):
 
 @route("POST", "/eth/v1/validator/prepare_beacon_proposer", P0)
 def prepare_proposer(ctx):
+    """Record per-validator fee recipients (reference proposer_prep_service:
+    the VC's PreparationService posts these each epoch; payload production
+    consumes them)."""
+    chain = ctx.chain
+    for entry in (ctx.body or []):
+        try:
+            idx = int(entry["validator_index"])
+            recipient = bytes.fromhex(entry["fee_recipient"][2:])
+        except (KeyError, TypeError, ValueError) as e:
+            raise _bad(f"malformed preparation entry: {e}")
+        if len(recipient) != 20:
+            raise _bad("fee_recipient must be 20 bytes")
+        chain.proposer_preparations[idx] = recipient
     return None
 
 

@@ -25,6 +25,7 @@ from .services import (
     DoppelgangerService,
     DutiesService,
     NoViableBeaconNode,
+    PreparationService,
     SyncCommitteeService,
 )
 from .slashing_protection import SlashingProtectionDB, SlashingProtectionError
@@ -51,6 +52,7 @@ class ValidatorClient:
         genesis_validators_root: bytes,
         slashing_db: Optional[SlashingProtectionDB] = None,
         fake_signatures: bool = False,
+        fee_recipient: bytes = b"\x00" * 20,
     ):
         self.spec = spec
         self.types = types
@@ -71,6 +73,10 @@ class ValidatorClient:
         )
         self.sync_committee = SyncCommitteeService(
             store=self.store, duties=self.duties, fallback=self.fallback, types=types
+        )
+        self.preparation = PreparationService(
+            store=self.store, duties=self.duties, fallback=self.fallback,
+            fee_recipient=fee_recipient,
         )
         self.doppelganger: Optional[DoppelgangerService] = None
         self._last_duties_epoch: Optional[int] = None
@@ -98,6 +104,10 @@ class ValidatorClient:
             self.update_duties(epoch)
             if self.doppelganger is not None:
                 self.doppelganger.check(epoch)
+            try:
+                self.preparation.prepare()
+            except NoViableBeaconNode:
+                pass  # preparations are best-effort; retried next epoch
         if not self.store.signing_enabled:
             # Doppelganger gate down: perform NO duties (the whole point),
             # but keep polling duties/liveness above.
@@ -149,6 +159,7 @@ class ValidatorClient:
                 safely("duties update", self.update_duties, epoch)
                 if self.doppelganger is not None:
                     safely("doppelganger check", self.doppelganger.check, epoch)
+                safely("proposer preparation", self.preparation.prepare)
             if not self.store.signing_enabled:
                 # doppelganger gate down: no duties at all — running them
                 # would even pollute the slashing DB with roots that were

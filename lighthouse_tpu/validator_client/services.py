@@ -134,6 +134,40 @@ class DutiesService:
         return self._proposers.get(epoch, {}).get(slot)
 
 
+class PreparationService:
+    """Fee-recipient preparations (reference ``preparation_service.rs``):
+    POST prepare_beacon_proposer for every managed validator each epoch so
+    the BN builds payloads paying OUR recipient.  (Builder/relay validator
+    registration is a separate flow: register_validator, tests/test_builder.)"""
+
+    def __init__(self, *, store: ValidatorStore, duties: DutiesService,
+                 fallback: BeaconNodeFallback,
+                 fee_recipient: bytes = b"\x00" * 20):
+        self.store = store
+        self.duties = duties
+        self.fallback = fallback
+        self.fee_recipient = bytes(fee_recipient)
+        self.per_validator: Dict[bytes, bytes] = {}  # pubkey -> recipient
+
+    def set_fee_recipient(self, pubkey: bytes, recipient: bytes) -> None:
+        self.per_validator[bytes(pubkey)] = bytes(recipient)
+
+    def prepare(self) -> int:
+        indices = self.duties.resolve_indices()
+        entries = []
+        for pk, idx in indices.items():
+            recipient = self.per_validator.get(pk, self.fee_recipient)
+            entries.append({
+                "validator_index": str(idx),
+                "fee_recipient": "0x" + recipient.hex(),
+            })
+        if entries:
+            self.fallback.first_success(
+                lambda c: c.prepare_beacon_proposer(entries)
+            )
+        return len(entries)
+
+
 class SyncDuty:
     __slots__ = ("pubkey", "validator_index", "positions")
 

@@ -367,3 +367,26 @@ def test_doppelganger_detects_live_validator():
             server.stop()
     finally:
         set_backend("host")
+
+
+def test_preparation_service_routes_fee_recipient(vc_setup):
+    """PreparationService POSTs per-validator fee recipients each epoch and
+    the produced payload pays the prepared recipient (preparation_service.rs
+    -> proposer_prep_service -> payload attributes)."""
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+
+    set_backend("fake")  # earlier tests in this module restore "host"
+    harness, server, vc = vc_setup
+    chain = harness.chain
+    recipient = b"\x42" * 20
+    vc.preparation.fee_recipient = recipient
+    n = vc.preparation.prepare()
+    assert n == 16
+    assert chain.proposer_preparations  # BN recorded them
+    assert all(r == recipient for r in chain.proposer_preparations.values())
+
+    slot = harness.advance_slot()
+    summary = vc.run_slot(slot)
+    assert summary["proposed"] is not None
+    head = chain.get_block(chain.head_root)
+    assert bytes(head.message.body.execution_payload.fee_recipient) == recipient
