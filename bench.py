@@ -12,14 +12,17 @@ multi-pairing per batch).  Lighthouse publishes no absolute numbers
 well-known ~0.4-0.5 ms/thread per aggregate-verify pairing cost:
     64 threads / 0.45 ms  ->  ~142k sets/s.  We use 142_000 sets/s.
 
-Failure-containment contract (VERDICT r2 item 1): the parent process NEVER
-imports jax.  Every benchmark attempt re-execs this file in a subprocess with
-a hard wall-clock timeout, because ``jax.devices()`` against a TPU tunnel has
-been observed to block ~25 minutes per call (BENCH_r02 rc=124 — the in-process
-retry loop out-waited the driver's budget and the "always emit JSON" fallback
-never ran).  Attempt order: real device platform first, then a CPU-forced
-child so a structured number exists even when the tunnel is dead.  The parent
-emits the JSON line no matter what any child does.
+Failure-containment contract (VERDICT r2 item 1, hardened per VERDICT r3
+item 1): the parent NEVER imports jax.  The TPU tunnel has been observed to
+block ``jax.devices()`` for ~25 MINUTES, so two 420 s attempts (r03)
+mathematically could not survive it.  This version runs ONE device child
+under a long timeout (default 2100 s > the observed hang), and the child
+checkpoints a cumulative result dict to a file after EVERY milestone
+(init -> smoke 1x1 -> headline 128x32 -> scale 4096x32).  The parent
+harvests the last checkpoint even when it has to kill the child, so a
+timeout still yields init/compile timings instead of a bare error.  A
+CPU-forced child runs only if the device child produced no headline value.
+The parent emits the JSON line no matter what.
 """
 
 from __future__ import annotations
@@ -42,11 +45,8 @@ SCALE_REPS = 2
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
-# Per-child hard timeouts (seconds).  First TPU compile of the pairing program
-# is slow (~threeish minutes worst case with a cold cache); a hung tunnel gets
-# killed long before the driver's budget.
-TPU_ATTEMPTS = int(os.environ.get("BENCH_DEVICE_ATTEMPTS", "2"))
-TPU_TIMEOUT_S = float(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "420"))
+# One long device attempt: must outlast the ~25-min tunnel hang plus compile.
+TPU_TIMEOUT_S = float(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "2100"))
 CPU_TIMEOUT_S = float(os.environ.get("BENCH_CPU_TIMEOUT_S", "900"))
 
 MARKER = "BENCH_RESULT_JSON:"
@@ -65,15 +65,34 @@ def _emit(value: float, vs_baseline: float, extra: dict) -> None:
 
 
 # ---------------------------------------------------------------------------
-# Child mode: actually run the benchmark on whatever platform the env selects.
+# Child mode: run the bench on whatever platform the env selects, checkpointing
+# a cumulative result dict after every milestone.
 # ---------------------------------------------------------------------------
+
+
+def _checkpoint(out: dict) -> None:
+    path = os.environ.get("BENCH_RESULT_FILE")
+    if path:
+        # Atomic replace: the parent's timeout SIGKILL can land at any
+        # instant, and a truncate-in-place would destroy every previously
+        # harvested checkpoint — the exact data this design exists to keep.
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(out))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    print(MARKER + json.dumps(out))
+    sys.stdout.flush()
 
 
 def _bench_shape(jax, _device_verify, fe_is_one, build, n_sets, n_keys, reps, seed):
     batch = build(n_sets=n_sets, n_keys=n_keys, seed=seed)
     # Warmup / compile.
+    t0 = time.perf_counter()
     fe, w_z = _device_verify(*batch)
     jax.block_until_ready((fe, w_z))
+    warm = time.perf_counter() - t0
     assert fe_is_one(fe), f"benchmark batch ({n_sets}x{n_keys}) failed to verify"
 
     t0 = time.perf_counter()
@@ -81,11 +100,11 @@ def _bench_shape(jax, _device_verify, fe_is_one, build, n_sets, n_keys, reps, se
         fe, w_z = _device_verify(*batch)
     jax.block_until_ready((fe, w_z))
     dt = (time.perf_counter() - t0) / reps
-    return n_sets / dt
+    return n_sets / dt, warm
 
 
 def _child_main(force_cpu: bool) -> None:
-    """Run the bench; print one MARKER-prefixed JSON line; always exit 0."""
+    """Run the bench; checkpoint after each milestone; always exit 0."""
     os.environ.setdefault("JAX_ENABLE_X64", "0")
     sys.path.insert(0, HERE)
     out: dict = {}
@@ -107,35 +126,47 @@ def _child_main(force_cpu: bool) -> None:
         except Exception:
             pass
 
-        devs = jax.devices()
+        devs = jax.devices()  # <-- known ~25-min tunnel hang point
         out["platform"] = devs[0].platform
         out["init_secs"] = round(time.perf_counter() - t_init, 2)
+        _checkpoint(out)
 
         from __graft_entry__ import _build_example
         from lighthouse_tpu.ops.pairing import fe_is_one
         from lighthouse_tpu.ops.verify import _device_verify
 
-        # CPU executes one 128-set multi-pairing in ~minutes (measured
-        # ~158 s) — one rep is all the timeout budget allows there.
-        reps = REPS if devs[0].platform != "cpu" else 1
-        headline = _bench_shape(
+        on_cpu = devs[0].platform == "cpu"
+
+        # Smoke: smallest bucket. Proves end-to-end device execution cheaply
+        # and records a compile time even if the headline shape never finishes.
+        smoke, warm = _bench_shape(
+            jax, _device_verify, fe_is_one, _build_example, 1, 1, 1 if on_cpu else 3, seed=11
+        )
+        out["smoke_sets_per_sec_1x1"] = round(smoke, 2)
+        out["smoke_warm_secs"] = round(warm, 1)
+        _checkpoint(out)
+
+        # Headline: 128 sets x 32-key committees. CPU executes one such
+        # multi-pairing in ~158 s — one rep is all the timeout budget allows.
+        reps = 1 if on_cpu else REPS
+        headline, warm = _bench_shape(
             jax, _device_verify, fe_is_one, _build_example, N_SETS, N_KEYS, reps, seed=3
         )
         out["value"] = headline
+        out["headline_warm_secs"] = round(warm, 1)
+        _checkpoint(out)
 
         # Scale config: 4,096 sets x 32-key committees (best-effort — a failure
-        # here must not void the headline number).  Gate on the platform jax
-        # ACTUALLY selected, not the --cpu flag: a device child that silently
-        # fell back to CPU would otherwise burn its whole timeout on a
-        # minutes-slow CPU scale run and lose the computed headline.
-        if devs[0].platform != "cpu":
+        # here must not void the headline number). Skip on CPU: minutes-slow.
+        if not on_cpu:
             try:
-                scale = _bench_shape(
+                scale, warm = _bench_shape(
                     jax, _device_verify, fe_is_one, _build_example,
                     SCALE_N_SETS, N_KEYS, SCALE_REPS, seed=5,
                 )
                 out["sets_per_sec_4096x32"] = round(scale, 1)
                 out["vs_baseline_4096x32"] = round(scale / BLST_64T_SETS_PER_SEC, 4)
+                out["scale_warm_secs"] = round(warm, 1)
             except Exception as e:
                 out["scale_bench_error"] = f"{type(e).__name__}: {e}"
     except Exception as e:
@@ -143,8 +174,7 @@ def _child_main(force_cpu: bool) -> None:
 
         traceback.print_exc()
         out["error"] = f"{type(e).__name__}: {e}"
-    print(MARKER + json.dumps(out))
-    sys.stdout.flush()
+    _checkpoint(out)
 
 
 # ---------------------------------------------------------------------------
@@ -164,50 +194,76 @@ def _cpu_child_env() -> dict:
 
 
 def _run_child(force_cpu: bool, timeout_s: float) -> dict:
-    """Run one bench child; return its parsed MARKER dict (synthesized on failure)."""
+    """Run one bench child; return its last checkpoint (synthesized on failure)."""
     argv = [sys.executable, os.path.abspath(__file__), "--child"]
     env = _cpu_child_env() if force_cpu else dict(os.environ)
     if force_cpu:
         argv.append("--cpu")
     env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(HERE, ".jax_cache"))
+    scratch = os.path.join(HERE, ".bench_scratch")
+    os.makedirs(scratch, exist_ok=True)
+    tag = f"{'cpu' if force_cpu else 'dev'}_{os.getpid()}"
+    result_file = os.path.join(scratch, f"result_{tag}.json")
+    log_file = os.path.join(scratch, f"child_{tag}.log")
+    env["BENCH_RESULT_FILE"] = result_file
+
     t0 = time.perf_counter()
+    timed_out = False
+    res: dict = {}
     try:
-        proc = subprocess.run(
-            argv, env=env, cwd=HERE,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        return {"error": f"child timed out after {timeout_s:.0f}s (hung backend init or compile)"}
-    text = proc.stdout.decode(errors="replace")
-    # find(), not startswith(): stderr shares the pipe and a partial-line
-    # write (compile progress, '\r' spinners) can prefix the marker line.
-    for line in reversed(text.splitlines()):
-        at = line.find(MARKER)
-        if at >= 0:
+        with open(log_file, "wb") as lf:
             try:
-                res = json.loads(line[at + len(MARKER):])
-                res["child_secs"] = round(time.perf_counter() - t0, 1)
-                return res
-            except json.JSONDecodeError:
-                break
-    tail = text[-2000:]
-    return {"error": f"child rc={proc.returncode}, no result line; tail: {tail!r}"}
+                subprocess.run(
+                    argv, env=env, cwd=HERE,
+                    stdout=lf, stderr=subprocess.STDOUT, timeout=timeout_s,
+                )
+            except subprocess.TimeoutExpired:
+                timed_out = True
+        try:
+            with open(result_file) as f:
+                res = json.loads(f.read())
+        except (OSError, json.JSONDecodeError):
+            pass
+    finally:
+        for p in (result_file, result_file + ".tmp"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+    res["child_secs"] = round(time.perf_counter() - t0, 1)
+    if timed_out:
+        res["timed_out_after_s"] = timeout_s
+        if "value" not in res:
+            res.setdefault(
+                "error",
+                f"child killed at {timeout_s:.0f}s "
+                + ("after init (compile/exec hang)" if "platform" in res
+                   else "before jax.devices() returned (tunnel hang)"),
+            )
+    elif not res.get("platform") and "error" not in res:
+        # Died before the first checkpoint (segfault / OOM-kill during
+        # import or backend init) — surface the log tail, it is the only
+        # diagnostic that exists.
+        tail = ""
+        try:
+            with open(log_file, "rb") as f:
+                tail = f.read()[-1500:].decode(errors="replace")
+        except OSError:
+            pass
+        res["error"] = f"child exited without any checkpoint; log tail: {tail!r}"
+    return res
 
 
 def main() -> None:
     extra: dict = {"attempts": []}
     result: dict | None = None
 
-    for i in range(TPU_ATTEMPTS):
-        res = _run_child(force_cpu=False, timeout_s=TPU_TIMEOUT_S)
-        extra["attempts"].append({"mode": "device", **{k: res[k] for k in res if k != "value"}})
-        if "value" in res:
-            # A cpu-platform result here means jax itself fell back — still a
-            # real number; retrying the device would just repeat the fallback.
-            result = res
-            break
-        print(f"bench: device attempt {i + 1}/{TPU_ATTEMPTS} failed: {res.get('error')}",
-              file=sys.stderr)
+    res = _run_child(force_cpu=False, timeout_s=TPU_TIMEOUT_S)
+    extra["attempts"].append({"mode": "device", **{k: res[k] for k in res if k != "value"}})
+    if "value" in res:
+        result = res
+    else:
+        print(f"bench: device attempt failed: {res.get('error')}", file=sys.stderr)
 
     if result is None:
         res = _run_child(force_cpu=True, timeout_s=CPU_TIMEOUT_S)
@@ -216,8 +272,9 @@ def main() -> None:
             result = res
 
     if result is not None:
-        for k in ("platform", "init_secs", "sets_per_sec_4096x32", "vs_baseline_4096x32",
-                  "scale_bench_error"):
+        for k in ("platform", "init_secs", "smoke_sets_per_sec_1x1", "smoke_warm_secs",
+                  "headline_warm_secs", "sets_per_sec_4096x32", "vs_baseline_4096x32",
+                  "scale_warm_secs", "scale_bench_error"):
             if k in result:
                 extra[k] = result[k]
         _emit(result["value"], result["value"] / BLST_64T_SETS_PER_SEC, extra)

@@ -576,6 +576,10 @@ class BeaconChain:
                 self.validator_monitor.on_attestation_included(
                     int(att.data.target.epoch), indexed.attesting_indices
                 )
+                for idx in indexed.attesting_indices:
+                    self.observed.block_attesters.observe(
+                        int(att.data.target.epoch), int(idx)
+                    )
                 self.fork_choice.on_attestation(
                     current_slot=current_slot,
                     attestation_slot=int(att.data.slot),
@@ -784,10 +788,32 @@ class BeaconChain:
 
     # ------------------------------------------------ sync committee duty
 
-    def _sync_committee_positions(self, state, validator_index: int) -> List[int]:
+    def _sync_committee_for_slot(self, state, slot: int):
+        """The committee actually signing at ``slot``: at a sync-committee
+        period boundary (or when the head state lags the wall clock into the
+        next period) the message's period may be the state's NEXT period —
+        checking ``current_sync_committee`` unconditionally rejects valid
+        messages from the new committee (reference
+        ``sync_committee_verification.rs`` resolves the duty-epoch
+        committee the same way)."""
+        from ..consensus.helpers import compute_sync_committee_period
+
+        msg_period = compute_sync_committee_period(
+            int(slot) // self.spec.slots_per_epoch, self.spec
+        )
+        state_period = compute_sync_committee_period(
+            int(state.slot) // self.spec.slots_per_epoch, self.spec
+        )
+        if msg_period == state_period + 1:
+            return state.next_sync_committee
+        return state.current_sync_committee
+
+    def _sync_committee_positions(self, state, validator_index: int,
+                                  slot: int) -> List[int]:
+        committee = self._sync_committee_for_slot(state, slot)
         pk = bytes(state.validators[validator_index].pubkey)
         return [
-            i for i, p in enumerate(state.current_sync_committee.pubkeys)
+            i for i, p in enumerate(committee.pubkeys)
             if bytes(p) == pk
         ]
 
@@ -807,7 +833,7 @@ class BeaconChain:
         vidx = int(msg.validator_index)
         if vidx >= len(state.validators):
             raise AttestationError("sync message validator index out of range")
-        positions = self._sync_committee_positions(state, vidx)
+        positions = self._sync_committee_positions(state, vidx, slot=int(msg.slot))
         if not positions:
             raise AttestationError("validator is not in the current sync committee")
         sig_set = sets.sync_committee_message_set(
@@ -898,7 +924,7 @@ class BeaconChain:
         if aggregator >= len(state.validators):
             raise AttestationError("aggregator index out of range")
         sub_size = self.sync_contribution_pool._sub_size()
-        positions = self._sync_committee_positions(state, aggregator)
+        positions = self._sync_committee_positions(state, aggregator, slot=slot)
         if not any(p // sub_size == sub for p in positions):
             raise AttestationError("aggregator is not in the contribution's subcommittee")
         modulo = max(1, sub_size // self.spec.target_aggregators_per_sync_subcommittee)
@@ -906,7 +932,7 @@ class BeaconChain:
         if int.from_bytes(digest[:8], "little") % modulo != 0:
             raise AttestationError("validator is not a selected sync aggregator")
 
-        committee = state.current_sync_committee
+        committee = self._sync_committee_for_slot(state, slot)
         participants = [
             sets.pubkey_cache(bytes(committee.pubkeys[sub * sub_size + i]))
             for i, bit in enumerate(contribution.aggregation_bits)

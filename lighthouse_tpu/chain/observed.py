@@ -105,6 +105,18 @@ class ObservedBlockProducers:
             for k in [k for k in self._seen if k[0] < finalized_slot]:
                 del self._seen[k]
 
+    def proposer_seen_in_epoch(self, epoch: int, proposer: int,
+                               slots_per_epoch: int) -> bool:
+        """Did ``proposer`` produce any observed block in ``epoch``?  Liveness
+        query (reference ``validator_seen_at_epoch``, beacon_chain.rs:6615)."""
+        lo = epoch * slots_per_epoch
+        hi = lo + slots_per_epoch
+        with self._lock:
+            return any(
+                lo <= slot < hi and prod == proposer
+                for (slot, prod) in self._seen
+            )
+
 
 class ObservedCaches:
     """The bundle a chain owns, pruned together each finalization."""
@@ -115,6 +127,11 @@ class ObservedCaches:
         self.aggregates = ObservedAggregates()
         self.block_producers = ObservedBlockProducers()
         self.sync_contributors = ObservedAttesters()  # (slot-as-epoch, validator)
+        # Attester indices seen inside imported blocks, by target epoch.  A
+        # node subscribed to few subnets sees most attestations only in
+        # aggregates/blocks, so doppelganger liveness MUST consult this too
+        # (reference observed_attesters.rs ``ObservedBlockAttesters``).
+        self.block_attesters = ObservedAttesters()
 
     def prune(self, finalized_epoch: int, slots_per_epoch: int) -> None:
         finalized_slot = finalized_epoch * slots_per_epoch
@@ -122,3 +139,18 @@ class ObservedCaches:
         self.aggregators.prune(finalized_epoch)
         self.aggregates.prune(finalized_slot)
         self.block_producers.prune(finalized_slot)
+        self.block_attesters.prune(finalized_epoch)
+
+    def validator_seen_at_epoch(self, epoch: int, index: int,
+                                slots_per_epoch: int) -> bool:
+        """OR over every cache that can prove a validator was active in
+        ``epoch``: gossip attestations, attestations inside imported blocks,
+        aggregation duties, and block proposals (the reference's four-cache
+        check, beacon_chain.rs:6615 ``validator_seen_at_epoch``)."""
+        return (
+            self.attesters.is_known(epoch, index)
+            or self.block_attesters.is_known(epoch, index)
+            or self.aggregators.is_known(epoch, index)
+            or self.block_producers.proposer_seen_in_epoch(
+                epoch, index, slots_per_epoch)
+        )

@@ -134,6 +134,15 @@ class ClientBuilder:
         if fork not in types.signed_block:
             raise ValueError(f"checkpoint provider sent unknown fork {fork!r}")
         anchor_block = types.signed_block[fork].from_ssz_bytes(raw_block)
+        if anchor_block.message.hash_tree_root() != root:
+            # The URL may be plain HTTP and the provider is only *semi*
+            # trusted: without this check a tampered response could anchor
+            # the node on a different block while still passing the
+            # state-root check below.
+            raise ValueError(
+                "checkpoint provider served a block that does not match the "
+                "finalized root it advertised — refusing the anchor"
+            )
         state_root = bytes(anchor_block.message.state_root)
         raw_state, sfork = remote.get_ssz(
             f"/eth/v2/debug/beacon/states/0x{state_root.hex()}"

@@ -802,10 +802,12 @@ def contribution_and_proofs(ctx):
 
 @route("POST", "/eth/v1/validator/liveness/{epoch}", P0)
 def validator_liveness(ctx):
-    """Per-validator liveness for ``epoch`` from the observed-attester cache
-    — the doppelganger service's data source (reference
-    ``http_api/src/lib.rs`` liveness endpoint backed by the chain's
-    observed caches)."""
+    """Per-validator liveness for ``epoch`` — the doppelganger service's
+    data source.  ORs every observed cache that can prove activity (gossip
+    attesters, block-included attesters, aggregators, block proposers),
+    matching the reference's four-cache ``validator_seen_at_epoch``
+    (beacon_chain.rs:6615): a duplicate instance whose attestations reach
+    this node only inside aggregates or blocks must still read live."""
     epoch = int(ctx.params["epoch"])
     chain = ctx.chain
     out = []
@@ -813,7 +815,8 @@ def validator_liveness(ctx):
         idx = int(raw)
         out.append({
             "index": str(idx),
-            "is_live": bool(chain.observed.attesters.is_known(epoch, idx)),
+            "is_live": bool(chain.observed.validator_seen_at_epoch(
+                epoch, idx, chain.spec.slots_per_epoch)),
         })
     return {"data": out}
 

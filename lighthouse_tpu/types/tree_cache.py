@@ -253,38 +253,57 @@ class _ValidatorListCache:
         return mix_in_length(body, n)
 
 
+def _value_fingerprint(v):
+    """Hashable deep fingerprint of an SSZ value (ints/bools/bytes at the
+    leaves, tuples for containers and lists).  Cheap for the small elements
+    these lists hold (Eth1Data, HistoricalSummary, PendingAttestation)."""
+    fields = getattr(v, "fields", None)
+    if fields is not None and not isinstance(v, type):
+        return tuple(_value_fingerprint(getattr(v, f)) for f in fields)
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v)
+    if isinstance(v, (list, tuple)):
+        return tuple(_value_fingerprint(x) for x in v)
+    return v
+
+
 class _ElementMemoListCache:
     """Cache for append-mostly lists of container elements (eth1_data_votes,
     historical_summaries, phase0 pending attestations): per-index root memo
-    keyed by element IDENTITY — these lists only ever append fresh objects or
-    reset wholesale, never mutate an element in place — plus the incremental
+    keyed by the element's deep VALUE fingerprint — unlike an identity key,
+    an in-place mutation of a cached element can never serve a stale root
+    (a wrong BeaconState root is a consensus split) — plus the incremental
     tree over element roots."""
 
     def __init__(self, elem_type, limit_elems: int):
         self.elem_type = elem_type
         self.tree = _LeafTree(max(1, limit_elems))
-        self.objs: List[object] = []
+        self.fps: List[object] = []
         self.roots: Optional[np.ndarray] = None  # (n, 32) uint8
 
     def root(self, values) -> bytes:
         n = len(values)
         if self.roots is None or len(self.roots) != n:
-            old_objs, old_roots = self.objs, self.roots
+            old_fps, old_roots = self.fps, self.roots
             roots = np.zeros((n, 32), dtype=np.uint8)
-            keep = min(n, len(old_objs)) if old_roots is not None else 0
+            keep = min(n, len(old_fps)) if old_roots is not None else 0
             if keep:
                 roots[:keep] = old_roots[:keep]
-            self.objs = list(values)
+            self.fps = [None] * n
             self.roots = roots
             for i, v in enumerate(values):
-                if i < keep and v is old_objs[i]:
+                fp = _value_fingerprint(v)
+                if i < keep and fp == old_fps[i]:
+                    self.fps[i] = fp
                     continue
+                self.fps[i] = fp
                 self.roots[i] = np.frombuffer(
                     self.elem_type.hash_tree_root(v), dtype=np.uint8)
         else:
             for i, v in enumerate(values):
-                if v is not self.objs[i]:
-                    self.objs[i] = v
+                fp = _value_fingerprint(v)
+                if fp != self.fps[i]:
+                    self.fps[i] = fp
                     self.roots[i] = np.frombuffer(
                         self.elem_type.hash_tree_root(v), dtype=np.uint8)
         body = self.tree.update(self.roots)
@@ -397,7 +416,7 @@ class StateTreeHashCache:
                     c.fingerprints = list(cache.fingerprints)
                     c.roots = None if cache.roots is None else cache.roots.copy()
                 elif isinstance(cache, _ElementMemoListCache):
-                    c.objs = list(cache.objs)
+                    c.fps = list(cache.fps)
                     c.roots = None if cache.roots is None else cache.roots.copy()
                 clone.caches[name] = c
         return clone

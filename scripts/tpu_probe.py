@@ -1,0 +1,95 @@
+"""Long-running TPU probe: survive the tunnel hang, record real device timings.
+
+The axon TPU tunnel has been observed to block ``jax.devices()`` for ~25
+minutes.  This probe is designed to be launched detached (nohup) with NO
+timeout, logging one timestamped JSON line per stage to stdout so a watcher
+can distinguish tunnel-hang from compile-hang from execute-slow, and harvest
+partial results at any point.
+
+Stages: import jax -> jax.devices() -> tiny matmul smoke -> per-shape
+(build batch on host, compile+first-run, timed reps) for the north-star
+configs (BASELINE.md): 1x1 smoke, 8x2, 128x32 headline, 4096x32 scale.
+
+Run:  nohup python scripts/tpu_probe.py > .tpu_probe/probe.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+T0 = time.time()
+
+
+def log(stage: str, **kw) -> None:
+    rec = {"t": round(time.time() - T0, 1), "stage": stage}
+    rec.update(kw)
+    print("PROBE " + json.dumps(rec), flush=True)
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_ENABLE_X64", "0")
+    log("start", pid=os.getpid())
+
+    import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR", os.path.join(HERE, ".jax_cache")),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # pragma: no cover
+        log("cache_config_failed", error=str(e))
+    log("jax_imported", version=jax.__version__)
+
+    devs = jax.devices()  # <-- the known ~25-min tunnel hang point
+    log("devices", platform=devs[0].platform, n=len(devs),
+        kind=getattr(devs[0], "device_kind", "?"))
+
+    import jax.numpy as jnp
+
+    t = time.time()
+    x = jnp.ones((128, 128), dtype=jnp.bfloat16)
+    (x @ x).block_until_ready()
+    log("smoke_matmul", secs=round(time.time() - t, 2))
+
+    from __graft_entry__ import _build_example
+    from lighthouse_tpu.ops.pairing import fe_is_one
+    from lighthouse_tpu.ops.verify import _device_verify
+
+    for n_sets, n_keys, reps in [(1, 1, 2), (8, 2, 2), (128, 32, 5), (4096, 32, 2)]:
+        shape = f"{n_sets}x{n_keys}"
+        try:
+            t = time.time()
+            batch = _build_example(n_sets=n_sets, n_keys=n_keys, seed=3)
+            log("built", shape=shape, build_secs=round(time.time() - t, 1))
+
+            t = time.time()
+            fe, w_z = _device_verify(*batch)
+            jax.block_until_ready((fe, w_z))
+            log("warm", shape=shape, compile_plus_first_secs=round(time.time() - t, 1),
+                ok=bool(fe_is_one(fe)))
+
+            t = time.time()
+            for _ in range(reps):
+                fe, w_z = _device_verify(*batch)
+            jax.block_until_ready((fe, w_z))
+            dt = (time.time() - t) / reps
+            log("timed", shape=shape, secs_per_batch=round(dt, 3),
+                sets_per_sec=round(n_sets / dt, 2))
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            log("shape_failed", shape=shape, error=f"{type(e).__name__}: {e}")
+    log("done")
+
+
+if __name__ == "__main__":
+    main()

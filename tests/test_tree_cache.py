@@ -167,3 +167,16 @@ def test_composite_list_caches_track_changes(setup):
     assert st.hash_tree_root() == uncached_root(st)
     st.eth1_data_votes = []  # period reset
     assert st.hash_tree_root() == uncached_root(st)
+
+
+def test_composite_list_cache_detects_in_place_mutation(setup):
+    """The element memo must key on field VALUES, not object identity: an
+    in-place mutation of a cached element served a stale root before r4
+    (ADVICE r3 tree_cache.py:256 — a wrong state root is a consensus split)."""
+    spec, types, state = setup
+    st = state.copy()
+    st.eth1_data_votes.append(types.Eth1Data(
+        deposit_root=b"\x01" * 32, deposit_count=5, block_hash=b"\x02" * 32))
+    st.hash_tree_root()  # prime the memo with the element cached
+    st.eth1_data_votes[0].deposit_count = 99  # same object, new value
+    assert st.hash_tree_root() == uncached_root(st)
