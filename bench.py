@@ -240,17 +240,18 @@ def _run_child(force_cpu: bool, timeout_s: float) -> dict:
                 + ("after init (compile/exec hang)" if "platform" in res
                    else "before jax.devices() returned (tunnel hang)"),
             )
-    elif not res.get("platform") and "error" not in res:
-        # Died before the first checkpoint (segfault / OOM-kill during
-        # import or backend init) — surface the log tail, it is the only
-        # diagnostic that exists.
+    elif "value" not in res and "error" not in res:
+        # Died without a headline number (segfault / OOM-kill during
+        # import, backend init, or batch build) — surface the log tail, it
+        # is the only diagnostic that exists.
         tail = ""
         try:
             with open(log_file, "rb") as f:
                 tail = f.read()[-1500:].decode(errors="replace")
         except OSError:
             pass
-        res["error"] = f"child exited without any checkpoint; log tail: {tail!r}"
+        stage = "after init" if "platform" in res else "without any checkpoint"
+        res["error"] = f"child died {stage}; log tail: {tail!r}"
     return res
 
 

@@ -798,8 +798,11 @@ class BeaconChain:
         committee the same way)."""
         from ..consensus.helpers import compute_sync_committee_period
 
+        # Duty period of slot+1, not slot: sync-committee messages at the
+        # LAST slot of a period are signed by the NEXT committee (reference
+        # ``sync_committee_at_next_slot``, beacon_chain.rs:1288).
         msg_period = compute_sync_committee_period(
-            int(slot) // self.spec.slots_per_epoch, self.spec
+            (int(slot) + 1) // self.spec.slots_per_epoch, self.spec
         )
         state_period = compute_sync_committee_period(
             int(state.slot) // self.spec.slots_per_epoch, self.spec

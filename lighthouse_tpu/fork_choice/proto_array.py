@@ -193,12 +193,15 @@ class ProtoArray:
         new_proposer_boost: tuple = (None, 0),
     ) -> None:
         """Back-propagate vote deltas and refresh best-child/descendant links
-        (reference: ``proto_array.rs`` ``apply_score_changes``).
+        (reference: ``proto_array.rs:212`` ``apply_score_changes``).
 
-        ``deltas`` is one int64 per node.  Proposer boost is folded into the
-        deltas here: the previous boost is removed and the new one added
-        (reference: ``proto_array.rs`` proposer-boost handling in
-        ``apply_score_changes``)."""
+        ``deltas`` is one int64 per node.  Reference semantics preserved
+        exactly: the zero-hash root (genesis alias in scripted tests) is
+        skipped; a payload-INVALID node's delta is replaced with ``-weight``
+        so its weight pins to zero and the removal propagates to ancestors
+        (vote deltas ON the invalid node are discarded, not propagated);
+        proposer boost is never applied to, nor removed from, invalid
+        nodes."""
         if len(deltas) != len(self.nodes):
             raise ProtoArrayError(
                 f"delta length {len(deltas)} != node count {len(self.nodes)}"
@@ -207,23 +210,37 @@ class ProtoArray:
         self.finalized_checkpoint = finalized_checkpoint
 
         prev_root, prev_score = self.previous_proposer_boost
-        if prev_root is not None and prev_root in self.indices:
-            deltas[self.indices[prev_root]] -= prev_score
         boost_root, boost_score = new_proposer_boost
-        if boost_root is not None and boost_root in self.indices and boost_score:
-            deltas[self.indices[boost_root]] += boost_score
-        self.previous_proposer_boost = (boost_root, boost_score) if boost_root else (None, 0)
+        applied_boost = 0  # recorded only if the boost node was credited
+        zero_root = b"\x00" * 32
 
         # Children always have higher indices than parents (append order), so a
         # single reverse pass both applies deltas and propagates to parents.
         for i in range(len(self.nodes) - 1, -1, -1):
             node = self.nodes[i]
-            d = int(deltas[i])
-            node.weight += d
-            if node.weight < 0:
-                raise ProtoArrayError(f"negative weight at node {i}")
+            if node.root == zero_root:
+                continue
+            is_invalid = node.execution_status == ExecutionStatus.INVALID
+            if is_invalid:
+                d = -node.weight
+            else:
+                d = int(deltas[i])
+                if prev_root is not None and prev_root == node.root:
+                    d -= prev_score
+                if boost_root is not None and boost_root == node.root and boost_score:
+                    d += boost_score
+                    applied_boost = boost_score
+            if is_invalid:
+                node.weight = 0
+            else:
+                node.weight += d
+                if node.weight < 0:
+                    raise ProtoArrayError(f"negative weight at node {i}")
             if node.parent is not None:
                 deltas[node.parent] += d
+        self.previous_proposer_boost = (
+            (boost_root, applied_boost) if boost_root else (None, 0)
+        )
         for i in range(len(self.nodes) - 1, -1, -1):
             parent = self.nodes[i].parent
             if parent is not None:
@@ -236,6 +253,11 @@ class ProtoArray:
         if ji is None:
             raise ProtoArrayError(f"justified root unknown: {justified_root.hex()[:16]}")
         justified = self.nodes[ji]
+        if justified.execution_status == ExecutionStatus.INVALID:
+            # No valid descendant of an invalid justified block can exist:
+            # fork choice is broken until a new justified root is set
+            # (reference find_head, proto_array.rs:712).
+            raise ProtoArrayError("justified block has an invalid payload")
         best = justified.best_descendant
         node = self.nodes[best] if best is not None else justified
         if not self._node_is_viable_for_head(node, current_slot):
@@ -253,7 +275,10 @@ class ProtoArray:
         current_epoch = current_slot // self.slots_per_epoch
         node_epoch = node.slot // self.slots_per_epoch
         if current_epoch > node_epoch:
-            return node.unrealized_justified_checkpoint
+            # Unrealized justification may be untracked (reference keeps an
+            # Option and falls back to the realized checkpoint).
+            if node.unrealized_justified_checkpoint is not None:
+                return node.unrealized_justified_checkpoint
         return node.justified_checkpoint
 
     def _node_is_viable_for_head(self, node: ProtoNode, current_slot: int) -> bool:
@@ -275,8 +300,7 @@ class ProtoArray:
             return False
         if f_epoch == 0:
             return True
-        finalized_slot = f_epoch * self.slots_per_epoch
-        return self._ancestor_at_slot(node, finalized_slot) == f_root
+        return self.is_finalized_checkpoint_or_descendant(node.root)
 
     def _node_leads_to_viable_head(self, node: ProtoNode, current_slot: int) -> bool:
         if node.best_descendant is not None:
@@ -375,46 +399,127 @@ class ProtoArray:
             node.execution_status = ExecutionStatus.VALID
             idx = node.parent
 
+    def execution_block_hash_to_beacon_block_root(
+        self, block_hash: bytes
+    ) -> Optional[bytes]:
+        """Latest block whose payload hash matches (reference searches nodes
+        in reverse — most recent wins)."""
+        for node in reversed(self.nodes):
+            if node.execution_block_hash == block_hash:
+                return node.root
+        return None
+
+    def is_finalized_checkpoint_or_descendant(self, root: bytes) -> bool:
+        """Reference ``proto_array.rs:1024``: checkpoint shortcuts first,
+        then an ancestry walk down to the finalized slot."""
+        f_epoch, f_root = self.finalized_checkpoint
+        f_slot = f_epoch * self.slots_per_epoch
+        idx = self.indices.get(root)
+        if idx is None:
+            return False
+        node = self.nodes[idx]
+        for cp in (
+            node.finalized_checkpoint,
+            node.justified_checkpoint,
+            node.unrealized_finalized_checkpoint,
+            node.unrealized_justified_checkpoint,
+        ):
+            if cp is not None and tuple(cp) == tuple(self.finalized_checkpoint):
+                return True
+        while True:
+            if node.slot <= f_slot:
+                return node.root == f_root
+            if node.parent is None:
+                return False
+            node = self.nodes[node.parent]
+
     def on_invalid_execution_payload(
-        self, head_root: bytes, latest_valid_hash: Optional[bytes] = None
+        self,
+        head_root: bytes,
+        latest_valid_hash: Optional[bytes] = None,
+        always_invalidate_head: bool = True,
     ) -> None:
-        """Mark ``head_root`` (and descendants, and ancestors newer than
-        ``latest_valid_hash``) INVALID (reference:
-        ``propagate_execution_payload_invalidation``)."""
+        """Mark payloads INVALID (reference:
+        ``propagate_execution_payload_invalidation``, proto_array.rs:499).
+
+        ``latest_valid_hash=None`` is the reference's ``InvalidateOne``:
+        only ``head_root`` and its descendants are invalidated, never
+        ancestors.  With a hash, ancestors between head and the latest valid
+        ancestor are invalidated — but ONLY if that ancestor is known and is
+        a finalized-checkpoint descendant; an unknown/junk hash invalidates
+        just the head (the alternative — invalidating every ancestor — could
+        brand the justified checkpoint invalid and halt the client)."""
         start = self.indices.get(head_root)
         if start is None:
             raise ProtoArrayError("invalidated block unknown")
-        invalid = set()
-        # Walk ancestors until the latest valid hash (exclusive).
-        idx = start
+        invalid: set = set()
+
+        lva_root = (
+            self.execution_block_hash_to_beacon_block_root(latest_valid_hash)
+            if latest_valid_hash is not None
+            else None
+        )
+        lva_is_descendant = lva_root is not None and (
+            self.is_descendant(lva_root, head_root)
+            and self.is_finalized_checkpoint_or_descendant(lva_root)
+        )
+
+        # Step 1: walk ancestors from the head, collecting invalidations.
+        idx: Optional[int] = start
         while idx is not None:
             node = self.nodes[idx]
+            if node.execution_status == ExecutionStatus.IRRELEVANT:
+                break
+            if not lva_is_descendant and node.root != head_root:
+                break
             if (
                 latest_valid_hash is not None
                 and node.execution_block_hash == latest_valid_hash
             ):
-                self.on_valid_execution_payload(node.root)
+                # The latest valid ancestor itself: scrub best links that
+                # point into the invalidated set, then stop.
+                if node.best_child in invalid:
+                    node.best_child = None
+                if node.best_descendant in invalid:
+                    node.best_descendant = None
                 break
-            if node.execution_status == ExecutionStatus.VALID:
-                if latest_valid_hash is None:
-                    break
-                raise InvalidAncestorError(
-                    f"invalidation reaches VALID block {node.root.hex()[:16]}"
-                )
-            if node.execution_status == ExecutionStatus.IRRELEVANT:
-                break
-            invalid.add(idx)
+            if (
+                node.root != head_root
+                or always_invalidate_head
+                or lva_is_descendant
+            ):
+                if node.execution_status == ExecutionStatus.VALID:
+                    raise InvalidAncestorError(
+                        f"invalidation reaches VALID block {node.root.hex()[:16]}"
+                    )
+                if node.execution_status == ExecutionStatus.OPTIMISTIC:
+                    invalid.add(idx)
+                    node.execution_status = ExecutionStatus.INVALID
+                    node.best_child = None
+                    node.best_descendant = None
+                # already INVALID: keep walking so ancestors update too
             idx = node.parent
-        # All descendants of any invalidated node are invalid.
-        for i, node in enumerate(self.nodes):
-            if node.parent in invalid:
-                invalid.add(i)
-        for i in invalid:
+
+        # Step 2: forward sweep — descendants of any invalidated node are
+        # invalid (children always have higher indices than parents).
+        start_root = lva_root if lva_is_descendant else head_root
+        si = self.indices[start_root]
+        for i in range(si + 1, len(self.nodes)):
             node = self.nodes[i]
-            node.execution_status = ExecutionStatus.INVALID
-            node.weight = 0
-            node.best_child = None
-            node.best_descendant = None
+            if node.parent in invalid:
+                if node.execution_status == ExecutionStatus.VALID:
+                    raise InvalidAncestorError(
+                        f"VALID descendant {node.root.hex()[:16]} of invalid block"
+                    )
+                if node.execution_status == ExecutionStatus.IRRELEVANT:
+                    raise ProtoArrayError(
+                        f"irrelevant (pre-merge) descendant {node.root.hex()[:16]} "
+                        "of a post-merge block"
+                    )
+                node.execution_status = ExecutionStatus.INVALID
+                node.best_child = None
+                node.best_descendant = None
+                invalid.add(i)
 
     # -------------------------------------------------------------- prune
 

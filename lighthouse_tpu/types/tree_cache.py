@@ -253,32 +253,22 @@ class _ValidatorListCache:
         return mix_in_length(body, n)
 
 
-def _value_fingerprint(v):
-    """Hashable deep fingerprint of an SSZ value (ints/bools/bytes at the
-    leaves, tuples for containers and lists).  Cheap for the small elements
-    these lists hold (Eth1Data, HistoricalSummary, PendingAttestation)."""
-    fields = getattr(v, "fields", None)
-    if fields is not None and not isinstance(v, type):
-        return tuple(_value_fingerprint(getattr(v, f)) for f in fields)
-    if isinstance(v, (bytes, bytearray)):
-        return bytes(v)
-    if isinstance(v, (list, tuple)):
-        return tuple(_value_fingerprint(x) for x in v)
-    return v
 
 
 class _ElementMemoListCache:
     """Cache for append-mostly lists of container elements (eth1_data_votes,
     historical_summaries, phase0 pending attestations): per-index root memo
-    keyed by the element's deep VALUE fingerprint — unlike an identity key,
-    an in-place mutation of a cached element can never serve a stale root
-    (a wrong BeaconState root is a consensus split) — plus the incremental
-    tree over element roots."""
+    keyed by the element's SSZ serialization — unlike an identity key, an
+    in-place mutation of a cached element can never serve a stale root (a
+    wrong BeaconState root is a consensus split), and unlike a deep Python
+    tuple, the unchanged-element check is one flat bytes compare (SSZ
+    encoding is injective for a fixed type) — plus the incremental tree over
+    element roots."""
 
     def __init__(self, elem_type, limit_elems: int):
         self.elem_type = elem_type
         self.tree = _LeafTree(max(1, limit_elems))
-        self.fps: List[object] = []
+        self.fps: List[Optional[bytes]] = []
         self.roots: Optional[np.ndarray] = None  # (n, 32) uint8
 
     def root(self, values) -> bytes:
@@ -292,7 +282,7 @@ class _ElementMemoListCache:
             self.fps = [None] * n
             self.roots = roots
             for i, v in enumerate(values):
-                fp = _value_fingerprint(v)
+                fp = self.elem_type.serialize(v)
                 if i < keep and fp == old_fps[i]:
                     self.fps[i] = fp
                     continue
@@ -301,7 +291,7 @@ class _ElementMemoListCache:
                     self.elem_type.hash_tree_root(v), dtype=np.uint8)
         else:
             for i, v in enumerate(values):
-                fp = _value_fingerprint(v)
+                fp = self.elem_type.serialize(v)
                 if fp != self.fps[i]:
                     self.fps[i] = fp
                     self.roots[i] = np.frombuffer(

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Retry the TPU probe until the tunnel comes back (or the session ends).
+# Each attempt can hang ~25+ min in jax.devices(); failures sleep 5 min and
+# retry.  Success leaves real device timings in the log and a warm .jax_cache
+# for bench.py.  Run detached:
+#   nohup bash scripts/tpu_probe_loop.sh >> .tpu_probe/probe.log 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+attempt=0
+while true; do
+  attempt=$((attempt + 1))
+  echo "PROBE_LOOP attempt=$attempt start=$(date -u +%H:%M:%S)"
+  if timeout 3000 python scripts/tpu_probe.py; then
+    if grep -q '"stage": "timed"' .tpu_probe/probe.log 2>/dev/null; then
+      echo "PROBE_LOOP success after attempt=$attempt"
+      break
+    fi
+  fi
+  echo "PROBE_LOOP attempt=$attempt failed rc=$? $(date -u +%H:%M:%S); sleeping 300s"
+  sleep 300
+done

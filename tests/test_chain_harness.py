@@ -266,3 +266,27 @@ class TestLiveness:
         assert len(still_seen) >= 8, (
             f"liveness lost without gossip caches: {still_seen}"
         )
+
+
+def test_sync_committee_period_boundary_selection(harness):
+    """At the LAST slot of a sync-committee period the signing committee is
+    the state's NEXT committee (duty epoch = epoch(slot+1); reference
+    sync_committee_at_next_slot, beacon_chain.rs:1288).  Mid-period slots
+    use the current committee (ADVICE r3: period-boundary messages were
+    rejected against the wrong committee)."""
+    from types import SimpleNamespace
+
+    chain = harness.chain
+    spec = chain.spec
+    spe = spec.slots_per_epoch
+    period_epochs = spec.preset.epochs_per_sync_committee_period
+    period_slots = period_epochs * spe
+
+    cur, nxt = object(), object()
+    state = SimpleNamespace(slot=5, current_sync_committee=cur,
+                            next_sync_committee=nxt)
+    assert chain._sync_committee_for_slot(state, 5) is cur
+    # last slot of period 0: signs for slot+1 which is period 1 -> NEXT
+    assert chain._sync_committee_for_slot(state, period_slots - 1) is nxt
+    # first slot of period 1 with a state still in period 0 -> NEXT
+    assert chain._sync_committee_for_slot(state, period_slots) is nxt

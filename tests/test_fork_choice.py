@@ -252,7 +252,10 @@ class TestExecutionStatus:
         assert get_head(pa, votes, np.zeros(0), np.zeros(0)) == root(3)
         pa.on_invalid_execution_payload(root(3), latest_valid_hash=root(102))
         assert pa.get_block(root(3)).execution_status == ExecutionStatus.INVALID
-        assert pa.get_block(root(2)).execution_status == ExecutionStatus.VALID
+        # The latest valid ancestor stays OPTIMISTIC: the reference's
+        # invalidation never promotes it to VALID (proto_array.rs:556-579) —
+        # validation comes only from a direct EL verdict.
+        assert pa.get_block(root(2)).execution_status == ExecutionStatus.OPTIMISTIC
         assert get_head(pa, votes, np.zeros(0), np.zeros(0)) == root(2)
 
     def test_invalidation_propagates_to_descendants(self):
