@@ -37,8 +37,22 @@ def state_depth(state) -> int:
     return max(0, (len(state.ssz_type.field_types) - 1).bit_length())
 
 
-def lc_era(state) -> str:
-    """Which LC container era the state's layout requires."""
+def era_for_slot(spec, slot: int) -> str:
+    """LC container era for objects at ``slot``: tracks the header format
+    (capella introduces the execution header, light_client_header.rs:40-59)
+    and the electra branch deepening.  altair/bellatrix share the
+    beacon-only header."""
+    fork = spec.fork_name_at_slot(int(slot))
+    if fork in ("capella", "deneb", "electra"):
+        return fork
+    return "altair"
+
+
+def lc_era(state, spec=None) -> str:
+    """Which LC container era a state's objects must use."""
+    if spec is not None:
+        return era_for_slot(spec, int(state.slot))
+    # Fallback (legacy callers): depth-only discrimination.
     return "electra" if state_depth(state) > SYNC_COMMITTEE_BRANCH_DEPTH else "altair"
 
 
@@ -86,19 +100,72 @@ def finality_branch(state, roots: Optional[List[bytes]] = None):
     return [epoch_leaf] + state_level
 
 
-def block_to_lc_header(types, block_or_header):
+def _payload_to_lc_exec_header(types, payload, era: str):
+    """Payload -> the ERA's execution payload header, zero-extending fields
+    the payload's own fork predates (the spec's upgrade_lc_header_to_*
+    functions default new fields — e.g. a capella finalized block inside a
+    deneb update gets blob_gas_used = excess_blob_gas = 0)."""
+    hdr_cls = types.payload_header["deneb" if era == "electra" else era]
+    kwargs = {}
+    for name in hdr_cls.fields:
+        if name == "transactions_root":
+            kwargs[name] = payload.fields["transactions"].hash_tree_root(
+                payload.transactions)
+        elif name == "withdrawals_root":
+            kwargs[name] = payload.fields["withdrawals"].hash_tree_root(
+                payload.withdrawals)
+        elif hasattr(payload, name):
+            kwargs[name] = getattr(payload, name)
+    return hdr_cls(**kwargs)
+
+
+def block_to_lc_header(types, block_or_header, spec=None, era: str = None):
+    """Per-era LC header for a block (light_client_header.rs:40-59).
+
+    ``era`` is the CONTAINER era (defaults to the block slot's own era; an
+    update spanning a fork boundary passes its attested era so both headers
+    share one container type).  The execution part is present iff the block
+    itself is capella+ (spec ``block_to_light_client_header``): the payload
+    header plus the 4-deep Merkle branch proving it under the body root —
+    built from one body field-root pass (which also yields the body root,
+    so the beacon header costs nothing extra).  A bare ``BeaconBlockHeader``
+    input (no body available — the genesis-anchor corner) degrades to a
+    zeroed execution header."""
     msg = getattr(block_or_header, "message", block_or_header)
-    if hasattr(msg, "body_root"):
-        beacon = msg.copy()
-    else:
-        beacon = types.BeaconBlockHeader(
-            slot=msg.slot,
-            proposer_index=msg.proposer_index,
-            parent_root=msg.parent_root,
-            state_root=msg.state_root,
-            body_root=msg.body.hash_tree_root(),
-        )
-    return types.LightClientHeader(beacon=beacon)
+    if era is None:
+        era = (era_for_slot(spec, int(msg.slot))
+               if spec is not None else "altair")
+    hdr_cls = types.light_client[era]["header"]
+
+    if hasattr(msg, "body_root"):  # bare header: no body to prove against
+        return hdr_cls(beacon=msg.copy())
+
+    body = msg.body
+    bt = body.ssz_type
+    froots = [ft.hash_tree_root(getattr(body, n))
+              for n, ft in bt.field_types.items()]
+    beacon = types.BeaconBlockHeader(
+        slot=msg.slot,
+        proposer_index=msg.proposer_index,
+        parent_root=msg.parent_root,
+        state_root=msg.state_root,
+        body_root=ssz_mod.merkleize(froots),
+    )
+    block_fork = spec.fork_name_at_slot(int(msg.slot)) if spec is not None else None
+    if (era == "altair"
+            or block_fork not in ("capella", "deneb", "electra")
+            or not hasattr(body, "execution_payload")):
+        # Pre-capella block (or altair-era container): beacon-only /
+        # zeroed execution per the spec's default header.
+        return hdr_cls(beacon=beacon)
+
+    names = list(bt.field_types)
+    idx = names.index("execution_payload")
+    return hdr_cls(
+        beacon=beacon,
+        execution=_payload_to_lc_exec_header(types, body.execution_payload, era),
+        execution_branch=ssz_mod.merkle_branch(froots, 16, idx),
+    )
 
 
 class LightClientServerCache:
@@ -132,12 +199,14 @@ class LightClientServerCache:
             return
         participation = sum(sync_aggregate.sync_committee_bits)
         signature_slot = int(block.message.slot)
-        attested_header = block_to_lc_header(self.types, parent_block)
+        era = lc_era(parent_state, self.spec)
+        lc = self.types.light_client[era]
+        attested_header = block_to_lc_header(
+            self.types, parent_block, self.spec, era=era)
         # One field-root pass serves both branches below (the cache makes it
         # incremental; recomputing per branch would double the cost).
         roots = state_field_roots(parent_state)
 
-        lc = self.types.light_client[lc_era(parent_state)]
         optimistic = lc["optimistic_update"](
             attested_header=attested_header,
             sync_aggregate=sync_aggregate.copy(),
@@ -152,10 +221,14 @@ class LightClientServerCache:
             self._new_optimistic = optimistic
 
         fin_branch = finality_branch(parent_state, roots)
-        if fin_branch is not None and finalized_block is not None:
+        finalized_header = (
+            block_to_lc_header(self.types, finalized_block, self.spec, era=era)
+            if fin_branch is not None and finalized_block is not None else None
+        )
+        if finalized_header is not None:
             finality = lc["finality_update"](
                 attested_header=attested_header,
-                finalized_header=block_to_lc_header(self.types, finalized_block),
+                finalized_header=finalized_header,
                 finality_branch=fin_branch,
                 sync_aggregate=sync_aggregate.copy(),
                 signature_slot=signature_slot,
@@ -176,12 +249,12 @@ class LightClientServerCache:
         # could never rotate past them.
         nsc_branch = sync_committee_branch(parent_state, "next_sync_committee", roots)
         if nsc_branch is not None:
-            if fin_branch is not None and finalized_block is not None:
-                fin_header = block_to_lc_header(self.types, finalized_block)
+            if finalized_header is not None:
+                fin_header = finalized_header
                 fin_br = fin_branch
                 has_finality = True
             else:
-                fin_header = self.types.LightClientHeader()
+                fin_header = lc["header"]()
                 fin_br = [b"\x00" * 32] * (state_depth(parent_state) + 1)
                 has_finality = False
             period = self._period(int(parent_block.message.slot)
@@ -214,9 +287,9 @@ class LightClientServerCache:
         branch = sync_committee_branch(state, "current_sync_committee")
         if branch is None:
             return None
-        era = lc_era(state)
+        era = lc_era(state, self.spec)
         return self.types.light_client[era]["bootstrap"](
-            header=block_to_lc_header(self.types, block),
+            header=block_to_lc_header(self.types, block, self.spec),
             current_sync_committee=state.current_sync_committee.copy(),
             current_sync_committee_branch=branch,
         )

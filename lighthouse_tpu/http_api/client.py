@@ -205,16 +205,24 @@ class BeaconNodeHttpClient:
         )
 
     @staticmethod
-    def _lc_era(branch) -> str:
-        # 6/7-element branches are the electra (64-leaf state) era
-        return "electra" if len(branch) >= 6 else "altair"
+    def _lc_era(branch, header_json=None) -> str:
+        """Era from wire shape: 6/7-element state branches are electra
+        (64-leaf state); otherwise the header tells capella vs deneb vs
+        the beacon-only altair format (blob-gas fields are deneb-only)."""
+        if len(branch) >= 6:
+            return "electra"
+        execution = (header_json or {}).get("execution")
+        if execution is None:
+            return "altair"
+        return "deneb" if "blob_gas_used" in execution else "capella"
 
     def light_client_bootstrap(self, block_root: bytes, types=None):
         data = self.get(
             f"/eth/v1/beacon/light_client/bootstrap/0x{bytes(block_root).hex()}"
         )["data"]
         if types is not None:
-            era = self._lc_era(data["current_sync_committee_branch"])
+            era = self._lc_era(data["current_sync_committee_branch"],
+                               data.get("header"))
             return container_from_json(types.light_client[era]["bootstrap"], data)
         return data
 
@@ -227,7 +235,8 @@ class BeaconNodeHttpClient:
             return [
                 container_from_json(
                     types.light_client[
-                        self._lc_era(e["data"]["next_sync_committee_branch"])
+                        self._lc_era(e["data"]["next_sync_committee_branch"],
+                                     e["data"].get("attested_header"))
                     ]["update"],
                     e["data"],
                 )
@@ -238,7 +247,9 @@ class BeaconNodeHttpClient:
     def light_client_finality_update(self, types=None):
         data = self.get("/eth/v1/beacon/light_client/finality_update")["data"]
         if types is not None:
-            era = "electra" if len(data["finality_branch"]) >= 7 else "altair"
+            branch = data["finality_branch"]
+            era = ("electra" if len(branch) >= 7 else
+                   self._lc_era([], data.get("attested_header")))
             return container_from_json(
                 types.light_client[era]["finality_update"], data
             )
@@ -247,7 +258,11 @@ class BeaconNodeHttpClient:
     def light_client_optimistic_update(self, types=None):
         data = self.get("/eth/v1/beacon/light_client/optimistic_update")["data"]
         if types is not None:
-            return container_from_json(types.LightClientOptimisticUpdate, data)
+            # No branch on the wire: the header shape is the only signal
+            # (electra optimistic updates share deneb's header).
+            era = self._lc_era([], data.get("attested_header"))
+            return container_from_json(
+                types.light_client[era]["optimistic_update"], data)
         return data
 
     def prepare_beacon_proposer(self, preparations: List[dict]) -> None:

@@ -27,10 +27,48 @@ NEXT_SYNC_COMMITTEE_INDEX = 23
 FINALIZED_ROOT_SUBINDEX = 20 * 2 + 1  # checkpoint.root under finalized_checkpoint
 # depths derive from the received branch lengths: 5/6 through deneb,
 # 6/7 for electra's 64-leaf state layout
+EXECUTION_PAYLOAD_SUBINDEX = 9  # body field index (gindex 25, depth 4)
 
 
 class LightClientError(Exception):
     pass
+
+
+def is_valid_light_client_header(header, spec=None) -> bool:
+    """Spec ``is_valid_light_client_header`` for capella+ headers: the
+    execution payload header's root must prove against the beacon header's
+    body root through the 4-deep ``execution_branch`` (gindex 25 — reference
+    light_client_header.rs:52-59).  Altair-era (beacon-only) headers are
+    trivially valid — and so is a capella+ CONTAINER carrying a pre-capella
+    BLOCK, which the spec requires to hold the default (all-zero) execution
+    header and branch (e.g. the finalized header of an update spanning the
+    capella fork epoch)."""
+    if "execution" not in header.fields:
+        return True
+    branch_is_zero = all(bytes(b) == b"\x00" * 32 for b in header.execution_branch)
+    if branch_is_zero:
+        pre_capella = spec is not None and spec.fork_name_at_slot(
+            int(header.beacon.slot)
+        ) not in ("capella", "deneb", "electra")
+        exec_is_default = (
+            header.execution.hash_tree_root()
+            == type(header.execution)().hash_tree_root()
+        )
+        if pre_capella or spec is None:
+            return exec_is_default
+        return False
+    return is_valid_merkle_branch(
+        header.execution.hash_tree_root(),
+        header.execution_branch,
+        len(header.execution_branch),
+        EXECUTION_PAYLOAD_SUBINDEX,
+        bytes(header.beacon.body_root),
+    )
+
+
+def _require_valid_header(header, what: str, spec=None) -> None:
+    if not is_valid_light_client_header(header, spec):
+        raise LightClientError(f"invalid execution branch in {what} header")
 
 
 class LightClientStore:
@@ -54,6 +92,7 @@ class LightClientStore:
         header_root = bootstrap.header.beacon.hash_tree_root()
         if header_root != bytes(trusted_block_root):
             raise LightClientError("bootstrap header does not match trusted root")
+        _require_valid_header(bootstrap.header, "bootstrap", self.spec)
         if not is_valid_merkle_branch(
             bootstrap.current_sync_committee.hash_tree_root(),
             bootstrap.current_sync_committee_branch,
@@ -119,7 +158,10 @@ class LightClientStore:
         sig_period = self._verify_sync_aggregate(
             update.attested_header, update.sync_aggregate, int(update.signature_slot)
         )
+        _require_valid_header(update.attested_header, "attested", self.spec)
         has_finality = any(any(b) for b in update.finality_branch)
+        if has_finality:
+            _require_valid_header(update.finalized_header, "finalized", self.spec)
         fin_depth = len(update.finality_branch)
         if has_finality and not is_valid_merkle_branch(
             bytes(update.finalized_header.beacon.hash_tree_root()),
@@ -160,6 +202,8 @@ class LightClientStore:
         self._verify_sync_aggregate(
             update.attested_header, update.sync_aggregate, int(update.signature_slot)
         )
+        _require_valid_header(update.attested_header, "attested", self.spec)
+        _require_valid_header(update.finalized_header, "finalized", self.spec)
         fin_depth = len(update.finality_branch)
         if not is_valid_merkle_branch(
             bytes(update.finalized_header.beacon.hash_tree_root()),
@@ -179,5 +223,6 @@ class LightClientStore:
         self._verify_sync_aggregate(
             update.attested_header, update.sync_aggregate, int(update.signature_slot)
         )
+        _require_valid_header(update.attested_header, "attested", self.spec)
         if int(update.attested_header.beacon.slot) > int(self.optimistic_header.beacon.slot):
             self.optimistic_header = update.attested_header.copy()

@@ -34,6 +34,7 @@ from .ssz import (
     uint64,
     uint8,
     uint256,
+    _ContainerMeta,
 )
 
 
@@ -723,13 +724,32 @@ def build_types(preset: Preset) -> SimpleNamespace:
         fields = {"message": ContributionAndProof.ssz_type, "signature": bytes96}
 
     # ------------------------------------------------- light client protocol
-    # Reference: consensus/types/src/light_client_{bootstrap,update,...}.rs.
-    # Headers are the altair (beacon-only) format; the capella+ execution
-    # header extension is additive and not yet carried (the sync-committee
-    # and finality proofs below are complete without it).
+    # Reference: consensus/types/src/light_client_{header,bootstrap,...}.rs.
+    # Headers are per-era (light_client_header.rs:40-59): altair/bellatrix
+    # carry only the beacon header; capella adds the execution payload
+    # header + the 4-deep ``execution_branch`` proving it under the block's
+    # body root (EXECUTION_PAYLOAD_GINDEX = 25); deneb/electra carry their
+    # era's payload header.  Electra additionally deepens the state-side
+    # branches (64-leaf state layout: depths 6/7).
+
+    _exec_branch = Vector(bytes32, 4)  # floorlog2(EXECUTION_PAYLOAD_GINDEX)
 
     class LightClientHeader(Container):
         fields = {"beacon": BeaconBlockHeader.ssz_type}
+
+    class LightClientHeaderCapella(Container):
+        fields = {
+            "beacon": BeaconBlockHeader.ssz_type,
+            "execution": ExecutionPayloadHeaderCapella.ssz_type,
+            "execution_branch": _exec_branch,
+        }
+
+    class LightClientHeaderDeneb(Container):
+        fields = {
+            "beacon": BeaconBlockHeader.ssz_type,
+            "execution": ExecutionPayloadHeaderDeneb.ssz_type,
+            "execution_branch": _exec_branch,
+        }
 
     _sc_branch = Vector(bytes32, 5)  # depth of a 32-leaf state container
     _fin_branch = Vector(bytes32, 6)  # finalized root: one level deeper
@@ -776,17 +796,17 @@ def build_types(preset: Preset) -> SimpleNamespace:
 
     class LightClientBootstrapElectra(Container):
         fields = {
-            "header": LightClientHeader.ssz_type,
+            "header": LightClientHeaderDeneb.ssz_type,
             "current_sync_committee": SyncCommittee.ssz_type,
             "current_sync_committee_branch": _sc_branch_electra,
         }
 
     class LightClientUpdateElectra(Container):
         fields = {
-            "attested_header": LightClientHeader.ssz_type,
+            "attested_header": LightClientHeaderDeneb.ssz_type,
             "next_sync_committee": SyncCommittee.ssz_type,
             "next_sync_committee_branch": _sc_branch_electra,
-            "finalized_header": LightClientHeader.ssz_type,
+            "finalized_header": LightClientHeaderDeneb.ssz_type,
             "finality_branch": _fin_branch_electra,
             "sync_aggregate": SyncAggregate.ssz_type,
             "signature_slot": uint64,
@@ -794,8 +814,8 @@ def build_types(preset: Preset) -> SimpleNamespace:
 
     class LightClientFinalityUpdateElectra(Container):
         fields = {
-            "attested_header": LightClientHeader.ssz_type,
-            "finalized_header": LightClientHeader.ssz_type,
+            "attested_header": LightClientHeaderDeneb.ssz_type,
+            "finalized_header": LightClientHeaderDeneb.ssz_type,
             "finality_branch": _fin_branch_electra,
             "sync_aggregate": SyncAggregate.ssz_type,
             "signature_slot": uint64,
@@ -811,20 +831,56 @@ def build_types(preset: Preset) -> SimpleNamespace:
     ns.block_body = _bodies
     ns.block = _blocks
     ns.signed_block = _signed_blocks
-    # Per-era LC container sets (keyed by the DEPTH era, selected from the
-    # state's field count at production time).
+
+    # Per-era LC container sets.  The era key tracks BOTH axes that change
+    # across forks: the header format (altair beacon-only; capella/deneb
+    # execution header + execution_branch) and the state-branch depths
+    # (electra: 6/7).  capella/deneb variants are generated here from the
+    # altair shapes with the era's header substituted
+    # (light_client_bootstrap.rs / light_client_update.rs per-fork structs).
+    def _lc_variants(era_name, header_cls):
+        out = {}
+        for kind, base in (("bootstrap", LightClientBootstrap),
+                           ("update", LightClientUpdate),
+                           ("finality_update", LightClientFinalityUpdate),
+                           ("optimistic_update", LightClientOptimisticUpdate)):
+            fields = {}
+            for fname, ftype in base.fields.items():
+                if fname in ("header", "attested_header", "finalized_header"):
+                    fields[fname] = header_cls.ssz_type
+                else:
+                    fields[fname] = ftype
+            cls_name = base.__name__ + era_name.capitalize()
+            cls = _ContainerMeta(cls_name, (Container,), {"fields": fields})
+            setattr(ns, cls_name, cls)
+            out[kind] = cls
+        out["header"] = header_cls
+        return out
+
+    class LightClientOptimisticUpdateElectra(Container):
+        fields = {
+            "attested_header": LightClientHeaderDeneb.ssz_type,
+            "sync_aggregate": SyncAggregate.ssz_type,
+            "signature_slot": uint64,
+        }
+
+    ns.LightClientOptimisticUpdateElectra = LightClientOptimisticUpdateElectra
     ns.light_client = {
         "altair": {
+            "header": LightClientHeader,
             "bootstrap": LightClientBootstrap,
             "update": LightClientUpdate,
             "finality_update": LightClientFinalityUpdate,
             "optimistic_update": LightClientOptimisticUpdate,
         },
+        "capella": _lc_variants("capella", LightClientHeaderCapella),
+        "deneb": _lc_variants("deneb", LightClientHeaderDeneb),
         "electra": {
+            "header": LightClientHeaderDeneb,
             "bootstrap": LightClientBootstrapElectra,
             "update": LightClientUpdateElectra,
             "finality_update": LightClientFinalityUpdateElectra,
-            "optimistic_update": LightClientOptimisticUpdate,  # no branch
+            "optimistic_update": LightClientOptimisticUpdateElectra,
         },
     }
     ns.blinded_block_body = _blinded_bodies
