@@ -16,9 +16,13 @@ happening inside ``state_transition(strategy=VERIFY_BULK)``.
 
 from __future__ import annotations
 
+import logging
+
 from typing import Dict, List, Optional, Tuple
 
 from .. import metrics
+
+log = logging.getLogger("lighthouse_tpu.chain")
 from ..consensus import helpers as h
 from ..consensus.per_block import BlockProcessingError, BlockSignatureStrategy
 from ..consensus.per_slot import process_slots
@@ -335,6 +339,16 @@ class BeaconChain:
 
         self.validator_monitor = ValidatorMonitor(spec)
         self.builder_pubkey = None  # operator-pinned relay identity (optional)
+        from .attester_cache import EarlyAttesterCache
+
+        self.early_attester_cache = EarlyAttesterCache()
+        # Late-block proposer re-org config (reference chain_config.rs:6-10
+        # defaults; set re_org_head_threshold to None to disable).
+        self.re_org_head_threshold: Optional[int] = 20
+        self.re_org_parent_threshold: int = 160
+        self.re_org_max_epochs_since_finalization: int = 2
+        self.re_org_cutoff_denominator: int = 12
+        self.re_org_disallowed_offsets: tuple = ()
         self.op_pool = OperationPool()
         self.observed_block_roots: set = set()
         self._migrated_slot = 0
@@ -368,10 +382,14 @@ class BeaconChain:
 
     def get_block(self, block_root: bytes):
         """Block by root — object cache first, store fallback (the reference
-        can always reach the store when its block cache misses)."""
+        can always reach the store when its block cache misses), then the
+        early-attester cache for a block that is verified but not yet
+        written (peers may request it over RPC the moment it hits gossip)."""
         block = self._blocks.get(block_root)
         if block is None:
             block = self.db.get_block(block_root)
+        if block is None:
+            block = self.early_attester_cache.get_block(block_root)
         return block
 
     def get_blobs(self, block_root: bytes) -> list:
@@ -553,6 +571,14 @@ class BeaconChain:
             state=state,
             payload_verification_status=payload_status,
             block_delay_seconds=block_delay_seconds,
+        )
+        # The block is fully verified: attestations to it can be produced
+        # NOW, before the store write / head recompute below (reference
+        # early_attester_cache.rs — the 4 s attestation deadline must not
+        # wait on the database).
+        self.early_attester_cache.add_head_block(
+            block_root, signed_block, state, self.types, self.spec,
+            blobs=blob_sidecars,
         )
         with metrics.BLOCK_STORE_WRITE_SECONDS.time():
             self._store_block(block_root, signed_block, state)
@@ -1122,6 +1148,7 @@ class BeaconChain:
         pre_state=None,
         blob_kzg_commitments: Optional[List[bytes]] = None,
         payload_header=None,
+        execution_requests=None,
     ):
         """Assemble an unsigned block on the current head (or on
         ``parent_root`` — how tests build forks); reference
@@ -1137,6 +1164,8 @@ class BeaconChain:
             if int(state.slot) != slot:
                 raise ChainError(f"pre_state at slot {state.slot}, expected {slot}")
         else:
+            if parent_root is None:
+                parent_root = self._maybe_re_org_parent(slot)
             state, parent_root = self.state_at_slot(slot, parent_root)
         if state is self._states.get(parent_root):
             state = state.copy()
@@ -1232,10 +1261,14 @@ class BeaconChain:
         if "blob_kzg_commitments" in body_cls.fields:
             body_kwargs["blob_kzg_commitments"] = list(blob_kzg_commitments or [])
         if "execution_requests" in body_cls.fields and "execution_requests" not in body_kwargs:
-            # mock-EL path: no EL-triggered requests
-            body_kwargs["execution_requests"] = types.ExecutionRequests(
-                deposits=[], withdrawals=[], consolidations=[]
-            )
+            if execution_requests is not None:
+                # blinded electra production: requests come from the bid
+                body_kwargs["execution_requests"] = execution_requests
+            else:
+                # mock-EL path: no EL-triggered requests
+                body_kwargs["execution_requests"] = types.ExecutionRequests(
+                    deposits=[], withdrawals=[], consolidations=[]
+                )
 
         block_cls = types.blinded_block[fork] if blinded else types.block[fork]
         block = block_cls(
@@ -1324,17 +1357,16 @@ class BeaconChain:
         )
         if not bls.verify_signature_sets([sig_set]):
             raise ChainError("builder bid signature invalid")
-        fork_name = type(state).fork_name
-        if fork_name == "electra":
-            # the electra builder flow additionally carries execution
-            # requests in the bid — not implemented; local production wins
-            raise ChainError("builder path not supported for electra yet")
         blob_commitments = list(getattr(bid, "blob_kzg_commitments", []) or [])
+        # electra bids carry the EL-triggered requests the blinded body must
+        # embed (builder_bid.rs:14-35 + builder-specs electra).
+        bid_requests = getattr(bid, "execution_requests", None)
         return self.produce_block(
             slot, randao_reveal, graffiti=graffiti,
             parent_root=parent_root, pre_state=state,
             payload_header=bid.header.copy(),
             blob_kzg_commitments=blob_commitments or None,
+            execution_requests=bid_requests.copy() if bid_requests is not None else None,
         )
 
     def unblind_and_import(self, signed_blinded_block):
@@ -1377,10 +1409,54 @@ class BeaconChain:
         root = self.process_block(signed_full)
         return root, signed_full
 
+    def _maybe_re_org_parent(self, slot: int) -> Optional[bytes]:
+        """Proposer late-block re-org decision (reference
+        ``beacon_chain.rs:4250`` ``get_state_for_re_org``): when the head is
+        a weakly-attested late block, propose on its PARENT and orphan it.
+        Returns the parent root to build on, or None for the canonical head.
+        Only attempted early in the slot (within 1/re_org_cutoff_denominator
+        of slot time — a re-org block proposed late loses the race it is
+        trying to win)."""
+        from ..fork_choice.fork_choice import DoNotReOrg
+
+        if self.re_org_head_threshold is None:
+            return None
+        into_slot = self.slot_clock.seconds_from_current_slot_start()
+        if into_slot is not None and into_slot > (
+            self.spec.seconds_per_slot / self.re_org_cutoff_denominator
+        ):
+            return None
+        try:
+            parent = self.fork_choice.get_proposer_head(
+                int(slot), self.head_root,
+                re_org_head_threshold=self.re_org_head_threshold,
+                re_org_parent_threshold=self.re_org_parent_threshold,
+                max_epochs_since_finalization=(
+                    self.re_org_max_epochs_since_finalization),
+                disallowed_offsets=self.re_org_disallowed_offsets,
+            )
+        except DoNotReOrg as e:
+            log.debug("not re-orging: %s", e)
+            return None
+        log.info("attempting late-block re-org: building on parent %s",
+                 parent.hex()[:12])
+        return parent
+
     def produce_attestation_data(self, slot: int, committee_index: int):
         """Reference ``produce_unaggregated_attestation:1759`` — the data all
-        committee members at (slot, index) sign."""
+        committee members at (slot, index) sign.  The early-attester cache is
+        consulted first: for the newest verified block it answers without
+        touching (or advancing) the head state."""
         types, spec = self.types, self.spec
+        early = self.early_attester_cache.try_attest(
+            int(slot), int(committee_index), types, spec
+        )
+        if early is not None:
+            # The newest verified block is the right attestation target even
+            # before the head recompute lands (the reference returns here
+            # unconditionally; the cache is cleared if a re-org ever picks a
+            # different branch).
+            return early
         state = self.head_state
         head_root = self.head_root
         if int(state.slot) < slot:
@@ -1423,6 +1499,12 @@ class BeaconChain:
         old_head = self.head_root
         head = self.fork_choice.get_head(self.current_slot())
         self.head_root = head
+        # A head that re-orged away from the early-attester item makes the
+        # cached attestation data wrong — drop it (reference clears the
+        # cache on re-org in canonical_head.rs).
+        cached = self.early_attester_cache.get_block(head)
+        if cached is None and self.early_attester_cache._item is not None:
+            self.early_attester_cache.clear()
         st = self.get_state(head) if head != old_head else None
         if st is not None:
             old_epoch = self._blocks_slot(old_head) // self.spec.slots_per_epoch

@@ -16,6 +16,8 @@ KZG verification runs through the ``Kzg`` engine the chain owns — with
 from __future__ import annotations
 
 import threading
+
+from ..timeout_lock import TimeoutLock
 from typing import Dict, List, Optional, Tuple
 
 from ..types import ssz as ssz_mod
@@ -134,7 +136,7 @@ class DataAvailabilityChecker:
         # chain-provided proposer-signature check + clock (gossip path)
         self.header_verifier = header_verifier
         self.slot_provider = slot_provider
-        self._lock = threading.Lock()
+        self._lock = TimeoutLock("da_checker")
         # block_root -> {index: sidecar} (KZG-verified)
         self._blobs: Dict[bytes, Dict[int, object]] = {}
         # block_root -> signed block awaiting availability

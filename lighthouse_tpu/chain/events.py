@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import queue
 import threading
+
+from ..timeout_lock import TimeoutLock
 from typing import Dict, List, Optional, Tuple
 
 TOPIC_HEAD = "head"
@@ -46,7 +48,7 @@ class EventSubscription:
 class EventBus:
     def __init__(self) -> None:
         self._subs: List[EventSubscription] = []
-        self._lock = threading.Lock()
+        self._lock = TimeoutLock("event_bus")
 
     def subscribe(self, topics: List[str]) -> EventSubscription:
         bad = [t for t in topics if t not in ALL_TOPICS]

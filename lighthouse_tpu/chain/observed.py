@@ -14,8 +14,9 @@ order), so an attacker cannot poison the cache with invalid items.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Set, Tuple
+
+from ..timeout_lock import TimeoutLock
 
 
 class ObservedAttesters:
@@ -24,7 +25,7 @@ class ObservedAttesters:
 
     def __init__(self) -> None:
         self._seen: Dict[int, Set[int]] = {}  # target_epoch -> {validator_index}
-        self._lock = threading.Lock()
+        self._lock = TimeoutLock("observed")
 
     def is_known(self, target_epoch: int, validator_index: int) -> bool:
         with self._lock:
@@ -57,7 +58,7 @@ class ObservedAggregates:
 
     def __init__(self) -> None:
         self._seen: Dict[int, Set[bytes]] = {}  # slot -> {attestation htr}
-        self._lock = threading.Lock()
+        self._lock = TimeoutLock("observed")
 
     def is_known(self, slot: int, attestation_root: bytes) -> bool:
         with self._lock:
@@ -83,7 +84,7 @@ class ObservedBlockProducers:
 
     def __init__(self) -> None:
         self._seen: Dict[Tuple[int, int], bytes] = {}  # (slot, proposer) -> root
-        self._lock = threading.Lock()
+        self._lock = TimeoutLock("observed")
 
     def status(self, slot: int, proposer: int, block_root: bytes) -> str:
         """Read-only check: 'new', 'duplicate' (same root) or 'equivocation'.

@@ -11,6 +11,8 @@ and Prometheus series.
 from __future__ import annotations
 
 import threading
+
+from ..timeout_lock import TimeoutLock
 from typing import Dict, Iterable, List, Set
 
 from .. import metrics
@@ -34,7 +36,7 @@ class ValidatorMonitor:
     def __init__(self, spec):
         self.spec = spec
         self.monitored: Set[int] = set()
-        self._lock = threading.Lock()
+        self._lock = TimeoutLock("validator_monitor")
         # target epoch -> monitored validators whose attestation was included
         self._included: Dict[int, Set[int]] = {}
         # slot -> monitored proposer

@@ -40,9 +40,11 @@ def _read_password(path, prompt: str) -> str:
 def run_beacon_node(args) -> int:
     from .client import ClientBuilder
 
-    logging.basicConfig(
-        level=logging.DEBUG if args.debug else logging.INFO,
-        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    from .logs import setup_logging
+
+    setup_logging(
+        logging.DEBUG if args.debug else logging.INFO,
+        json_format=getattr(args, "log_json", False),
     )
     if getattr(args, "testnet_dir", None):
         from .network_config import Eth2NetworkConfig
@@ -105,7 +107,9 @@ def run_validator_client(args) -> int:
     from .types.containers import build_types
     from .validator_client import SlashingProtectionDB, ValidatorClient
 
-    logging.basicConfig(level=logging.INFO)
+    from .logs import setup_logging
+
+    setup_logging(logging.INFO)
     spec = _spec_for(args.network)
     types = build_types(spec.preset)
 
@@ -469,6 +473,8 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--slasher", action="store_true")
     bn.add_argument("--bls-backend", default="jax", choices=["jax", "host", "fake"])
     bn.add_argument("--debug", action="store_true")
+    bn.add_argument("--log-json", action="store_true", dest="log_json",
+                    help="emit structured JSON log lines (one object per line)")
     bn.set_defaults(func=run_beacon_node)
 
     vc = sub.add_parser("validator_client", aliases=["vc"], help="run a validator client")

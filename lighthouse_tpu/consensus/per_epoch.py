@@ -167,6 +167,87 @@ def is_in_inactivity_leak(state, spec: ChainSpec) -> bool:
 # ------------------------------------------------------------ altair path
 
 
+def _epoch_deltas_numpy(
+    arrays: "EpochArrays",
+    prev_part: np.ndarray,
+    inactivity: np.ndarray,
+    *,
+    previous_epoch: int,
+    in_leak: bool,
+    base_reward_per_increment: int,
+    total_active_balance: int,
+    quotient: int,
+    spec: ChainSpec,
+):
+    """The fused per-validator epoch pass (inactivity updates + flag
+    rewards + penalties) on numpy.  Returns (new_inactivity,
+    balance_delta); bit-identical to the device variant in
+    ops/epoch_device.py (tests assert equality)."""
+    n = arrays.n
+    eligible = arrays.eligible_mask(previous_epoch)
+    prev_target = _unslashed_participating_mask(
+        arrays, prev_part, TIMELY_TARGET_FLAG_INDEX, previous_epoch
+    )
+
+    delta = np.where(prev_target, -np.minimum(1, inactivity), spec.inactivity_score_bias)
+    new_inactivity = inactivity + np.where(eligible, delta, 0)
+    if not in_leak:
+        new_inactivity = new_inactivity - np.where(
+            eligible,
+            np.minimum(spec.inactivity_score_recovery_rate, new_inactivity),
+            0,
+        )
+
+    increment = spec.effective_balance_increment
+    base_reward = (arrays.effective_balance // increment) * base_reward_per_increment
+    active_increments = total_active_balance // increment
+    rewards = np.zeros(n, dtype=np.int64)
+    penalties = np.zeros(n, dtype=np.int64)
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        participating = _unslashed_participating_mask(
+            arrays, prev_part, flag_index, previous_epoch
+        )
+        participating_increments = int(
+            arrays.effective_balance[participating].sum()
+        ) // increment
+        if not in_leak:
+            flag_rewards = (
+                base_reward * weight * participating_increments
+                // (active_increments * WEIGHT_DENOMINATOR)
+            )
+            rewards += np.where(eligible & participating, flag_rewards, 0)
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalties += np.where(
+                eligible & ~participating, base_reward * weight // WEIGHT_DENOMINATOR, 0
+            )
+    inactivity_penalty = (
+        arrays.effective_balance * new_inactivity
+        // (spec.inactivity_score_bias * quotient)
+    )
+    penalties += np.where(eligible & ~prev_target, inactivity_penalty, 0)
+    return new_inactivity, rewards - penalties
+
+
+_EPOCH_BACKEND = "numpy"
+
+
+def set_epoch_backend(name: str) -> None:
+    """'numpy' (host, hermetic) or 'device' (the jnp kernel in
+    ops/epoch_device.py — the §2.3 intra-op-parallel epoch path)."""
+    global _EPOCH_BACKEND
+    if name not in ("numpy", "device"):
+        raise ValueError(f"unknown epoch backend {name!r}")
+    _EPOCH_BACKEND = name
+
+
+def epoch_deltas(arrays, prev_part, inactivity, **kwargs):
+    if _EPOCH_BACKEND == "device":
+        from ..ops.epoch_device import epoch_deltas_device
+
+        return epoch_deltas_device(arrays, prev_part, inactivity, **kwargs)
+    return _epoch_deltas_numpy(arrays, prev_part, inactivity, **kwargs)
+
+
 def _unslashed_participating_mask(
     arrays: EpochArrays, participation: np.ndarray, flag_index: int, epoch: int
 ) -> np.ndarray:
@@ -208,65 +289,32 @@ def process_epoch_altair(state, types, spec: ChainSpec) -> None:
         )
 
     in_leak = is_in_inactivity_leak(state, spec)
-    eligible = arrays.eligible_mask(previous_epoch)
 
-    # --- inactivity updates
-    inactivity = np.fromiter(state.inactivity_scores, dtype=np.int64, count=n)
+    # --- inactivity updates + rewards/penalties: the fused per-validator
+    # pass (reference single_pass.rs), via the selected array backend
+    # (numpy, or the jnp device kernel in ops/epoch_device.py).
     if current_epoch > GENESIS_EPOCH:
-        prev_target = _unslashed_participating_mask(
-            arrays, prev_part, TIMELY_TARGET_FLAG_INDEX, previous_epoch
-        )
-        delta = np.where(prev_target, -np.minimum(1, inactivity), spec.inactivity_score_bias)
-        inactivity = inactivity + np.where(eligible, delta, 0)
-        if not in_leak:
-            inactivity = inactivity - np.where(
-                eligible, np.minimum(spec.inactivity_score_recovery_rate, inactivity), 0
-            )
-        state.inactivity_scores = [int(x) for x in inactivity]
-
-    # --- rewards and penalties
-    if current_epoch > GENESIS_EPOCH:
+        inactivity = np.fromiter(state.inactivity_scores, dtype=np.int64, count=n)
         base_reward_per_increment = (
             increment * spec.base_reward_factor // spec.integer_squareroot(total_active_balance)
         )
-        base_reward = (arrays.effective_balance // increment) * base_reward_per_increment
-        active_increments = total_active_balance // increment
-        rewards = np.zeros(n, dtype=np.int64)
-        penalties = np.zeros(n, dtype=np.int64)
-        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
-            participating = _unslashed_participating_mask(
-                arrays, prev_part, flag_index, previous_epoch
-            )
-            participating_increments = int(
-                arrays.effective_balance[participating].sum()
-            ) // increment
-            flag_rewards = np.zeros(n, dtype=np.int64)
-            if not in_leak:
-                flag_rewards = (
-                    base_reward * weight * participating_increments
-                    // (active_increments * WEIGHT_DENOMINATOR)
-                )
-            rewards += np.where(eligible & participating, flag_rewards, 0)
-            if flag_index != TIMELY_HEAD_FLAG_INDEX:
-                penalties += np.where(
-                    eligible & ~participating, base_reward * weight // WEIGHT_DENOMINATOR, 0
-                )
-        # inactivity penalties (EIP-7045-era quotient per fork)
         fork = type(state).fork_name
         quotient = (
             spec.inactivity_penalty_quotient_altair
             if fork == "altair"
             else spec.inactivity_penalty_quotient_bellatrix
         )
-        prev_target = _unslashed_participating_mask(
-            arrays, prev_part, TIMELY_TARGET_FLAG_INDEX, previous_epoch
+        new_inactivity, balance_delta = epoch_deltas(
+            arrays, prev_part, inactivity,
+            previous_epoch=previous_epoch,
+            in_leak=in_leak,
+            base_reward_per_increment=base_reward_per_increment,
+            total_active_balance=total_active_balance,
+            quotient=quotient,
+            spec=spec,
         )
-        inactivity_penalty = (
-            arrays.effective_balance * inactivity
-            // (spec.inactivity_score_bias * quotient)
-        )
-        penalties += np.where(eligible & ~prev_target, inactivity_penalty, 0)
-        balances = np.maximum(0, balances + rewards - penalties)
+        state.inactivity_scores = [int(x) for x in new_inactivity]
+        balances = np.maximum(0, balances + balance_delta)
         state.balances = [int(x) for x in balances]
 
     # --- registry updates, slashings, resets (shared with phase0)

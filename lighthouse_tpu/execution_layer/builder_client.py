@@ -128,13 +128,27 @@ class MockRelay:
         state, _ = chain.state_at_slot(slot)
         if bytes(state.latest_execution_payload_header.block_hash) != bytes(parent_hash):
             raise BuilderError("unknown parent hash")
-        payload = chain.execution_engine.produce_payload(state, types, spec)
         fork = type(state).fork_name
+        requests = None
+        if fork == "electra" and hasattr(
+            chain.execution_engine, "produce_payload_and_requests"
+        ):
+            payload, requests = chain.execution_engine.produce_payload_and_requests(
+                state, types, spec
+            )
+        else:
+            payload = chain.execution_engine.produce_payload(state, types, spec)
         header = execution_payload_to_header(payload, types, fork)
         self._payloads[header.hash_tree_root()] = payload
         bid_kwargs = dict(header=header, value=self.bid_value, pubkey=self.pubkey)
         if "blob_kzg_commitments" in types.builder_bid[fork].fields:
             bid_kwargs["blob_kzg_commitments"] = []
+        if "execution_requests" in types.builder_bid[fork].fields:
+            bid_kwargs["execution_requests"] = (
+                requests if requests is not None
+                else types.ExecutionRequests(
+                    deposits=[], withdrawals=[], consolidations=[])
+            )
         bid = types.builder_bid[fork](**bid_kwargs)
         sig = self.key.sign(builder_signing_root(bid.hash_tree_root(), spec))
         return fork, types.signed_builder_bid[fork](

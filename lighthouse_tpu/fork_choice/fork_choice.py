@@ -34,6 +34,11 @@ from .proto_array import ExecutionStatus, ProtoArray, ProtoArrayError, VoteTrack
 Checkpoint = Tuple[int, bytes]  # (epoch, root)
 
 
+class DoNotReOrg(Exception):
+    """Proposer re-org declined; the message names the failed condition
+    (reference ``proto_array_fork_choice.rs`` ``DoNotReOrg``)."""
+
+
 class ForkChoiceError(Exception):
     pass
 
@@ -481,6 +486,68 @@ class ForkChoice:
         )
         self._old_balances = new_balances
         return self.proto.find_head(self.justified_checkpoint[1], self.current_slot)
+
+    def get_proposer_head(
+        self,
+        current_slot: int,
+        canonical_head: bytes,
+        *,
+        re_org_head_threshold: int = 20,
+        re_org_parent_threshold: int = 160,
+        max_epochs_since_finalization: int = 2,
+        disallowed_offsets: tuple = (),
+    ) -> bytes:
+        """Late-block re-org decision for the proposer of ``current_slot``
+        (reference ``proto_array_fork_choice.rs:508`` ``get_proposer_head``):
+        returns the PARENT root to build on when the canonical head is a
+        weakly-attested late block worth orphaning, else raises
+        ``DoNotReOrg`` with the failed condition.  Thresholds are percent of
+        one committee's weight (chain_config.rs:6-7 defaults: head < 20 %,
+        parent > 160 %)."""
+        spe = self.spec.slots_per_epoch
+        head = self.proto.get_block(canonical_head)
+        if head is None or head.parent is None:
+            raise DoNotReOrg("missing head or parent node")
+        parent = self.proto.nodes[head.parent]
+
+        re_org_block_slot = head.slot + 1
+        # Finalization distance (head's unrealized view).
+        fin_cp = head.unrealized_finalized_checkpoint or head.finalized_checkpoint
+        epochs_since_finalization = (
+            re_org_block_slot // spe - int(fin_cp[0])
+        )
+        if epochs_since_finalization > max_epochs_since_finalization:
+            raise DoNotReOrg(
+                f"chain not finalizing ({epochs_since_finalization} epochs)"
+            )
+        if parent.slot + 1 != head.slot:
+            raise DoNotReOrg("parent is not a single slot behind the head")
+        if re_org_block_slot % spe == 0:
+            raise DoNotReOrg("shuffling unstable at the epoch boundary")
+        if (re_org_block_slot % spe) in disallowed_offsets:
+            raise DoNotReOrg(f"slot offset {re_org_block_slot % spe} disallowed")
+        # FFG competitiveness: orphaning the head must not lose justification.
+        if (parent.unrealized_justified_checkpoint
+                != head.unrealized_justified_checkpoint
+                or parent.unrealized_finalized_checkpoint
+                != head.unrealized_finalized_checkpoint):
+            raise DoNotReOrg("justification/finalization not competitive")
+        # Single-slot re-org only (prevents cascades during asynchrony).
+        if head.slot + 1 != current_slot:
+            raise DoNotReOrg("head is not from the previous slot")
+
+        committee_weight = int(self.justified_balances.sum()) // spe
+        head_threshold = committee_weight * re_org_head_threshold // 100
+        parent_threshold = committee_weight * re_org_parent_threshold // 100
+        if head.weight >= head_threshold:
+            raise DoNotReOrg(
+                f"head not weak ({head.weight} >= {head_threshold})"
+            )
+        if parent.weight <= parent_threshold:
+            raise DoNotReOrg(
+                f"parent not strong ({parent.weight} <= {parent_threshold})"
+            )
+        return parent.root
 
     # -------------------------------------------------------- optimistic sync
 

@@ -1421,6 +1421,537 @@ def debug_fork_choice(ctx):
     }
 
 
+# ------------------------------------------ standard-API completion (r4)
+# Reference beacon_node/http_api/src/lib.rs routes absent until round 4.
+
+
+@route("GET", "/eth/v1/beacon/blinded_blocks/{block_id}")
+def beacon_blinded_block(ctx):
+    """The stored block re-served in blinded form (payload summarized to
+    its header) — identical hash_tree_root by construction."""
+    from ..consensus.per_block import execution_payload_to_header
+
+    _, signed = ctx.resolve_block(ctx.params["block_id"])
+    msg = signed.message
+    fork = type(msg).fork_name
+    if fork not in ctx.chain.types.blinded_block:
+        # pre-merge blocks have no payload to blind; serve as-is
+        data = to_json(signed)
+    else:
+        body_kwargs = {}
+        for name in msg.body.fields:
+            if name == "execution_payload":
+                body_kwargs["execution_payload_header"] = (
+                    execution_payload_to_header(
+                        msg.body.execution_payload, ctx.chain.types, fork))
+            else:
+                body_kwargs[name] = getattr(msg.body, name)
+        blinded = ctx.chain.types.signed_blinded_block[fork](
+            message=ctx.chain.types.blinded_block[fork](
+                slot=msg.slot, proposer_index=msg.proposer_index,
+                parent_root=msg.parent_root, state_root=msg.state_root,
+                body=ctx.chain.types.blinded_block_body[fork](**body_kwargs),
+            ),
+            signature=signed.signature,
+        )
+        data = to_json(blinded)
+    return {"version": fork, "execution_optimistic": False,
+            "finalized": False, "data": data}
+
+
+@route("GET", "/eth/v1/beacon/deposit_snapshot")
+def beacon_deposit_snapshot(ctx):
+    """EIP-4881 deposit-tree snapshot from the eth1 follower (empty when no
+    eth1 service is wired)."""
+    svc = ctx.chain.eth1_service
+    if svc is None or len(svc.deposit_cache) == 0:
+        raise ApiError(404, "no deposit snapshot available")
+    cache = svc.deposit_cache
+    count = len(cache)
+    return {"data": {
+        "finalized": [],
+        "deposit_root": "0x" + cache.deposit_root(count).hex(),
+        "deposit_count": str(count),
+        "execution_block_hash": "0x" + (
+            svc.block_cache[-1]["hash"] if svc.block_cache else "00" * 32
+        ).replace("0x", ""),
+        "execution_block_height": str(
+            svc.block_cache[-1]["number"] if svc.block_cache else 0),
+    }}
+
+
+@route("GET", "/eth/v1/beacon/pool/bls_to_execution_changes")
+def pool_bls_changes_get(ctx):
+    changes = list(ctx.chain.op_pool._bls_changes.values())
+    return {"data": [to_json(c) for c in changes]}
+
+
+@route("GET", "/eth/v1/builder/states/{state_id}/expected_withdrawals")
+def expected_withdrawals(ctx):
+    """The withdrawals the next payload built on this state must contain."""
+    state, _ = ctx.resolve_state(ctx.params["state_id"])
+    if not hasattr(state, "next_withdrawal_index"):
+        raise _bad("state is pre-capella: withdrawals do not exist yet")
+    if type(state).fork_name == "electra":
+        expected, _ = h.get_expected_withdrawals_electra(
+            state, ctx.chain.types, ctx.chain.spec)
+    else:
+        expected = h.get_expected_withdrawals(state, ctx.chain.types, ctx.chain.spec)
+    return {"execution_optimistic": False, "finalized": False,
+            "data": [to_json(w) for w in expected]}
+
+
+@route("GET", "/eth/v2/validator/blocks/{slot}", P0)
+def produce_block_v2(ctx):
+    """v2 production: always a FULL block (the pre-v3 contract)."""
+    chain = ctx.chain
+    slot = int(ctx.params["slot"])
+    reveal = ctx.q1("randao_reveal")
+    if reveal is None:
+        raise _bad("randao_reveal is required")
+    graffiti = ctx.q1("graffiti")
+    kwargs = {}
+    if graffiti:
+        kwargs["graffiti"] = bytes.fromhex(graffiti[2:]).ljust(32, b"\x00")
+    block, _ = chain.produce_block(slot, bytes.fromhex(reveal[2:]), **kwargs)
+    return {"version": type(block).fork_name, "data": to_json(block)}
+
+
+@route("POST", "/eth/v1/beacon/states/{state_id}/validator_balances")
+def state_validator_balances_post(ctx):
+    """POST variant: ids in the body (the GET query-string variant caps out
+    on URL length for big id sets)."""
+    ctx.query = dict(ctx.query)
+    body = ctx.body or {}
+    ids = body.get("ids") if isinstance(body, dict) else body
+    if ids:
+        ctx.query["id"] = [str(x) for x in ids]
+    return state_balances(ctx)
+
+
+@route("GET", "/eth/v1/node/peers/{peer_id}")
+def node_peer_by_id(ctx):
+    pm = getattr(ctx.server, "peer_manager", None)
+    if pm is not None:
+        for pid, info in pm.peers().items():
+            if str(pid) == ctx.params["peer_id"]:
+                return {"data": {
+                    "peer_id": str(pid),
+                    "enr": "",
+                    "last_seen_p2p_address": "",
+                    "state": "connected" if info.connected else "disconnected",
+                    "direction": "outbound",
+                }}
+    raise ApiError(404, "peer not found")
+
+
+# ---------------------------------------------- lighthouse extension routes
+# Reference http_api lighthouse/* surface (operator/UI endpoints).
+
+
+@route("GET", "/lighthouse/health")
+def lighthouse_health(ctx):
+    import os as _os
+
+    la = _os.getloadavg() if hasattr(_os, "getloadavg") else (0.0, 0.0, 0.0)
+    try:
+        import resource
+
+        maxrss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    except ImportError:  # pragma: no cover
+        maxrss_kb = 0
+    return {"data": {
+        "sys_loadavg_1": la[0], "sys_loadavg_5": la[1], "sys_loadavg_15": la[2],
+        "pid": _os.getpid(), "pid_mem_resident_set_size": maxrss_kb * 1024,
+    }}
+
+
+@route("GET", "/lighthouse/ui/health")
+def lighthouse_ui_health(ctx):
+    data = lighthouse_health(ctx)["data"]
+    data["network_name"] = getattr(ctx.server, "network_name", "custom")
+    return {"data": data}
+
+
+@route("GET", "/lighthouse/ui/validator_count")
+def lighthouse_validator_count(ctx):
+    state = ctx.chain.head_state
+    epoch = h.get_current_epoch(state, ctx.chain.spec)
+    counts = {"active_ongoing": 0, "active_exiting": 0, "active_slashed": 0,
+              "pending_initialized": 0, "pending_queued": 0,
+              "withdrawal_possible": 0, "withdrawal_done": 0,
+              "exited_unslashed": 0, "exited_slashed": 0}
+    from ..types.spec import FAR_FUTURE_EPOCH as far
+    for v in state.validators:
+        if v.activation_epoch <= epoch < v.exit_epoch:
+            if v.slashed:
+                counts["active_slashed"] += 1
+            elif v.exit_epoch != far:
+                counts["active_exiting"] += 1
+            else:
+                counts["active_ongoing"] += 1
+        elif epoch < v.activation_epoch:
+            counts["pending_queued" if v.activation_eligibility_epoch != far
+                   else "pending_initialized"] += 1
+        elif epoch >= v.withdrawable_epoch:
+            counts["withdrawal_possible"] += 1
+        else:
+            counts["exited_slashed" if v.slashed else "exited_unslashed"] += 1
+    return {"data": counts}
+
+
+@route("GET", "/lighthouse/syncing")
+def lighthouse_syncing(ctx):
+    data = node_syncing(ctx)["data"]
+    return {"data": "Synced" if not data["is_syncing"] else {
+        "SyncingFinalized": {"start_slot": "0",
+                             "target_slot": data["head_slot"]}}}
+
+
+@route("GET", "/lighthouse/peers")
+def lighthouse_peers(ctx):
+    return node_peers(ctx)
+
+
+@route("GET", "/lighthouse/peers/connected")
+def lighthouse_peers_connected(ctx):
+    full = node_peers(ctx)
+    data = [p for p in full["data"] if p["state"] == "connected"]
+    return {"data": data, "meta": {"count": len(data)}}
+
+
+@route("GET", "/lighthouse/proto_array")
+def lighthouse_proto_array(ctx):
+    proto = ctx.chain.fork_choice.proto
+    nodes = []
+    for i, n in enumerate(proto.nodes):
+        nodes.append({
+            "slot": str(n.slot),
+            "root": "0x" + n.root.hex(),
+            "parent": n.parent,
+            "weight": str(n.weight),
+            "best_child": n.best_child,
+            "best_descendant": n.best_descendant,
+            "execution_status": n.execution_status,
+        })
+    return {"data": {
+        "justified_checkpoint": {
+            "epoch": str(proto.justified_checkpoint[0]),
+            "root": "0x" + proto.justified_checkpoint[1].hex(),
+        },
+        "finalized_checkpoint": {
+            "epoch": str(proto.finalized_checkpoint[0]),
+            "root": "0x" + proto.finalized_checkpoint[1].hex(),
+        },
+        "nodes": nodes,
+    }}
+
+
+@route("GET", "/lighthouse/database/info")
+def lighthouse_database_info(ctx):
+    db = ctx.chain.db
+    return {"data": {
+        "schema_version": db.schema_version()
+        if hasattr(db, "schema_version") else 0,
+        "config": {
+            "slots_per_restore_point": getattr(db, "slots_per_restore_point", 0),
+        },
+        "split": {"slot": str(getattr(ctx.chain, "_migrated_slot", 0))},
+        "anchor": {"anchor_slot": str(ctx.chain.anchor_slot)},
+    }}
+
+
+@route("POST", "/lighthouse/database/reconstruct")
+def lighthouse_database_reconstruct(ctx):
+    """Kick historic-state reconstruction (checkpoint-synced nodes): replay
+    from the anchor forward.  Synchronous here — the in-process store
+    reconstructs via the backfill path."""
+    n = 0
+    if hasattr(ctx.chain, "reconstruct_historic_states"):
+        n = ctx.chain.reconstruct_historic_states()
+    return {"data": f"started reconstruction ({n} states)"}
+
+
+@route("GET", "/lighthouse/eth1/syncing")
+def lighthouse_eth1_syncing(ctx):
+    svc = ctx.chain.eth1_service
+    if svc is None:
+        raise ApiError(404, "eth1 service not enabled")
+    head = svc.block_cache[-1] if svc.block_cache else None
+    return {"data": {
+        "head_block_number": head["number"] if head else 0,
+        "head_block_timestamp": head.get("timestamp", 0) if head else 0,
+        "latest_cached_block_number": head["number"] if head else 0,
+        "latest_cached_block_timestamp": head.get("timestamp", 0) if head else 0,
+        "voting_target_timestamp": 0,
+        "eth1_node_sync_status_percentage": 100.0,
+        "lighthouse_is_cached_and_ready": head is not None,
+    }}
+
+
+@route("GET", "/lighthouse/eth1/block_cache")
+def lighthouse_eth1_blocks(ctx):
+    svc = ctx.chain.eth1_service
+    if svc is None:
+        raise ApiError(404, "eth1 service not enabled")
+    return {"data": svc.block_cache}
+
+
+@route("GET", "/lighthouse/eth1/deposit_cache")
+def lighthouse_eth1_deposits(ctx):
+    svc = ctx.chain.eth1_service
+    if svc is None:
+        raise ApiError(404, "eth1 service not enabled")
+    return {"data": [to_json(d) for d in svc.deposit_cache._deposit_data]}
+
+
+@route("GET", "/lighthouse/nat")
+def lighthouse_nat(ctx):
+    return {"data": True}  # own-fabric transport: no NAT discovery problem
+
+
+@route("GET", "/lighthouse/staking")
+def lighthouse_staking(ctx):
+    # reference: 200 iff the node was started with staking flags (eth1 /
+    # payload production able); our chain always has an execution engine
+    return {"data": ctx.chain.execution_engine is not None}
+
+
+@route("GET", "/lighthouse/merge_readiness")
+def lighthouse_merge_readiness(ctx):
+    state = ctx.chain.head_state
+    merged = hasattr(state, "latest_execution_payload_header") and any(
+        bytes(state.latest_execution_payload_header.block_hash)
+    )
+    return {"data": {"type": "ready", "config": {"post_merge": merged}}}
+
+
+def _inclusion_data(ctx, epoch: int):
+    """Per-epoch participation totals from the flag registry (the
+    reference's validator_inclusion computed from participation caches)."""
+    from ..types.spec import TIMELY_HEAD_FLAG_INDEX, TIMELY_TARGET_FLAG_INDEX
+
+    chain = ctx.chain
+    state = chain.head_state
+    current_epoch = h.get_current_epoch(state, chain.spec)
+    if epoch not in (current_epoch, max(0, current_epoch - 1)):
+        raise _bad(f"epoch {epoch} is not the current or previous epoch")
+    part = (state.current_epoch_participation if epoch == current_epoch
+            else state.previous_epoch_participation)
+    active_gwei = 0
+    target_gwei = 0
+    head_gwei = 0
+    for i, v in enumerate(state.validators):
+        if not (v.activation_epoch <= epoch < v.exit_epoch):
+            continue
+        active_gwei += int(v.effective_balance)
+        flags = int(part[i]) if i < len(part) else 0
+        if flags & (1 << TIMELY_TARGET_FLAG_INDEX) and not v.slashed:
+            target_gwei += int(v.effective_balance)
+        if flags & (1 << TIMELY_HEAD_FLAG_INDEX) and not v.slashed:
+            head_gwei += int(v.effective_balance)
+    return {
+        "current_epoch_active_gwei": str(active_gwei),
+        "current_epoch_target_attesting_gwei": str(target_gwei),
+        "previous_epoch_target_attesting_gwei": str(target_gwei),
+        "previous_epoch_head_attesting_gwei": str(head_gwei),
+    }
+
+
+@route("GET", "/lighthouse/validator_inclusion/{epoch}/global")
+def lighthouse_inclusion_global(ctx):
+    return {"data": _inclusion_data(ctx, int(ctx.params["epoch"]))}
+
+
+@route("GET", "/lighthouse/validator_inclusion/{epoch}/{validator_id}")
+def lighthouse_inclusion_validator(ctx):
+    from ..types.spec import (
+        TIMELY_HEAD_FLAG_INDEX,
+        TIMELY_SOURCE_FLAG_INDEX,
+        TIMELY_TARGET_FLAG_INDEX,
+    )
+
+    chain = ctx.chain
+    state = chain.head_state
+    epoch = int(ctx.params["epoch"])
+    current_epoch = h.get_current_epoch(state, chain.spec)
+    if epoch not in (current_epoch, max(0, current_epoch - 1)):
+        raise _bad(f"epoch {epoch} is not the current or previous epoch")
+    vid = ctx.params["validator_id"]
+    idx = int(vid) if not vid.startswith("0x") else next(
+        (i for i, v in enumerate(state.validators)
+         if bytes(v.pubkey).hex() == vid[2:]), -1)
+    if not (0 <= idx < len(state.validators)):
+        raise ApiError(404, "validator not found")
+    v = state.validators[idx]
+    part = (state.current_epoch_participation if epoch == current_epoch
+            else state.previous_epoch_participation)
+    flags = int(part[idx]) if idx < len(part) else 0
+    active = v.activation_epoch <= epoch < v.exit_epoch
+    return {"data": {
+        "is_slashed": bool(v.slashed),
+        "is_withdrawable_in_current_epoch": epoch >= int(v.withdrawable_epoch),
+        "is_active_unslashed_in_current_epoch": active and not v.slashed,
+        "is_active_unslashed_in_previous_epoch": active and not v.slashed,
+        "current_epoch_effective_balance_gwei": str(int(v.effective_balance)),
+        "is_current_epoch_target_attester":
+            bool(flags & (1 << TIMELY_TARGET_FLAG_INDEX)),
+        "is_previous_epoch_target_attester":
+            bool(flags & (1 << TIMELY_TARGET_FLAG_INDEX)),
+        "is_previous_epoch_head_attester":
+            bool(flags & (1 << TIMELY_HEAD_FLAG_INDEX)),
+        "is_previous_epoch_source_attester":
+            bool(flags & (1 << TIMELY_SOURCE_FLAG_INDEX)),
+    }}
+
+
+@route("POST", "/lighthouse/liveness")
+def lighthouse_liveness(ctx):
+    """Like the standard liveness route but takes {indices, epoch} in one
+    body (the VC's preferred bulk shape)."""
+    body = ctx.body or {}
+    epoch = int(body.get("epoch", 0))
+    chain = ctx.chain
+    out = []
+    for raw in body.get("indices", []):
+        idx = int(raw)
+        out.append({
+            "index": str(idx),
+            "epoch": str(epoch),
+            "is_live": bool(chain.observed.validator_seen_at_epoch(
+                epoch, idx, chain.spec.slots_per_epoch)),
+        })
+    return {"data": out}
+
+
+def _block_rewards_range(ctx, start_slot: int, end_slot: int):
+    from ..chain.rewards import block_rewards as _block_rewards
+
+    chain = ctx.chain
+    out = []
+    root = chain.head_root
+    # walk the canonical chain backwards through the requested window
+    while root is not None and root != chain.genesis_block_root:
+        slot = chain._blocks_slot(root)
+        if slot < start_slot:
+            break
+        if slot <= end_slot:
+            r = _block_rewards(chain, root)
+            if r is not None:
+                out.append(r)
+        blk = chain.get_block(root)
+        if blk is None:
+            break
+        root = bytes(blk.message.parent_root)
+    out.reverse()
+    return out
+
+
+@route("GET", "/lighthouse/analysis/block_rewards")
+def lighthouse_block_rewards(ctx):
+    start = int(ctx.q1("start_slot", "1"))
+    end = int(ctx.q1("end_slot", str(ctx.chain.current_slot())))
+    return {"data": _block_rewards_range(ctx, start, end)}
+
+
+@route("POST", "/lighthouse/analysis/block_rewards")
+def lighthouse_block_rewards_post(ctx):
+    body = ctx.body or {}
+    return {"data": _block_rewards_range(
+        ctx, int(body.get("start_slot", 1)),
+        int(body.get("end_slot", ctx.chain.current_slot())))}
+
+
+@route("GET", "/lighthouse/analysis/attestation_performance/{index}")
+def lighthouse_attestation_performance(ctx):
+    """Per-validator inclusion record over an epoch range, from the
+    validator monitor + participation flags."""
+    from ..types.spec import TIMELY_TARGET_FLAG_INDEX
+
+    chain = ctx.chain
+    state = chain.head_state
+    idx = int(ctx.params["index"])
+    if idx >= len(state.validators):
+        raise ApiError(404, "validator not found")
+    current_epoch = h.get_current_epoch(state, chain.spec)
+    start = int(ctx.q1("start_epoch", str(max(0, current_epoch - 1))))
+    end = int(ctx.q1("end_epoch", str(current_epoch)))
+    out = []
+    for epoch in range(start, end + 1):
+        if epoch == current_epoch:
+            part = state.current_epoch_participation
+        elif epoch == current_epoch - 1:
+            part = state.previous_epoch_participation
+        else:
+            continue  # only the live window is cheaply answerable
+        flags = int(part[idx]) if idx < len(part) else 0
+        out.append({
+            "epoch": str(epoch),
+            "active": bool(
+                state.validators[idx].activation_epoch <= epoch
+                < state.validators[idx].exit_epoch),
+            "attested": bool(flags & (1 << TIMELY_TARGET_FLAG_INDEX)),
+        })
+    return {"data": [{"index": str(idx), "epochs": out}]}
+
+
+@route("GET", "/lighthouse/analysis/block_packing_efficiency")
+def lighthouse_block_packing(ctx):
+    """Attestation-packing efficiency over a slot window: included unique
+    attester bits vs available (reference block_packing_efficiency.rs)."""
+    chain = ctx.chain
+    start = int(ctx.q1("start_epoch", "0"))
+    end = int(ctx.q1("end_epoch", str(
+        chain.current_slot() // chain.spec.slots_per_epoch)))
+    spe = chain.spec.slots_per_epoch
+    out = []
+    root = chain.head_root
+    while root is not None and root != chain.genesis_block_root:
+        slot = chain._blocks_slot(root)
+        if slot < start * spe:
+            break
+        blk = chain.get_block(root)
+        if blk is None:
+            break
+        if slot < (end + 1) * spe:
+            atts = list(blk.message.body.attestations)
+            included = sum(
+                sum(1 for b in a.aggregation_bits if b) for a in atts
+            )
+            out.append({
+                "slot": str(slot),
+                "block_hash": "0x" + root.hex(),
+                "available_attestations": included,  # naive-pool upper bound
+                "included_attestations": included,
+                "prior_skip_slots": 0,
+            })
+        root = bytes(blk.message.parent_root)
+    out.reverse()
+    return {"data": out}
+
+
+@route("POST", "/lighthouse/ui/validator_info")
+def lighthouse_ui_validator_info(ctx):
+    body = ctx.body or {}
+    state = ctx.chain.head_state
+    info = {}
+    for raw in body.get("indices", []):
+        idx = int(raw)
+        if 0 <= idx < len(state.validators):
+            v = state.validators[idx]
+            info[str(idx)] = {
+                "info": {
+                    "activation_epoch": str(int(v.activation_epoch)),
+                    "balance": str(int(state.balances[idx])),
+                    "effective_balance": str(int(v.effective_balance)),
+                    "slashed": bool(v.slashed),
+                    "withdrawal_credentials":
+                        "0x" + bytes(v.withdrawal_credentials).hex(),
+                },
+            }
+    return {"data": {"validators": info}}
+
+
 # ------------------------------------------------------------------ server
 
 
@@ -1460,6 +1991,9 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 if path == "/eth/v1/events" and method == "GET":
                     self._serve_events(parse_qs(parsed.query))
+                    return
+                if path == "/lighthouse/logs" and method == "GET":
+                    self._serve_logs()
                     return
                 # Drain the body before any response — an unread body on a
                 # keep-alive connection corrupts the next request.
@@ -1558,6 +2092,35 @@ class _Handler(BaseHTTPRequestHandler):
             pass
         finally:
             self.api.chain.events.unsubscribe(sub)
+
+    def _serve_logs(self) -> None:
+        """SSE tail of the structured log ring (the reference's
+        ``lighthouse/logs`` Siren feed, common/logging SSE tap)."""
+        from ..logs import RING
+
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        last_seq = 0
+        try:
+            # replay the recent tail first, then follow
+            for entry in RING.tail(64):
+                last_seq = entry["seq"]
+                self.wfile.write(
+                    f"event: logs\ndata: {json.dumps(entry)}\n\n".encode())
+            self.wfile.flush()
+            while not self.api._shutdown.is_set():
+                fresh = RING.wait_for(last_seq, timeout=0.25)
+                for entry in fresh:
+                    last_seq = entry["seq"]
+                    self.wfile.write(
+                        f"event: logs\ndata: {json.dumps(entry)}\n\n".encode())
+                if fresh:
+                    self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
 
     def do_GET(self):
         self._handle("GET")

@@ -149,6 +149,91 @@ def scalar_mul_bits(ops: FieldOps, p, bits):
     return jax.lax.fori_loop(0, nbits, body, acc)
 
 
+def _window_digits(bits: jax.Array, window: int) -> jax.Array:
+    """MSB-first bit array (..., NBITS) -> MSB-first base-2^window digits
+    (..., NBITS/window), each in [0, 2^window)."""
+    nbits = bits.shape[-1]
+    assert nbits % window == 0
+    grouped = bits.reshape(*bits.shape[:-1], nbits // window, window)
+    weights = jnp.asarray([1 << (window - 1 - j) for j in range(window)], jnp.int32)
+    return jnp.einsum("...w,w->...", grouped, weights)
+
+
+def _window_table(ops: FieldOps, p, window: int):
+    """Stacked multiples [0]P..[2^w-1]P: tuple of (2^w, ...) coord arrays.
+    One ``lax.scan`` of complete additions — a compact rolled graph (an
+    unrolled chain multiplies compile time, the project's scarcest
+    resource)."""
+    size = 1 << window
+    first = identity(ops, p[0].shape[: -ops.zero.ndim])
+
+    def body(acc, _):
+        return point_add(ops, acc, p), acc
+
+    _, rows = jax.lax.scan(body, first, None, length=size)
+    return rows  # tuple of (2^w, ...) stacked coords
+
+
+def _table_select(table, digits: jax.Array):
+    """table: (2^w, N, ...) coords; digits: (N,) -> selected (N, ...) points.
+    One-hot einsum keeps the selection matmul-shaped (MXU) instead of a
+    gather."""
+    size = table[0].shape[0]
+    onehot = (digits[:, None] == jnp.arange(size)[None, :]).astype(jnp.int32)
+
+    def sel(c):  # c: (2^w, N, ...) -> (N, ...), per-set column selection
+        return jnp.einsum("nd,dn...->n...", onehot, c,
+                          preferred_element_type=jnp.int32)
+
+    return tuple(sel(c) for c in table)
+
+
+def scalar_mul_windowed(ops: FieldOps, p, bits, window: int = 4):
+    """Per-set [k]P via fixed 2^w windows (VERDICT r3 item 2): a shared
+    per-set multiples table + NBITS/w ladder steps of (w doublings + one
+    table-select + one add) — ~25 % fewer group ops than double-and-add.
+    Rolled as a ``lax.fori_loop`` so the graph stays small (doubling the
+    identity on the first step is a harmless no-op)."""
+    digits = _window_digits(bits, window)  # (N, S) MSB-first
+    table = _window_table(ops, p, window)  # (2^w, N, ...)
+    steps = digits.shape[-1]
+    acc0 = identity(ops, bits.shape[:-1])
+
+    def body(s, acc):
+        for _ in range(window):
+            acc = point_double(ops, acc)
+        d = jax.lax.dynamic_index_in_dim(digits, s, axis=-1, keepdims=False)
+        return point_add(ops, acc, _table_select(table, d))
+
+    return jax.lax.fori_loop(0, steps, body, acc0)
+
+
+def msm_windowed(ops: FieldOps, pts, bits, window: int = 4):
+    """Multi-scalar multiplication sum_i [k_i] P_i with one SHARED doubling
+    ladder (the batch-verification W = sum [r_i] sig_i collapses to this —
+    blst.rs:112-114 computes the same sum point-by-point on CPU threads).
+
+    Per ladder step: w doublings of ONE accumulator + a one-hot table
+    select + a masked tree-sum across the batch — ~4x fewer group ops than
+    per-set double-and-add followed by a tree-sum.  Rolled as a
+    ``lax.fori_loop`` for compile-time economy."""
+    n = pts[0].shape[0]
+    assert n & (n - 1) == 0, "msm_windowed requires power-of-two batch"
+    digits = _window_digits(bits, window)  # (N, S)
+    table = _window_table(ops, pts, window)  # (2^w, N, ...)
+    steps = digits.shape[-1]
+    acc0 = identity(ops)
+
+    def body(s, acc):
+        for _ in range(window):
+            acc = point_double(ops, acc)
+        d = jax.lax.dynamic_index_in_dim(digits, s, axis=-1, keepdims=False)
+        contrib = _table_select(table, d)  # (N, ...) points
+        return point_add(ops, acc, tree_sum(ops, contrib, axis=0))
+
+    return jax.lax.fori_loop(0, steps, body, acc0)
+
+
 def tree_sum(ops: FieldOps, pts, axis: int = 0):
     """Sum points along a batch axis by halving rounds of complete additions.
 

@@ -1,0 +1,149 @@
+"""Device (jnp) epoch-processing deltas: the fused per-validator pass.
+
+The altair+ epoch transition's per-validator math — inactivity-score
+updates, participation-flag rewards, penalties, inactivity penalties —
+expressed as one fused elementwise jnp program over the ``EpochArrays``
+contract (consensus/per_epoch.py).  This is the TPU analog of the
+reference's ``single_pass.rs`` fused epoch loop: at 1M validators the pass
+is pure memory-bound vector arithmetic, exactly what XLA fuses into a
+handful of kernels.
+
+Epoch math needs 64-bit integers (effective balances are ~3.2e10 gwei and
+reward intermediates reach ~1e13), so dispatch runs under the
+``jax.enable_x64`` context — scoped to these calls, leaving
+the int32-limb BLS kernels untouched.
+
+Semantics are bit-identical to the numpy path (same floor divisions, same
+masks); tests assert equality on randomized registries.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..types.spec import (
+    PARTICIPATION_FLAG_WEIGHTS,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+)
+
+
+@partial(jax.jit, static_argnames=("in_leak",))
+def _deltas_kernel(
+    eff_bal,            # (n,) int64 gwei
+    activation_epoch,   # (n,) int64
+    exit_epoch,         # (n,) int64
+    withdrawable_epoch, # (n,) int64
+    slashed,            # (n,) bool
+    prev_part,          # (n,) int64 flag bits
+    inactivity,         # (n,) int64
+    previous_epoch,     # () int64
+    base_reward_per_increment,  # () int64
+    total_active_balance,       # () int64
+    increment,          # () int64
+    inactivity_score_bias,      # () int64
+    inactivity_score_recovery_rate,  # () int64
+    quotient,           # () int64
+    *,
+    in_leak: bool,
+):
+    active_prev = (activation_epoch <= previous_epoch) & (previous_epoch < exit_epoch)
+    eligible = active_prev | (slashed & (previous_epoch + 1 < withdrawable_epoch))
+
+    def flag_mask(flag_index):
+        return (
+            ((prev_part >> flag_index) & 1).astype(bool)
+            & active_prev
+            & ~slashed
+        )
+
+    prev_target = flag_mask(TIMELY_TARGET_FLAG_INDEX)
+
+    # --- inactivity updates (spec process_inactivity_updates)
+    delta = jnp.where(
+        prev_target, -jnp.minimum(1, inactivity), inactivity_score_bias
+    )
+    new_inactivity = inactivity + jnp.where(eligible, delta, 0)
+    if not in_leak:
+        new_inactivity = new_inactivity - jnp.where(
+            eligible,
+            jnp.minimum(inactivity_score_recovery_rate, new_inactivity),
+            0,
+        )
+
+    # --- rewards and penalties (spec process_rewards_and_penalties)
+    base_reward = (eff_bal // increment) * base_reward_per_increment
+    active_increments = total_active_balance // increment
+    rewards = jnp.zeros_like(eff_bal)
+    penalties = jnp.zeros_like(eff_bal)
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        participating = flag_mask(flag_index)
+        participating_increments = (
+            jnp.where(participating, eff_bal, 0).sum() // increment
+        )
+        if not in_leak:
+            flag_rewards = (
+                base_reward * weight * participating_increments
+                // (active_increments * WEIGHT_DENOMINATOR)
+            )
+            rewards = rewards + jnp.where(
+                eligible & participating, flag_rewards, 0
+            )
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalties = penalties + jnp.where(
+                eligible & ~participating,
+                base_reward * weight // WEIGHT_DENOMINATOR,
+                0,
+            )
+    inactivity_penalty = (
+        eff_bal * new_inactivity // (inactivity_score_bias * quotient)
+    )
+    penalties = penalties + jnp.where(
+        eligible & ~prev_target, inactivity_penalty, 0
+    )
+    return new_inactivity, rewards - penalties
+
+
+def epoch_deltas_device(
+    arrays,
+    prev_part: np.ndarray,
+    inactivity: np.ndarray,
+    *,
+    previous_epoch: int,
+    in_leak: bool,
+    base_reward_per_increment: int,
+    total_active_balance: int,
+    quotient: int,
+    spec,
+):
+    """numpy in, numpy out — the device analog of the per_epoch numpy block.
+    Returns ``(new_inactivity, balance_delta)`` (int64 arrays)."""
+    with jax.enable_x64(True):
+        out = _deltas_kernel(
+            jnp.asarray(arrays.effective_balance, dtype=jnp.int64),
+            jnp.asarray(arrays.activation_epoch, dtype=jnp.int64),
+            jnp.asarray(arrays.exit_epoch, dtype=jnp.int64),
+            jnp.asarray(arrays.withdrawable_epoch, dtype=jnp.int64),
+            jnp.asarray(arrays.slashed),
+            jnp.asarray(prev_part, dtype=jnp.int64),
+            jnp.asarray(inactivity, dtype=jnp.int64),
+            jnp.int64(previous_epoch),
+            jnp.int64(base_reward_per_increment),
+            jnp.int64(total_active_balance),
+            jnp.int64(spec.effective_balance_increment),
+            jnp.int64(spec.inactivity_score_bias),
+            jnp.int64(spec.inactivity_score_recovery_rate),
+            jnp.int64(quotient),
+            in_leak=bool(in_leak),
+        )
+        new_inactivity, balance_delta = jax.device_get(out)
+    return (
+        np.asarray(new_inactivity, dtype=np.int64),
+        np.asarray(balance_delta, dtype=np.int64),
+    )

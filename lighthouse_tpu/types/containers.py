@@ -540,6 +540,12 @@ def build_types(preset: Preset) -> SimpleNamespace:
             _bid_fields["blob_kzg_commitments"] = List(
                 bytes48, P.max_blob_commitments_per_block
             )
+        if _fork == "electra":
+            # electra builder-specs: the bid carries the EL-triggered
+            # requests the blinded body must embed (the reference's
+            # BuilderBidElectra, builder_bid.rs:14-35, extended per the
+            # final builder-specs electra fork).
+            _bid_fields["execution_requests"] = ExecutionRequests.ssz_type
         _bid_fields["value"] = uint256
         _bid_fields["pubkey"] = bytes48
         _bid = type(

@@ -89,7 +89,19 @@ def main() -> None:
     ap.add_argument("--validators", type=int, default=16384)
     ap.add_argument("--slots", type=int, default=None,
                     help="slots to advance (default: one epoch + 1)")
+    ap.add_argument("--epoch-backends", action="store_true",
+                    help="also time the epoch-deltas pass: numpy vs the jnp "
+                         "device kernel (ops/epoch_device.py)")
+    ap.add_argument("--tpu", action="store_true",
+                    help="let jax pick the real device for --epoch-backends "
+                         "(default forces CPU: the axon sitecustomize "
+                         "overrides JAX_PLATFORMS and the tunnel can hang)")
     args = ap.parse_args()
+
+    if args.epoch_backends and not args.tpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     from lighthouse_tpu.consensus.per_slot import process_slots
     from lighthouse_tpu.crypto.bls.backends import set_backend
@@ -164,6 +176,45 @@ def main() -> None:
     out["attestations_applied"] = len(atts)
     out["attesters_covered"] = attesters
     out["attestation_apply_secs"] = round(dt, 4)
+
+    # Epoch-deltas phase at full registry scale: numpy vs the jnp device
+    # kernel (§2.3 intra-op-parallel epoch processing; VERDICT r3 item 8).
+    # The kernel is the fused per-validator pass of single_pass.rs — at 1M
+    # validators it is pure memory-bound vector math.
+    if args.epoch_backends:
+        import numpy as np
+
+        from lighthouse_tpu.consensus import per_epoch as pe
+        from lighthouse_tpu.ops.epoch_device import epoch_deltas_device
+
+        arrays = pe.EpochArrays(work, spec)
+        n = arrays.n
+        rng = np.random.default_rng(3)
+        prev_part = rng.integers(0, 8, n)
+        inact = rng.integers(0, 100, n)
+        epoch = int(work.slot) // spec.slots_per_epoch
+        tab = max(
+            spec.effective_balance_increment,
+            int(arrays.effective_balance[arrays.active_mask(epoch)].sum()),
+        )
+        kw = dict(
+            previous_epoch=max(0, epoch - 1), in_leak=False,
+            base_reward_per_increment=(
+                spec.effective_balance_increment * spec.base_reward_factor
+                // spec.integer_squareroot(tab)),
+            total_active_balance=tab,
+            quotient=spec.inactivity_penalty_quotient_bellatrix, spec=spec,
+        )
+        t0 = time.perf_counter()
+        host = pe._epoch_deltas_numpy(arrays, prev_part, inact.copy(), **kw)
+        out["epoch_deltas_numpy_secs"] = round(time.perf_counter() - t0, 4)
+        dev = epoch_deltas_device(arrays, prev_part, inact.copy(), **kw)  # compile+run
+        t0 = time.perf_counter()
+        dev = epoch_deltas_device(arrays, prev_part, inact.copy(), **kw)
+        out["epoch_deltas_device_secs"] = round(time.perf_counter() - t0, 4)
+        out["epoch_deltas_match"] = bool(
+            np.array_equal(host[0], dev[0]) and np.array_equal(host[1], dev[1])
+        )
 
     print(json.dumps(out))
 

@@ -201,3 +201,52 @@ def test_pinned_relay_identity_enforced(rig):
         assert type(block).__name__.startswith("BlindedBeaconBlock")
     finally:
         chain.builder_pubkey = None
+
+
+def test_electra_blinded_round_trip():
+    """The electra builder path (VERDICT r3 item 5): the bid carries
+    ExecutionRequests (builder_bid.rs:14-35 + builder-specs electra), the
+    blinded body embeds them, and unblinding reproduces the identical root."""
+    from lighthouse_tpu.types.spec import DOMAIN_BEACON_PROPOSER, minimal_spec
+
+    set_backend("fake")
+    try:
+        spec = minimal_spec(
+            altair_fork_epoch=0, bellatrix_fork_epoch=0, capella_fork_epoch=0,
+            deneb_fork_epoch=0, electra_fork_epoch=0,
+        )
+        harness = BeaconChainHarness(validator_count=16, spec=spec,
+                                     fake_crypto=True)
+        relay = MockRelay(harness.chain).start()
+        chain = harness.chain
+        chain.builder = BuilderHttpClient(relay.url)
+        try:
+            harness.extend_chain(2)
+            slot = harness.advance_slot()
+            state, _ = chain.state_at_slot(slot)
+            proposer = h.get_beacon_proposer_index(state, harness.spec)
+            reveal = harness.randao_reveal(state, slot, proposer)
+
+            block, _root = chain.produce_blinded_block(slot, reveal)
+            assert type(block).__name__ == "BlindedBeaconBlockElectra"
+            assert hasattr(block.body, "execution_requests")
+            blinded_root = block.hash_tree_root()
+
+            signed_cls = harness.types.signed_blinded_block["electra"]
+            state2, _ = chain.state_at_slot(slot)
+            domain = harness._domain_at(state2, DOMAIN_BEACON_PROPOSER,
+                                        slot // harness.spec.slots_per_epoch)
+            root = h.compute_signing_root(blinded_root, domain)
+            sig = harness._sign(int(block.proposer_index), root)
+            signed_blinded = signed_cls(message=block, signature=sig.to_bytes())
+
+            imported_root, signed_full = chain.unblind_and_import(signed_blinded)
+            assert imported_root == blinded_root
+            assert chain.head_root == imported_root
+            assert type(signed_full.message).fork_name == "electra"
+            assert hasattr(signed_full.message.body, "execution_requests")
+        finally:
+            relay.stop()
+            chain.builder = None
+    finally:
+        set_backend("host")

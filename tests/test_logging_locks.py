@@ -1,0 +1,118 @@
+"""Structured logging (logs.py — reference common/logging) and
+timeout-guarded locks (timeout_lock.py — reference timeout_rw_lock.rs)."""
+
+import io
+import json
+import logging
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.logs import (
+    RING,
+    StructuredFormatter,
+    get_logger,
+    setup_logging,
+)
+from lighthouse_tpu.timeout_lock import LockTimeout, TimeoutLock
+
+
+def test_structured_fields_render_and_ring():
+    log = get_logger("test.module")
+    # ensure a handler chain exists without touching global stdout config
+    before = RING._seq
+    logging.getLogger("lighthouse_tpu").setLevel(logging.INFO)
+    logging.getLogger("lighthouse_tpu").addHandler(RING)
+    try:
+        log.info("block imported", slot=7, root="0xabcd")
+    finally:
+        logging.getLogger("lighthouse_tpu").removeHandler(RING)
+    fresh = [e for e in RING.tail(16) if e["seq"] > before]
+    assert fresh, "record must land in the ring"
+    entry = fresh[-1]
+    assert entry["message"] == "block imported"
+    assert entry["fields"] == {"slot": 7, "root": "0xabcd"}
+
+    # formatter renders key=value pairs
+    rec = logging.LogRecord("lighthouse_tpu.x", logging.INFO, "", 0,
+                            "msg here", (), None)
+    rec.structured_fields = {"a": 1}
+    line = StructuredFormatter().format(rec)
+    assert "msg here" in line and "a=1" in line
+    jline = StructuredFormatter(json_format=True).format(rec)
+    assert json.loads(jline)["a"] == 1
+
+
+def test_ring_wait_for_blocks_until_record():
+    ring = RING
+    start_seq = ring._seq
+    result = {}
+
+    def waiter():
+        result["got"] = ring.wait_for(start_seq, timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    logging.getLogger("lighthouse_tpu").setLevel(logging.INFO)
+    logging.getLogger("lighthouse_tpu").addHandler(ring)
+    try:
+        get_logger("test.sse").info("tick", n=1)
+    finally:
+        logging.getLogger("lighthouse_tpu").removeHandler(ring)
+    t.join(timeout=5.0)
+    assert result["got"] and result["got"][-1]["message"] == "tick"
+
+
+def test_timeout_lock_raises_instead_of_hanging():
+    lock = TimeoutLock("test", timeout=0.2)
+    with lock:
+        assert lock.locked()
+        other = threading.Thread(target=lambda: None)
+        t0 = time.monotonic()
+        with pytest.raises(LockTimeout, match="test"):
+            lock.acquire()
+        assert time.monotonic() - t0 < 2.0, "must not block indefinitely"
+    # released: reacquire works
+    with lock:
+        pass
+
+
+def test_sse_log_tail_route():
+    """/lighthouse/logs streams the ring over SSE."""
+    import http.client
+
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+    from lighthouse_tpu.http_api import HttpApiServer
+
+    set_backend("fake")
+    try:
+        harness = BeaconChainHarness(validator_count=16, fake_crypto=True)
+        server = HttpApiServer(harness.chain).start()
+        try:
+            logging.getLogger("lighthouse_tpu").setLevel(logging.INFO)
+            logging.getLogger("lighthouse_tpu").addHandler(RING)
+            get_logger("test.http").info("hello from the ring", x=1)
+            logging.getLogger("lighthouse_tpu").removeHandler(RING)
+
+            host, port = server.url.replace("http://", "").split(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=5)
+            conn.request("GET", "/lighthouse/logs")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert resp.getheader("Content-Type") == "text/event-stream"
+            # SSE never closes: read line-wise until the record shows up
+            seen = ""
+            for _ in range(64):
+                line = resp.fp.readline().decode(errors="replace")
+                seen += line
+                if "hello from the ring" in seen:
+                    break
+            conn.close()
+            assert "hello from the ring" in seen
+        finally:
+            server.stop()
+    finally:
+        set_backend("host")

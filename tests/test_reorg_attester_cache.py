@@ -1,0 +1,156 @@
+"""Late-block proposer re-orgs + the early-attester cache (VERDICT r3
+item 6; reference ``chain_config.rs:1-38``, ``early_attester_cache.rs``,
+``proto_array_fork_choice.rs:508`` ``get_proposer_head``)."""
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.crypto.bls.backends import set_backend
+from lighthouse_tpu.fork_choice.fork_choice import DoNotReOrg
+
+
+@pytest.fixture()
+def harness():
+    set_backend("fake")
+    yield BeaconChainHarness(validator_count=16, fake_crypto=True)
+    set_backend("host")
+
+
+class TestEarlyAttesterCache:
+    def test_attestation_without_head_state(self, harness):
+        """After import, attestation data for the new block is served from
+        the early-attester cache — no head-state (or state-advance) access
+        at all on the 4 s deadline path."""
+        chain = harness.chain
+        harness.extend_chain(3)
+        slot = chain.current_slot()
+
+        # Baseline: what the slow path would answer.
+        item = chain.early_attester_cache._item
+        assert item is not None and item["block_root"] == chain.head_root
+
+        # Poison every state-access path; the early cache must not need them.
+        def boom(*a, **k):
+            raise AssertionError("early-attester path touched chain state")
+
+        orig_state_at_slot = chain.state_at_slot
+        chain.state_at_slot = boom
+        states, chain._states = chain._states, {}
+        try:
+            data = chain.produce_attestation_data(slot, 0)
+        finally:
+            chain.state_at_slot = orig_state_at_slot
+            chain._states = states
+        assert bytes(data.beacon_block_root) == chain.head_root
+        assert int(data.slot) == slot
+        # and it matches the slow path's answer exactly
+        chain.early_attester_cache.clear()
+        slow = chain.produce_attestation_data(slot, 0)
+        assert data.hash_tree_root() == slow.hash_tree_root()
+
+    def test_serves_block_before_store(self, harness):
+        """A verified-but-unwritten block is reachable via get_block
+        (reference: the cache serves RPC for gossip-known blocks)."""
+        chain = harness.chain
+        harness.extend_chain(2)
+        root = chain.head_root
+        blk = chain._blocks.pop(root)  # simulate the store write not landed
+        db_block = chain.db.get_block(root)
+        if db_block is not None:
+            # also hide it from the store layer
+            import unittest.mock as mock
+            with mock.patch.object(chain.db, "get_block", return_value=None):
+                assert chain.get_block(root) is not None
+        else:
+            assert chain.get_block(root) is not None
+        chain._blocks[root] = blk
+
+    def test_reorg_clears_cache(self, harness):
+        """A head re-org away from the cached block drops the item."""
+        chain = harness.chain
+        roots = harness.extend_chain(2, attest=False)
+        harness.advance_slot()
+        # two competing blocks at slot 3; the second one loses fork choice
+        canonical = harness.produce_signed_block(slot=3)
+        fork_block = harness.produce_signed_block(
+            slot=3, parent_root=roots[0], graffiti=b"\x42" * 32
+        )
+        c_root = chain.process_block(canonical, block_delay_seconds=1.0)
+        chain.process_block(fork_block, block_delay_seconds=20.0)  # no boost
+        assert chain.head_root == c_root
+        # the losing import populated the cache last, then recompute_head
+        # saw a different head and cleared it
+        assert chain.early_attester_cache._item is None
+
+
+class TestProposerReOrg:
+    def _weak_head_setup(self, harness):
+        """Chain where the head is a fresh zero-weight block on an attested
+        parent: extend (attested) then import one block nobody attests to."""
+        chain = harness.chain
+        harness.extend_chain(4)  # slots 1..4, attested
+        slot = harness.advance_slot()  # slot 5
+        late = harness.produce_signed_block(slot=slot, sync_participation=False)
+        chain.process_block(late, block_delay_seconds=11.0)  # late: no boost
+        return chain, late
+
+    def test_get_proposer_head_decision(self, harness):
+        chain, late = self._weak_head_setup(harness)
+        late_root = late.message.hash_tree_root()
+        assert chain.head_root == late_root
+        next_slot = chain.current_slot() + 1
+        # minimal-preset committees are tiny (2 validators/slot), so the
+        # mainnet 160 % parent bar is unreachable — scale it to the rig
+        parent = chain.fork_choice.get_proposer_head(
+            next_slot, late_root,
+            re_org_head_threshold=20, re_org_parent_threshold=50,
+        )
+        assert parent == bytes(late.message.parent_root)
+        # an attested (strong) head refuses with HeadNotWeak semantics
+        harness.attest_to_head()
+        chain.slot_clock.advance_slot()
+        chain.fork_choice.get_head(chain.current_slot())  # apply queued votes
+        with pytest.raises(DoNotReOrg, match="not weak"):
+            chain.fork_choice.get_proposer_head(
+                chain.current_slot(), late_root,
+                re_org_head_threshold=20, re_org_parent_threshold=50,
+            )
+
+    def test_late_block_orphaned_by_next_proposer(self, harness):
+        """The full flow: produce_block builds on the PARENT of the weak
+        late head, and once imported (with proposer boost) the late block is
+        orphaned (reference beacon_chain.rs:4250 get_state_for_re_org)."""
+        chain, late = self._weak_head_setup(harness)
+        late_root = late.message.hash_tree_root()
+        chain.re_org_parent_threshold = 50  # scale to the 2-validator committee
+        slot = harness.advance_slot()
+
+        # harness.produce_signed_block passes pre_state, bypassing the
+        # decision — call the chain path directly to exercise it end to end:
+        import lighthouse_tpu.consensus.helpers as h
+
+        state, _ = chain.state_at_slot(slot, bytes(late.message.parent_root))
+        proposer = h.get_beacon_proposer_index(state, harness.spec)
+        reveal = harness.randao_reveal(state, slot, proposer)
+        reorg_block, _ = chain.produce_block(slot, reveal)
+        assert bytes(reorg_block.parent_root) == bytes(late.message.parent_root), (
+            "proposer must build on the parent, orphaning the weak late head"
+        )
+        signed = harness.sign_block(
+            reorg_block, chain.state_at_slot(slot, bytes(reorg_block.parent_root))[0]
+        )
+        new_root = chain.process_block(signed, block_delay_seconds=1.0)
+        assert chain.head_root == new_root
+        assert not chain.fork_choice.is_descendant(late_root, new_root), (
+            "the late block must be orphaned"
+        )
+
+    def test_reorg_declined_when_disabled_or_late(self, harness):
+        chain, late = self._weak_head_setup(harness)
+        chain.re_org_parent_threshold = 50
+        harness.advance_slot()
+        chain.re_org_head_threshold = None  # disabled
+        assert chain._maybe_re_org_parent(chain.current_slot()) is None
+        chain.re_org_head_threshold = 20
+        chain.slot_clock.advance_seconds(2.0)  # past the 1/12 cutoff (0.5 s)
+        assert chain._maybe_re_org_parent(chain.current_slot()) is None
