@@ -7,29 +7,43 @@ signature sets x 32-validator committees, plus the 4,096-set scale config
 
 ``vs_baseline`` compares against a documented estimate of the reference's
 blst-on-64-CPU-threads throughput for the same semantics (one 64-bit-weighted
-multi-pairing per batch).  Lighthouse publishes no absolute numbers
-(BASELINE.json.published == {}); the figure below is derived from blst's
-well-known ~0.4-0.5 ms/thread per aggregate-verify pairing cost:
+multi-pairing per batch, /root/reference/crypto/bls/src/impls/blst.rs:35-117).
+Lighthouse publishes no absolute numbers (BASELINE.json.published == {}); the
+figure below is derived from blst's well-known ~0.4-0.5 ms/thread per
+aggregate-verify pairing cost:
     64 threads / 0.45 ms  ->  ~142k sets/s.  We use 142_000 sets/s.
 
-Failure-containment contract (VERDICT r2 item 1, hardened per VERDICT r3
-item 1): the parent NEVER imports jax.  The TPU tunnel has been observed to
-block ``jax.devices()`` for ~25 MINUTES, so two 420 s attempts (r03)
-mathematically could not survive it.  This version runs ONE device child
-under a long timeout (default 2100 s > the observed hang), and the child
-checkpoints a cumulative result dict to a file after EVERY milestone
-(init -> smoke 1x1 -> headline 128x32 -> scale 4096x32).  The parent
-harvests the last checkpoint even when it has to kill the child, so a
-timeout still yields init/compile timings instead of a bare error.  A
-CPU-forced child runs only if the device child produced no headline value.
-The parent emits the JSON line no matter what.
+Failure-containment contract (VERDICT r4 item 1 — "indestructible"):
+
+* The total wall budget is read from ``BENCH_TOTAL_BUDGET_S`` (default 1500 s)
+  and the schedule fits it BY CONSTRUCTION: one device attempt capped at
+  budget - 240 s, then a CPU fallback capped at 180 s.  The CPU fallback runs
+  a 16x32 batch x 1 rep (sets/s is shape-stable on this CPU, measured r3/r4:
+  ~1.24 s/set at both 16 and 128 sets) and extrapolates linearly, labelled
+  ``cpu_extrapolated: true`` — never the ~160 s/rep 128x32 shape that blew
+  the r4 budget.
+* The parent NEVER imports jax (the tunnel can hang ``jax.devices()`` ~25
+  minutes).  Children checkpoint a cumulative result dict to a file after
+  EVERY milestone; the parent harvests the last checkpoint even when it has
+  to kill the child.
+* The parent registers ``atexit`` + SIGTERM/SIGINT/SIGHUP handlers that emit
+  the final JSON line from the best checkpoint available, so even an
+  EXTERNAL kill (the driver's own timeout — the r4 failure mode, rc=124 with
+  no parsed artifact) still leaves a parsed JSON line on stdout.
+* ``scripts/tpu_probe_loop.sh`` runs all round; the moment a probe finds the
+  tunnel up it fires the full device bench, writing
+  ``.tpu_probe/bench_device_result.json``.  This parent reuses that file
+  first — a device number captured at ANY point in the round survives to the
+  end-of-round artifact even if the tunnel has died again by then.
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import re
+import signal
 import subprocess
 import sys
 import time
@@ -43,11 +57,21 @@ REPS = 5
 SCALE_N_SETS = 4096
 SCALE_REPS = 2
 
-HERE = os.path.dirname(os.path.abspath(__file__))
+# CPU fallback: small shape, one rep, linear extrapolation (see module doc).
+CPU_QUICK_N_SETS = 16
+CPU_QUICK_REPS = 1
 
-# One long device attempt: must outlast the ~25-min tunnel hang plus compile.
-TPU_TIMEOUT_S = float(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "2100"))
-CPU_TIMEOUT_S = float(os.environ.get("BENCH_CPU_TIMEOUT_S", "900"))
+HERE = os.path.dirname(os.path.abspath(__file__))
+PROBE_RESULT_FILE = os.path.join(HERE, ".tpu_probe", "bench_device_result.json")
+
+# Fit the driver's budget by construction (VERDICT r4: r04 died at roughly
+# half the old 2100+900 s schedule).  Device attempt gets everything except
+# a 240 s reserve that covers the CPU fallback (<=180 s) plus parent slack.
+TOTAL_BUDGET_S = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "1500"))
+TPU_TIMEOUT_S = float(
+    os.environ.get("BENCH_DEVICE_TIMEOUT_S", str(max(60.0, TOTAL_BUDGET_S - 240.0)))
+)
+CPU_TIMEOUT_S = float(os.environ.get("BENCH_CPU_TIMEOUT_S", "180"))
 
 MARKER = "BENCH_RESULT_JSON:"
 
@@ -137,38 +161,51 @@ def _child_main(force_cpu: bool) -> None:
 
         on_cpu = devs[0].platform == "cpu"
 
+        if on_cpu:
+            # Quick extrapolated fallback: one small batch, one rep.  Exec at
+            # 16 sets is ~20 s; compile of this bucket is warm in .jax_cache
+            # from the device-bucket tests.  Full 128x32 on this 1-core host
+            # (~160 s/rep + compile) is exactly what overran the r4 budget.
+            value, warm = _bench_shape(
+                jax, _device_verify, fe_is_one, _build_example,
+                CPU_QUICK_N_SETS, N_KEYS, CPU_QUICK_REPS, seed=3,
+            )
+            out["value"] = value
+            out["cpu_extrapolated"] = True
+            out["cpu_measured_shape"] = f"{CPU_QUICK_N_SETS}x{N_KEYS}"
+            out["cpu_warm_secs"] = round(warm, 1)
+            _checkpoint(out)
+            return
+
         # Smoke: smallest bucket. Proves end-to-end device execution cheaply
         # and records a compile time even if the headline shape never finishes.
         smoke, warm = _bench_shape(
-            jax, _device_verify, fe_is_one, _build_example, 1, 1, 1 if on_cpu else 3, seed=11
+            jax, _device_verify, fe_is_one, _build_example, 1, 1, 3, seed=11
         )
         out["smoke_sets_per_sec_1x1"] = round(smoke, 2)
         out["smoke_warm_secs"] = round(warm, 1)
         _checkpoint(out)
 
-        # Headline: 128 sets x 32-key committees. CPU executes one such
-        # multi-pairing in ~158 s — one rep is all the timeout budget allows.
-        reps = 1 if on_cpu else REPS
+        # Headline: 128 sets x 32-key committees.
         headline, warm = _bench_shape(
-            jax, _device_verify, fe_is_one, _build_example, N_SETS, N_KEYS, reps, seed=3
+            jax, _device_verify, fe_is_one, _build_example, N_SETS, N_KEYS, REPS, seed=3
         )
         out["value"] = headline
         out["headline_warm_secs"] = round(warm, 1)
         _checkpoint(out)
 
         # Scale config: 4,096 sets x 32-key committees (best-effort — a failure
-        # here must not void the headline number). Skip on CPU: minutes-slow.
-        if not on_cpu:
-            try:
-                scale, warm = _bench_shape(
-                    jax, _device_verify, fe_is_one, _build_example,
-                    SCALE_N_SETS, N_KEYS, SCALE_REPS, seed=5,
-                )
-                out["sets_per_sec_4096x32"] = round(scale, 1)
-                out["vs_baseline_4096x32"] = round(scale / BLST_64T_SETS_PER_SEC, 4)
-                out["scale_warm_secs"] = round(warm, 1)
-            except Exception as e:
-                out["scale_bench_error"] = f"{type(e).__name__}: {e}"
+        # here must not void the headline number).
+        try:
+            scale, warm = _bench_shape(
+                jax, _device_verify, fe_is_one, _build_example,
+                SCALE_N_SETS, N_KEYS, SCALE_REPS, seed=5,
+            )
+            out["sets_per_sec_4096x32"] = round(scale, 1)
+            out["vs_baseline_4096x32"] = round(scale / BLST_64T_SETS_PER_SEC, 4)
+            out["scale_warm_secs"] = round(warm, 1)
+        except Exception as e:
+            out["scale_bench_error"] = f"{type(e).__name__}: {e}"
     except Exception as e:
         import traceback
 
@@ -178,8 +215,105 @@ def _child_main(force_cpu: bool) -> None:
 
 
 # ---------------------------------------------------------------------------
-# Parent mode: orchestrate children with hard timeouts; always emit JSON.
+# Parent mode: orchestrate children with hard timeouts; always emit JSON —
+# even when the parent itself is killed from outside (atexit + signals).
 # ---------------------------------------------------------------------------
+
+_STATE: dict = {
+    "emitted": False,
+    "result": None,          # dict with "value" once any attempt succeeds
+    "extra": {"attempts": []},
+    "child_result_file": None,  # checkpoint file of the child currently running
+    "child_proc": None,
+}
+
+
+def _read_json(path: str) -> dict:
+    try:
+        with open(path) as f:
+            return json.loads(f.read())
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _usable_probe_result() -> dict:
+    """The probe loop's device capture, iff it is a DEVICE number measured
+    against the CURRENT kernel sources.
+
+    A cpu-platform fallback is rejected (not the number this file exists to
+    capture), and a file older than any of the kernel/bench sources is
+    rejected (a stale capture from a previous build must not be emitted as
+    this build's benchmark)."""
+    probe = _read_json(PROBE_RESULT_FILE)
+    if "value" not in probe or probe.get("platform") in (None, "cpu"):
+        return {}
+    try:
+        captured = os.path.getmtime(PROBE_RESULT_FILE)
+    except OSError:
+        return {}
+    newest_src = 0.0
+    ops_dir = os.path.join(HERE, "lighthouse_tpu", "ops")
+    for d in (ops_dir,):
+        try:
+            for name in os.listdir(d):
+                if name.endswith(".py"):
+                    newest_src = max(newest_src, os.path.getmtime(os.path.join(d, name)))
+        except OSError:
+            pass
+    newest_src = max(newest_src, os.path.getmtime(os.path.abspath(__file__)))
+    if captured < newest_src:
+        return {}  # kernel or bench changed after the capture: stale
+    probe["from_probe_loop"] = True
+    probe["probe_result_age_s"] = round(time.time() - captured, 0)
+    return probe
+
+
+def _final_emit() -> None:
+    """Emit the JSON line exactly once, from the best data available.
+
+    Reachable from normal completion, atexit, or a signal handler — the
+    driver's own outer timeout (r4's rc=124) lands here via SIGTERM and still
+    produces a parsed artifact.
+    """
+    if _STATE["emitted"]:
+        return
+    _STATE["emitted"] = True
+    extra = _STATE["extra"]
+    result = _STATE["result"]
+    if result is None and _STATE["child_result_file"]:
+        # A child was mid-flight: harvest its last checkpoint right now.
+        ckpt = _read_json(_STATE["child_result_file"])
+        if ckpt:
+            extra["attempts"].append({"mode": "killed_mid_flight", **{
+                k: ckpt[k] for k in ckpt if k != "value"}})
+            if "value" in ckpt:
+                result = ckpt
+    if result is None:
+        probe = _usable_probe_result()
+        if probe:
+            result = probe
+    if result is not None:
+        for k in ("platform", "init_secs", "smoke_sets_per_sec_1x1", "smoke_warm_secs",
+                  "headline_warm_secs", "sets_per_sec_4096x32", "vs_baseline_4096x32",
+                  "scale_warm_secs", "scale_bench_error", "cpu_extrapolated",
+                  "cpu_measured_shape", "cpu_warm_secs", "from_probe_loop"):
+            if k in result:
+                extra[k] = result[k]
+        _emit(result["value"], result["value"] / BLST_64T_SETS_PER_SEC, extra)
+    else:
+        extra["error"] = "all bench attempts failed (see attempts[])"
+        _emit(0.0, 0.0, extra)
+
+
+def _signal_emit(signum, _frame) -> None:
+    proc = _STATE.get("child_proc")
+    if proc is not None and proc.poll() is None:
+        try:
+            proc.kill()
+        except OSError:
+            pass
+    _final_emit()
+    os._exit(0)
 
 
 def _cpu_child_env() -> dict:
@@ -206,25 +340,31 @@ def _run_child(force_cpu: bool, timeout_s: float) -> dict:
     result_file = os.path.join(scratch, f"result_{tag}.json")
     log_file = os.path.join(scratch, f"child_{tag}.log")
     env["BENCH_RESULT_FILE"] = result_file
+    _STATE["child_result_file"] = result_file
 
     t0 = time.perf_counter()
     timed_out = False
     res: dict = {}
     try:
         with open(log_file, "wb") as lf:
+            proc = subprocess.Popen(argv, env=env, cwd=HERE, stdout=lf,
+                                    stderr=subprocess.STDOUT)
+            _STATE["child_proc"] = proc
             try:
-                subprocess.run(
-                    argv, env=env, cwd=HERE,
-                    stdout=lf, stderr=subprocess.STDOUT, timeout=timeout_s,
-                )
+                proc.wait(timeout=timeout_s)
             except subprocess.TimeoutExpired:
                 timed_out = True
-        try:
-            with open(result_file) as f:
-                res = json.loads(f.read())
-        except (OSError, json.JSONDecodeError):
-            pass
+                proc.kill()
+                proc.wait()
+        res = _read_json(result_file)
+        if "value" in res:
+            # Publish BEFORE the cleanup below: a SIGTERM landing between
+            # the unlink and the caller's own assignment must not discard a
+            # fully measured result.
+            _STATE["result"] = res
     finally:
+        _STATE["child_proc"] = None
+        _STATE["child_result_file"] = None
         for p in (result_file, result_file + ".tmp"):
             try:
                 os.unlink(p)
@@ -256,32 +396,35 @@ def _run_child(force_cpu: bool, timeout_s: float) -> dict:
 
 
 def main() -> None:
-    extra: dict = {"attempts": []}
-    result: dict | None = None
+    atexit.register(_final_emit)
+    for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
+        try:
+            signal.signal(sig, _signal_emit)
+        except (OSError, ValueError):
+            pass
+
+    extra = _STATE["extra"]
+
+    # 0) A device number captured by the probe loop at ANY point in the round
+    #    (against the current sources) beats re-rolling the tunnel dice now.
+    probe = _usable_probe_result()
+    if probe:
+        _STATE["result"] = probe
+        _final_emit()
+        return
 
     res = _run_child(force_cpu=False, timeout_s=TPU_TIMEOUT_S)
     extra["attempts"].append({"mode": "device", **{k: res[k] for k in res if k != "value"}})
     if "value" in res:
-        result = res
+        _STATE["result"] = res
     else:
         print(f"bench: device attempt failed: {res.get('error')}", file=sys.stderr)
-
-    if result is None:
         res = _run_child(force_cpu=True, timeout_s=CPU_TIMEOUT_S)
         extra["attempts"].append({"mode": "cpu", **{k: res[k] for k in res if k != "value"}})
         if "value" in res:
-            result = res
+            _STATE["result"] = res
 
-    if result is not None:
-        for k in ("platform", "init_secs", "smoke_sets_per_sec_1x1", "smoke_warm_secs",
-                  "headline_warm_secs", "sets_per_sec_4096x32", "vs_baseline_4096x32",
-                  "scale_warm_secs", "scale_bench_error"):
-            if k in result:
-                extra[k] = result[k]
-        _emit(result["value"], result["value"] / BLST_64T_SETS_PER_SEC, extra)
-    else:
-        extra["error"] = "all bench attempts failed (see attempts[])"
-        _emit(0.0, 0.0, extra)
+    _final_emit()
     # Exit 0 always: the JSON line itself records success or failure; a nonzero
     # rc would leave the driver with no parsed artifact at all (VERDICT r1/r2).
 

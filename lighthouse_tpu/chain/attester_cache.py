@@ -35,6 +35,17 @@ class EarlyAttesterCache:
         with self._lock:
             self._item = None
 
+    def clear_unless(self, block_root: bytes) -> None:
+        """Atomically drop the item unless it is for ``block_root``.
+
+        Head-recompute path: a compare-then-``clear()`` outside the lock
+        races a concurrent ``add_head_block`` — the fresh item of the block
+        that just became head could be wiped between the check and the
+        clear, dropping a valid early-attestation target."""
+        with self._lock:
+            if self._item is not None and self._item["block_root"] != bytes(block_root):
+                self._item = None
+
     def add_head_block(self, block_root: bytes, signed_block, state,
                        types, spec, blobs: Optional[list] = None) -> None:
         """Capture attestation-production state for the verified block

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from .. import metrics
@@ -349,6 +350,10 @@ class BeaconChain:
         self.re_org_max_epochs_since_finalization: int = 2
         self.re_org_cutoff_denominator: int = 12
         self.re_org_disallowed_offsets: tuple = ()
+        # Import-time block arrival delays (root -> seconds into its slot),
+        # consulted by the proposer re-org head_late gate
+        # (beacon_chain.rs:4289-4290).  Bounded: pruned FIFO past 128 roots.
+        self._block_delays: "OrderedDict[bytes, float]" = OrderedDict()
         self.op_pool = OperationPool()
         self.observed_block_roots: set = set()
         self._migrated_slot = 0
@@ -552,8 +557,15 @@ class BeaconChain:
             raise BlockError(f"state transition failed: {e}") from e
 
         if block_delay_seconds is None:
-            since_start = self.slot_clock.seconds_from_current_slot_start()
-            block_delay_seconds = since_start if since_start is not None else 1e9
+            # Delay relative to the BLOCK'S OWN slot start (reference
+            # block_times_cache semantics) — a slot-N block arriving during
+            # slot N+1 is very late, not "0.5 s into the current slot".
+            now = self.slot_clock._seconds()
+            start = self.slot_clock.start_of(int(block.slot))
+            block_delay_seconds = max(0.0, now - start)
+        self._block_delays[block_root] = float(block_delay_seconds)
+        while len(self._block_delays) > 128:
+            self._block_delays.popitem(last=False)
         if hasattr(block.body, "execution_payload"):
             ph = bytes(block.body.execution_payload.block_hash)
             optimistic = getattr(self.execution_engine, "optimistic_hashes", None)
@@ -1426,6 +1438,13 @@ class BeaconChain:
             self.spec.seconds_per_slot / self.re_org_cutoff_denominator
         ):
             return None
+        # head_late gate (beacon_chain.rs:4289-4290): only a head that
+        # arrived AFTER the attestation deadline (seconds_per_slot/3) may be
+        # orphaned — a timely block that is merely weakly attested (slow
+        # attestation propagation, low participation) must be left alone.
+        head_delay = self._block_delays.get(self.head_root)
+        if head_delay is None or head_delay <= self.spec.seconds_per_slot / 3:
+            return None
         try:
             parent = self.fork_choice.get_proposer_head(
                 int(slot), self.head_root,
@@ -1501,10 +1520,10 @@ class BeaconChain:
         self.head_root = head
         # A head that re-orged away from the early-attester item makes the
         # cached attestation data wrong — drop it (reference clears the
-        # cache on re-org in canonical_head.rs).
-        cached = self.early_attester_cache.get_block(head)
-        if cached is None and self.early_attester_cache._item is not None:
-            self.early_attester_cache.clear()
+        # cache on re-org in canonical_head.rs).  Atomic under the cache
+        # lock: a concurrent add_head_block for this very head must not be
+        # wiped by a stale compare-then-clear.
+        self.early_attester_cache.clear_unless(head)
         st = self.get_state(head) if head != old_head else None
         if st is not None:
             old_epoch = self._blocks_slot(old_head) // self.spec.slots_per_epoch

@@ -1726,35 +1726,59 @@ def lighthouse_merge_readiness(ctx):
     return {"data": {"type": "ready", "config": {"post_merge": merged}}}
 
 
-def _inclusion_data(ctx, epoch: int):
-    """Per-epoch participation totals from the flag registry (the
-    reference's validator_inclusion computed from participation caches)."""
-    from ..types.spec import TIMELY_HEAD_FLAG_INDEX, TIMELY_TARGET_FLAG_INDEX
-
+def _inclusion_state(ctx, epoch: int):
+    """The state whose ``current_epoch_participation`` register belongs to
+    the requested epoch (reference validator_inclusion loads the state at
+    the requested epoch, so 'current'/'previous' fields each come from
+    their own register)."""
     chain = ctx.chain
     state = chain.head_state
     current_epoch = h.get_current_epoch(state, chain.spec)
     if epoch not in (current_epoch, max(0, current_epoch - 1)):
         raise _bad(f"epoch {epoch} is not the current or previous epoch")
-    part = (state.current_epoch_participation if epoch == current_epoch
-            else state.previous_epoch_participation)
-    active_gwei = 0
-    target_gwei = 0
-    head_gwei = 0
+    if epoch != current_epoch:
+        # Rewind to the requested epoch's end: replay from the ANCESTOR block
+        # at/before that slot (state_at_slot cannot rewind the head state).
+        end_slot = (epoch + 1) * chain.spec.slots_per_epoch - 1
+        ancestor = h.get_block_root_at_slot(state, end_slot, chain.spec)
+        state, _ = chain.state_at_slot(end_slot, bytes(ancestor))
+    return state
+
+
+def _inclusion_data(ctx, epoch: int):
+    """Per-epoch participation totals from the flag registry (the
+    reference's validator_inclusion computed from participation caches) —
+    current-epoch fields from ``current_epoch_participation``,
+    previous-epoch fields from ``previous_epoch_participation``."""
+    from ..types.spec import TIMELY_HEAD_FLAG_INDEX, TIMELY_TARGET_FLAG_INDEX
+
+    state = _inclusion_state(ctx, epoch)
+    prev_epoch = max(0, epoch - 1)
+    cur_part = state.current_epoch_participation
+    prev_part = state.previous_epoch_participation
+    cur_active = 0
+    cur_target = prev_target = prev_head = 0
     for i, v in enumerate(state.validators):
-        if not (v.activation_epoch <= epoch < v.exit_epoch):
-            continue
-        active_gwei += int(v.effective_balance)
-        flags = int(part[i]) if i < len(part) else 0
-        if flags & (1 << TIMELY_TARGET_FLAG_INDEX) and not v.slashed:
-            target_gwei += int(v.effective_balance)
-        if flags & (1 << TIMELY_HEAD_FLAG_INDEX) and not v.slashed:
-            head_gwei += int(v.effective_balance)
+        eb = int(v.effective_balance)
+        if v.activation_epoch <= epoch < v.exit_epoch:
+            cur_active += eb
+            flags = int(cur_part[i]) if i < len(cur_part) else 0
+            if flags & (1 << TIMELY_TARGET_FLAG_INDEX) and not v.slashed:
+                cur_target += eb
+        if v.activation_epoch <= prev_epoch < v.exit_epoch:
+            flags = int(prev_part[i]) if i < len(prev_part) else 0
+            if not v.slashed:
+                if flags & (1 << TIMELY_TARGET_FLAG_INDEX):
+                    prev_target += eb
+                if flags & (1 << TIMELY_HEAD_FLAG_INDEX):
+                    prev_head += eb
+    # Exactly the reference GlobalValidatorInclusionData fields
+    # (common/eth2/src/lighthouse.rs:54-66) — no extra keys.
     return {
-        "current_epoch_active_gwei": str(active_gwei),
-        "current_epoch_target_attesting_gwei": str(target_gwei),
-        "previous_epoch_target_attesting_gwei": str(target_gwei),
-        "previous_epoch_head_attesting_gwei": str(head_gwei),
+        "current_epoch_active_gwei": str(cur_active),
+        "current_epoch_target_attesting_gwei": str(cur_target),
+        "previous_epoch_target_attesting_gwei": str(prev_target),
+        "previous_epoch_head_attesting_gwei": str(prev_head),
     }
 
 
@@ -1772,11 +1796,9 @@ def lighthouse_inclusion_validator(ctx):
     )
 
     chain = ctx.chain
-    state = chain.head_state
     epoch = int(ctx.params["epoch"])
-    current_epoch = h.get_current_epoch(state, chain.spec)
-    if epoch not in (current_epoch, max(0, current_epoch - 1)):
-        raise _bad(f"epoch {epoch} is not the current or previous epoch")
+    state = _inclusion_state(ctx, epoch)
+    prev_epoch = max(0, epoch - 1)
     vid = ctx.params["validator_id"]
     idx = int(vid) if not vid.startswith("0x") else next(
         (i for i, v in enumerate(state.validators)
@@ -1784,24 +1806,31 @@ def lighthouse_inclusion_validator(ctx):
     if not (0 <= idx < len(state.validators)):
         raise ApiError(404, "validator not found")
     v = state.validators[idx]
-    part = (state.current_epoch_participation if epoch == current_epoch
-            else state.previous_epoch_participation)
-    flags = int(part[idx]) if idx < len(part) else 0
-    active = v.activation_epoch <= epoch < v.exit_epoch
+    cur_part = state.current_epoch_participation
+    prev_part = state.previous_epoch_participation
+    cur_flags = int(cur_part[idx]) if idx < len(cur_part) else 0
+    prev_flags = int(prev_part[idx]) if idx < len(prev_part) else 0
+    # Attester booleans follow the reference ParticipationCache's
+    # is_unslashed_participating_index: flag AND active-in-epoch AND
+    # not slashed (a slashed validator's stale flags must not read true).
+    unslashed_cur = (v.activation_epoch <= epoch < v.exit_epoch) and not v.slashed
+    unslashed_prev = (
+        v.activation_epoch <= prev_epoch < v.exit_epoch
+    ) and not v.slashed
     return {"data": {
         "is_slashed": bool(v.slashed),
         "is_withdrawable_in_current_epoch": epoch >= int(v.withdrawable_epoch),
-        "is_active_unslashed_in_current_epoch": active and not v.slashed,
-        "is_active_unslashed_in_previous_epoch": active and not v.slashed,
+        "is_active_unslashed_in_current_epoch": unslashed_cur,
+        "is_active_unslashed_in_previous_epoch": unslashed_prev,
         "current_epoch_effective_balance_gwei": str(int(v.effective_balance)),
         "is_current_epoch_target_attester":
-            bool(flags & (1 << TIMELY_TARGET_FLAG_INDEX)),
+            unslashed_cur and bool(cur_flags & (1 << TIMELY_TARGET_FLAG_INDEX)),
         "is_previous_epoch_target_attester":
-            bool(flags & (1 << TIMELY_TARGET_FLAG_INDEX)),
+            unslashed_prev and bool(prev_flags & (1 << TIMELY_TARGET_FLAG_INDEX)),
         "is_previous_epoch_head_attester":
-            bool(flags & (1 << TIMELY_HEAD_FLAG_INDEX)),
+            unslashed_prev and bool(prev_flags & (1 << TIMELY_HEAD_FLAG_INDEX)),
         "is_previous_epoch_source_attester":
-            bool(flags & (1 << TIMELY_SOURCE_FLAG_INDEX)),
+            unslashed_prev and bool(prev_flags & (1 << TIMELY_SOURCE_FLAG_INDEX)),
     }}
 
 

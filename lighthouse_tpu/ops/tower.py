@@ -189,7 +189,19 @@ def fq12_mul(a, b):
 
 
 def fq12_square(a):
-    return fq12_mul(a, a)
+    """Complex squaring over the w-quadratic: (a0 + a1 w)^2 =
+    (a0^2 + v a1^2) + 2 a0 a1 w, via 2 fq6 muls instead of fq12_mul's 3:
+    t0 = a0 a1;  t1 = (a0 + a1)(a0 + v a1) = a0^2 + v a1^2 + (1 + v) t0."""
+    a0, a1 = fq12_parts(a)
+    t = fq6_mul(
+        jnp.stack([a0, a0 + a1], axis=-4),
+        jnp.stack([a1, a0 + fq6_mul_by_v(a1)], axis=-4),
+    )
+    t0 = t[..., 0, :, :, :]
+    t1 = t[..., 1, :, :, :]
+    c0 = t1 - t0 - fq6_mul_by_v(t0)
+    c1 = t0 + t0
+    return jnp.stack([c0, c1], axis=-4)
 
 
 def fq12_conj(a):

@@ -1,19 +1,33 @@
 #!/bin/bash
 # Retry the TPU probe until the tunnel comes back (or the session ends).
 # Each attempt can hang ~25+ min in jax.devices(); failures sleep 5 min and
-# retry.  Success leaves real device timings in the log and a warm .jax_cache
-# for bench.py.  Run detached:
+# retry.  On the FIRST success this fires the full device bench immediately
+# (the tunnel has been observed to die again within hours), writing
+# .tpu_probe/bench_device_result.json — which bench.py reuses at end of
+# round, so a device number captured at ANY point survives.  Run detached:
 #   nohup bash scripts/tpu_probe_loop.sh >> .tpu_probe/probe.log 2>&1 &
 cd "$(dirname "$0")/.." || exit 1
+mkdir -p .tpu_probe
 attempt=0
 while true; do
   attempt=$((attempt + 1))
   echo "PROBE_LOOP attempt=$attempt start=$(date -u +%H:%M:%S)"
-  if timeout 3000 python scripts/tpu_probe.py; then
-    if grep -q '"stage": "timed"' .tpu_probe/probe.log 2>/dev/null; then
-      echo "PROBE_LOOP success after attempt=$attempt"
+  if timeout 3000 python scripts/tpu_probe.py && \
+     grep -q '"stage": "timed"' .tpu_probe/probe.log 2>/dev/null; then
+    echo "PROBE_LOOP success after attempt=$attempt; firing device bench $(date -u +%H:%M:%S)"
+    # Stale results must not satisfy the capture check below.
+    rm -f .tpu_probe/bench_device_result.json
+    BENCH_RESULT_FILE="$PWD/.tpu_probe/bench_device_result.json" \
+      timeout 3000 python bench.py --child
+    echo "PROBE_LOOP bench child rc=$? done=$(date -u +%H:%M:%S)"
+    if grep -q '"value"' .tpu_probe/bench_device_result.json 2>/dev/null && \
+       ! grep -q '"platform": "cpu"' .tpu_probe/bench_device_result.json; then
+      echo "PROBE_LOOP device bench result captured"
       break
     fi
+    # Probe succeeded but bench didn't capture a DEVICE headline (a
+    # cpu-platform fallback result doesn't count: bench.py main() rejects
+    # it and the tunnel may yet return) — keep trying.
   fi
   echo "PROBE_LOOP attempt=$attempt failed rc=$? $(date -u +%H:%M:%S); sleeping 300s"
   sleep 300

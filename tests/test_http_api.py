@@ -390,3 +390,36 @@ def test_r4_lighthouse_extension_routes(served):
     vi = client.post("/lighthouse/ui/validator_info",
                      {"indices": ["2"]})["data"]["validators"]
     assert "2" in vi and "balance" in vi["2"]["info"]
+
+
+def test_r5_validator_inclusion_previous_epoch():
+    """Previous-epoch inclusion requests replay the ancestor state (ADVICE
+    r4 per-register fix + the rewind path): exercised at epoch >= 1, where
+    head-state shortcuts cannot answer.  Field set matches the reference
+    GlobalValidatorInclusionData exactly."""
+    set_backend("fake")
+    try:
+        harness = BeaconChainHarness(validator_count=16, fake_crypto=True)
+        spe = harness.spec.slots_per_epoch
+        harness.extend_chain(spe + 2)  # into epoch 1
+        server = HttpApiServer(harness.chain).start()
+        try:
+            client = BeaconNodeHttpClient(server.url)
+            epoch = harness.chain.current_slot() // spe
+            assert epoch >= 1
+            g = client.get(
+                f"/lighthouse/validator_inclusion/{epoch - 1}/global")["data"]
+            assert set(g) == {
+                "current_epoch_active_gwei",
+                "current_epoch_target_attesting_gwei",
+                "previous_epoch_target_attesting_gwei",
+                "previous_epoch_head_attesting_gwei",
+            }
+            assert int(g["current_epoch_active_gwei"]) > 0
+            one = client.get(
+                f"/lighthouse/validator_inclusion/{epoch - 1}/0")["data"]
+            assert isinstance(one["is_previous_epoch_target_attester"], bool)
+        finally:
+            server.stop()
+    finally:
+        set_backend("host")

@@ -145,6 +145,27 @@ class TestProposerReOrg:
             "the late block must be orphaned"
         )
 
+    def test_reorg_declined_for_timely_head(self, harness):
+        """head_late gate (reference beacon_chain.rs:4289-4290): a head that
+        arrived BEFORE the attestation deadline is never orphaned, even when
+        weakly attested (slow attestation propagation must not get honest
+        blocks re-orged)."""
+        chain = harness.chain
+        harness.extend_chain(4)
+        slot = harness.advance_slot()
+        timely = harness.produce_signed_block(slot=slot, sync_participation=False)
+        chain.process_block(timely, block_delay_seconds=1.0)  # before deadline
+        chain.re_org_parent_threshold = 50
+        next_slot = harness.advance_slot()
+        # fork choice alone WOULD re-org (the head is weak)...
+        parent = chain.fork_choice.get_proposer_head(
+            next_slot, chain.head_root,
+            re_org_head_threshold=20, re_org_parent_threshold=50,
+        )
+        assert parent == bytes(timely.message.parent_root)
+        # ...but the chain's head_late gate declines.
+        assert chain._maybe_re_org_parent(next_slot) is None
+
     def test_reorg_declined_when_disabled_or_late(self, harness):
         chain, late = self._weak_head_setup(harness)
         chain.re_org_parent_threshold = 50
