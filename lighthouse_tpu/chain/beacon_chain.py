@@ -370,13 +370,30 @@ class BeaconChain:
             slot_provider=self.current_slot,
         )
         self._blob_sidecars: Dict[bytes, list] = {}
+        # Payload-free persistence + on-read reconstruction (reference
+        # beacon_block_streamer.rs): with store_payloads=False, post-merge
+        # blocks hit the DB blinded and get_block rebuilds the payload from
+        # the EL via engine_getPayloadBodiesByHash.
+        from .block_streamer import BeaconBlockStreamer
+
+        self.store_payloads: bool = True
+        self.block_streamer = BeaconBlockStreamer(self)
 
     # ------------------------------------------------------------- storage
 
     def _store_block(self, block_root: bytes, signed_block, post_state) -> None:
         if signed_block is not None:
             self._blocks[block_root] = signed_block
-            self.db.put_block(block_root, signed_block)
+            if not self.store_payloads and hasattr(
+                signed_block.message.body, "execution_payload"
+            ):
+                from .block_streamer import blind_signed_block
+
+                self.db.put_blinded_block(
+                    block_root, blind_signed_block(signed_block, self.types)
+                )
+            else:
+                self.db.put_block(block_root, signed_block)
             # The post-state root was verified against the block's claim in
             # state_transition — reuse it instead of re-merkleizing.
             state_root = bytes(signed_block.message.state_root)
@@ -386,16 +403,51 @@ class BeaconChain:
         self.db.put_state(state_root, post_state, block_root)
 
     def get_block(self, block_root: bytes):
-        """Block by root — object cache first, store fallback (the reference
-        can always reach the store when its block cache misses), then the
-        early-attester cache for a block that is verified but not yet
-        written (peers may request it over RPC the moment it hits gossip)."""
+        """FULL block by root — object cache first, store fallback (the
+        reference can always reach the store when its block cache misses),
+        then the early-attester cache for a block that is verified but not
+        yet written (peers may request it over RPC the moment it hits
+        gossip).  A blinded store hit is reconstructed through the block
+        streamer (payload from the EL), so every serving path — HTTP blocks,
+        BlocksByRange/Root — hands out full blocks transparently."""
         block = self._blocks.get(block_root)
         if block is None:
             block = self.db.get_block(block_root)
+            if block is not None:
+                from .block_streamer import is_blinded
+
+                if is_blinded(block):
+                    block = self.block_streamer.reconstruct_one(block)
         if block is None:
             block = self.early_attester_cache.get_block(block_root)
         return block
+
+    def get_blocks(self, block_roots) -> list:
+        """FULL blocks for many roots with ONE batched EL round trip for
+        every blinded store hit (the reference's beacon_block_streamer range
+        path) — N-block BlocksByRange must not cost N
+        engine_getPayloadBodiesByHash calls."""
+        raw = []
+        for root in block_roots:
+            block = self._blocks.get(root) or self.db.get_block(root)
+            if block is None:
+                block = self.early_attester_cache.get_block(root)
+            raw.append(block)
+        return self.block_streamer.reconstruct(raw)
+
+    def get_blinded_block(self, block_root: bytes):
+        """The block in blinded form (payload header), reading the blinded
+        store representation directly when present."""
+        from .block_streamer import blind_signed_block, is_blinded
+
+        block = self._blocks.get(block_root) or self.db.get_block(block_root)
+        if block is None:
+            block = self.early_attester_cache.get_block(block_root)
+        if block is None or is_blinded(block):
+            return block
+        if not hasattr(block.message.body, "execution_payload"):
+            return block  # pre-merge: blinded == full
+        return blind_signed_block(block, self.types)
 
     def get_blobs(self, block_root: bytes) -> list:
         """Blob sidecars stored at import or backfill (memory first, store
@@ -611,8 +663,26 @@ class BeaconChain:
         for att in block.body.attestations:
             try:
                 indexed = h.get_indexed_attestation(state, att, self.types, self.spec)
+                # Head/target correctness vs the including chain (reference
+                # validator_monitor.rs attestation scoring); None when the
+                # root is not yet derivable from this state's history.
+                head_hit = target_hit = None
+                try:
+                    head_hit = bytes(att.data.beacon_block_root) == bytes(
+                        h.get_block_root_at_slot(state, int(att.data.slot), self.spec)
+                    )
+                except Exception:
+                    pass
+                try:
+                    target_hit = bytes(att.data.target.root) == bytes(
+                        h.get_block_root(state, int(att.data.target.epoch), self.spec)
+                    )
+                except Exception:
+                    pass
                 self.validator_monitor.on_attestation_included(
-                    int(att.data.target.epoch), indexed.attesting_indices
+                    int(att.data.target.epoch), indexed.attesting_indices,
+                    head_hit=head_hit, target_hit=target_hit,
+                    inclusion_distance=int(block.slot) - int(att.data.slot),
                 )
                 for idx in indexed.attesting_indices:
                     self.observed.block_attesters.observe(

@@ -24,6 +24,16 @@ class MockExecutionEngine:
         self.invalid_hashes: Set[bytes] = set()
         self.offline = False
         self.payloads_seen = 0
+        # block_hash -> payload body, for engine_getPayloadBodiesByHash/Range
+        # (reference MockServer keeps every payload it has seen).
+        self._bodies: dict = {}
+
+    def _record_body(self, payload) -> None:
+        self._bodies[bytes(payload.block_hash)] = {
+            "block_number": int(payload.block_number),
+            "transactions": [bytes(t) for t in payload.transactions],
+            "withdrawals": [w.copy() for w in getattr(payload, "withdrawals", [])],
+        }
 
     # ------------------------------------------------------------- produce
 
@@ -67,7 +77,9 @@ class MockExecutionEngine:
         if fork in ("deneb", "electra"):
             kwargs["blob_gas_used"] = 0
             kwargs["excess_blob_gas"] = 0
-        return cls(**kwargs)
+        payload = cls(**kwargs)
+        self._record_body(payload)
+        return payload
 
     # -------------------------------------------------------------- verify
 
@@ -76,4 +88,20 @@ class MockExecutionEngine:
         if self.offline:
             raise ConnectionError("mock execution engine offline")
         self.payloads_seen += 1
+        self._record_body(payload)
         return bytes(payload.block_hash) not in self.invalid_hashes
+
+    # ------------------------------------------------------- payload bodies
+
+    def get_payload_bodies_by_hash(self, hashes):
+        """engine_getPayloadBodiesByHashV1 (body dict or None per hash)."""
+        if self.offline:
+            raise ConnectionError("mock execution engine offline")
+        return [self._bodies.get(bytes(h)) for h in hashes]
+
+    def get_payload_bodies_by_range(self, start: int, count: int):
+        """engine_getPayloadBodiesByRangeV1 by block_number."""
+        if self.offline:
+            raise ConnectionError("mock execution engine offline")
+        by_number = {b["block_number"]: b for b in self._bodies.values()}
+        return [by_number.get(n) for n in range(start, start + count)]

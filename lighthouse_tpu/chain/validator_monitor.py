@@ -3,17 +3,16 @@
 Equivalent of the reference's ``beacon_chain/src/validator_monitor.rs``
 (2.1k LoC): operators register the indices they care about; the monitor
 watches on-chain inclusion (did my validator's attestation land in a block?
-did my proposal land?), keeps per-epoch hit/miss state, and surfaces both a
-summary (the notifier line / ``/lighthouse/ui/validator_metrics`` analog)
-and Prometheus series.
+did my proposal land?), keeps per-epoch hit/miss state, and surfaces a
+summary, cumulative per-validator metrics (the
+``POST /lighthouse/ui/validator_metrics`` shape — reference
+``http_api/src/ui.rs:152-258``), and Prometheus series.
 """
 
 from __future__ import annotations
 
-import threading
-
 from ..timeout_lock import TimeoutLock
-from typing import Dict, Iterable, List, Set
+from typing import Dict, Iterable, Optional, Set
 
 from .. import metrics
 
@@ -32,6 +31,14 @@ MONITORED_COUNT = metrics.gauge(
 )
 
 
+def _pct(hits: int, misses: int) -> float:
+    # Floor division on purpose: the reference computes
+    # `(100 * hits / total) as f64` over u64s (ui.rs:219-232), which
+    # truncates — wire parity beats precision here.
+    total = hits + misses
+    return 0.0 if total == 0 else float(100 * hits // total)
+
+
 class ValidatorMonitor:
     def __init__(self, spec):
         self.spec = spec
@@ -39,19 +46,43 @@ class ValidatorMonitor:
         self._lock = TimeoutLock("validator_monitor")
         # target epoch -> monitored validators whose attestation was included
         self._included: Dict[int, Set[int]] = {}
+        # target epoch -> vidx -> {"head": bool|None, "target": bool|None}
+        self._flags: Dict[int, Dict[int, dict]] = {}
         # slot -> monitored proposer
         self._proposed: Dict[int, int] = {}
+        # cumulative per-validator counters, advanced as epochs close
+        self._counters: Dict[int, dict] = {}
+        self._registered_epoch: Dict[int, int] = {}
+        self._last_closed_epoch: int = -1
 
-    def register(self, indices: Iterable[int]) -> None:
+    def register(self, indices: Iterable[int], current_epoch: int = 0) -> None:
         with self._lock:
-            self.monitored.update(int(i) for i in indices)
+            for i in indices:
+                i = int(i)
+                if i not in self.monitored:
+                    self.monitored.add(i)
+                    self._registered_epoch[i] = int(current_epoch)
+                    self._counters.setdefault(i, {
+                        "attestation_hits": 0, "attestation_misses": 0,
+                        "attestation_head_hits": 0, "attestation_head_misses": 0,
+                        "attestation_target_hits": 0, "attestation_target_misses": 0,
+                        "latest_attestation_inclusion_distance": 0,
+                    })
             MONITORED_COUNT.set(len(self.monitored))
 
     # ------------------------------------------------------------- feeding
 
-    def on_attestation_included(self, target_epoch: int,
-                                attesting_indices: Iterable[int]) -> None:
-        """Called per attestation in an imported block."""
+    def on_attestation_included(
+        self,
+        target_epoch: int,
+        attesting_indices: Iterable[int],
+        head_hit: Optional[bool] = None,
+        target_hit: Optional[bool] = None,
+        inclusion_distance: Optional[int] = None,
+    ) -> None:
+        """Called per attestation in an imported block.  head_hit/target_hit
+        say whether the attested head/target match the including chain
+        (None = undeterminable, not counted either way)."""
         if not self.monitored:
             return
         hits = self.monitored.intersection(int(i) for i in attesting_indices)
@@ -61,6 +92,13 @@ class ValidatorMonitor:
             seen = self._included.setdefault(int(target_epoch), set())
             new = hits - seen
             seen.update(new)
+            flags = self._flags.setdefault(int(target_epoch), {})
+            for v in new:
+                flags[v] = {"head": head_hit, "target": target_hit}
+                if inclusion_distance is not None and v in self._counters:
+                    self._counters[v]["latest_attestation_inclusion_distance"] = int(
+                        inclusion_distance
+                    )
         if new:
             MONITORED_ATTESTATION_HITS.inc(len(new))
 
@@ -69,6 +107,35 @@ class ValidatorMonitor:
             with self._lock:
                 self._proposed[int(slot)] = int(proposer_index)
             MONITORED_BLOCKS.inc()
+
+    def _close_epochs(self, current_epoch: int) -> None:
+        """Tally cumulative hit/miss counters for every epoch that can no
+        longer gain inclusions (inclusion lags at most one full epoch, so
+        epoch e closes once current_epoch >= e + 2).  Lock held by caller."""
+        start = self._last_closed_epoch + 1
+        for e in range(start, int(current_epoch) - 1):
+            included = self._included.get(e, set())
+            flags = self._flags.get(e, {})
+            for v in self.monitored:
+                if self._registered_epoch.get(v, 0) > e:
+                    continue
+                c = self._counters.get(v)
+                if c is None:
+                    continue
+                if v in included:
+                    c["attestation_hits"] += 1
+                    f = flags.get(v, {})
+                    if f.get("head") is True:
+                        c["attestation_head_hits"] += 1
+                    elif f.get("head") is False:
+                        c["attestation_head_misses"] += 1
+                    if f.get("target") is True:
+                        c["attestation_target_hits"] += 1
+                    elif f.get("target") is False:
+                        c["attestation_target_misses"] += 1
+                else:
+                    c["attestation_misses"] += 1
+            self._last_closed_epoch = e
 
     # ------------------------------------------------------------- queries
 
@@ -90,11 +157,41 @@ class ValidatorMonitor:
             "proposal_slots": proposals,
         }
 
+    def validator_metrics(self, indices: Iterable[int]) -> dict:
+        """Reference ``post_validator_monitor_metrics``: cumulative counters
+        for the intersection of the requested and monitored sets."""
+        out = {}
+        with self._lock:
+            for raw in indices:
+                v = int(raw)
+                c = self._counters.get(v)
+                if v not in self.monitored or c is None:
+                    continue
+                out[str(v)] = {
+                    "attestation_hits": c["attestation_hits"],
+                    "attestation_misses": c["attestation_misses"],
+                    "attestation_hit_percentage": _pct(
+                        c["attestation_hits"], c["attestation_misses"]),
+                    "attestation_head_hits": c["attestation_head_hits"],
+                    "attestation_head_misses": c["attestation_head_misses"],
+                    "attestation_head_hit_percentage": _pct(
+                        c["attestation_head_hits"], c["attestation_head_misses"]),
+                    "attestation_target_hits": c["attestation_target_hits"],
+                    "attestation_target_misses": c["attestation_target_misses"],
+                    "attestation_target_hit_percentage": _pct(
+                        c["attestation_target_hits"], c["attestation_target_misses"]),
+                    "latest_attestation_inclusion_distance":
+                        c["latest_attestation_inclusion_distance"],
+                }
+        return {"validators": out}
+
     def prune(self, current_epoch: int) -> None:
         cutoff = int(current_epoch) - MONITOR_HISTORY_EPOCHS
         with self._lock:
+            self._close_epochs(int(current_epoch))
             for e in [e for e in self._included if e < cutoff]:
                 del self._included[e]
+                self._flags.pop(e, None)
             slot_cutoff = cutoff * self.spec.slots_per_epoch
             for s in [s for s in self._proposed if s < slot_cutoff]:
                 del self._proposed[s]

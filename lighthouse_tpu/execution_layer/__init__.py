@@ -119,6 +119,18 @@ class ExecutionLayer:
             )
         )
 
+    def get_payload_bodies_by_hash(self, hashes) -> list:
+        """Batched payload-body fetch for blinded-block reconstruction
+        (beacon_block_streamer analog — chain/block_streamer.py)."""
+        return self.engine.request(
+            lambda api: api.get_payload_bodies_by_hash(hashes)
+        )
+
+    def get_payload_bodies_by_range(self, start: int, count: int) -> list:
+        return self.engine.request(
+            lambda api: api.get_payload_bodies_by_range(start, count)
+        )
+
     def produce_payload(self, state, types, spec,
                         suggested_fee_recipient=None):
         """The real getPayload flow: forkchoiceUpdated(head, attributes) →

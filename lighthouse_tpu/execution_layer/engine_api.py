@@ -34,6 +34,8 @@ SUPPORTED_METHODS = [
     "engine_getPayloadV2",
     "engine_getPayloadV3",
     "engine_getPayloadV4",
+    "engine_getPayloadBodiesByHashV1",
+    "engine_getPayloadBodiesByRangeV1",
 ]
 
 
@@ -98,6 +100,16 @@ def execution_requests_from_json(lst, types):
             raise EngineApiError(f"unknown execution request type {raw[0]}")
         kwargs[field] = cls.fields[field].deserialize(raw[1:])
     return cls(**kwargs)
+
+
+def _body_from_json(obj) -> Optional[Dict[str, Any]]:
+    """ExecutionPayloadBodyV1 JSON -> normalized dict (or None)."""
+    if obj is None:
+        return None
+    return {
+        "transactions": [bytes.fromhex(t[2:]) for t in obj.get("transactions", [])],
+        "withdrawals": list(obj.get("withdrawals") or []),
+    }
 
 
 def kzg_commitment_to_versioned_hash(commitment: bytes) -> bytes:
@@ -267,3 +279,16 @@ class EngineApiClient:
             "electra": "engine_getPayloadV4",
         }.get(fork, "engine_getPayloadV3")
         return self.rpc(version, [payload_id])
+
+    def get_payload_bodies_by_hash(self, hashes) -> list:
+        """engine_getPayloadBodiesByHashV1: normalized body dicts
+        ({transactions: [bytes], withdrawals: [json]}) or None per hash."""
+        res = self.rpc(
+            "engine_getPayloadBodiesByHashV1",
+            [["0x" + bytes(h).hex() for h in hashes]],
+        )
+        return [_body_from_json(b) for b in (res or [])]
+
+    def get_payload_bodies_by_range(self, start: int, count: int) -> list:
+        res = self.rpc("engine_getPayloadBodiesByRangeV1", [_q(start), _q(count)])
+        return [_body_from_json(b) for b in (res or [])]

@@ -32,6 +32,9 @@ class MockEngineServer:
         self.fcu_seen = 0
         self._payload_id = 0
         self._pending: Dict[str, dict] = {}  # payloadId -> {head, attributes}
+        # block_hash -> ExecutionPayloadBodyV1 JSON, for
+        # engine_getPayloadBodiesByHash/Range (payload reconstruction).
+        self._bodies: Dict[bytes, dict] = {}
         self._lock = threading.Lock()
 
         server = ThreadingHTTPServer((host, port), _Handler)
@@ -67,6 +70,12 @@ class MockEngineServer:
             payload = params[0]
             self.payloads_seen += 1
             block_hash = bytes.fromhex(payload["blockHash"][2:])
+            with self._lock:
+                self._bodies[block_hash] = {
+                    "blockNumber": payload.get("blockNumber", "0x0"),
+                    "transactions": list(payload.get("transactions", [])),
+                    "withdrawals": payload.get("withdrawals"),
+                }
             if block_hash in self.invalid_hashes:
                 return {"status": "INVALID", "latestValidHash": None,
                         "validationError": "marked invalid by test"}
@@ -93,6 +102,22 @@ class MockEngineServer:
                     }
                     result["payloadId"] = pid
             return result
+        if method == "engine_getPayloadBodiesByHashV1":
+            with self._lock:
+                return [
+                    self._body_json(self._bodies.get(bytes.fromhex(h[2:])))
+                    for h in params[0]
+                ]
+        if method == "engine_getPayloadBodiesByRangeV1":
+            start, count = int(params[0], 16), int(params[1], 16)
+            with self._lock:
+                by_number = {
+                    int(b["blockNumber"], 16): b for b in self._bodies.values()
+                }
+                return [
+                    self._body_json(by_number.get(n))
+                    for n in range(start, start + count)
+                ]
         if method.startswith("engine_getPayload"):
             pid = params[0]
             with self._lock:
@@ -110,6 +135,13 @@ class MockEngineServer:
                 out["executionRequests"] = []
             return out
         raise _RpcError(-32601, f"method not found: {method}")
+
+    @staticmethod
+    def _body_json(body: Optional[dict]) -> Optional[dict]:
+        if body is None:
+            return None
+        return {"transactions": body["transactions"],
+                "withdrawals": body["withdrawals"]}
 
     def _build_payload(self, head: bytes, attrs: dict) -> dict:
         with self._lock:
@@ -141,6 +173,12 @@ class MockEngineServer:
         if "parentBeaconBlockRoot" in attrs:
             out["blobGasUsed"] = "0x0"
             out["excessBlobGas"] = "0x0"
+        with self._lock:
+            self._bodies[block_hash] = {
+                "blockNumber": out["blockNumber"],
+                "transactions": list(out["transactions"]),
+                "withdrawals": out.get("withdrawals"),
+            }
         return out
 
 

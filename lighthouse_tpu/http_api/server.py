@@ -132,9 +132,16 @@ class Context:
             return chain.justified_checkpoint()[1]
         root, slot = parse_root_or_slot(block_id)
         if root is not None:
-            if chain.get_block(root) is None and root != chain.genesis_block_root:
-                if chain.db.get_block(root) is None:
-                    raise _not_found(f"block {block_id}")
+            # Existence check against the RAW store (db.get_block may return
+            # a blinded block) — resolving a root must not trigger a payload
+            # reconstruction round trip.
+            if (
+                root != chain.genesis_block_root
+                and root not in chain._blocks
+                and chain.db.get_block(root) is None
+                and chain.early_attester_cache.get_block(root) is None
+            ):
+                raise _not_found(f"block {block_id}")
             return root
         found = chain.block_root_at_slot(slot)
         if found is None:
@@ -597,6 +604,17 @@ def beacon_block(ctx):
     return out
 
 
+@route("GET", "/eth/v1/beacon/blocks/{block_id}")
+def beacon_block_v1(ctx):
+    """v1 block fetch: bare {data} envelope (reference get_beacon_block is
+    version-generic via any_version; V1 responses carry no version key)."""
+    root, block = ctx.resolve_block(ctx.params["block_id"])
+    fork = type(block.message).fork_name
+    if ctx.wants_ssz:
+        return SszResponse(block.as_ssz_bytes(), fork)
+    return {"data": to_json(block)}
+
+
 @route("GET", "/eth/v1/beacon/blocks/{block_id}/root")
 def beacon_block_root(ctx):
     root = ctx.resolve_block_root(ctx.params["block_id"])
@@ -701,15 +719,14 @@ publish_block_v2._accepts_ssz = True
 # -------------------------------------------------------------- pool routes
 
 
-@route("POST", "/eth/v1/beacon/pool/attestations", P0)
-def pool_attestations_post(ctx):
+def _submit_attestations(ctx, att_cls) -> None:
     from ..chain.beacon_chain import AttestationError
 
     chain = ctx.chain
     failures = []
     for i, att_json in enumerate(ctx.body or []):
         try:
-            att = container_from_json(chain.types.Attestation, att_json)
+            att = container_from_json(att_cls, att_json)
             chain.process_attestation(att)
             publish = getattr(ctx.server, "publish_attestation_fn", None)
             if publish is not None:
@@ -722,7 +739,23 @@ def pool_attestations_post(ctx):
             "message": "error processing attestations",
             "failures": failures,
         }))
-    return None
+
+
+@route("POST", "/eth/v1/beacon/pool/attestations", P0)
+def pool_attestations_post(ctx):
+    return _submit_attestations(ctx, ctx.chain.types.Attestation)
+
+
+@route("POST", "/eth/v2/beacon/pool/attestations", P0)
+def pool_attestations_post_v2(ctx):
+    """v2 submission (electra, EIP-7549): the Eth-Consensus-Version header
+    selects the per-fork attestation container (committee_bits form for
+    electra)."""
+    version = (ctx.headers.get("Eth-Consensus-Version") or "").lower()
+    att_cls = ctx.chain.types.attestation_by_fork.get(
+        version, ctx.chain.types.Attestation
+    )
+    return _submit_attestations(ctx, att_cls)
 
 
 @route("POST", "/eth/v1/beacon/pool/sync_committees", P0)
@@ -821,10 +854,29 @@ def validator_liveness(ctx):
     return {"data": out}
 
 
+def _pool_attestations(ctx):
+    atts = list(ctx.chain.attestation_pool._pool.values())
+    slot = ctx.q1("slot")
+    index = ctx.q1("committee_index")
+    if slot is not None:
+        atts = [a for a in atts if int(a.data.slot) == int(slot)]
+    if index is not None:
+        atts = [a for a in atts if int(a.data.index) == int(index)]
+    return atts
+
+
 @route("GET", "/eth/v1/beacon/pool/attestations")
 def pool_attestations_get(ctx):
-    atts = list(ctx.chain.attestation_pool._pool.values())
-    return {"data": [to_json(a) for a in atts]}
+    return {"data": [to_json(a) for a in _pool_attestations(ctx)]}
+
+
+@route("GET", "/eth/v2/beacon/pool/attestations")
+def pool_attestations_get_v2(ctx):
+    """v2 wraps the pool dump in a version envelope (electra-era API)."""
+    chain = ctx.chain
+    version = chain.spec.fork_name_at_slot(chain.current_slot())
+    return {"version": version,
+            "data": [to_json(a) for a in _pool_attestations(ctx)]}
 
 
 @route("POST", "/eth/v1/beacon/pool/voluntary_exits", P0)
@@ -875,6 +927,27 @@ def pool_attester_slashings_post(ctx):
 @route("GET", "/eth/v1/beacon/pool/attester_slashings")
 def pool_attester_slashings_get(ctx):
     return {"data": [to_json(s) for s in ctx.chain.op_pool._attester_slashings]}
+
+
+@route("POST", "/eth/v2/beacon/pool/attester_slashings", P0)
+def pool_attester_slashings_post_v2(ctx):
+    """v2 submission: Eth-Consensus-Version selects the per-fork container
+    (electra slashings carry IndexedAttestationElectra)."""
+    chain = ctx.chain
+    version = (ctx.headers.get("Eth-Consensus-Version") or "").lower()
+    cls = (chain.types.AttesterSlashingElectra if version == "electra"
+           else chain.types.AttesterSlashing)
+    slashing = container_from_json(cls, ctx.body)
+    chain.op_pool.insert_attester_slashing(slashing)
+    return None
+
+
+@route("GET", "/eth/v2/beacon/pool/attester_slashings")
+def pool_attester_slashings_get_v2(ctx):
+    chain = ctx.chain
+    version = chain.spec.fork_name_at_slot(chain.current_slot())
+    return {"version": version,
+            "data": [to_json(s) for s in chain.op_pool._attester_slashings]}
 
 
 @route("POST", "/eth/v1/beacon/pool/bls_to_execution_changes", P0)
@@ -1277,8 +1350,21 @@ def rewards_sync_committee(ctx):
 def validator_monitor_register(ctx):
     """Register validator indices with the monitor (reference:
     --validator-monitor flags + the lighthouse UI endpoints)."""
-    ctx.chain.validator_monitor.register(int(i) for i in (ctx.body or []))
+    chain = ctx.chain
+    epoch = chain.current_slot() // chain.spec.slots_per_epoch
+    chain.validator_monitor.register(
+        (int(i) for i in (ctx.body or [])), current_epoch=epoch
+    )
     return None
+
+
+@route("POST", "/lighthouse/ui/validator_metrics", P1)
+def validator_metrics(ctx):
+    """Cumulative hit/miss metrics for monitored validators (reference
+    http_api/src/ui.rs:176 post_validator_monitor_metrics)."""
+    body = ctx.body or {}
+    indices = [int(i) for i in body.get("indices", [])]
+    return {"data": ctx.chain.validator_monitor.validator_metrics(indices)}
 
 
 @route("GET", "/lighthouse/ui/validator_monitor/{epoch}", P1)
@@ -1391,18 +1477,40 @@ def debug_state(ctx):
     }
 
 
-@route("GET", "/eth/v1/debug/beacon/heads")
-def debug_heads(ctx):
+def _head_entries(ctx, with_optimistic: bool):
     chain = ctx.chain
     proto = chain.fork_choice.proto
     heads = []
     for root in proto.head_roots() if hasattr(proto, "head_roots") else [chain.head_root]:
-        heads.append({
-            "root": "0x" + root.hex(),
-            "slot": str(chain._blocks_slot(root)),
-            "execution_optimistic": False,
-        })
-    return {"data": heads}
+        entry = {"root": "0x" + root.hex(), "slot": str(chain._blocks_slot(root))}
+        if with_optimistic:
+            entry["execution_optimistic"] = False
+        heads.append(entry)
+    return heads
+
+
+@route("GET", "/eth/v1/debug/beacon/heads")
+def debug_heads(ctx):
+    return {"data": _head_entries(ctx, with_optimistic=False)}
+
+
+@route("GET", "/eth/v2/debug/beacon/heads")
+def debug_heads_v2(ctx):
+    """v2 adds per-head execution_optimistic (reference get_debug_beacon_heads
+    accepts any endpoint version via its any_version filter)."""
+    return {"data": _head_entries(ctx, with_optimistic=True)}
+
+
+@route("GET", "/eth/v1/debug/beacon/states/{state_id}")
+def debug_state_v1(ctx):
+    """v1 debug state: bare {data}, no version envelope (reference
+    get_debug_beacon_states is version-generic; V1 responses are
+    unversioned)."""
+    state, _ = ctx.resolve_state(ctx.params["state_id"])
+    fork = type(state).fork_name
+    if ctx.wants_ssz:
+        return SszResponse(state.as_ssz_bytes(), fork)
+    return {"data": to_json(state)}
 
 
 @route("GET", "/eth/v1/debug/fork_choice")
@@ -1427,36 +1535,17 @@ def debug_fork_choice(ctx):
 
 @route("GET", "/eth/v1/beacon/blinded_blocks/{block_id}")
 def beacon_blinded_block(ctx):
-    """The stored block re-served in blinded form (payload summarized to
-    its header) — identical hash_tree_root by construction."""
-    from ..consensus.per_block import execution_payload_to_header
-
-    _, signed = ctx.resolve_block(ctx.params["block_id"])
-    msg = signed.message
-    fork = type(msg).fork_name
-    if fork not in ctx.chain.types.blinded_block:
-        # pre-merge blocks have no payload to blind; serve as-is
-        data = to_json(signed)
-    else:
-        body_kwargs = {}
-        for name in msg.body.fields:
-            if name == "execution_payload":
-                body_kwargs["execution_payload_header"] = (
-                    execution_payload_to_header(
-                        msg.body.execution_payload, ctx.chain.types, fork))
-            else:
-                body_kwargs[name] = getattr(msg.body, name)
-        blinded = ctx.chain.types.signed_blinded_block[fork](
-            message=ctx.chain.types.blinded_block[fork](
-                slot=msg.slot, proposer_index=msg.proposer_index,
-                parent_root=msg.parent_root, state_root=msg.state_root,
-                body=ctx.chain.types.blinded_block_body[fork](**body_kwargs),
-            ),
-            signature=signed.signature,
-        )
-        data = to_json(blinded)
+    """The stored block served in blinded form (payload summarized to its
+    header) — identical hash_tree_root by construction.  Reads the store's
+    blinded representation directly when present: no EL round trip, and a
+    payload the EL has since pruned cannot fail this endpoint."""
+    root = ctx.resolve_block_root(ctx.params["block_id"])
+    signed = ctx.chain.get_blinded_block(root)
+    if signed is None:
+        raise _not_found(f"block {ctx.params['block_id']}")
+    fork = type(signed.message).fork_name
     return {"version": fork, "execution_optimistic": False,
-            "finalized": False, "data": data}
+            "finalized": False, "data": to_json(signed)}
 
 
 @route("GET", "/eth/v1/beacon/deposit_snapshot")

@@ -423,7 +423,8 @@ class Router:
             self.service.peer_manager.report(sender, PeerAction.LOW_TOLERANCE, "oversize range")
             return [rpc_mod.encode_response_chunk(rpc_mod.INVALID_REQUEST, b"count too large")]
         chain = self.chain
-        chunks: List[bytes] = []
+        roots: List[bytes] = []
+        slots: List[int] = []
         prev_root = None
         for slot in range(req.start_slot, req.start_slot + req.count):
             root = chain.block_root_at_slot(slot)
@@ -433,7 +434,11 @@ class Router:
                     continue
                 root = root_cold
             prev_root = root
-            block = chain.get_block(root) or chain.db.get_block(root)
+            roots.append(root)
+            slots.append(slot)
+        # Batched: blinded store hits cost one EL round trip total.
+        chunks: List[bytes] = []
+        for slot, block in zip(slots, chain.get_blocks(roots)):
             if block is not None and int(block.message.slot) == slot:
                 chunks.append(self._block_chunk(block))
         return chunks
@@ -442,8 +447,7 @@ class Router:
         if len(req.roots) > rpc_mod.MAX_REQUEST_BLOCKS:
             return [rpc_mod.encode_response_chunk(rpc_mod.INVALID_REQUEST, b"too many roots")]
         chunks = []
-        for root in req.roots:
-            block = self.chain.get_block(root) or self.chain.db.get_block(root)
+        for block in self.chain.get_blocks(list(req.roots)):
             if block is not None:
                 chunks.append(self._block_chunk(block))
         return chunks

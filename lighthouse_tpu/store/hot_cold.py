@@ -128,11 +128,25 @@ class HotColdDB:
         payload = fork.encode() + b"\x00" + signed_block.as_ssz_bytes()
         self.hot.put(DBColumn.BEACON_BLOCK, block_root, payload)
 
+    def put_blinded_block(self, block_root: bytes, signed_blinded) -> None:
+        """Persist a block WITHOUT its execution payload (how the reference
+        stores every post-merge block; the beacon_block_streamer analog
+        reconstructs the payload from the EL on read)."""
+        fork = type(signed_blinded).fork_name
+        payload = b"blinded:" + fork.encode() + b"\x00" + signed_blinded.as_ssz_bytes()
+        self.hot.put(DBColumn.BEACON_BLOCK, block_root, payload)
+
     def get_block(self, block_root: bytes):
+        """The stored block — a signed full block, or a signed BLINDED block
+        when it was persisted payload-free (callers that must serve full
+        blocks go through ``BeaconChain.get_block``, which reconstructs)."""
         raw = self.hot.get(DBColumn.BEACON_BLOCK, block_root)
         if raw is None:
             return None
         fork, data = raw.split(b"\x00", 1)
+        if fork.startswith(b"blinded:"):
+            reg = self.types.signed_blinded_block[fork[len(b"blinded:"):].decode()]
+            return reg.from_ssz_bytes(data)
         return self.types.signed_block[fork.decode()].from_ssz_bytes(data)
 
     def delete_block(self, block_root: bytes) -> None:

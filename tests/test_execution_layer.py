@@ -56,6 +56,33 @@ def test_engine_state_machine_and_capabilities():
         server.stop()
 
 
+def test_payload_bodies_round_trip_over_http():
+    """engine_getPayloadBodiesByHash/Range over the real JSON-RPC wire
+    (reconstruction path of chain/block_streamer.py)."""
+    server = MockEngineServer(SECRET).start()
+    try:
+        el = ExecutionLayer(url=server.url, jwt_secret=SECRET)
+        payload_json = {
+            "blockHash": "0x" + "ab" * 32,
+            "blockNumber": "0x5",
+            "transactions": ["0x02f870", "0x01"],
+            "withdrawals": [{"index": "0x1", "validatorIndex": "0x2",
+                             "address": "0x" + "11" * 20, "amount": "0x3"}],
+            "parentHash": "0x" + "00" * 32,
+        }
+        server.handle("engine_newPayloadV2", [payload_json])
+        bodies = el.get_payload_bodies_by_hash(
+            [bytes.fromhex("ab" * 32), b"\x00" * 32]
+        )
+        assert bodies[1] is None
+        assert bodies[0]["transactions"] == [bytes.fromhex("02f870"), b"\x01"]
+        assert bodies[0]["withdrawals"][0]["validatorIndex"] == "0x2"
+        ranged = el.get_payload_bodies_by_range(5, 2)
+        assert ranged[0] is not None and ranged[1] is None
+    finally:
+        server.stop()
+
+
 def test_engine_rejects_bad_jwt():
     from lighthouse_tpu.execution_layer.engines import STATE_AUTH_FAILED
 

@@ -392,6 +392,48 @@ def test_r4_lighthouse_extension_routes(served):
     assert "2" in vi and "balance" in vi["2"]["info"]
 
 
+def test_r5_version_variant_routes(served):
+    """Round-trips for the r5 route additions: v1 block fetch, v1 debug
+    state, v2 debug heads, v2 pool dumps, validator metrics (reference
+    any_version filters + ui.rs validator_metrics)."""
+    harness, server, client = served
+    chain = harness.chain
+
+    # v1 block: bare {data}, no version key; root matches v2
+    head = chain.head_root.hex()
+    v1 = client.get(f"/eth/v1/beacon/blocks/0x{head}")
+    assert "version" not in v1 and "data" in v1
+    v2 = client.get(f"/eth/v2/beacon/blocks/0x{head}")
+    assert v1["data"]["message"]["slot"] == v2["data"]["message"]["slot"]
+
+    # v1 debug state (bare) vs v2 (version envelope)
+    s1 = client.get("/eth/v1/debug/beacon/states/head")
+    assert "version" not in s1 and "slot" in s1["data"]
+    s2 = client.get("/eth/v2/debug/beacon/states/head")
+    assert "version" in s2
+
+    # debug heads: v1 entries bare, v2 entries carry execution_optimistic
+    h1 = client.get("/eth/v1/debug/beacon/heads")["data"]
+    assert h1 and "execution_optimistic" not in h1[0]
+    h2 = client.get("/eth/v2/debug/beacon/heads")["data"]
+    assert h2 and h2[0]["execution_optimistic"] is False
+
+    # v2 pool dumps carry a version envelope
+    pa = client.get("/eth/v2/beacon/pool/attestations")
+    assert "version" in pa and isinstance(pa["data"], list)
+    ps = client.get("/eth/v2/beacon/pool/attester_slashings")
+    assert "version" in ps and isinstance(ps["data"], list)
+
+    # validator metrics: register then query; unmonitored indices drop out
+    client.post("/lighthouse/ui/validator_monitor", ["0", "1"])
+    m = client.post("/lighthouse/ui/validator_metrics",
+                    {"indices": ["0", "5"]})["data"]["validators"]
+    assert set(m) <= {"0"} or set(m) <= {"0", "1"}
+    if "0" in m:
+        assert "attestation_hits" in m["0"]
+        assert "attestation_hit_percentage" in m["0"]
+
+
 def test_r5_validator_inclusion_previous_epoch():
     """Previous-epoch inclusion requests replay the ancestor state (ADVICE
     r4 per-register fix + the rewind path): exercised at epoch >= 1, where

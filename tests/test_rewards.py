@@ -120,5 +120,17 @@ def test_validator_monitor_tracks_inclusion_and_proposals(harness):
         assert summary["monitored"] == 3
         assert summary["attestation_included"] == [1, 2, 15], summary
         assert summary["attestation_missed"] == []
+
+        # Cumulative metrics (reference ui.rs validator_metrics): after
+        # enough epochs close, each monitored validator has hits, the
+        # percentages are populated, and inclusion distance is recorded.
+        harness.extend_chain(spe * 2)  # close at least one fully-attested epoch
+        m = client.post("/lighthouse/ui/validator_metrics",
+                        {"indices": ["1", "2", "15", "9"]})["data"]["validators"]
+        assert set(m) == {"1", "2", "15"}  # 9 is not monitored
+        for v in ("1", "2", "15"):
+            assert m[v]["attestation_hits"] >= 1, m[v]
+            assert m[v]["attestation_hit_percentage"] > 0.0
+            assert m[v]["latest_attestation_inclusion_distance"] >= 1
     finally:
         server.stop()
