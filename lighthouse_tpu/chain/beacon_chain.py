@@ -16,14 +16,16 @@ happening inside ``state_transition(strategy=VERIFY_BULK)``.
 
 from __future__ import annotations
 
-import logging
+import time
 
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from .. import metrics
 
-log = logging.getLogger("lighthouse_tpu.chain")
+from ..logs import get_logger
+
+log = get_logger("chain")
 from ..consensus import helpers as h
 from ..consensus.per_block import BlockProcessingError, BlockSignatureStrategy
 from ..consensus.per_slot import process_slots
@@ -304,6 +306,15 @@ class BeaconChain:
         self._blocks: Dict[bytes, object] = {}
         self._states: Dict[bytes, object] = {}  # post-state by block root
         self._state_class: Dict[bytes, type] = {}
+        # Payload-free persistence + on-read reconstruction (reference
+        # beacon_block_streamer.rs): with store_payloads=False, post-merge
+        # blocks hit the DB blinded and get_block rebuilds the payload from
+        # the EL via engine_getPayloadBodiesByHash.  Must exist before the
+        # anchor/genesis _store_block below.
+        from .block_streamer import BeaconBlockStreamer
+
+        self.store_payloads: bool = True
+        self.block_streamer = BeaconBlockStreamer(self)
         if anchor_block is not None:
             anchor_root = anchor_block.message.hash_tree_root()
             if anchor_root != self.genesis_block_root:
@@ -370,14 +381,9 @@ class BeaconChain:
             slot_provider=self.current_slot,
         )
         self._blob_sidecars: Dict[bytes, list] = {}
-        # Payload-free persistence + on-read reconstruction (reference
-        # beacon_block_streamer.rs): with store_payloads=False, post-merge
-        # blocks hit the DB blinded and get_block rebuilds the payload from
-        # the EL via engine_getPayloadBodiesByHash.
-        from .block_streamer import BeaconBlockStreamer
+        from .pre_finalization_cache import PreFinalizationBlockCache
 
-        self.store_payloads: bool = True
-        self.block_streamer = BeaconBlockStreamer(self)
+        self.pre_finalization_cache = PreFinalizationBlockCache()
 
     # ------------------------------------------------------------- storage
 
@@ -546,6 +552,7 @@ class BeaconChain:
             )
 
     def _process_block_inner(self, signed_block, block_delay_seconds, sidecars=None):
+        t_import = time.perf_counter()
         block = signed_block.message
         block_root = block.hash_tree_root()
         if block_root in self._blocks or block_root == self.genesis_block_root:
@@ -647,6 +654,7 @@ class BeaconChain:
         with metrics.BLOCK_STORE_WRITE_SECONDS.time():
             self._store_block(block_root, signed_block, state)
         self.observed_block_roots.add(block_root)
+        self.pre_finalization_cache.block_processed(block_root)
         self._update_light_client_cache(signed_block, parent_root, parent_state)
         if blob_sidecars:
             self._blob_sidecars[block_root] = list(blob_sidecars)
@@ -706,6 +714,16 @@ class BeaconChain:
         with metrics.BLOCK_FORK_CHOICE_SECONDS.time():
             self.recompute_head()
         self.events.block(slot=int(block.slot), block_root=block_root)
+        # Reference beacon_chain.rs logs every import with slot/root/delay
+        # (the notifier and Siren both read these).
+        log.info(
+            "block imported",
+            slot=int(block.slot),
+            root="0x" + block_root.hex()[:16],
+            delay_s=round(float(block_delay_seconds), 3),
+            import_s=round(time.perf_counter() - t_import, 3),
+            attestations=len(block.body.attestations),
+        )
         return block_root
 
     def verify_block_header_signature(self, signed_header) -> bool:
@@ -1577,6 +1595,24 @@ class BeaconChain:
             raise ChainError(f"unknown block {block_root.hex()[:16]}")
         return int(block.message.slot)
 
+    def is_pre_finalization_block(self, block_root: bytes) -> bool:
+        """Is an (unknown-to-fork-choice) root a pre-finalization block?
+        True -> attestations to it are rejected outright; False -> a
+        single-block lookup is warranted (reference
+        pre_finalization_cache.rs ``is_pre_finalization_block``)."""
+        return self.pre_finalization_cache.check(block_root, self)
+
+    def reset_fork_choice_to_finalization(self) -> None:
+        """Swap in a fork choice rebuilt from the finalized checkpoint by
+        canonical replay (reference fork_revert.rs
+        ``reset_fork_choice_to_finalization``) — the recovery path for a
+        corrupt or unsound persisted fork choice.  Destructive: every
+        non-canonical branch is forgotten."""
+        from .fork_revert import reset_fork_choice_to_finalization
+
+        self.fork_choice = reset_fork_choice_to_finalization(self)
+        self.recompute_head()
+
     # ----------------------------------------------------------------- head
 
     def recompute_head(self) -> bytes:
@@ -1594,6 +1630,20 @@ class BeaconChain:
         # lock: a concurrent add_head_block for this very head must not be
         # wiped by a stale compare-then-clear.
         self.early_attester_cache.clear_unless(head)
+        if head != old_head:
+            # Head swap vs re-org: a re-org abandons the old head's branch
+            # (reference canonical_head.rs logs these distinctly).
+            if self.fork_choice.is_descendant(old_head, head):
+                log.info("new head", slot=self._blocks_slot(head),
+                         root="0x" + head.hex()[:16])
+            else:
+                log.warning(
+                    "head re-org",
+                    old_root="0x" + old_head.hex()[:16],
+                    old_slot=self._blocks_slot(old_head),
+                    new_root="0x" + head.hex()[:16],
+                    new_slot=self._blocks_slot(head),
+                )
         st = self.get_state(head) if head != old_head else None
         if st is not None:
             old_epoch = self._blocks_slot(old_head) // self.spec.slots_per_epoch
@@ -1632,6 +1682,8 @@ class BeaconChain:
         f_epoch, f_root = self.fork_choice.finalized_checkpoint
         if f_epoch > self._last_finalized_epoch:
             self._last_finalized_epoch = f_epoch
+            log.info("finalized checkpoint advanced", epoch=f_epoch,
+                     root="0x" + f_root.hex()[:16])
             f_state = self._states.get(f_root)
             self.events.finalized(
                 epoch=f_epoch,

@@ -14,7 +14,6 @@ explicitly (VERDICT r1 item 5: the device backend is the node default).
 
 from __future__ import annotations
 
-import logging
 import os
 import threading
 from typing import List, Optional
@@ -25,7 +24,9 @@ from ..scheduler import BeaconProcessor
 from ..types.containers import build_types
 from ..types.spec import ChainSpec, mainnet_spec
 
-log = logging.getLogger("lighthouse_tpu.client")
+from ..logs import get_logger
+
+log = get_logger("client")
 
 
 class ClientBuilder:

@@ -14,6 +14,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..logs import get_logger
+
+log = get_logger("network.peers")
+
 MIN_SCORE_BEFORE_DISCONNECT = -20.0
 MIN_SCORE_BEFORE_BAN = -50.0
 SCORE_HALFLIFE_SECS = 600.0
@@ -73,14 +77,19 @@ class PeerManager:
         """Returns False when the peer is banned and must be refused."""
         info = self._peer(peer_id)
         if self.is_banned(peer_id):
+            log.debug("refused banned peer", peer=peer_id)
             return False
         info.state = ConnectionState.CONNECTED
+        log.info("peer connected", peer=peer_id,
+                 connected=len(self.connected_peers()))
         return True
 
     def on_disconnect(self, peer_id: str) -> None:
         info = self._peer(peer_id)
         if info.state != ConnectionState.BANNED:
             info.state = ConnectionState.DISCONNECTED
+            log.info("peer disconnected", peer=peer_id,
+                     connected=len(self.connected_peers()))
 
     # ----------------------------------------------------------- scoring
 
@@ -96,6 +105,8 @@ class PeerManager:
             info.score = min(info.score, MIN_SCORE_BEFORE_BAN)
             info.state = ConnectionState.BANNED
             info.banned_at = now
+            log.warning("peer banned", peer=peer_id, action=action,
+                        score=round(info.score, 1), reason=_reason)
         elif info.score <= MIN_SCORE_BEFORE_DISCONNECT:
             if info.state == ConnectionState.CONNECTED:
                 info.state = ConnectionState.DISCONNECTED

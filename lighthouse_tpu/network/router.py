@@ -283,7 +283,20 @@ class Router:
                     inner = cand
             except AttestationError as e:
                 if "unknown head block" in str(e):
-                    continue  # behind — ignore, don't penalize (reference queues)
+                    # Pre-finalization roots can never become the head: reject
+                    # and penalize (reference attestation_verification.rs ->
+                    # is_pre_finalization_block).  Genuinely-unknown roots are
+                    # left to sync's single-block lookup, unpenalized.
+                    root = bytes(attestation.data.beacon_block_root)
+                    if chain.is_pre_finalization_block(root):
+                        self.service.peer_manager.report(
+                            sender, PeerAction.LOW_TOLERANCE,
+                            "attestation to pre-finalization block",
+                        )
+                    elif self.sync is not None:
+                        # genuinely unknown: single-block lookup off-thread
+                        self.sync.lookup_block_async(root, sender)
+                    continue
                 self.service.peer_manager.report(
                     sender, PeerAction.MID_TOLERANCE, f"bad attestation: {e}"
                 )

@@ -13,8 +13,11 @@ import threading
 from typing import Dict, List, Optional
 
 from ..chain.beacon_chain import BlockError
+from ..logs import get_logger
 from . import rpc as rpc_mod
 from .peer_manager import PeerAction
+
+log = get_logger("network.sync")
 
 BATCH_SLOTS = 16  # 2 epochs on the minimal preset (reference: 2-epoch batches)
 PARENT_DEPTH_LIMIT = 32  # reference ``block_lookups`` parent chain bound
@@ -74,12 +77,17 @@ class SyncManager:
 
     def _range_sync(self, peer: str, status: rpc_mod.Status) -> None:
         chain = self.chain
+        log.info("range sync started", peer=peer,
+                 from_slot=chain._blocks_slot(chain.head_root),
+                 target_slot=int(status.head_slot))
         try:
             prev_start = -1
             while True:
                 start = chain._blocks_slot(chain.head_root) + 1
                 if start > status.head_slot:
                     break
+                log.debug("range sync batch", peer=peer, start_slot=start,
+                          target_slot=int(status.head_slot))
                 if start == prev_start:
                     # No head progress over a full batch (e.g. the peer keeps
                     # serving a fork our fork choice doesn't prefer): stop
@@ -112,6 +120,8 @@ class SyncManager:
                         return
         finally:
             self.state = SyncState.SYNCED
+            log.info("range sync finished", peer=peer,
+                     head_slot=chain._blocks_slot(chain.head_root))
 
     def _import_with_blobs(self, peer: str, signed) -> None:
         """Import a synced block, fetching its blob sidecars over
@@ -140,6 +150,56 @@ class SyncManager:
             except Exception as e:
                 raise BlockError(f"undecodable blob sidecar: {e}") from e
         chain.process_block_with_blobs(signed, sidecars)
+
+    # ------------------------------------------------- single-block lookup
+
+    def lookup_block(self, block_root: bytes, peer: str) -> None:
+        """Fetch one unknown block by root (attestation-triggered single
+        block lookup, reference ``block_lookups/single_block_lookup.rs``) and
+        import it.  A served-but-unimportable block is remembered in the
+        pre-finalization cache so future attestations to it are rejected
+        outright and their senders penalized."""
+        chain = self.chain
+        block_root = bytes(block_root)
+        if chain.fork_choice.contains_block(block_root):
+            return
+        try:
+            chunks = self.service.request(
+                peer,
+                rpc_mod.BLOCKS_BY_ROOT,
+                rpc_mod.BlocksByRootRequest(roots=[block_root]),
+                timeout=5.0,
+            )
+        except rpc_mod.RpcError:
+            return
+        got = [c for c in chunks if c[0] == rpc_mod.SUCCESS]
+        if not got:
+            return  # peer doesn't have it either: learn nothing
+        try:
+            signed = self._decode_block_chunk(got[0][1])
+            chain.process_block(signed)
+            log.debug("single-block lookup imported", root=block_root.hex()[:16],
+                      peer=peer)
+        except BlockError as e:
+            if "unknown parent" in str(e):
+                try:
+                    self.on_unknown_parent(signed, peer)
+                    if chain.fork_choice.contains_block(block_root):
+                        return
+                except Exception:
+                    pass
+            # The block exists but cannot join our chain: treat as
+            # pre-finalization/unviable (reference
+            # pre_finalization_block_rejected).
+            chain.pre_finalization_cache.block_rejected(block_root)
+            log.debug("single-block lookup rejected", root=block_root.hex()[:16],
+                      reason=str(e)[:80])
+
+    def lookup_block_async(self, block_root: bytes, peer: str) -> None:
+        threading.Thread(
+            target=self.lookup_block, args=(bytes(block_root), peer),
+            daemon=True, name="single-block-lookup",
+        ).start()
 
     # ------------------------------------------------------ parent lookup
 

@@ -135,9 +135,9 @@ class ValidatorClient:
     def run_forever(self, *, genesis_time: int, stop_after_slots: Optional[int] = None):
         """Wall-clock loop: propose at slot start, attest at +1/3, aggregate
         at +2/3 (the reference's slot-timing contract)."""
-        import logging
+        from ..logs import get_logger
 
-        log = logging.getLogger("validator_client")
+        log = get_logger("vc")
         sps = self.spec.seconds_per_slot
 
         def safely(what, fn, *args):

@@ -18,6 +18,11 @@ from ..http_api.serde import container_from_json
 from .validator_store import ValidatorStore
 
 
+from ..logs import get_logger
+
+log = get_logger("vc")
+
+
 class NoViableBeaconNode(Exception):
     pass
 
@@ -390,6 +395,8 @@ class AttestationService:
             self.fallback.first_success(
                 lambda c: c.submit_attestations(attestations)
             )
+            log.info("attestations published", slot=int(slot),
+                     count=len(attestations))
         return len(attestations)
 
     def aggregate(self, slot: int) -> int:
@@ -483,7 +490,10 @@ class BlockService:
         sig = self.store.sign_block(pubkey, block)  # slashing DB veto point
         signed = self.types.signed_block[fork](message=block, signature=sig)
         self.fallback.first_success(lambda c: c.publish_block(signed))
-        return block.hash_tree_root()
+        root = block.hash_tree_root()
+        log.info("block proposed", slot=int(slot),
+                 root="0x" + root.hex()[:16], path="local")
+        return root
 
     def _propose_blinded(self, slot: int, pubkey: bytes, reveal: bytes) -> bytes:
         resp = self.fallback.first_success(
@@ -494,4 +504,7 @@ class BlockService:
         sig = self.store.sign_block(pubkey, block)  # same slashing veto
         signed = self.types.signed_blinded_block[fork](message=block, signature=sig)
         self.fallback.first_success(lambda c: c.publish_blinded_block(signed))
-        return block.hash_tree_root()
+        root = block.hash_tree_root()
+        log.info("block proposed", slot=int(slot),
+                 root="0x" + root.hex()[:16], path="builder")
+        return root

@@ -1,0 +1,149 @@
+"""fork_revert + pre-finalization cache (VERDICT r4 item 9; reference
+``beacon_chain/src/fork_revert.rs``, ``pre_finalization_cache.rs``)."""
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.chain.fork_revert import (
+    ForkRevertError,
+    revert_to_fork_boundary,
+    reset_fork_choice_to_finalization,
+)
+from lighthouse_tpu.crypto.bls.backends import set_backend
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+@pytest.fixture()
+def harness():
+    set_backend("fake")
+    yield BeaconChainHarness(validator_count=16, fake_crypto=True)
+    set_backend("host")
+
+
+def test_reset_fork_choice_to_finalization(harness):
+    chain = harness.chain
+    spe = harness.spec.slots_per_epoch
+    harness.extend_chain(spe * 5)  # enough for finality on minimal
+    assert chain.finalized_checkpoint()[0] >= 1
+    head_before = chain.head_root
+    fin_before = chain.finalized_checkpoint()
+
+    # Simulate an unsound persisted fork choice: replace it wholesale.
+    chain.reset_fork_choice_to_finalization()
+
+    assert chain.head_root == head_before, "canonical head must survive reset"
+    fc = chain.fork_choice
+    assert fc.finalized_checkpoint[1] == fin_before[1]
+    # the rebuilt proto-array spans anchor..head
+    assert fc.is_descendant(fin_before[1], head_before)
+    # and the node still extends the chain afterwards
+    harness.extend_chain(2)
+    assert chain.head_root != head_before
+
+
+def test_reset_fork_choice_forgets_side_branches(harness):
+    chain = harness.chain
+    harness.extend_chain(3)
+    # a side block at slot 3's fork
+    roots = list(chain._blocks)
+    harness.advance_slot()
+    side = harness.produce_signed_block(
+        slot=chain.current_slot(), graffiti=b"\x13" * 32,
+        parent_root=chain.head_root,
+    )
+    canon = harness.produce_signed_block(slot=chain.current_slot())
+    c_root = chain.process_block(canon, block_delay_seconds=1.0)
+    s_root = chain.process_block(side, block_delay_seconds=20.0)
+    assert s_root in chain.fork_choice.proto.indices
+
+    chain.reset_fork_choice_to_finalization()
+    # the replay follows only the canonical ancestry: side branch forgotten
+    assert s_root not in chain.fork_choice.proto.indices
+    assert chain.head_root in chain.fork_choice.proto.indices
+    del roots
+
+
+def test_revert_to_fork_boundary():
+    set_backend("fake")
+    try:
+        spec = minimal_spec(
+            altair_fork_epoch=0, bellatrix_fork_epoch=0, capella_fork_epoch=2,
+            deneb_fork_epoch=None,
+        )
+        h = BeaconChainHarness(validator_count=16, fake_crypto=True, spec=spec)
+        chain = h.chain
+        spe = spec.slots_per_epoch
+        h.extend_chain(spe * 3)  # well past the capella boundary at slot 2*spe
+        boundary = 2 * spe
+        assert spec.fork_name_at_slot(chain.current_slot()) == "capella"
+
+        root, block = revert_to_fork_boundary(chain, chain.current_slot())
+        assert block is not None
+        assert int(block.message.slot) < boundary
+        # it is the LAST pre-fork ancestor: the child at/after the boundary
+        # has it as parent on the canonical chain
+        assert chain.fork_choice.is_descendant(root, chain.head_root)
+    finally:
+        set_backend("host")
+
+
+def test_revert_refuses_phase0():
+    set_backend("fake")
+    try:
+        spec = minimal_spec(
+            altair_fork_epoch=None, bellatrix_fork_epoch=None,
+            capella_fork_epoch=None, deneb_fork_epoch=None,
+        )
+        h = BeaconChainHarness(validator_count=16, fake_crypto=True, spec=spec)
+        h.extend_chain(2)
+        with pytest.raises(ForkRevertError, match="phase0"):
+            revert_to_fork_boundary(h.chain, h.chain.current_slot())
+    finally:
+        set_backend("host")
+
+
+class TestPreFinalizationCache:
+    def test_recent_history_and_disk_hits(self, harness):
+        chain = harness.chain
+        spe = harness.spec.slots_per_epoch
+        harness.extend_chain(spe * 2)
+        # (1) recent-history path: an old canonical root answers from the
+        # head state's block-roots vector, no disk touch (the caller's
+        # contract is that fork choice does not know the root).
+        old_root = bytes(chain.head_state.block_roots[1])
+        assert chain.is_pre_finalization_block(old_root) is True
+        # cached now: a second query answers from memory
+        assert chain.pre_finalization_cache.contains(old_root)
+
+        # (2) disk path: a block present in the STORE but on no chain the
+        # head state remembers (a pruned branch survivor).
+        slot = harness.advance_slot()
+        orphan = harness.produce_signed_block(slot=slot, graffiti=b"\x77" * 32)
+        orphan_root = orphan.message.hash_tree_root()
+        chain.db.put_block(orphan_root, orphan)
+        assert chain.is_pre_finalization_block(orphan_root) is True
+        assert chain.pre_finalization_cache.contains(orphan_root)
+
+    def test_unknown_root_defers_to_lookup_then_rejects(self, harness):
+        chain = harness.chain
+        harness.extend_chain(2)
+        mystery = b"\x5a" * 32
+        assert chain.is_pre_finalization_block(mystery) is False
+        # de-duplicated while the lookup is in flight
+        assert chain.is_pre_finalization_block(mystery) is False
+        _, in_progress = chain.pre_finalization_cache.metrics()
+        assert in_progress == 1
+        # sync's lookup discovered it is pre-finalization after all
+        chain.pre_finalization_cache.block_rejected(mystery)
+        assert chain.is_pre_finalization_block(mystery) is True
+
+    def test_import_clears_in_progress(self, harness):
+        chain = harness.chain
+        harness.extend_chain(1)
+        slot = harness.advance_slot()
+        block = harness.produce_signed_block(slot=slot)
+        root = block.message.hash_tree_root()
+        assert chain.is_pre_finalization_block(root) is False  # registers lookup
+        chain.process_block(block)
+        _, in_progress = chain.pre_finalization_cache.metrics()
+        assert in_progress == 0

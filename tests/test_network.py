@@ -208,6 +208,24 @@ class TestSync:
         finally:
             na.shutdown(); nb.shutdown()
 
+    def test_attestation_triggered_single_block_lookup(self):
+        """An attestation to a block b never saw triggers the single-block
+        lookup (reference block_lookups/single_block_lookup.rs), importing
+        it by root from the sender."""
+        hub, na, nb = two_nodes()
+        try:
+            hub.connect("a", "b")
+            na.harness.advance_slot()
+            nb.harness.advance_slot()
+            signed = na.harness.produce_signed_block()
+            root = na.chain.process_block(signed, block_delay_seconds=1.0)
+            # b never hears the block on gossip; hand it the root directly
+            nb.sync.lookup_block(root, "a")
+            assert nb.chain.get_block(root) is not None
+            assert nb.chain.fork_choice.contains_block(root)
+        finally:
+            na.shutdown(); nb.shutdown()
+
     def test_parent_lookup_on_gossip_gap(self):
         hub, na, nb = two_nodes()
         try:
