@@ -83,13 +83,16 @@ class PreFinalizationBlockCache:
         # 1. Recent history: the head state's block-roots vector covers the
         #    last SLOTS_PER_HISTORICAL_ROOT slots without touching disk
         #    (O(1) against the per-head frozenset snapshot).
-        if block_root in self._head_history(chain):
-            with self._lock:
-                self._block_roots.put(block_root)
-            return True
         # 2. Disk: a stored block that fork choice does NOT know is on a
         #    pruned (pre-finalization) branch.
-        if chain.db.get_block(block_root) is not None:
+        if (block_root in self._head_history(chain)
+                or chain.db.get_block(block_root) is not None):
+            # Re-check fork choice AFTER the store read: a concurrent import
+            # may have landed between the caller's fork-choice miss and now —
+            # a freshly-imported head must not be classified ancient (and
+            # its attester penalized).
+            if chain.fork_choice.contains_block(block_root):
+                return False
             with self._lock:
                 self._block_roots.put(block_root)
             return True

@@ -49,6 +49,7 @@ class SyncManager:
         self.state = SyncState.SYNCED
         self._lock = threading.Lock()
         self._sync_thread: Optional[threading.Thread] = None
+        self._lookups_in_flight: set = set()
 
     # ------------------------------------------------------------- status
 
@@ -153,51 +154,87 @@ class SyncManager:
 
     # ------------------------------------------------- single-block lookup
 
+    # BlockError fragments that are TRANSIENT: the block may import fine
+    # later (clock skew, blobs still propagating, ancestry still fetching) —
+    # they must never poison the root as pre-finalization.
+    _TRANSIENT_BLOCK_ERRORS = ("future slot", "pending availability",
+                               "unknown parent")
+    MAX_CONCURRENT_LOOKUPS = 8
+
     def lookup_block(self, block_root: bytes, peer: str) -> None:
         """Fetch one unknown block by root (attestation-triggered single
         block lookup, reference ``block_lookups/single_block_lookup.rs``) and
-        import it.  A served-but-unimportable block is remembered in the
-        pre-finalization cache so future attestations to it are rejected
-        outright and their senders penalized."""
+        import it.  Only a root-verified block that PERMANENTLY fails import
+        is remembered as rejected — a transient failure or a peer serving
+        the wrong bytes must not let an attacker poison an honest root."""
         chain = self.chain
         block_root = bytes(block_root)
-        if chain.fork_choice.contains_block(block_root):
-            return
         try:
-            chunks = self.service.request(
-                peer,
-                rpc_mod.BLOCKS_BY_ROOT,
-                rpc_mod.BlocksByRootRequest(roots=[block_root]),
-                timeout=5.0,
-            )
-        except rpc_mod.RpcError:
-            return
-        got = [c for c in chunks if c[0] == rpc_mod.SUCCESS]
-        if not got:
-            return  # peer doesn't have it either: learn nothing
-        try:
-            signed = self._decode_block_chunk(got[0][1])
-            chain.process_block(signed)
-            log.debug("single-block lookup imported", root=block_root.hex()[:16],
-                      peer=peer)
-        except BlockError as e:
-            if "unknown parent" in str(e):
-                try:
-                    self.on_unknown_parent(signed, peer)
+            if chain.fork_choice.contains_block(block_root):
+                return
+            try:
+                chunks = self.service.request(
+                    peer,
+                    rpc_mod.BLOCKS_BY_ROOT,
+                    rpc_mod.BlocksByRootRequest(roots=[block_root]),
+                    timeout=5.0,
+                )
+            except rpc_mod.RpcError:
+                return
+            got = [c for c in chunks if c[0] == rpc_mod.SUCCESS]
+            if not got:
+                return  # peer doesn't have it either: learn nothing
+            try:
+                signed = self._decode_block_chunk(got[0][1])
+            except Exception:
+                self.service.peer_manager.report(
+                    peer, PeerAction.LOW_TOLERANCE, "undecodable lookup block")
+                return
+            if signed.message.hash_tree_root() != block_root:
+                # The response is NOT the requested block: penalize the
+                # server; the root itself has proven nothing.
+                self.service.peer_manager.report(
+                    peer, PeerAction.LOW_TOLERANCE,
+                    "lookup block root mismatch")
+                return
+            try:
+                self._import_with_blobs(peer, signed)
+                log.debug("single-block lookup imported",
+                          root=block_root.hex()[:16], peer=peer)
+            except BlockError as e:
+                msg = str(e)
+                if "unknown parent" in msg:
+                    try:
+                        self.on_unknown_parent(signed, peer)
+                    except Exception:
+                        pass
                     if chain.fork_choice.contains_block(block_root):
                         return
-                except Exception:
-                    pass
-            # The block exists but cannot join our chain: treat as
-            # pre-finalization/unviable (reference
-            # pre_finalization_block_rejected).
-            chain.pre_finalization_cache.block_rejected(block_root)
-            log.debug("single-block lookup rejected", root=block_root.hex()[:16],
-                      reason=str(e)[:80])
+                if any(t in msg for t in self._TRANSIENT_BLOCK_ERRORS):
+                    return  # may import later: learn nothing yet
+                # Root-verified block, permanent rejection: remember
+                # (reference pre_finalization_block_rejected).
+                chain.pre_finalization_cache.block_rejected(block_root)
+                log.debug("single-block lookup rejected",
+                          root=block_root.hex()[:16], reason=msg[:80])
+        finally:
+            with self._lock:
+                self._lookups_in_flight.discard(block_root)
 
     def lookup_block_async(self, block_root: bytes, peer: str) -> None:
+        """Bounded, de-duplicated spawn: one thread per distinct root, at
+        most MAX_CONCURRENT_LOOKUPS in flight (gossip flooding random roots
+        must not exhaust threads — the DoS the pre-finalization cache
+        exists to blunt)."""
+        block_root = bytes(block_root)
+        with self._lock:
+            if block_root in self._lookups_in_flight:
+                return
+            if len(self._lookups_in_flight) >= self.MAX_CONCURRENT_LOOKUPS:
+                return
+            self._lookups_in_flight.add(block_root)
         threading.Thread(
-            target=self.lookup_block, args=(bytes(block_root), peer),
+            target=self.lookup_block, args=(block_root, peer),
             daemon=True, name="single-block-lookup",
         ).start()
 

@@ -106,14 +106,24 @@ class TestPreFinalizationCache:
     def test_recent_history_and_disk_hits(self, harness):
         chain = harness.chain
         spe = harness.spec.slots_per_epoch
-        harness.extend_chain(spe * 2)
-        # (1) recent-history path: an old canonical root answers from the
-        # head state's block-roots vector, no disk touch (the caller's
-        # contract is that fork choice does not know the root).
+        # prune aggressively so finalized history actually leaves fork
+        # choice (default threshold keeps small proto-arrays unpruned)
+        chain.fork_choice.proto.prune_threshold = 0
+        harness.extend_chain(spe * 5)
+        assert chain.finalized_checkpoint()[0] >= 1
+        # (1) recent-history path: an old canonical root PRUNED from fork
+        # choice answers from the head state's block-roots vector.
         old_root = bytes(chain.head_state.block_roots[1])
+        assert not chain.fork_choice.contains_block(old_root), \
+            "test needs a pruned root"
         assert chain.is_pre_finalization_block(old_root) is True
         # cached now: a second query answers from memory
         assert chain.pre_finalization_cache.contains(old_root)
+
+        # a root fork choice still KNOWS is never classified (race guard:
+        # a concurrent import must not get its attester penalized)
+        known = chain.head_root
+        assert chain.is_pre_finalization_block(known) is False
 
         # (2) disk path: a block present in the STORE but on no chain the
         # head state remembers (a pruned branch survivor).

@@ -20,7 +20,8 @@ def test_basic_sim_three_nodes_finalize():
     from lighthouse_tpu.logs import RING, setup_logging
 
     setup_logging()
-    seq_before = RING._seq
+    tail = RING.tail(1)
+    seq_before = tail[-1]["seq"] if tail else 0
     sim = Simulator(node_count=3, validator_count=16)
     try:
         sim.run_epochs(5)
