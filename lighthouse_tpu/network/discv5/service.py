@@ -51,6 +51,11 @@ class _PendingRequest:
     event: threading.Event = field(default_factory=threading.Event)
     responses: List = field(default_factory=list)
     total_expected: int = 1
+    created: float = field(default_factory=time.monotonic)
+
+PENDING_TTL = 10.0     # s: un-answered handshake elicitations
+CHALLENGE_TTL = 30.0   # s: WHOAREYOU challenges we issued
+MAX_ADDRS = 4096       # spoofed src-id flood bound
 
 
 def _enr_to_item(enr: ENR):
@@ -80,7 +85,7 @@ class Discv5Service:
         self._sessions: Dict[bytes, Session] = {}          # node-id -> keys
         self._pending: Dict[bytes, _PendingRequest] = {}   # nonce -> request
         self._requests: Dict[bytes, _PendingRequest] = {}  # request-id -> req
-        self._challenges: Dict[bytes, packets.Packet] = {} # node-id -> sent WHOAREYOU
+        self._challenges: Dict[bytes, Tuple[packets.Packet, float]] = {}  # node-id -> (WHOAREYOU, ts)
         self._addrs: Dict[bytes, Tuple[str, int]] = {}     # node-id -> addr
         # routing table: node-id -> ENR (flat; bucketized on query)
         self.table: Dict[bytes, ENR] = {}
@@ -148,10 +153,19 @@ class Discv5Service:
         ad = masking_iv + header.encode()
         ct = packets.encrypt_message(sess.send_key, nonce, plaintext, ad)
         datagram = packets.encode_packet(dest_id, header, ct, masking_iv=masking_iv)
+        if req is not None:
+            # Register even sessioned sends: if the peer LOST its session
+            # (restart), it answers WHOAREYOU with this nonce and we must be
+            # able to replay the request through a fresh handshake.
+            with self._lock:
+                self._pending[nonce] = req
         self._sock.sendto(datagram, addr)
 
     def _request(self, dest: ENR, plaintext: bytes, request_id: bytes,
                  timeout: float = REQUEST_TIMEOUT) -> List:
+        # The handshake resolves the peer through the table: every request
+        # target must be there (a hidden add_enr precondition otherwise).
+        self.add_enr(dest)
         req = _PendingRequest(message=plaintext, request_id=request_id)
         with self._lock:
             self._requests[request_id] = req
@@ -163,6 +177,8 @@ class Discv5Service:
         finally:
             with self._lock:
                 self._requests.pop(request_id, None)
+                for nonce in [n for n, r in self._pending.items() if r is req]:
+                    del self._pending[nonce]
 
     # -------------------------------------------------------------- public
 
@@ -235,8 +251,27 @@ class Discv5Service:
 
     # ------------------------------------------------------------- receive
 
+    def _gc(self) -> None:
+        """Expire stale handshake state: timed-out pendings, old
+        challenges, and the addr map's size bound — per-packet state must
+        not accumulate under churn or a spoofed-src flood."""
+        now = time.monotonic()
+        with self._lock:
+            for nonce in [n for n, r in self._pending.items()
+                          if now - r.created > PENDING_TTL]:
+                del self._pending[nonce]
+            for nid in [n for n, (_, ts) in self._challenges.items()
+                        if now - ts > CHALLENGE_TTL]:
+                del self._challenges[nid]
+            while len(self._addrs) > MAX_ADDRS:
+                self._addrs.pop(next(iter(self._addrs)))
+
     def _rx_loop(self) -> None:
+        last_gc = time.monotonic()
         while self._running:
+            if time.monotonic() - last_gc > 5.0:
+                self._gc()
+                last_gc = time.monotonic()
             try:
                 datagram, addr = self._sock.recvfrom(2048)
             except socket.timeout:
@@ -276,6 +311,11 @@ class Discv5Service:
         if dest is None:
             return
         dest_id = dest.node_id
+        # Any session we held with this peer is stale (it sent WHOAREYOU
+        # because it cannot decrypt us — e.g. it restarted): drop it so the
+        # fresh handshake keys take over.
+        with self._lock:
+            self._sessions.pop(dest_id, None)
         challenge_data = pkt.challenge_data
         eph = KeyPair()
         init_key, recp_key = session_mod.derive_keys(
@@ -305,9 +345,10 @@ class Discv5Service:
             pkt.header.authdata
         )
         with self._lock:
-            challenge = self._challenges.pop(src_id, None)
-        if challenge is None:
+            entry = self._challenges.pop(src_id, None)
+        if entry is None:
             return
+        challenge, _ts = entry
         challenge_data = challenge.challenge_data
         if enr_rlp:
             enr = ENR.from_rlp(enr_rlp)
@@ -365,7 +406,7 @@ class Discv5Service:
             masking_iv = secrets.token_bytes(16)
             challenge = packets.Packet(masking_iv, header, b"")
             with self._lock:
-                self._challenges[src_id] = challenge
+                self._challenges[src_id] = (challenge, time.monotonic())
                 self._addrs[src_id] = addr
             self._sock.sendto(
                 packets.encode_packet(src_id, header, b"", masking_iv=masking_iv),

@@ -154,11 +154,14 @@ class SyncManager:
 
     # ------------------------------------------------- single-block lookup
 
-    # BlockError fragments that are TRANSIENT: the block may import fine
-    # later (clock skew, blobs still propagating, ancestry still fetching) —
-    # they must never poison the root as pre-finalization.
+    # BlockError fragments that are TRANSIENT or PEER-ATTRIBUTABLE: the
+    # block may import fine later (clock skew, ancestry still fetching) or
+    # a different peer may serve good sidecars ("blob" covers missing /
+    # undecodable / unverifiable sidecars — blob faults belong to the
+    # serving peer, not the root).  None of these may poison the root as
+    # pre-finalization.
     _TRANSIENT_BLOCK_ERRORS = ("future slot", "pending availability",
-                               "unknown parent")
+                               "unknown parent", "blob")
     MAX_CONCURRENT_LOOKUPS = 8
 
     def lookup_block(self, block_root: bytes, peer: str) -> None:

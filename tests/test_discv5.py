@@ -134,6 +134,30 @@ class TestLiveNodes:
         finally:
             a.stop(); b.stop()
 
+    def test_session_recovers_after_peer_restart(self):
+        """A peer that lost its session (restart) answers WHOAREYOU to our
+        sessioned packet; the request must replay through a fresh handshake
+        instead of timing out forever on stale keys."""
+        a = Discv5Service(KeyPair()).start()
+        b = Discv5Service(KeyPair()).start()
+        try:
+            assert a.ping(b.enr) == 1
+            b._sessions.clear()  # simulate b restarting
+            assert a.ping(b.enr) == 1
+            # both sides ended on fresh working keys
+            assert a.ping(b.enr) == 1
+        finally:
+            a.stop(); b.stop()
+
+    def test_ping_without_prior_add_enr(self):
+        """The public request APIs must not hide an add_enr precondition."""
+        a = Discv5Service(KeyPair()).start()
+        b = Discv5Service(KeyPair()).start()
+        try:
+            assert a.ping(b.enr) == 1  # no add_enr first
+        finally:
+            a.stop(); b.stop()
+
     def test_bootstrap_discovers_peers(self):
         boot = Discv5Service(KeyPair()).start()
         others = [Discv5Service(KeyPair()).start() for _ in range(3)]
