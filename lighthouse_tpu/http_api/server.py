@@ -1956,6 +1956,10 @@ def _block_rewards_range(ctx, start_slot: int, end_slot: int):
         if slot <= end_slot:
             r = _block_rewards(chain, root)
             if r is not None:
+                # analysis-layer enrichment (watch keys rows by slot); the
+                # standard /eth/v1/beacon/rewards/blocks response keeps the
+                # bare spec shape.
+                r = dict(r, slot=str(slot), block_root="0x" + root.hex())
                 out.append(r)
         blk = chain.get_block(root)
         if blk is None:

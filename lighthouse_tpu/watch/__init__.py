@@ -36,6 +36,22 @@ CREATE TABLE IF NOT EXISTS attestation_performance (
     head INTEGER NOT NULL,
     PRIMARY KEY (epoch, validator)
 );
+CREATE TABLE IF NOT EXISTS block_packing (
+    slot INTEGER PRIMARY KEY,
+    available INTEGER NOT NULL,
+    included INTEGER NOT NULL,
+    prior_skip_slots INTEGER NOT NULL
+);
+CREATE TABLE IF NOT EXISTS block_rewards (
+    slot INTEGER PRIMARY KEY,
+    total INTEGER NOT NULL,
+    attestation_reward INTEGER NOT NULL,
+    sync_committee_reward INTEGER NOT NULL
+);
+CREATE TABLE IF NOT EXISTS blockprint (
+    slot INTEGER PRIMARY KEY,
+    best_guess TEXT NOT NULL
+);
 """
 
 
@@ -62,6 +78,33 @@ class WatchDB:
         with self._lock:
             self._conn.execute(
                 "INSERT OR IGNORE INTO skipped_slots VALUES (?)", (slot,)
+            )
+            self._conn.commit()
+
+    def record_block_packing(self, slot: int, available: int, included: int,
+                             prior_skip_slots: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO block_packing VALUES (?,?,?,?)",
+                (slot, available, included, prior_skip_slots),
+            )
+            self._conn.commit()
+
+    def record_block_rewards(self, slot: int, total: int,
+                             attestation_reward: int,
+                             sync_committee_reward: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO block_rewards VALUES (?,?,?,?)",
+                (slot, total, attestation_reward, sync_committee_reward),
+            )
+            self._conn.commit()
+
+    def record_blockprint(self, slot: int, best_guess: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO blockprint VALUES (?,?)",
+                (slot, best_guess),
             )
             self._conn.commit()
 
@@ -119,6 +162,49 @@ class WatchDB:
         return [{"validator": v, "source": bool(s), "target": bool(t),
                  "head": bool(h)} for v, s, t, h in rows]
 
+    def block_packing(self, slot: int) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT slot, available, included, prior_skip_slots FROM "
+                "block_packing WHERE slot=?", (slot,),
+            ).fetchone()
+        if row is None:
+            return None
+        avail = row[1]
+        return {"slot": row[0], "available": avail, "included": row[2],
+                "prior_skip_slots": row[3],
+                "efficiency": (row[2] / avail) if avail else 0.0}
+
+    def block_rewards(self, slot: int) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT slot, total, attestation_reward, "
+                "sync_committee_reward FROM block_rewards WHERE slot=?",
+                (slot,),
+            ).fetchone()
+        if row is None:
+            return None
+        return {"slot": row[0], "total": row[1],
+                "attestation_reward": row[2],
+                "sync_committee_reward": row[3]}
+
+    def blockprint_at(self, slot: int) -> Optional[str]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT best_guess FROM blockprint WHERE slot=?", (slot,),
+            ).fetchone()
+        return row[0] if row else None
+
+    def blockprint_summary(self) -> Dict[str, int]:
+        """Client-diversity counts over all fingerprinted blocks
+        (reference blockprint's aggregate view)."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT best_guess, COUNT(*) FROM blockprint GROUP BY "
+                "best_guess ORDER BY COUNT(*) DESC",
+            ).fetchall()
+        return {guess: n for guess, n in rows}
+
     def participation_rate(self, epoch: int) -> Optional[dict]:
         with self._lock:
             row = self._conn.execute(
@@ -137,6 +223,21 @@ class WatchDB:
             self._conn.close()
 
 
+def blockprint_guess(graffiti: str) -> str:
+    """Heuristic client fingerprint from the block's visible shape.
+
+    The reference's blockprint subsystem defers to an external ML service;
+    offline, the strongest public signal is the graffiti convention each
+    client ships by default."""
+    g = graffiti.lower()
+    for needle, name in (("lighthouse", "Lighthouse"), ("teku", "Teku"),
+                         ("nimbus", "Nimbus"), ("prysm", "Prysm"),
+                         ("lodestar", "Lodestar"), ("grandine", "Grandine")):
+        if needle in g:
+            return name
+    return "Uncertain"
+
+
 class WatchUpdater:
     """Poll a beacon node into the DB (reference watch's updater loop)."""
 
@@ -145,6 +246,7 @@ class WatchUpdater:
         self.db = db
         self.spec = spec
         self._last_rewards_epoch: Optional[int] = None
+        self._packing_frontier_epoch: int = 0
 
     def update(self) -> int:
         """One round: ingest new slots up to the node's head; pull
@@ -153,23 +255,39 @@ class WatchUpdater:
         head = self.client.block_header("head")
         head_slot = int(head["header"]["message"]["slot"])
         start = (self.db.highest_slot() or 0) + 1
+        try:
+            ingested, last_done = self._ingest_blocks(start, head_slot)
+        finally:
+            # Analytics must cover every slot that actually landed, even
+            # when the block loop aborted mid-round (a transient error must
+            # not leave a permanent packing/rewards gap).
+            if last_done >= start:
+                self._ingest_packing_and_rewards(start, last_done)
+        self._maybe_pull_rewards_performance(head_slot)
+        return ingested
+
+    def _ingest_blocks(self, start: int, head_slot: int):
         from ..http_api.client import ApiClientError
 
+        head = self.client.block_header("head")
         ingested = 0
+        last_done = start - 1
         for slot in range(start, head_slot + 1):
             try:
                 resp = self.client.block(str(slot))
             except ApiClientError as e:
                 if e.code == 404:
                     self.db.record_skipped(slot)  # genuinely empty slot
+                    last_done = slot
                     continue
-                return ingested  # node-side error: retry this slot next round
+                return ingested, last_done  # node-side error: retry next round
             except OSError:
-                return ingested  # transient transport failure: never record
-                                 # a live slot as skipped
+                return ingested, last_done  # transient transport failure:
+                                            # never record a live slot skipped
             msg = resp["data"]["message"]
             if int(msg["slot"]) != slot:
                 self.db.record_skipped(slot)
+                last_done = slot
                 continue
             body = msg["body"]
             sync_part = None
@@ -179,17 +297,23 @@ class WatchUpdater:
                 total = self.spec.preset.sync_committee_size
                 ones = sum(bin(b).count("1") for b in raw)
                 sync_part = min(1.0, ones / total)
+            att_count = len(body.get("attestations", []))
+            graffiti = body.get("graffiti", "")
             self.db.record_block(
                 slot=slot,
                 root=bytes.fromhex(head["root"][2:]) if slot == head_slot
                 else self._root_for(slot),
                 proposer=int(msg["proposer_index"]),
-                attestation_count=len(body.get("attestations", [])),
+                attestation_count=att_count,
                 sync_participation=sync_part,
-                graffiti=body.get("graffiti", ""),
+                graffiti=graffiti,
             )
+            self.db.record_blockprint(slot, blockprint_guess(graffiti))
             ingested += 1
+            last_done = slot
+        return ingested, last_done
 
+    def _maybe_pull_rewards_performance(self, head_slot: int) -> None:
         spe = self.spec.slots_per_epoch
         completed_epoch = head_slot // spe - 2
         if completed_epoch >= 0 and completed_epoch != self._last_rewards_epoch:
@@ -203,7 +327,40 @@ class WatchUpdater:
                 self._last_rewards_epoch = completed_epoch
             except Exception:
                 pass  # rewards unavailable (pruned state): analytics are best-effort
-        return ingested
+
+    def _ingest_packing_and_rewards(self, start: int, end: int) -> None:
+        """Best-effort packing + rewards pulls for the newly ingested span
+        (reference watch's block_packing and block_rewards updaters)."""
+        spe = self.spec.slots_per_epoch
+        try:
+            # Epoch-granular endpoint: only re-fetch from the frontier (the
+            # last epoch may have been partial when previously pulled).
+            start_epoch = min(start // spe, self._packing_frontier_epoch)
+            resp = self.client.get(
+                "/lighthouse/analysis/block_packing_efficiency"
+                f"?start_epoch={start_epoch}&end_epoch={end // spe}"
+            )
+            for row in resp["data"]:
+                self.db.record_block_packing(
+                    int(row["slot"]), int(row["available_attestations"]),
+                    int(row["included_attestations"]),
+                    int(row["prior_skip_slots"]),
+                )
+            self._packing_frontier_epoch = end // spe
+        except Exception:
+            pass
+        try:
+            resp = self.client.get(
+                f"/lighthouse/analysis/block_rewards?start_slot={max(1, start)}"
+                f"&end_slot={end}"
+            )
+            for row in resp["data"]:
+                self.db.record_block_rewards(
+                    int(row["slot"]), int(row["total"]),
+                    int(row["attestations"]), int(row["sync_aggregate"]),
+                )
+        except Exception:
+            pass
 
     def _root_for(self, slot: int) -> bytes:
         return self.client.block_root(str(slot))
@@ -251,6 +408,30 @@ class WatchServer:
                             self._reply(404, {"message": "epoch not ingested"})
                         else:
                             self._reply(200, {"data": row})
+                        return
+                    if parts[:2] == ["v1", "packing"] and len(parts) == 3:
+                        row = db.block_packing(int(parts[2]))
+                        if row is None:
+                            self._reply(404, {"message": "no packing data"})
+                        else:
+                            self._reply(200, {"data": row})
+                        return
+                    if parts[:2] == ["v1", "rewards"] and len(parts) == 3:
+                        row = db.block_rewards(int(parts[2]))
+                        if row is None:
+                            self._reply(404, {"message": "no rewards data"})
+                        else:
+                            self._reply(200, {"data": row})
+                        return
+                    if parts[:2] == ["v1", "blockprint"] and len(parts) == 3:
+                        if parts[2] == "summary":
+                            self._reply(200, {"data": db.blockprint_summary()})
+                            return
+                        guess = db.blockprint_at(int(parts[2]))
+                        if guess is None:
+                            self._reply(404, {"message": "no blockprint"})
+                        else:
+                            self._reply(200, {"data": {"best_guess": guess}})
                         return
                     if (parts[:2] == ["v1", "suboptimal_attestations"]
                             and len(parts) == 3):

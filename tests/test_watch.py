@@ -78,5 +78,16 @@ def test_watch_http_routes(rig):
         assert part["validators"] == 16
         sub = get(f"/v1/suboptimal_attestations/{spe * 3 // spe - 2}")["data"]
         assert isinstance(sub, list)  # full participation -> usually empty
+
+        # r5 analytics depth: packing, rewards, blockprint (reference
+        # watch/src/{block_packing,block_rewards,blockprint})
+        pack = get("/v1/packing/2")["data"]
+        assert pack["slot"] == 2 and 0.0 <= pack["efficiency"] <= 1.0
+        rew = get("/v1/rewards/2")["data"]
+        assert rew["total"] >= rew["sync_committee_reward"] >= 0
+        bp = get("/v1/blockprint/2")["data"]
+        assert "best_guess" in bp
+        summary = get("/v1/blockprint/summary")["data"]
+        assert sum(summary.values()) >= spe * 3 - 1
     finally:
         ws.stop()
