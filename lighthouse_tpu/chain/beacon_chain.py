@@ -1730,6 +1730,8 @@ class BeaconChain:
                 if r in self._blocks
             ],
         )
+        log.info("hot->cold migration", finalized_slot=f_slot,
+                 abandoned_forks=len(abandoned))
         # Prune object caches: keep finalized root and everything after it.
         for root in abandoned:
             self._states.pop(root, None)

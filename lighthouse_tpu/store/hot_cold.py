@@ -20,7 +20,10 @@ import struct
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
+from ..logs import get_logger
 from .kv import DBColumn, KeyValueStore, MemoryStore, StoreError
+
+log = get_logger("store")
 
 CHUNK_SIZE = 128  # roots per freezer chunk (reference chunked_vector default)
 SCHEMA_VERSION = 1
@@ -406,4 +409,6 @@ class HotColdDB:
             self.delete_state(state_root)
         final_root = canonical_root_at_slot(finalized_slot)
         self.put_split(finalized_slot, final_root or b"\x00" * 32)
+        log.info("freezer migration", split_slot=finalized_slot,
+                 frozen_roots=len(block_roots))
         return len(block_roots)
