@@ -1,0 +1,3 @@
+"""lighthouse-tpu: a TPU-native Ethereum consensus-layer framework."""
+
+__version__ = "0.2.0"

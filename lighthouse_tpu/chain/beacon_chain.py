@@ -384,6 +384,11 @@ class BeaconChain:
         from .pre_finalization_cache import PreFinalizationBlockCache
 
         self.pre_finalization_cache = PreFinalizationBlockCache()
+        from .graffiti_calculator import GraffitiCalculator
+
+        self.graffiti_calculator = GraffitiCalculator(
+            execution_engine=self.execution_engine
+        )
 
     # ------------------------------------------------------------- storage
 
@@ -713,6 +718,19 @@ class BeaconChain:
 
         with metrics.BLOCK_FORK_CHOICE_SECONDS.time():
             self.recompute_head()
+        if self.head_root == block_root:
+            # Score strictly against the CANONICAL chain: only the block
+            # that fork choice just made head may consume simulated votes
+            # (a side-fork post-state would grade them against the wrong
+            # branch and destroy them).
+            self.validator_monitor.score_simulated_attestations(
+                state, self.spec, h
+            )
+        if int(block.slot) == current_slot:
+            # Re-vote the simulator for this slot now its block is here:
+            # the reference fires at +1/3 INTO the slot (after a timely
+            # block); the slot-start vote stands only for empty slots.
+            self.simulate_attestation()
         self.events.block(slot=int(block.slot), block_root=block_root)
         # Reference beacon_chain.rs logs every import with slot/root/delay
         # (the notifier and Siren both read these).
@@ -1257,6 +1275,9 @@ class BeaconChain:
         if the caller has it (avoids re-advancing); it will be mutated.
         Returns ``(block, post_state_root)``; caller signs."""
         types, spec = self.types, self.spec
+        # Graffiti precedence (graffiti_calculator.rs): VC-provided wins;
+        # otherwise operator flag, then the calculated EL+CL version string.
+        graffiti = self.graffiti_calculator.get_graffiti(graffiti)
         if pre_state is not None:
             if parent_root is None:
                 raise ChainError("pre_state requires an explicit parent_root")
@@ -1742,11 +1763,29 @@ class BeaconChain:
         self.fork_choice.prune()
         self._migrated_slot = f_slot
 
+    def simulate_attestation(self) -> None:
+        """Produce (but never publish) one committee-0 attestation for the
+        current slot and hand it to the validator monitor for later scoring
+        (reference ``attestation_simulator.rs``): a free per-slot measure of
+        what OUR view would have voted, scored against the canonical chain
+        once the truth for the slot is knowable.  Skipped while syncing
+        (head > 2 epochs behind — old-state committees are a burden)."""
+        slot = self.current_slot()
+        tolerance = 2 * self.spec.slots_per_epoch
+        if self._blocks_slot(self.head_root) + tolerance < slot:
+            return
+        try:
+            data = self.produce_attestation_data(slot, 0)
+        except Exception:
+            return
+        self.validator_monitor.set_unaggregated_attestation(slot, data)
+
     def per_slot_task(self) -> None:
         """Per-slot tick (reference ``timer`` → ``per_slot_task``)."""
         slot = self.current_slot()
         self.fork_choice.update_time(slot)
         self.recompute_head()
+        self.simulate_attestation()
         self.attestation_pool.prune(slot)
         self.sync_contribution_pool.prune(slot)
         self.op_pool.prune(self.head_state, self.spec, current_slot=slot)

@@ -91,6 +91,13 @@ class MockExecutionEngine:
         self._record_body(payload)
         return bytes(payload.block_hash) not in self.invalid_hashes
 
+    def get_client_version(self) -> dict:
+        """engine_getClientVersionV1 (graffiti_calculator's EL identity)."""
+        if self.offline:
+            raise ConnectionError("mock execution engine offline")
+        return {"code": "MK", "name": "mock-el", "version": "0.1.0",
+                "commit": "deadbeef"}
+
     # ------------------------------------------------------- payload bodies
 
     def get_payload_bodies_by_hash(self, hashes):

@@ -29,6 +29,24 @@ MONITORED_BLOCKS = metrics.counter(
 MONITORED_COUNT = metrics.gauge(
     "validator_monitor_validators", "number of monitored validators",
 )
+SIMULATOR_HEAD_HITS = metrics.counter(
+    "validator_monitor_attestation_simulator_head_attester_hits_total",
+    "simulated attestations whose head vote matched the canonical chain",
+)
+SIMULATOR_HEAD_MISSES = metrics.counter(
+    "validator_monitor_attestation_simulator_head_attester_misses_total",
+    "simulated attestations whose head vote missed",
+)
+SIMULATOR_TARGET_HITS = metrics.counter(
+    "validator_monitor_attestation_simulator_target_attester_hits_total",
+    "simulated attestations whose target vote matched",
+)
+SIMULATOR_TARGET_MISSES = metrics.counter(
+    "validator_monitor_attestation_simulator_target_attester_misses_total",
+    "simulated attestations whose target vote missed",
+)
+
+MAX_UNAGGREGATED_ATTESTATIONS = 64
 
 
 def _pct(hits: int, misses: int) -> float:
@@ -54,6 +72,10 @@ class ValidatorMonitor:
         self._counters: Dict[int, dict] = {}
         self._registered_epoch: Dict[int, int] = {}
         self._last_closed_epoch: int = -1
+        # slot -> simulated AttestationData (attestation_simulator.rs feed)
+        self._simulated: Dict[int, object] = {}
+        self.simulator_stats = {"head_hits": 0, "head_misses": 0,
+                                "target_hits": 0, "target_misses": 0}
 
     def register(self, indices: Iterable[int], current_epoch: int = 0) -> None:
         with self._lock:
@@ -136,6 +158,56 @@ class ValidatorMonitor:
                 else:
                     c["attestation_misses"] += 1
             self._last_closed_epoch = e
+
+    def set_unaggregated_attestation(self, slot: int, data) -> None:
+        """Store one simulated per-slot attestation (the attestation
+        simulator's feed, reference validator_monitor.rs
+        ``set_unaggregated_attestation``); bounded like the reference."""
+        with self._lock:
+            if len(self._simulated) >= MAX_UNAGGREGATED_ATTESTATIONS:
+                self._simulated.pop(min(self._simulated), None)
+            self._simulated[int(slot)] = data
+
+    def score_simulated_attestations(self, state, spec, helpers) -> None:
+        """Compare stored simulated attestations against the now-canonical
+        chain (called at block import, once the truth for their slots is
+        knowable) and count head/target hit/miss metrics."""
+        with self._lock:
+            due = [(s, d) for s, d in self._simulated.items()
+                   if s < int(state.slot)]
+            for s, _ in due:
+                del self._simulated[s]
+        tally = {"head_hits": 0, "head_misses": 0,
+                 "target_hits": 0, "target_misses": 0}
+        for slot, data in due:
+            try:
+                head_hit = bytes(data.beacon_block_root) == bytes(
+                    helpers.get_block_root_at_slot(state, slot, spec)
+                )
+            except Exception:
+                continue
+            try:
+                target_hit = bytes(data.target.root) == bytes(
+                    helpers.get_block_root(state, int(data.target.epoch), spec)
+                )
+            except Exception:
+                target_hit = None
+            if head_hit:
+                SIMULATOR_HEAD_HITS.inc()
+                tally["head_hits"] += 1
+            else:
+                SIMULATOR_HEAD_MISSES.inc()
+                tally["head_misses"] += 1
+            if target_hit is True:
+                SIMULATOR_TARGET_HITS.inc()
+                tally["target_hits"] += 1
+            elif target_hit is False:
+                SIMULATOR_TARGET_MISSES.inc()
+                tally["target_misses"] += 1
+        if any(tally.values()):
+            with self._lock:  # shared stats follow the class's lock rule
+                for k, v in tally.items():
+                    self.simulator_stats[k] += v
 
     # ------------------------------------------------------------- queries
 

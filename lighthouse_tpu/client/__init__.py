@@ -376,6 +376,14 @@ class Client:
             "slot %d | head %s at slot %d | finalized epoch %d | %s",
             slot, chain.head_root.hex()[:10], head_slot, f_epoch, status,
         )
+        # Fork-readiness watcher (reference notifier.rs *_readiness blocks):
+        # logs ready / NOT-ready inside the pre-fork window.
+        from ..chain.fork_readiness import fork_readiness
+
+        try:
+            fork_readiness(chain)
+        except Exception:
+            pass  # a readiness probe must never kill the notifier
 
     def stop(self) -> None:
         self._shutdown.set()

@@ -131,6 +131,10 @@ class ExecutionLayer:
             lambda api: api.get_payload_bodies_by_range(start, count)
         )
 
+    def get_client_version(self) -> Optional[Dict]:
+        """The EL's identity (graffiti_calculator + fork-readiness logs)."""
+        return self.engine.request(lambda api: api.get_client_version())
+
     def produce_payload(self, state, types, spec,
                         suggested_fee_recipient=None):
         """The real getPayload flow: forkchoiceUpdated(head, attributes) →

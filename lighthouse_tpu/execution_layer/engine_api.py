@@ -36,6 +36,7 @@ SUPPORTED_METHODS = [
     "engine_getPayloadV4",
     "engine_getPayloadBodiesByHashV1",
     "engine_getPayloadBodiesByRangeV1",
+    "engine_getClientVersionV1",
 ]
 
 
@@ -292,3 +293,14 @@ class EngineApiClient:
     def get_payload_bodies_by_range(self, start: int, count: int) -> list:
         res = self.rpc("engine_getPayloadBodiesByRangeV1", [_q(start), _q(count)])
         return [_body_from_json(b) for b in (res or [])]
+
+    def get_client_version(self) -> Optional[Dict[str, str]]:
+        """engine_getClientVersionV1: the EL identifies itself (we identify
+        ourselves in the request, per the spec's mutual exchange)."""
+        from .. import __version__
+
+        res = self.rpc("engine_getClientVersionV1", [{
+            "code": "LH", "name": "lighthouse-tpu",
+            "version": __version__, "commit": "00000000",
+        }])
+        return res[0] if res else None

@@ -102,6 +102,9 @@ class MockEngineServer:
                     }
                     result["payloadId"] = pid
             return result
+        if method == "engine_getClientVersionV1":
+            return [{"code": "MK", "name": "mock-engine",
+                     "version": "0.1.0", "commit": "deadbeef"}]
         if method == "engine_getPayloadBodiesByHashV1":
             with self._lock:
                 return [
