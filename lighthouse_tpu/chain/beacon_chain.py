@@ -389,6 +389,9 @@ class BeaconChain:
         self.graffiti_calculator = GraffitiCalculator(
             execution_engine=self.execution_engine
         )
+        from .otb_verification import OtbStore
+
+        self.otb_store = OtbStore(self.db)
 
     # ------------------------------------------------------------- storage
 
@@ -638,6 +641,14 @@ class BeaconChain:
                 if optimistic is not None and ph in optimistic
                 else ExecutionStatus.VALID
             )
+            if payload_status == ExecutionStatus.OPTIMISTIC:
+                from ..consensus.per_block import is_merge_transition_complete
+
+                if not is_merge_transition_complete(parent_state) and any(ph):
+                    # The MERGE TRANSITION block went in unverified: its PoW
+                    # parent must be TTD-checked once the EL can answer
+                    # (otb_verification_service.rs).
+                    self.otb_store.register(block_root, int(block.slot))
         else:
             payload_status = ExecutionStatus.IRRELEVANT
         self.fork_choice.on_block(
@@ -1611,7 +1622,12 @@ class BeaconChain:
     def _blocks_slot(self, block_root: bytes) -> int:
         if block_root == self.genesis_block_root:
             return int(self.genesis_state.slot)
-        block = self.get_block(block_root)
+        # Raw stored form only: a blinded block's slot is right there in the
+        # header, and this lookup must work while the EL is down (payload
+        # reconstruction would raise exactly then).
+        block = self._blocks.get(block_root) or self.db.get_block(block_root)
+        if block is None:
+            block = self.early_attester_cache.get_block(block_root)
         if block is None:
             raise ChainError(f"unknown block {block_root.hex()[:16]}")
         return int(block.message.slot)
@@ -1786,6 +1802,12 @@ class BeaconChain:
         self.fork_choice.update_time(slot)
         self.recompute_head()
         self.simulate_attestation()
+        from .otb_verification import verify_otbs
+
+        try:
+            verify_otbs(self)
+        except Exception as e:  # an OTB sweep must never starve pruning
+            log.warning("otb verification sweep failed", error=str(e)[:80])
         self.attestation_pool.prune(slot)
         self.sync_contribution_pool.prune(slot)
         self.op_pool.prune(self.head_state, self.spec, current_slot=slot)

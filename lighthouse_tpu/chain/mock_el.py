@@ -27,6 +27,8 @@ class MockExecutionEngine:
         # block_hash -> payload body, for engine_getPayloadBodiesByHash/Range
         # (reference MockServer keeps every payload it has seen).
         self._bodies: dict = {}
+        # PoW chain stub for transition-block TTD checks (tests seed this).
+        self.pow_blocks: dict = {}
 
     def _record_body(self, payload) -> None:
         self._bodies[bytes(payload.block_hash)] = {
@@ -90,6 +92,14 @@ class MockExecutionEngine:
         self.payloads_seen += 1
         self._record_body(payload)
         return bytes(payload.block_hash) not in self.invalid_hashes
+
+    def get_pow_block(self, block_hash: bytes):
+        """PoW-chain lookup for transition-block TTD validation
+        (otb_verification; reference MockServer's PoW block store).
+        Returns {"total_difficulty", "parent_total_difficulty"} or None."""
+        if self.offline:
+            raise ConnectionError("mock execution engine offline")
+        return self.pow_blocks.get(bytes(block_hash))
 
     def get_client_version(self) -> dict:
         """engine_getClientVersionV1 (graffiti_calculator's EL identity)."""

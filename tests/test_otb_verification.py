@@ -1,0 +1,93 @@
+"""Optimistic transition block verification (reference
+``otb_verification_service.rs``): the merge-transition block imported
+optimistically is persisted, TTD-checked once the EL answers, and
+invalidated in fork choice when the check fails."""
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.chain.beacon_chain import BeaconChain
+from lighthouse_tpu.chain.mock_el import MockExecutionEngine
+from lighthouse_tpu.chain.otb_verification import verify_otbs
+from lighthouse_tpu.chain.slot_clock import ManualSlotClock
+from lighthouse_tpu.chain.harness import interop_genesis_state
+from lighthouse_tpu.crypto.bls.backends import set_backend
+
+
+@pytest.fixture()
+def premerge_harness():
+    """A harness whose genesis predates the merge (empty payload header), so
+    the first produced block IS the transition block."""
+    set_backend("fake")
+    h = BeaconChainHarness(validator_count=16, fake_crypto=True)
+    genesis = interop_genesis_state(
+        16, h.types, h.spec, genesis_time=h.chain.genesis_time
+    )
+    genesis.latest_execution_payload_header = type(
+        genesis.latest_execution_payload_header
+    )()
+    h.chain = BeaconChain(
+        genesis_state=genesis,
+        types=h.types,
+        spec=h.spec,
+        slot_clock=ManualSlotClock(h.chain.genesis_time, h.spec.seconds_per_slot),
+        execution_engine=MockExecutionEngine(),
+    )
+    yield h
+    set_backend("host")
+
+
+def _import_transition_block_optimistically(h):
+    chain = h.chain
+    slot = h.advance_slot()
+    block = h.produce_signed_block(slot=slot)
+    payload_hash = bytes(block.message.body.execution_payload.block_hash)
+    assert any(payload_hash), "first block must carry the transition payload"
+    chain.execution_engine.optimistic_hashes = {payload_hash}
+    root = chain.process_block(block, block_delay_seconds=1.0)
+    return root, block
+
+
+def test_transition_block_registered_and_verified(premerge_harness):
+    h = premerge_harness
+    chain = h.chain
+    root, block = _import_transition_block_optimistically(h)
+    assert [r for r, _ in chain.otb_store.all()] == [root]
+
+    engine = chain.execution_engine
+    pow_parent = bytes(block.message.body.execution_payload.parent_hash)
+
+    # An EL WITHOUT the PoW lookup capability at all: undecidable, persists
+    chain.execution_engine = object()
+    assert verify_otbs(chain) == 0
+    assert chain.otb_store.all(), "capability-less EL must leave the OTB"
+    chain.execution_engine = engine
+
+    # EL reachable but erroring: also undecidable, record survives
+    engine_get = engine.get_pow_block
+    engine.get_pow_block = lambda h_: (_ for _ in ()).throw(ConnectionError())
+    assert verify_otbs(chain) == 0
+    assert chain.otb_store.all(), "unanswerable OTB must persist"
+    engine.get_pow_block = engine_get
+
+    # EL learns the PoW parent met TTD: record resolves, block stays viable
+    engine.pow_blocks[pow_parent] = {
+        "total_difficulty": chain.spec.terminal_total_difficulty,
+        "parent_total_difficulty": 0,
+    }
+    assert verify_otbs(chain) == 1
+    assert chain.otb_store.all() == []
+    assert chain.head_root == root
+
+
+def test_invalid_transition_block_is_invalidated(premerge_harness):
+    h = premerge_harness
+    chain = h.chain
+    root, block = _import_transition_block_optimistically(h)
+    assert chain.head_root == root
+
+    # The claimed PoW parent does not exist on the EL's chain -> provably
+    # invalid transition: fork choice must drop the block as head.
+    assert verify_otbs(chain) == 1
+    assert chain.otb_store.all() == []
+    assert chain.head_root != root, "invalid transition block kept as head"
