@@ -436,27 +436,30 @@ class BeaconChain:
             block = self.early_attester_cache.get_block(block_root)
         return block
 
+    def _raw_block(self, block_root: bytes):
+        """The stored form without payload reconstruction (may be blinded):
+        memory cache -> db -> early-attester cache.  The EL-free invariant
+        every slot/metadata lookup depends on lives HERE only."""
+        block = self._blocks.get(block_root) or self.db.get_block(block_root)
+        if block is None:
+            block = self.early_attester_cache.get_block(block_root)
+        return block
+
     def get_blocks(self, block_roots) -> list:
         """FULL blocks for many roots with ONE batched EL round trip for
         every blinded store hit (the reference's beacon_block_streamer range
         path) — N-block BlocksByRange must not cost N
         engine_getPayloadBodiesByHash calls."""
-        raw = []
-        for root in block_roots:
-            block = self._blocks.get(root) or self.db.get_block(root)
-            if block is None:
-                block = self.early_attester_cache.get_block(root)
-            raw.append(block)
-        return self.block_streamer.reconstruct(raw)
+        return self.block_streamer.reconstruct(
+            [self._raw_block(root) for root in block_roots]
+        )
 
     def get_blinded_block(self, block_root: bytes):
         """The block in blinded form (payload header), reading the blinded
         store representation directly when present."""
         from .block_streamer import blind_signed_block, is_blinded
 
-        block = self._blocks.get(block_root) or self.db.get_block(block_root)
-        if block is None:
-            block = self.early_attester_cache.get_block(block_root)
+        block = self._raw_block(block_root)
         if block is None or is_blinded(block):
             return block
         if not hasattr(block.message.body, "execution_payload"):
@@ -1625,9 +1628,7 @@ class BeaconChain:
         # Raw stored form only: a blinded block's slot is right there in the
         # header, and this lookup must work while the EL is down (payload
         # reconstruction would raise exactly then).
-        block = self._blocks.get(block_root) or self.db.get_block(block_root)
-        if block is None:
-            block = self.early_attester_cache.get_block(block_root)
+        block = self._raw_block(block_root)
         if block is None:
             raise ChainError(f"unknown block {block_root.hex()[:16]}")
         return int(block.message.slot)

@@ -65,10 +65,18 @@ def validate_merge_transition_block(chain, signed_block) -> Optional[bool]:
     except Exception:
         return None
     if pow_block is None:
-        return False  # the claimed PoW parent does not exist
+        # Not-found is UNDECIDABLE, not invalid (reference
+        # TerminalPoWBlockNotFound retries — the EL may still be syncing
+        # or has pruned pre-merge history); only a found-and-failing
+        # parent proves the transition invalid.
+        return None
     ttd = chain.spec.terminal_total_difficulty
-    parent_td = int(pow_block.get("parent_total_difficulty", 0))
-    return int(pow_block["total_difficulty"]) >= ttd and parent_td < ttd
+    try:
+        total_td = int(pow_block["total_difficulty"])
+        parent_td = int(pow_block["parent_total_difficulty"])
+    except (KeyError, TypeError, ValueError):
+        return None  # partial EL response: decide nothing on missing data
+    return total_td >= ttd and parent_td < ttd
 
 
 def verify_otbs(chain) -> int:

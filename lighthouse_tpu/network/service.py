@@ -40,6 +40,16 @@ LAZY_DEGREE = 6  # gossip_lazy
 MCACHE_SIZE = 512  # message cache entries servable via IWANT
 IWANT_RETRY_SECS = 5.0  # re-pull window when an advertiser never delivers
 
+# Gossipsub v1.1 peer-score thresholds (reference PeerScoreThresholds /
+# lighthouse_network's gossipsub config), mapped onto THIS peer manager's
+# score scale (disconnect at -20, ban at -50 — peer_manager.py):
+#  - below GOSSIP: the peer gets no eager push and no IHAVE from us
+#  - below PUBLISH: our own publications skip it too
+#  - below GRAYLIST: every incoming gossip/control message is ignored
+GOSSIP_THRESHOLD = -5.0
+PUBLISH_THRESHOLD = -10.0
+GRAYLIST_THRESHOLD = -16.0
+
 
 def message_id(uncompressed: bytes) -> bytes:
     """Spec gossip message-id for snappy-decodable messages."""
@@ -134,9 +144,14 @@ class NetworkService:
         return ranked[:MESH_DEGREE], ranked[MESH_DEGREE:MESH_DEGREE + LAZY_DEGREE]
 
     def _disseminate(self, topic: str, mid: bytes, compressed: bytes,
-                     exclude: Optional[str]) -> int:
+                     exclude: Optional[str], publishing: bool = False) -> int:
         self._cache_message(mid, topic, compressed)
-        peers = [p for p in self.peer_manager.connected_peers() if p != exclude]
+        # v1.1 score gates: low-scored peers fall out of gossip entirely,
+        # and our OWN publications demand the stricter publish threshold.
+        floor = PUBLISH_THRESHOLD if publishing else GOSSIP_THRESHOLD
+        pm = self.peer_manager
+        peers = [p for p in pm.connected_peers()
+                 if p != exclude and pm.score(p) >= floor]
         mesh, lazy = self.mesh_peers(topic, peers)
         env = Envelope(kind="gossip", sender=self.peer_id, topic=topic, data=compressed)
         n = 0
@@ -156,7 +171,8 @@ class NetworkService:
         mid = message_id(uncompressed)
         self._mark_seen(mid)
         return self._disseminate(
-            str(topic), mid, snappy_codec.compress(uncompressed), exclude=None
+            str(topic), mid, snappy_codec.compress(uncompressed), exclude=None,
+            publishing=True,
         )
 
     def forward(self, topic: str, compressed: bytes, exclude: str,
@@ -238,11 +254,17 @@ class NetworkService:
 
                 self.peer_manager.report(env.sender, PeerAction.LOW_TOLERANCE, "codec error")
 
+    def _graylisted(self, peer: str) -> bool:
+        return self.peer_manager.score(peer) < GRAYLIST_THRESHOLD
+
+    def _below_gossip_threshold(self, peer: str) -> bool:
+        return self.peer_manager.score(peer) < GOSSIP_THRESHOLD
+
     def _on_gossip(self, env: Envelope) -> None:
         from . import snappy_codec
         from .peer_manager import PeerAction
 
-        if env.topic not in self.subscriptions:
+        if env.topic not in self.subscriptions or self._graylisted(env.sender):
             return
         try:
             uncompressed = snappy_codec.decompress(env.data)
@@ -265,7 +287,9 @@ class NetworkService:
         """Lazy-gossip advert: pull the message if we haven't seen it
         (gossipsub handle_ihave → IWANT)."""
         mid = env.data
-        if len(mid) != 20 or env.topic not in self.subscriptions:
+        if (len(mid) != 20 or env.topic not in self.subscriptions
+                or self._below_gossip_threshold(env.sender)):
+            # v1.1: IHAVE from below-gossip-threshold peers is ignored
             return
         now = time.monotonic()
         with self._seen_lock:
@@ -287,6 +311,8 @@ class NetworkService:
 
     def _on_iwant(self, env: Envelope) -> None:
         """Serve a cached message to a puller (gossipsub handle_iwant)."""
+        if self._below_gossip_threshold(env.sender):
+            return  # v1.1: no pull access below the gossip threshold
         with self._seen_lock:
             entry = self._mcache.get(env.data)
         if entry is None:

@@ -192,6 +192,54 @@ class TestGossip:
             na.shutdown(); nb.shutdown()
 
 
+class TestScoreThresholds:
+    """Gossipsub v1.1 score gates (reference PeerScoreThresholds)."""
+
+    def test_graylisted_sender_is_ignored(self):
+        from lighthouse_tpu.network.service import GRAYLIST_THRESHOLD
+
+        hub, na, nb = two_nodes()
+        try:
+            hub.connect("a", "b")
+            na.harness.advance_slot(); nb.harness.advance_slot()
+            # b graylists a BEFORE the gossip arrives
+            info = nb.service.peer_manager._peer("a")
+            info.score = GRAYLIST_THRESHOLD - 1
+            signed = na.harness.produce_signed_block()
+            root = na.chain.process_block(signed, block_delay_seconds=1.0)
+            na.publish_block(signed)
+            assert not wait_until(lambda: nb.chain.head_root == root,
+                                  timeout=1.5)
+            # score recovers -> the next message flows again
+            info.score = 0.0
+            na.harness.advance_slot(); nb.harness.advance_slot()
+            nxt = na.harness.produce_signed_block()
+            root2 = na.chain.process_block(nxt, block_delay_seconds=1.0)
+            na.publish_block(nxt)
+            assert wait_until(lambda: nb.chain.head_root == root2, timeout=10.0)
+        finally:
+            na.shutdown(); nb.shutdown()
+
+    def test_low_scored_peer_excluded_from_publish(self):
+        from lighthouse_tpu.network.service import PUBLISH_THRESHOLD
+
+        hub, na, nb = two_nodes()
+        try:
+            hub.connect("a", "b")
+            na.harness.advance_slot(); nb.harness.advance_slot()
+            # a demotes b below the publish threshold: a's own messages
+            # must not reach it
+            info = na.service.peer_manager._peer("b")
+            info.score = PUBLISH_THRESHOLD - 1
+            signed = na.harness.produce_signed_block()
+            root = na.chain.process_block(signed, block_delay_seconds=1.0)
+            sent = na.publish_block(signed)
+            assert not wait_until(lambda: nb.chain.head_root == root,
+                                  timeout=1.5)
+        finally:
+            na.shutdown(); nb.shutdown()
+
+
 class TestSync:
     def test_range_sync_catches_up(self):
         hub, na, nb = two_nodes()

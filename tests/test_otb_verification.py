@@ -80,14 +80,44 @@ def test_transition_block_registered_and_verified(premerge_harness):
     assert chain.head_root == root
 
 
+def test_pow_parent_not_found_is_undecidable(premerge_harness):
+    """A missing PoW parent retries forever (reference
+    TerminalPoWBlockNotFound) — the EL may be syncing; it proves nothing."""
+    h = premerge_harness
+    chain = h.chain
+    root, _ = _import_transition_block_optimistically(h)
+    assert verify_otbs(chain) == 0
+    assert chain.otb_store.all(), "not-found must keep the record"
+    assert chain.head_root == root
+
+
 def test_invalid_transition_block_is_invalidated(premerge_harness):
     h = premerge_harness
     chain = h.chain
     root, block = _import_transition_block_optimistically(h)
     assert chain.head_root == root
 
-    # The claimed PoW parent does not exist on the EL's chain -> provably
-    # invalid transition: fork choice must drop the block as head.
+    # The PoW parent EXISTS but fails the TTD check -> provably invalid:
+    # fork choice must drop the block as head.
+    parent = bytes(block.message.body.execution_payload.parent_hash)
+    chain.execution_engine.pow_blocks[parent] = {
+        "total_difficulty": chain.spec.terminal_total_difficulty - 1,
+        "parent_total_difficulty": 0,
+    }
     assert verify_otbs(chain) == 1
     assert chain.otb_store.all() == []
     assert chain.head_root != root, "invalid transition block kept as head"
+
+
+def test_partial_el_response_is_undecidable(premerge_harness):
+    h = premerge_harness
+    chain = h.chain
+    root, block = _import_transition_block_optimistically(h)
+    parent = bytes(block.message.body.execution_payload.parent_hash)
+    chain.execution_engine.pow_blocks[parent] = {
+        "total_difficulty": chain.spec.terminal_total_difficulty,
+        # parent_total_difficulty missing: incomplete response
+    }
+    assert verify_otbs(chain) == 0
+    assert chain.otb_store.all(), "partial data must not resolve the OTB"
+    assert chain.head_root == root
