@@ -8,14 +8,19 @@ libp2p-noise spec, carrying a secp256k1 libp2p identity proof in the
 handshake payload) and then multiplexed with yamux framing.
 
 Modules:
-- ``x25519``  — RFC 7748 curve25519 (pinned to the RFC's test vectors)
-- ``protocol``— the Noise protocol core (CipherState/SymmetricState/XX)
-- ``secure``  — libp2p-noise over a socket: identity payloads, length-
-                prefixed encrypted frames
-- ``yamux``   — the yamux multiplexer (SYN/ACK/FIN/RST, windows, ping)
+- ``x25519``     — RFC 7748 curve25519 (pinned to the RFC's test vectors)
+- ``protocol``   — the Noise protocol core (CipherState/SymmetricState/XX)
+- ``secure``     — libp2p-noise over a socket: identity payloads, length-
+                   prefixed encrypted frames
+- ``yamux``      — the yamux multiplexer (SYN/ACK/FIN/RST, windows, ping)
+- ``multistream``— multistream-select 1.0: the upgrade ladder entry points
+                   (``upgrade_outbound``/``upgrade_inbound``) and per-stream
+                   protocol negotiation
 """
 
+from .multistream import upgrade_inbound, upgrade_outbound
 from .secure import NoiseConnection, secure_accept, secure_dial
 from .yamux import YamuxSession
 
-__all__ = ["NoiseConnection", "secure_accept", "secure_dial", "YamuxSession"]
+__all__ = ["NoiseConnection", "secure_accept", "secure_dial",
+           "YamuxSession", "upgrade_inbound", "upgrade_outbound"]

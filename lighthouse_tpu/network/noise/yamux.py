@@ -44,6 +44,7 @@ class YamuxStream:
         self._rx_buf = b""
         self._recv_window = INITIAL_WINDOW  # what we granted the peer
         self._send_window = INITIAL_WINDOW  # what the peer granted us
+        self._pending_credit = 0  # consumed bytes not yet re-credited
         self._window_cv = threading.Condition()
         self.closed_local = False
         self.closed_remote = False
@@ -81,16 +82,24 @@ class YamuxStream:
                 self.closed_remote = True
                 return b""
         out, self._rx_buf = self._rx_buf[:n], self._rx_buf[n:]
-        # Re-credit the peer for what the application consumed.  Best
-        # effort: bytes already delivered must not be lost to a dead
-        # session (draining after close/disconnect is legitimate).
+        # Re-credit the peer for consumed bytes, BATCHED at half a window
+        # (hashicorp yamux's delta threshold): per-byte reads (multistream
+        # varints) must not emit one encrypted frame per byte, and a
+        # blocked sender always unblocks because its window only empties
+        # after a full window of bytes was consumed here.  Best effort:
+        # bytes already delivered must not be lost to a dead session.
         with self._window_cv:
             self._recv_window += len(out)
-        try:
-            self.session._send_frame(TYPE_WINDOW_UPDATE, 0, self.stream_id,
-                                     b"", length=len(out))
-        except Exception:
-            pass
+            self._pending_credit += len(out)
+            credit = 0
+            if self._pending_credit >= INITIAL_WINDOW // 2:
+                credit, self._pending_credit = self._pending_credit, 0
+        if credit:
+            try:
+                self.session._send_frame(TYPE_WINDOW_UPDATE, 0,
+                                         self.stream_id, b"", length=credit)
+            except Exception:
+                pass
         return out
 
     def recv_exact(self, n: int, timeout: Optional[float] = 10.0) -> bytes:

@@ -151,6 +151,75 @@ class TestLibp2pNoiseOverTcp:
         cli.close(); srv.close()
 
 
+class TestMultistream:
+    def test_full_libp2p_upgrade_ladder(self):
+        """multistream -> /noise -> XX handshake -> multistream ->
+        /yamux/1.0.0 -> streams: the reference's exact connection upgrade
+        order, over real sockets."""
+        from lighthouse_tpu.network.noise import multistream
+
+        cli, srv = _tcp_pair()
+        out = {}
+
+        def acceptor():
+            out["s"] = multistream.upgrade_inbound(srv, 0x2222)
+
+        t = threading.Thread(target=acceptor)
+        t.start()
+        sa = multistream.upgrade_outbound(cli, 0x1111)
+        t.join(timeout=10)
+        sb = out["s"]
+        try:
+            # per-stream protocol negotiation, like an eth2 RPC request
+            stream = sa.open_stream()
+            proto = "/eth2/beacon_chain/req/status/1/ssz_snappy"
+
+            def answer():
+                r = sb.accept_stream()
+                got = multistream.negotiate_inbound(r, [proto])
+                out["proto"] = got
+                r.send(b"status-body")
+
+            t2 = threading.Thread(target=answer)
+            t2.start()
+            accepted = multistream.negotiate_outbound(stream, [proto])
+            t2.join(timeout=10)
+            assert accepted == proto and out["proto"] == proto
+            assert stream.recv_exact(11) == b"status-body"
+        finally:
+            sa.close(); sb.close()
+
+    def test_unsupported_protocol_gets_na(self):
+        from lighthouse_tpu.network.noise import multistream
+
+        cli, srv = _tcp_pair()
+        out = {}
+
+        def acceptor():
+            out["s"] = multistream.upgrade_inbound(srv, 0x2222)
+
+        t = threading.Thread(target=acceptor)
+        t.start()
+        sa = multistream.upgrade_outbound(cli, 0x1111)
+        t.join(timeout=10)
+        sb = out["s"]
+        try:
+            stream = sa.open_stream()
+
+            def answer():
+                r = sb.accept_stream()
+                multistream.negotiate_inbound(r, ["/only/this/1.0.0"])
+
+            t2 = threading.Thread(target=answer, daemon=True)
+            t2.start()
+            # first proposal refused with na, second accepted
+            accepted = multistream.negotiate_outbound(
+                stream, ["/not/supported/1.0.0", "/only/this/1.0.0"])
+            assert accepted == "/only/this/1.0.0"
+        finally:
+            sa.close(); sb.close()
+
+
 class TestYamux:
     def test_streams_over_noise(self):
         a, b = _handshake_pair()
