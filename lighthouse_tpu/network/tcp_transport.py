@@ -119,11 +119,58 @@ def _read_frame(sock: socket.socket) -> Optional[bytes]:
     return _read_exact(sock, length)
 
 
+class _SecuredChannel:
+    """Socket-shaped adapter over one yamux stream of a noise session, so
+    every existing envelope path (sendall/recv/shutdown/close) works
+    unchanged on a secured connection."""
+
+    def __init__(self, session, stream, sock) -> None:
+        self._session = session
+        self._stream = stream
+        self._sock = sock
+
+    def sendall(self, data: bytes) -> None:
+        self._stream.send(data)
+
+    def recv(self, n: int) -> bytes:
+        try:
+            return self._stream.recv(n, timeout=None)
+        except Exception:
+            return b""
+
+    def settimeout(self, t) -> None:
+        # Delegate to the RAW socket: it bounds every blocking read the
+        # yamux rx thread makes, so handshake timeouts (and their removal
+        # once established) keep working through the secured stack.
+        self._sock.settimeout(t)
+
+    def getpeername(self):
+        return self._sock.getpeername()
+
+    def shutdown(self, _how) -> None:
+        self._session.close()
+
+    def close(self) -> None:
+        self._session.close()
+
+
 class TcpEndpoint:
     """Drop-in for ``transport.Endpoint``: same attributes and methods, but
-    peers live in other processes."""
+    peers live in other processes.
 
-    def __init__(self, peer_id: str, host: str = "127.0.0.1", port: int = 0):
+    ``secured=True`` upgrades every connection through the libp2p ladder
+    (multistream-select -> Noise XX with a secp256k1 identity proof ->
+    yamux) and runs the envelope protocol over one yamux stream — the
+    reference's transport stack shape end to end."""
+
+    def __init__(self, peer_id: str, host: str = "127.0.0.1", port: int = 0,
+                 *, secured: bool = False, identity_priv: int = None):
+        self.secured = secured
+        if secured and identity_priv is None:
+            from .discv5.enr import KeyPair
+
+            identity_priv = KeyPair().priv
+        self.identity_priv = identity_priv
         self.peer_id = peer_id
         self.inbound: "queue.Queue[Envelope]" = queue.Queue()
         self.on_connect: Optional[Callable[[str], None]] = None
@@ -181,17 +228,43 @@ class TcpEndpoint:
             while len(self.peer_listen_addrs) > self.MAX_KNOWN_ADDRS:
                 self.peer_listen_addrs.pop(next(iter(self.peer_listen_addrs)))
 
+    def _upgrade_outbound(self, sock: socket.socket):
+        """Shared ladder (noise.upgrade_outbound) + the envelope stream.
+        The raw socket's timeout stays in force through the whole upgrade
+        (a stalling peer fails the handshake instead of pinning it)."""
+        from .noise import upgrade_outbound
+
+        session = upgrade_outbound(sock, self.identity_priv)
+        return _SecuredChannel(session, session.open_stream(), sock)
+
+    def _upgrade_inbound(self, sock: socket.socket):
+        from .noise import upgrade_inbound
+
+        session = upgrade_inbound(sock, self.identity_priv)
+        return _SecuredChannel(session, session.accept_stream(timeout=10.0), sock)
+
     def dial(self, host: str, port: int, timeout: float = 5.0) -> str:
         """Connect to a remote endpoint; returns its peer id."""
         sock = socket.create_connection((host, port), timeout=timeout)
         sock.settimeout(timeout)
-        sock.sendall(_encode(self._hello()))
-        payload = _read_frame(sock)
-        if payload is None:
-            raise TcpTransportError("peer closed during handshake")
-        hello = _decode(payload)
-        if hello.kind != "hello":
-            raise TcpTransportError(f"bad handshake frame kind {hello.kind!r}")
+        try:
+            if self.secured:
+                sock = self._upgrade_outbound(sock)
+            sock.sendall(_encode(self._hello()))
+            payload = _read_frame(sock)
+            if payload is None:
+                raise TcpTransportError("peer closed during handshake")
+            hello = _decode(payload)
+            if hello.kind != "hello":
+                raise TcpTransportError(
+                    f"bad handshake frame kind {hello.kind!r}")
+        except Exception:
+            # no leaked fd (or yamux rx thread) on a failed handshake
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
         sock.settimeout(None)
         # the address we DIALED is authoritative for this peer
         self._store_peer_addr(hello.sender, (host, port))
@@ -211,6 +284,8 @@ class TcpEndpoint:
     def _handshake_inbound(self, sock: socket.socket) -> None:
         try:
             sock.settimeout(5.0)
+            if self.secured:
+                sock = self._upgrade_inbound(sock)
             payload = _read_frame(sock)
             if payload is None:
                 sock.close()
@@ -221,7 +296,7 @@ class TcpEndpoint:
                 return
             sock.sendall(_encode(self._hello()))
             sock.settimeout(None)
-        except (OSError, TcpTransportError):
+        except Exception:
             sock.close()
             return
         self._record_peer_addr(hello.sender, sock, hello)
@@ -295,7 +370,15 @@ class TcpEndpoint:
             with wlock:
                 sock.sendall(_encode(env))
             return True
-        except OSError:
+        except Exception as e:
+            # secured channels raise YamuxError/NoiseError, raw sockets
+            # OSError — the Endpoint contract is bool either way, and a
+            # dead connection must be dropped (on_disconnect must fire)
+            from .noise.protocol import NoiseError
+            from .noise.yamux import YamuxError
+
+            if not isinstance(e, (OSError, YamuxError, NoiseError)):
+                raise
             self._drop_conn(to, sock)
             return False
 
