@@ -78,6 +78,68 @@ class LocalNode:
 
     # ----------------------------------------------------------- discovery
 
+    def enable_discv5(self, keypair=None):
+        """Attach a discv5-over-UDP discovery service whose ENR advertises
+        BOTH our discovery (udp) port and the TCP fabric listen port — the
+        reference node's discovery/transport split (discv5 finds peers,
+        libp2p dials them)."""
+        from .discv5 import Discv5Service, KeyPair
+        from .discv5.enr import ENR
+
+        self.discv5 = Discv5Service(keypair or KeyPair())
+        host, tcp_port = self.endpoint.listen_addr
+        # Advertise the FABRIC's host for the tcp entry (falling back to
+        # the discovery socket's when the fabric binds a wildcard) — peers
+        # dial what the ENR says.
+        ip = self.discv5.ip if host in ("0.0.0.0", "") else host
+        self.discv5.enr = ENR.build(
+            self.discv5.keypair, seq=1, ip=ip,
+            udp=self.discv5.port, tcp=tcp_port,
+        )
+        self.discv5.start()
+        return self.discv5
+
+    def _dial_new_addrs(self, addrs, max_new: int) -> int:
+        """Dial every address not already known, up to ``max_new`` — the
+        shared tail of both discovery flavors."""
+        endpoint = self.endpoint
+        known = set(endpoint.known_peer_addrs().values())
+        known.add(tuple(endpoint.listen_addr))
+        dialed = 0
+        for addr in addrs:
+            if addr in known:
+                continue
+            try:
+                endpoint.dial(*addr, timeout=3.0)
+                known.add(addr)
+                dialed += 1
+            except Exception:
+                continue  # stale address: skip
+            if dialed >= max_new:
+                break
+        return dialed
+
+    def discover_peers_discv5(self, boot_enrs, max_new: int = 8) -> int:
+        """One discv5 discovery round: bootstrap FINDNODE sweeps against the
+        boot ENRs, then dial every discovered record that advertises a TCP
+        port.  Returns #dialed."""
+        from .discv5 import rlp as discv5_rlp
+
+        if getattr(self, "discv5", None) is None:
+            return 0
+        for boot in boot_enrs:
+            try:
+                self.discv5.bootstrap(boot)
+            except Exception:
+                continue
+        addrs = []
+        for enr in list(self.discv5.table.values()):
+            tcp_raw = enr.pairs.get(b"tcp")
+            ip = enr.ip()
+            if tcp_raw and ip is not None:
+                addrs.append((ip, discv5_rlp.decode_uint(tcp_raw)))
+        return self._dial_new_addrs(addrs, max_new)
+
     def discover_peers(self, max_new: int = 8) -> int:
         """One discovery round (the FINDNODE sweep a discv5 node runs):
         ask every connected peer — boot nodes included — for the listen
@@ -88,9 +150,7 @@ class LocalNode:
         endpoint = self.endpoint
         if not hasattr(endpoint, "dial"):
             return 0  # in-process hub: topology is explicit
-        known_addrs = set(endpoint.known_peer_addrs().values())
-        known_addrs.add(tuple(endpoint.listen_addr))
-        dialed = 0
+        addrs = []
         for peer in list(endpoint.connected_peers()):
             try:
                 chunks = self.service.request(
@@ -110,19 +170,11 @@ class LocalNode:
                         peer, PeerAction.LOW_TOLERANCE, "bad peer-exchange payload"
                     )
                     continue
-                for entry in entries:
-                    addr = (entry.host, entry.port)
-                    if entry.peer_id == self.peer_id or addr in known_addrs:
-                        continue
-                    try:
-                        endpoint.dial(entry.host, entry.port, timeout=3.0)
-                        known_addrs.add(addr)
-                        dialed += 1
-                    except Exception:
-                        continue  # stale address: skip
-                    if dialed >= max_new:
-                        return dialed
-        return dialed
+                addrs.extend(
+                    (e.host, e.port) for e in entries
+                    if e.peer_id != self.peer_id
+                )
+        return self._dial_new_addrs(addrs, max_new)
 
     # ------------------------------------------------------------ publish
 
@@ -157,5 +209,7 @@ class LocalNode:
     def shutdown(self) -> None:
         self.service.shutdown()
         self.processor.shutdown()
+        if getattr(self, "discv5", None) is not None:
+            self.discv5.stop()
         if hasattr(self.endpoint, "close"):
             self.endpoint.close()  # socket-backed endpoints own OS resources

@@ -120,6 +120,11 @@ def upgrade_outbound(sock, identity_priv: int):
     negotiate_outbound(raw, ["/noise"])
     conn = secure_dial(sock, identity_priv)
     negotiate_outbound(conn, ["/yamux/1.0.0"])
+    # The yamux rx thread must NEVER run with a socket timeout: a timeout
+    # set for the handshake would fire on the first idle gap and kill the
+    # session (an in-flight recv also ignores later settimeout calls).
+    # Every read before this point ran in the calling thread, bounded.
+    sock.settimeout(None)
     return YamuxSession(conn, dialer=True)
 
 
@@ -132,4 +137,5 @@ def upgrade_inbound(sock, identity_priv: int, on_stream=None):
     negotiate_inbound(raw, ["/noise"])
     conn = secure_accept(sock, identity_priv)
     negotiate_inbound(conn, ["/yamux/1.0.0"])
+    sock.settimeout(None)  # see upgrade_outbound: the rx thread starts now
     return YamuxSession(conn, dialer=False, on_stream=on_stream)

@@ -128,21 +128,23 @@ class _SecuredChannel:
         self._session = session
         self._stream = stream
         self._sock = sock
+        self._timeout = None
+        self.remote_identity = session.conn.remote_identity
 
     def sendall(self, data: bytes) -> None:
         self._stream.send(data)
 
     def recv(self, n: int) -> bytes:
         try:
-            return self._stream.recv(n, timeout=None)
+            return self._stream.recv(n, timeout=self._timeout)
         except Exception:
             return b""
 
     def settimeout(self, t) -> None:
-        # Delegate to the RAW socket: it bounds every blocking read the
-        # yamux rx thread makes, so handshake timeouts (and their removal
-        # once established) keep working through the secured stack.
-        self._sock.settimeout(t)
+        # A SOFT timeout on stream reads only — the raw socket must stay
+        # timeout-free (the yamux rx thread owns it; a socket timeout
+        # would tear down an idle healthy session).
+        self._timeout = t
 
     def getpeername(self):
         return self._sock.getpeername()
@@ -176,6 +178,10 @@ class TcpEndpoint:
         self.on_connect: Optional[Callable[[str], None]] = None
         self.on_disconnect: Optional[Callable[[str], None]] = None
         self._conns: Dict[str, socket.socket] = {}
+        # peer id -> Noise-proven secp256k1 identity (secured mode): a later
+        # connection claiming the same peer id with a DIFFERENT identity is
+        # an impersonation attempt and is refused, not allowed to evict.
+        self._peer_identities: Dict[str, bytes] = {}
         # peer id -> (host, listen_port) for re-dialing / peer exchange
         self.peer_listen_addrs: Dict[str, Tuple[str, int]] = {}
         # per-connection write mutex: sendall from multiple threads must not
@@ -303,6 +309,21 @@ class TcpEndpoint:
         self._register_conn(hello.sender, sock)
 
     def _register_conn(self, peer: str, sock: socket.socket) -> None:
+        identity = getattr(sock, "remote_identity", None)
+        with self._lock:
+            bound = self._peer_identities.get(peer)
+            if identity is not None and bound is not None and bound != identity:
+                refused = True  # proven-key mismatch: impersonation
+            else:
+                refused = False
+                if identity is not None:
+                    self._peer_identities[peer] = identity
+        if refused:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
         with self._lock:
             old = self._conns.pop(peer, None)
             self._conns[peer] = sock

@@ -158,6 +158,58 @@ class TestLiveNodes:
         finally:
             a.stop(); b.stop()
 
+    def test_node_discovers_and_dials_over_fabric(self):
+        """The discovery/transport split end to end: nodes advertise their
+        TCP fabric port in ENRs, a newcomer learns peers via discv5
+        FINDNODE sweeps against a boot node, DIALS them over TCP, and
+        gossip flows (reference: discv5 finds, libp2p connects)."""
+        from lighthouse_tpu.chain import BeaconChainHarness
+        from lighthouse_tpu.network.node import LocalNode
+        from lighthouse_tpu.network.tcp_transport import TcpEndpoint
+        from lighthouse_tpu.crypto.bls.backends import set_backend
+        import time
+
+        set_backend("fake")
+        boot = Discv5Service(KeyPair()).start()
+        nodes = []
+        try:
+            for name in ("a", "b", "c"):
+                h = BeaconChainHarness(validator_count=16, fake_crypto=True,
+                                       genesis_time=1_600_000_000)
+                n = LocalNode(peer_id=name, harness=h,
+                              endpoint=TcpEndpoint(name))
+                n.enable_discv5()
+                nodes.append(n)
+            na, nb, nc = nodes
+            # a and b register with the boot node (handshake carries their
+            # ENRs, incl. tcp ports)
+            assert na.discv5.ping(boot.enr) == 1
+            assert nb.discv5.ping(boot.enr) == 1
+            assert len(boot.table) >= 2
+            # the newcomer discovers and dials them over the TCP fabric
+            dialed = nc.discover_peers_discv5([boot.enr], max_new=8)
+            assert dialed >= 2, f"only dialed {dialed}"
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and len(
+                    nc.endpoint.connected_peers()) < 2:
+                time.sleep(0.05)
+            assert {"a", "b"} <= nc.endpoint.connected_peers()
+            # and the fabric is live: gossip a block from a, c imports it
+            na.harness.advance_slot(); nb.harness.advance_slot()
+            nc.harness.advance_slot()
+            blk = na.harness.produce_signed_block()
+            root = na.chain.process_block(blk, block_delay_seconds=1.0)
+            na.publish_block(blk)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and nc.chain.head_root != root:
+                time.sleep(0.05)
+            assert nc.chain.head_root == root
+        finally:
+            for n in nodes:
+                n.shutdown()
+            boot.stop()
+            set_backend("host")
+
     def test_bootstrap_discovers_peers(self):
         boot = Discv5Service(KeyPair()).start()
         others = [Discv5Service(KeyPair()).start() for _ in range(3)]

@@ -82,6 +82,47 @@ class TestTcpEndpoint:
             a.close()
             b.close()
 
+    def test_secured_connection_survives_idle(self):
+        """The yamux rx thread must never inherit the handshake's socket
+        timeout: an idle healthy connection outlives every handshake bound
+        (regression: idle secured connections died ~5s after setup)."""
+        a = TcpEndpoint("alice", secured=True)
+        b = TcpEndpoint("bob", secured=True)
+        try:
+            a.dial(*b.listen_addr)
+            time.sleep(6.5)  # longer than any handshake timeout, no traffic
+            assert "bob" in a.connected_peers()
+            assert "alice" in b.connected_peers()
+            assert a.send("bob", Envelope(kind="gossip", sender="alice",
+                                          topic="t", data=b"post-idle"))
+            assert b.inbound.get(timeout=5).data == b"post-idle"
+        finally:
+            a.close()
+            b.close()
+
+    def test_secured_impersonation_refused(self):
+        """A connection proving a DIFFERENT secp256k1 identity but claiming
+        an already-bound peer id must be refused, not allowed to evict the
+        real peer's connection."""
+        a = TcpEndpoint("alice", secured=True)
+        b = TcpEndpoint("bob", secured=True)
+        evil = TcpEndpoint("alice", secured=True)  # same id, new identity
+        try:
+            a.dial(*b.listen_addr)
+            assert wait_until(lambda: "alice" in b.connected_peers(), 10)
+            try:
+                evil.dial(*b.listen_addr)
+            except Exception:
+                pass  # refusal may surface as a dial error
+            time.sleep(0.5)
+            assert a.send("bob", Envelope(kind="gossip", sender="alice",
+                                          topic="t", data=b"still-me"))
+            assert b.inbound.get(timeout=5).data == b"still-me"
+        finally:
+            a.close()
+            b.close()
+            evil.close()
+
     def test_disconnect_fires_callback(self):
         a = TcpEndpoint("alice")
         b = TcpEndpoint("bob")
