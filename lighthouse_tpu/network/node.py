@@ -136,8 +136,12 @@ class LocalNode:
         for enr in list(self.discv5.table.values()):
             tcp_raw = enr.pairs.get(b"tcp")
             ip = enr.ip()
-            if tcp_raw and ip is not None:
+            if not tcp_raw or ip is None:
+                continue
+            try:
                 addrs.append((ip, discv5_rlp.decode_uint(tcp_raw)))
+            except Exception:
+                continue  # one malformed record must not veto the round
         return self._dial_new_addrs(addrs, max_new)
 
     def discover_peers(self, max_new: int = 8) -> int:
@@ -150,8 +154,10 @@ class LocalNode:
         endpoint = self.endpoint
         if not hasattr(endpoint, "dial"):
             return 0  # in-process hub: topology is explicit
-        addrs = []
+        dialed = 0
         for peer in list(endpoint.connected_peers()):
+            if dialed >= max_new:
+                break  # stop issuing RPCs once the round's budget is met
             try:
                 chunks = self.service.request(
                     peer, rpc_mod.PEER_EXCHANGE,
@@ -159,6 +165,7 @@ class LocalNode:
                 )
             except rpc_mod.RpcError:
                 continue
+            addrs = []
             for result, payload, _ctx in chunks:
                 if result != rpc_mod.SUCCESS:
                     continue
@@ -174,7 +181,8 @@ class LocalNode:
                     (e.host, e.port) for e in entries
                     if e.peer_id != self.peer_id
                 )
-        return self._dial_new_addrs(addrs, max_new)
+            dialed += self._dial_new_addrs(addrs, max_new - dialed)
+        return dialed
 
     # ------------------------------------------------------------ publish
 

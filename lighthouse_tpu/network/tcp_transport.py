@@ -178,9 +178,13 @@ class TcpEndpoint:
         self.on_connect: Optional[Callable[[str], None]] = None
         self.on_disconnect: Optional[Callable[[str], None]] = None
         self._conns: Dict[str, socket.socket] = {}
-        # peer id -> Noise-proven secp256k1 identity (secured mode): a later
-        # connection claiming the same peer id with a DIFFERENT identity is
-        # an impersonation attempt and is refused, not allowed to evict.
+        # peer id -> Noise-proven secp256k1 identity (secured mode): while a
+        # connection is LIVE, a second connection claiming its peer id with
+        # a different key is refused (no eviction-by-impersonation).  The
+        # binding lifts when the connection drops — peer ids here are
+        # self-declared (the reference derives them from the key itself),
+        # so pinning beyond the connection's life would lock out an
+        # honestly-restarted peer with a fresh auto-generated key.
         self._peer_identities: Dict[str, bytes] = {}
         # peer id -> (host, listen_port) for re-dialing / peer exchange
         self.peer_listen_addrs: Dict[str, Tuple[str, int]] = {}
@@ -256,6 +260,7 @@ class TcpEndpoint:
         try:
             if self.secured:
                 sock = self._upgrade_outbound(sock)
+                sock.settimeout(timeout)  # soft bound on the hello reads
             sock.sendall(_encode(self._hello()))
             payload = _read_frame(sock)
             if payload is None:
@@ -272,9 +277,13 @@ class TcpEndpoint:
                 pass
             raise
         sock.settimeout(None)
-        # the address we DIALED is authoritative for this peer
+        if not self._register_conn(hello.sender, sock):
+            raise TcpTransportError(
+                f"peer {hello.sender!r} refused: identity mismatch with a "
+                "live connection")
+        # the address we DIALED is authoritative for this peer (recorded
+        # only for ESTABLISHED connections)
         self._store_peer_addr(hello.sender, (host, port))
-        self._register_conn(hello.sender, sock)
         return hello.sender
 
     def _accept_loop(self) -> None:
@@ -292,6 +301,7 @@ class TcpEndpoint:
             sock.settimeout(5.0)
             if self.secured:
                 sock = self._upgrade_inbound(sock)
+                sock.settimeout(5.0)  # soft bound on the hello reads
             payload = _read_frame(sock)
             if payload is None:
                 sock.close()
@@ -308,12 +318,16 @@ class TcpEndpoint:
         self._record_peer_addr(hello.sender, sock, hello)
         self._register_conn(hello.sender, sock)
 
-    def _register_conn(self, peer: str, sock: socket.socket) -> None:
+    def _register_conn(self, peer: str, sock: socket.socket) -> bool:
+        """Returns False when the connection was REFUSED (identity
+        mismatch against a live binding) — callers must not report it as
+        established."""
         identity = getattr(sock, "remote_identity", None)
         with self._lock:
             bound = self._peer_identities.get(peer)
-            if identity is not None and bound is not None and bound != identity:
-                refused = True  # proven-key mismatch: impersonation
+            if (identity is not None and bound is not None
+                    and bound != identity and peer in self._conns):
+                refused = True  # live conn + proven-key mismatch
             else:
                 refused = False
                 if identity is not None:
@@ -323,7 +337,7 @@ class TcpEndpoint:
                 sock.close()
             except OSError:
                 pass
-            return
+            return False
         with self._lock:
             old = self._conns.pop(peer, None)
             self._conns[peer] = sock
@@ -339,6 +353,7 @@ class TcpEndpoint:
         ).start()
         if self.on_connect:
             self.on_connect(peer)
+        return True
 
     # ---------------------------------------------------------------- io
 
@@ -362,6 +377,8 @@ class TcpEndpoint:
             if self._conns.get(peer) is sock:
                 del self._conns[peer]
                 self._write_locks.pop(peer, None)
+                # the identity binding lives as long as the connection
+                self._peer_identities.pop(peer, None)
             else:
                 return  # superseded by a reconnect
         try:

@@ -82,6 +82,35 @@ class TestTcpEndpoint:
             a.close()
             b.close()
 
+    def test_nodes_gossip_over_secured_fabric(self):
+        """Two full beacon nodes on SECURED endpoints (multistream -> noise
+        -> yamux): blocks gossip and import across the encrypted,
+        identity-proven fabric."""
+        from lighthouse_tpu.chain import BeaconChainHarness
+        from lighthouse_tpu.crypto.bls.backends import set_backend
+        from lighthouse_tpu.network.node import LocalNode
+
+        set_backend("fake")
+        ha = BeaconChainHarness(validator_count=16, fake_crypto=True,
+                                genesis_time=1_600_000_000)
+        hb = BeaconChainHarness(validator_count=16, fake_crypto=True,
+                                genesis_time=1_600_000_000)
+        na = LocalNode(peer_id="a", harness=ha,
+                       endpoint=TcpEndpoint("a", secured=True))
+        nb = LocalNode(peer_id="b", harness=hb,
+                       endpoint=TcpEndpoint("b", secured=True))
+        try:
+            na.endpoint.dial(*nb.endpoint.listen_addr)
+            assert wait_until(lambda: "a" in nb.endpoint.connected_peers(), 10)
+            ha.advance_slot(); hb.advance_slot()
+            blk = ha.produce_signed_block()
+            root = na.chain.process_block(blk, block_delay_seconds=1.0)
+            na.publish_block(blk)
+            assert wait_until(lambda: nb.chain.head_root == root, 15)
+        finally:
+            na.shutdown(); nb.shutdown()
+            set_backend("host")
+
     def test_secured_connection_survives_idle(self):
         """The yamux rx thread must never inherit the handshake's socket
         timeout: an idle healthy connection outlives every handshake bound
