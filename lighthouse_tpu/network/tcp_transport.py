@@ -315,14 +315,19 @@ class TcpEndpoint:
         except Exception:
             sock.close()
             return
-        self._record_peer_addr(hello.sender, sock, hello)
-        self._register_conn(hello.sender, sock)
+        if self._register_conn(hello.sender, sock):
+            # address recorded only for ESTABLISHED connections — a refused
+            # impersonator must not poison the address book either
+            self._record_peer_addr(hello.sender, sock, hello)
 
     def _register_conn(self, peer: str, sock: socket.socket) -> bool:
         """Returns False when the connection was REFUSED (identity
         mismatch against a live binding) — callers must not report it as
-        established."""
+        established.  Check and install are ONE critical section: two
+        concurrent handshakes for the same peer id must never leave the
+        binding describing a key other than the surviving connection's."""
         identity = getattr(sock, "remote_identity", None)
+        old = None
         with self._lock:
             bound = self._peer_identities.get(peer)
             if (identity is not None and bound is not None
@@ -332,16 +337,15 @@ class TcpEndpoint:
                 refused = False
                 if identity is not None:
                     self._peer_identities[peer] = identity
+                old = self._conns.pop(peer, None)
+                self._conns[peer] = sock
+                self._write_locks[peer] = threading.Lock()
         if refused:
             try:
                 sock.close()
             except OSError:
                 pass
             return False
-        with self._lock:
-            old = self._conns.pop(peer, None)
-            self._conns[peer] = sock
-            self._write_locks[peer] = threading.Lock()
         if old is not None:
             try:
                 old.close()
