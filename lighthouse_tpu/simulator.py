@@ -19,8 +19,9 @@ from .network.transport import Hub
 
 
 class SimNode:
-    def __init__(self, *, index: int, hub: Hub, validator_count: int,
-                 keys: List[int], genesis_time: int, spec=None):
+    def __init__(self, *, index: int, hub: Optional[Hub], validator_count: int,
+                 keys: List[int], genesis_time: int, spec=None,
+                 endpoint=None):
         self.index = index
         self.harness = BeaconChainHarness(
             validator_count=validator_count, fake_crypto=True,
@@ -28,7 +29,8 @@ class SimNode:
         )
         self.keys = set(keys)  # validator indices this node runs
         self.node = LocalNode(
-            hub=hub, peer_id=f"sim{index}", harness=self.harness
+            hub=hub, peer_id=f"sim{index}", harness=self.harness,
+            endpoint=endpoint,
         )
 
     @property
@@ -74,29 +76,73 @@ class SimNode:
     def shutdown(self) -> None:
         # sever the fabric links too: live peers must stop delivering into a
         # dead node's inbound queue (unbounded growth otherwise)
-        for peer in list(self.node.endpoint.connected_peers()):
-            self.node.endpoint.hub.disconnect(self.node.peer_id, peer)
+        endpoint = self.node.endpoint
+        if hasattr(endpoint, "hub"):
+            for peer in list(endpoint.connected_peers()):
+                endpoint.hub.disconnect(self.node.peer_id, peer)
         self.node.shutdown()
 
 
 class Simulator:
-    """N nodes, full mesh, validators partitioned round-robin."""
+    """N nodes, validators partitioned round-robin.
+
+    ``transport="hub"`` (default) is the in-process fabric; "tcp_secured"
+    runs every node on a real TCP endpoint upgraded through the libp2p
+    ladder (multistream -> noise -> yamux).  ``discovery="discv5"`` (tcp
+    only) has nodes find each other through a discv5 boot node instead of
+    an explicit full mesh — the reference simulator's topology built the
+    reference way."""
 
     def __init__(self, *, node_count: int = 3, validator_count: int = 16,
-                 genesis_time: int = 1_600_000_000, spec=None):
-        self.hub = Hub()
+                 genesis_time: int = 1_600_000_000, spec=None,
+                 transport: str = "hub", discovery: Optional[str] = None):
+        if transport not in ("hub", "tcp_secured"):
+            raise ValueError(f"unknown transport {transport!r}")
+        tcp = transport == "tcp_secured"
         self.nodes: List[SimNode] = []
+        self.boot_discv5 = None
+        self.hub = None if tcp else Hub()
         shares: List[List[int]] = [[] for _ in range(node_count)]
         for v in range(validator_count):
             shares[v % node_count].append(v)
-        for i in range(node_count):
-            self.nodes.append(SimNode(
-                index=i, hub=self.hub, validator_count=validator_count,
-                keys=shares[i], genesis_time=genesis_time, spec=spec,
-            ))
-        for i in range(node_count):
-            for j in range(i + 1, node_count):
-                self.hub.connect(f"sim{i}", f"sim{j}")
+
+        try:
+            for i in range(node_count):
+                endpoint = None
+                if tcp:
+                    from .network.tcp_transport import TcpEndpoint
+
+                    endpoint = TcpEndpoint(f"sim{i}", secured=True)
+                self.nodes.append(SimNode(
+                    index=i, hub=self.hub, validator_count=validator_count,
+                    keys=shares[i], genesis_time=genesis_time, spec=spec,
+                    endpoint=endpoint,
+                ))
+            # topology wiring
+            if not tcp:
+                for i in range(node_count):
+                    for j in range(i + 1, node_count):
+                        self.hub.connect(f"sim{i}", f"sim{j}")
+            elif discovery == "discv5":
+                from .network.discv5 import Discv5Service, KeyPair
+
+                self.boot_discv5 = Discv5Service(KeyPair()).start()
+                for n in self.nodes:  # register everyone with the boot node
+                    n.node.enable_discv5()
+                    n.node.discv5.ping(self.boot_discv5.enr)
+                for n in self.nodes:  # then discover + dial over the fabric
+                    n.node.discover_peers_discv5([self.boot_discv5.enr],
+                                                 max_new=node_count)
+            else:
+                for i in range(node_count):
+                    for j in range(i + 1, node_count):
+                        self.nodes[i].node.endpoint.dial(
+                            *self.nodes[j].node.endpoint.listen_addr)
+        except Exception:
+            # wiring failed mid-way: the caller never gets the object, so
+            # release every listener/UDP socket/thread created so far
+            self.shutdown()
+            raise
 
     def run_slot(self) -> int:
         """Advance every clock one slot and run all duties; returns the slot.
@@ -148,3 +194,5 @@ class Simulator:
     def shutdown(self) -> None:
         for n in self.nodes:
             n.shutdown()
+        if self.boot_discv5 is not None:
+            self.boot_discv5.stop()
