@@ -36,9 +36,14 @@ SEEN_CACHE_SIZE = 16384
 # degenerates to the old flood — same delivery, bounded amplification at
 # scale.
 MESH_DEGREE = 8  # gossipsub D
+MESH_DEGREE_LOW = 4  # D_low: heartbeat grafts below this
+MESH_DEGREE_HIGH = 12  # D_high: heartbeat prunes above this
 LAZY_DEGREE = 6  # gossip_lazy
 MCACHE_SIZE = 512  # message cache entries servable via IWANT
 IWANT_RETRY_SECS = 5.0  # re-pull window when an advertiser never delivers
+HEARTBEAT_SECS = 1.0  # gossipsub heartbeat_interval
+PRUNE_BACKOFF_SECS = 60  # v1.1 prune_backoff: no re-graft window
+PX_PEERS = 16  # v1.1 prune_peers: peer-exchange records per PRUNE
 
 # Gossipsub v1.1 peer-score thresholds (reference PeerScoreThresholds /
 # lighthouse_network's gossipsub config), mapped onto THIS peer manager's
@@ -66,6 +71,16 @@ class NetworkService:
         self.peer_manager = peer_manager if peer_manager is not None else PeerManager()
         self.rate_limiter = rate_limiter if rate_limiter is not None else RPCRateLimiter()
         self.subscriptions: set = set()
+        # gossipsub mesh state (reference vendored gossipsub behaviour.rs):
+        # peer_topics — which topics each connected peer announced via
+        # SubOpts; mesh — full-message peers per topic (both grafted-by-us
+        # and grafted-us); _graft_backoff — (peer, topic) -> monotonic
+        # deadline before which re-GRAFT is refused (v1.1 prune backoff)
+        self.peer_topics: Dict[str, set] = {}
+        self.mesh: Dict[str, set] = {}
+        self._graft_backoff: Dict[Tuple[str, str], float] = {}
+        self._mesh_lock = threading.Lock()
+        self._last_heartbeat = 0.0
         self._seen: "OrderedDict[bytes, None]" = OrderedDict()
         self._mcache: "OrderedDict[bytes, Tuple[str, bytes]]" = OrderedDict()
         self._iwant_pending: "OrderedDict[bytes, float]" = OrderedDict()
@@ -93,10 +108,19 @@ class NetworkService:
         if not self.peer_manager.on_connect(peer):
             self.endpoint.disconnect(peer)  # banned
             return
+        # announce our topic interest (gossipsub: SubOpts on stream open)
+        for topic in sorted(self.subscriptions):
+            self.endpoint.send(
+                peer, Envelope(kind="subscribe", sender=self.peer_id, topic=topic)
+            )
         if self.on_peer_connected:
             self.on_peer_connected(peer)
 
     def _handle_disconnect(self, peer: str) -> None:
+        with self._mesh_lock:
+            self.peer_topics.pop(peer, None)
+            for members in self.mesh.values():
+                members.discard(peer)
         self.peer_manager.on_disconnect(peer)
         if self.on_peer_disconnected:
             self.on_peer_disconnected(peer)
@@ -109,10 +133,28 @@ class NetworkService:
     # ------------------------------------------------------------- gossip
 
     def subscribe(self, topic: str) -> None:
-        self.subscriptions.add(str(topic))
+        topic = str(topic)
+        if topic in self.subscriptions:
+            return
+        self.subscriptions.add(topic)
+        env = Envelope(kind="subscribe", sender=self.peer_id, topic=topic)
+        for peer in self.endpoint.connected_peers():
+            self.endpoint.send(peer, env)
+        # mesh formation happens on the next heartbeat (gossipsub JOIN)
 
     def unsubscribe(self, topic: str) -> None:
-        self.subscriptions.discard(str(topic))
+        topic = str(topic)
+        if topic not in self.subscriptions:
+            return
+        self.subscriptions.discard(topic)
+        # gossipsub LEAVE: PRUNE every mesh member, then announce
+        with self._mesh_lock:
+            members = self.mesh.pop(topic, set())
+        for peer in members:
+            self._send_prune(peer, topic)
+        env = Envelope(kind="unsubscribe", sender=self.peer_id, topic=topic)
+        for peer in self.endpoint.connected_peers():
+            self.endpoint.send(peer, env)
 
     def _mark_seen(self, mid: bytes) -> bool:
         """True if newly seen."""
@@ -130,18 +172,41 @@ class NetworkService:
             while len(self._mcache) > MCACHE_SIZE:
                 self._mcache.popitem(last=False)
 
-    def mesh_peers(self, topic: str, candidates) -> Tuple[list, list]:
-        """(mesh, lazy) split: a stable per-(node, topic) choice of at most
-        MESH_DEGREE full-message peers; up to LAZY_DEGREE of the rest get
-        IHAVE.  OUR peer id is mixed into the ranking — a global order would
-        make every node pick the same top peers and starve the tail; per-node
-        orders give the random-graph connectivity gossipsub meshes rely on."""
+    def _rank_key(self, topic: str):
+        """Stable per-(node, topic) peer ranking.  OUR peer id is mixed
+        into the order — a global order would make every node pick the same
+        top peers and starve the tail; per-node orders give the
+        random-graph connectivity gossipsub meshes rely on."""
         me = self.peer_id.encode()
-        ranked = sorted(
-            candidates,
-            key=lambda p: hashlib.sha256(me + p.encode() + topic.encode()).digest(),
-        )
+
+        def key(p: str) -> bytes:
+            return hashlib.sha256(me + p.encode() + topic.encode()).digest()
+
+        return key
+
+    def mesh_peers(self, topic: str, candidates) -> Tuple[list, list]:
+        """(mesh, lazy) split of ``candidates`` by deterministic rank."""
+        ranked = sorted(candidates, key=self._rank_key(topic))
         return ranked[:MESH_DEGREE], ranked[MESH_DEGREE:MESH_DEGREE + LAZY_DEGREE]
+
+    def _topic_candidates(self, topic: str, exclude: Optional[str], floor: float):
+        """Connected peers eligible for ``topic`` traffic: above the score
+        floor and — when they have announced a subscription set — actually
+        subscribed (gossipsub never pushes to peers outside the topic).  A
+        peer with NO announcement yet is included: its SubOpts may still be
+        in flight."""
+        pm = self.peer_manager
+        with self._mesh_lock:
+            # membership-only reads under the lock — no per-message deep
+            # copy of every peer's whole topic set
+            excluded = {p for p, ts in self.peer_topics.items()
+                        if topic not in ts}
+        out = []
+        for p in pm.connected_peers():
+            if p == exclude or p in excluded or pm.score(p) < floor:
+                continue
+            out.append(p)
+        return out
 
     def _disseminate(self, topic: str, mid: bytes, compressed: bytes,
                      exclude: Optional[str], publishing: bool = False) -> int:
@@ -149,13 +214,19 @@ class NetworkService:
         # v1.1 score gates: low-scored peers fall out of gossip entirely,
         # and our OWN publications demand the stricter publish threshold.
         floor = PUBLISH_THRESHOLD if publishing else GOSSIP_THRESHOLD
-        pm = self.peer_manager
-        peers = [p for p in pm.connected_peers()
-                 if p != exclude and pm.score(p) >= floor]
-        mesh, lazy = self.mesh_peers(topic, peers)
+        candidates = self._topic_candidates(topic, exclude, floor)
+        with self._mesh_lock:
+            grafted = set(self.mesh.get(topic, ())) & set(candidates)
+        # Eager push: the grafted mesh, topped up by ranked candidates until
+        # the target degree — a just-subscribed node has full delivery
+        # before its first heartbeat forms the mesh.
+        ranked = sorted((p for p in candidates if p not in grafted),
+                        key=self._rank_key(topic))
+        eager = list(grafted) + ranked[:max(0, MESH_DEGREE - len(grafted))]
+        lazy = [p for p in ranked if p not in eager][:LAZY_DEGREE]
         env = Envelope(kind="gossip", sender=self.peer_id, topic=topic, data=compressed)
         n = 0
-        for peer in mesh:
+        for peer in eager:
             if self.endpoint.send(peer, env):
                 n += 1
         if lazy:
@@ -234,6 +305,10 @@ class NetworkService:
             # manager's heartbeat closes connections below the threshold).
             for peer in self.peer_manager.heartbeat():
                 self.endpoint.disconnect(peer)
+            now = time.monotonic()
+            if now - self._last_heartbeat >= HEARTBEAT_SECS:
+                self._last_heartbeat = now
+                self._mesh_heartbeat(now)
             if env is None:
                 continue
             try:
@@ -243,6 +318,14 @@ class NetworkService:
                     self._on_ihave(env)
                 elif env.kind == "iwant":
                     self._on_iwant(env)
+                elif env.kind == "subscribe":
+                    self._on_subscribe(env)
+                elif env.kind == "unsubscribe":
+                    self._on_unsubscribe(env)
+                elif env.kind == "graft":
+                    self._on_graft(env)
+                elif env.kind == "prune":
+                    self._on_prune(env)
                 elif env.kind == "rpc_request":
                     self._on_rpc_request(env)
                 elif env.kind == "rpc_response":
@@ -253,6 +336,168 @@ class NetworkService:
                 from .peer_manager import PeerAction
 
                 self.peer_manager.report(env.sender, PeerAction.LOW_TOLERANCE, "codec error")
+
+    # -------------------------------------------------- mesh maintenance
+
+    def _send_prune(self, peer: str, topic: str) -> None:
+        """PRUNE with v1.1 backoff + peer exchange.  Recording the backoff
+        locally serves both directions: we won't re-graft the peer during
+        the window, and a GRAFT from it inside the window is a violation."""
+        from .transport import encode_prune_data
+
+        px: list = []
+        book_fn = getattr(self.endpoint, "known_peer_addrs", None)
+        if book_fn is not None:
+            with self._mesh_lock:
+                excluded = {p for p, ts in self.peer_topics.items()
+                            if topic not in ts}
+            for p, (host, port) in book_fn().items():
+                if p in (peer, self.peer_id) or p in excluded:
+                    continue
+                px.append(f"{host}:{port}|{p}")
+                if len(px) >= PX_PEERS:
+                    break
+        with self._mesh_lock:
+            self._graft_backoff[(peer, topic)] = (
+                time.monotonic() + PRUNE_BACKOFF_SECS
+            )
+            self._trim_backoff_locked()
+        self.endpoint.send(
+            peer,
+            Envelope(kind="prune", sender=self.peer_id, topic=topic,
+                     data=encode_prune_data(PRUNE_BACKOFF_SECS, px)),
+        )
+
+    def _trim_backoff_locked(self) -> None:
+        while len(self._graft_backoff) > 4096:
+            self._graft_backoff.pop(next(iter(self._graft_backoff)))
+
+    MAX_PEER_TOPICS = 1024  # a real node needs ~100 (64 subnets + core)
+
+    def _on_subscribe(self, env: Envelope) -> None:
+        if not env.topic:
+            return
+        from .peer_manager import PeerAction
+
+        with self._mesh_lock:
+            topics = self.peer_topics.setdefault(env.sender, set())
+            if len(topics) >= self.MAX_PEER_TOPICS:
+                overflow = env.topic not in topics
+            else:
+                topics.add(env.topic)
+                overflow = False
+        if overflow:
+            self.peer_manager.report(
+                env.sender, PeerAction.LOW_TOLERANCE, "subscription flood")
+
+    def _on_unsubscribe(self, env: Envelope) -> None:
+        if not env.topic:
+            return
+        with self._mesh_lock:
+            self.peer_topics.get(env.sender, set()).discard(env.topic)
+            self.mesh.get(env.topic, set()).discard(env.sender)
+
+    def _on_graft(self, env: Envelope) -> None:
+        """gossipsub handle_graft: accept into the mesh, or PRUNE back —
+        and penalize backoff violations (v1.1 behaviour.rs)."""
+        from .peer_manager import PeerAction
+
+        topic, peer = env.topic, env.sender
+        if not topic:
+            return
+        if topic not in self.subscriptions or self.peer_manager.score(peer) < 0:
+            self._send_prune(peer, topic)
+            return
+        with self._mesh_lock:
+            deadline = self._graft_backoff.get((peer, topic), 0.0)
+        if time.monotonic() < deadline:
+            self.peer_manager.report(
+                peer, PeerAction.LOW_TOLERANCE, "graft inside prune backoff")
+            self._send_prune(peer, topic)
+            return
+        with self._mesh_lock:
+            self.mesh.setdefault(topic, set()).add(peer)
+            # grafting implies the peer treats itself as subscribed
+            self.peer_topics.setdefault(peer, set()).add(topic)
+
+    def _on_prune(self, env: Envelope) -> None:
+        from .transport import decode_prune_data
+
+        topic, peer = env.topic, env.sender
+        if not topic:
+            return
+        backoff, px = decode_prune_data(env.data)
+        with self._mesh_lock:
+            self.mesh.get(topic, set()).discard(peer)
+            self._graft_backoff[(peer, topic)] = (
+                time.monotonic() + min(int(backoff), 3600)
+            )
+            self._trim_backoff_locked()
+        # v1.1 peer exchange: feed dialable records to the address book
+        # (never overriding established entries — PX is a hint, not proof)
+        hint = getattr(self.endpoint, "px_hint", None)
+        if hint is None:
+            return
+        for rec in px[:PX_PEERS]:
+            try:
+                addr_part, pid = rec.rsplit("|", 1)
+                host, port_s = addr_part.rsplit(":", 1)
+                hint(pid, (host, int(port_s)))
+            except ValueError:
+                continue
+
+    def _mesh_heartbeat(self, now: float) -> None:
+        """Per-heartbeat mesh maintenance (gossipsub behaviour.rs
+        heartbeat): expire backoffs, evict negative-score members, GRAFT up
+        to D when below D_low, PRUNE down to D when above D_high."""
+        pm = self.peer_manager
+        connected = set(self.endpoint.connected_peers())
+        with self._mesh_lock:
+            for key in [k for k, d in self._graft_backoff.items() if d <= now]:
+                del self._graft_backoff[key]
+            mesh_snapshot = {t: set(m) for t, m in self.mesh.items()}
+            backoff = dict(self._graft_backoff)
+        for topic in sorted(self.subscriptions):
+            snapshot = mesh_snapshot.get(topic, set())
+            members = snapshot & connected
+            removals = snapshot - connected  # gone peers leave the mesh
+            bad = {p for p in members if pm.score(p) < 0}
+            for p in bad:
+                self._send_prune(p, topic)
+            members -= bad
+            removals |= bad
+            additions: set = set()
+            if len(members) < MESH_DEGREE_LOW:
+                with self._mesh_lock:
+                    subscribed = {p for p, ts in self.peer_topics.items()
+                                  if topic in ts}
+                candidates = [
+                    p for p in connected
+                    if p not in members
+                    and pm.score(p) >= 0
+                    and p in subscribed
+                    and backoff.get((p, topic), 0.0) <= now
+                ]
+                ranked = sorted(candidates, key=self._rank_key(topic))
+                graft = Envelope(kind="graft", sender=self.peer_id, topic=topic)
+                for p in ranked[:MESH_DEGREE - len(members)]:
+                    additions.add(p)
+                    self.endpoint.send(p, graft)
+            elif len(members) > MESH_DEGREE_HIGH:
+                ranked = sorted(members, key=self._rank_key(topic))
+                for p in ranked[MESH_DEGREE:]:
+                    removals.add(p)
+                    self._send_prune(p, topic)
+            # Apply as DELTAS under the lock — an unsubscribe() or
+            # disconnect that raced this round's snapshot must not be
+            # clobbered by writing the snapshot back wholesale.
+            with self._mesh_lock:
+                if topic not in self.subscriptions:
+                    self.mesh.pop(topic, None)
+                    continue
+                cur = self.mesh.setdefault(topic, set())
+                cur -= removals
+                cur |= additions
 
     def _graylisted(self, peer: str) -> bool:
         return self.peer_manager.score(peer) < GRAYLIST_THRESHOLD

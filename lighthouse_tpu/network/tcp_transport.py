@@ -40,8 +40,19 @@ from .transport import Envelope
 MAX_FRAME = 64 * 1024 * 1024
 
 _KIND_TO_WIRE = {"hello": 0, "gossip": 1, "rpc_request": 2, "rpc_response": 3,
-                 "ihave": 4, "iwant": 5}
+                 "ihave": 4, "iwant": 5, "subscribe": 6, "unsubscribe": 7,
+                 "graft": 8, "prune": 9}
 _WIRE_TO_KIND = {v: k for k, v in _KIND_TO_WIRE.items()}
+
+# Per-stream protocols negotiated with multistream-select over yamux
+# (secured mode).  Gossip-class traffic speaks the REAL gossipsub v1.1
+# protobuf wire format (reference: vendored gossipsub protocol.rs
+# PROTOCOL: "/meshsub/1.1.0" + varint-delimited rpc.proto frames); the
+# envelope stream carries hello + the ssz_snappy req/resp chunks.
+ENVELOPE_PROTOCOL = "/lighthouse-tpu/envelope/1.0.0"
+MESHSUB_PROTOCOL = "/meshsub/1.1.0"
+MESHSUB_KINDS = frozenset(
+    {"gossip", "ihave", "iwant", "graft", "prune", "subscribe", "unsubscribe"})
 
 
 class TcpTransportError(Exception):
@@ -97,6 +108,72 @@ def _decode(payload: bytes) -> Envelope:
         kind=kind, sender=sender, topic=topic, protocol=proto,
         request_id=request_id, data=data,
     )
+
+
+def _env_to_rpc(env: Envelope):
+    """Gossip-class Envelope -> one gossipsub protobuf RPC."""
+    from . import pb
+    from .transport import decode_prune_data
+
+    if env.kind == "gossip":
+        return pb.RPC(publish=[pb.Message(data=env.data, topic=env.topic or "")])
+    if env.kind == "subscribe":
+        return pb.RPC(subscriptions=[pb.SubOpts(True, env.topic or "")])
+    if env.kind == "unsubscribe":
+        return pb.RPC(subscriptions=[pb.SubOpts(False, env.topic or "")])
+    ctrl = pb.ControlMessage()
+    if env.kind == "ihave":
+        ctrl.ihave.append(pb.ControlIHave(env.topic or "", [env.data]))
+    elif env.kind == "iwant":
+        ctrl.iwant.append(pb.ControlIWant([env.data]))
+    elif env.kind == "graft":
+        ctrl.graft.append(pb.ControlGraft(env.topic or ""))
+    elif env.kind == "prune":
+        backoff, px = decode_prune_data(env.data)
+        peers = []
+        for rec in px:
+            pid = rec.rsplit("|", 1)[1] if "|" in rec else ""
+            peers.append(pb.PeerInfo(peer_id=pid.encode(),
+                                     signed_peer_record=rec.encode()))
+        ctrl.prune.append(pb.ControlPrune(env.topic or "", peers, backoff))
+    else:
+        raise TcpTransportError(f"not a meshsub kind: {env.kind}")
+    return pb.RPC(control=ctrl)
+
+
+def _rpc_to_envs(peer: str, rpc) -> list:
+    """One inbound gossipsub RPC -> Envelopes for the service loop.  The
+    sender is the connection's proven peer, never a wire field (Eth2
+    StrictNoSign: gossipsub's anonymous mode)."""
+    from .transport import encode_prune_data
+
+    envs = []
+    for sub in rpc.subscriptions:
+        envs.append(Envelope(
+            kind="subscribe" if sub.subscribe else "unsubscribe",
+            sender=peer, topic=sub.topic_id))
+    for msg in rpc.publish:
+        envs.append(Envelope(kind="gossip", sender=peer, topic=msg.topic,
+                             data=msg.data))
+    ctrl = rpc.control
+    if ctrl is not None:
+        for ih in ctrl.ihave:
+            for mid in ih.message_ids:
+                envs.append(Envelope(kind="ihave", sender=peer,
+                                     topic=ih.topic_id, data=mid))
+        for iw in ctrl.iwant:
+            for mid in iw.message_ids:
+                envs.append(Envelope(kind="iwant", sender=peer, data=mid))
+        for g in ctrl.graft:
+            envs.append(Envelope(kind="graft", sender=peer, topic=g.topic_id))
+        for pr in ctrl.prune:
+            px = [p.signed_peer_record.decode("utf-8", "replace")
+                  for p in pr.peers if p.signed_peer_record]
+            envs.append(Envelope(
+                kind="prune", sender=peer, topic=pr.topic_id,
+                data=encode_prune_data(
+                    pr.backoff if pr.backoff is not None else 60, px)))
+    return envs
 
 
 def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -191,6 +268,10 @@ class TcpEndpoint:
         # per-connection write mutex: sendall from multiple threads must not
         # interleave partial frames on the stream
         self._write_locks: Dict[str, threading.Lock] = {}
+        # peer -> (meshsub outbound yamux stream, its write lock): the
+        # negotiated /meshsub/1.1.0 substream gossip-class envelopes ride
+        # as protobuf RPC frames (secured mode only)
+        self._meshsub_out: Dict[str, Tuple[object, threading.Lock]] = {}
         self._lock = threading.Lock()
         self._shutdown = False
 
@@ -221,7 +302,11 @@ class TcpEndpoint:
                           hello: "Envelope") -> None:
         if len(hello.data) >= 2:
             (listen_port,) = struct.unpack(">H", hello.data[:2])
-            self._store_peer_addr(peer, (sock.getpeername()[0], listen_port))
+            try:
+                host = sock.getpeername()[0]
+            except OSError:
+                return  # connection already torn down — nothing to record
+            self._store_peer_addr(peer, (host, listen_port))
 
     MAX_KNOWN_ADDRS = 1024  # bound the address book under peer churn
 
@@ -231,6 +316,19 @@ class TcpEndpoint:
         with self._lock:
             return dict(self.peer_listen_addrs)
 
+    def px_hint(self, peer: str, addr: Tuple[str, int]) -> None:
+        """PRUNE peer-exchange hint: record a dialable address only for
+        peers we know NOTHING about — PX comes from an arbitrary peer and
+        must never override an address learned from an established
+        connection (address-book poisoning).  Check and store are ONE
+        critical section: a concurrent authoritative store must win."""
+        with self._lock:
+            if peer in self.peer_listen_addrs or peer == self.peer_id:
+                return
+            self.peer_listen_addrs[peer] = addr
+            while len(self.peer_listen_addrs) > self.MAX_KNOWN_ADDRS:
+                self.peer_listen_addrs.pop(next(iter(self.peer_listen_addrs)))
+
     def _store_peer_addr(self, peer: str, addr: Tuple[str, int]) -> None:
         with self._lock:
             self.peer_listen_addrs.pop(peer, None)
@@ -239,19 +337,27 @@ class TcpEndpoint:
                 self.peer_listen_addrs.pop(next(iter(self.peer_listen_addrs)))
 
     def _upgrade_outbound(self, sock: socket.socket):
-        """Shared ladder (noise.upgrade_outbound) + the envelope stream.
-        The raw socket's timeout stays in force through the whole upgrade
-        (a stalling peer fails the handshake instead of pinning it)."""
+        """Shared ladder (noise.upgrade_outbound) + the envelope stream,
+        negotiated per-stream with multistream-select like every libp2p
+        substream.  The raw socket's timeout stays in force through the
+        whole upgrade (a stalling peer fails the handshake instead of
+        pinning it)."""
         from .noise import upgrade_outbound
+        from .noise.multistream import negotiate_outbound
 
         session = upgrade_outbound(sock, self.identity_priv)
-        return _SecuredChannel(session, session.open_stream(), sock)
+        stream = session.open_stream()
+        negotiate_outbound(stream, [ENVELOPE_PROTOCOL])
+        return _SecuredChannel(session, stream, sock)
 
     def _upgrade_inbound(self, sock: socket.socket):
         from .noise import upgrade_inbound
+        from .noise.multistream import negotiate_inbound
 
         session = upgrade_inbound(sock, self.identity_priv)
-        return _SecuredChannel(session, session.accept_stream(timeout=10.0), sock)
+        stream = session.accept_stream(timeout=10.0)
+        negotiate_inbound(stream, [ENVELOPE_PROTOCOL])
+        return _SecuredChannel(session, stream, sock)
 
     def dial(self, host: str, port: int, timeout: float = 5.0) -> str:
         """Connect to a remote endpoint; returns its peer id."""
@@ -340,6 +446,9 @@ class TcpEndpoint:
                 old = self._conns.pop(peer, None)
                 self._conns[peer] = sock
                 self._write_locks[peer] = threading.Lock()
+                # the superseded connection's meshsub stream dies with its
+                # session — a send through it would tear down THIS conn
+                self._meshsub_out.pop(peer, None)
         if refused:
             try:
                 sock.close()
@@ -355,9 +464,94 @@ class TcpEndpoint:
             target=self._read_loop, args=(peer, sock),
             name=f"tcp-read-{self.peer_id}-{peer}", daemon=True,
         ).start()
+        session = getattr(sock, "_session", None)
+        if session is not None:
+            # Secured connection: accept the peer's substreams (its
+            # outbound meshsub) BEFORE opening ours — two nodes opening
+            # simultaneously must not deadlock on each other's accept.
+            threading.Thread(
+                target=self._stream_demux, args=(peer, sock, session),
+                name=f"meshsub-demux-{self.peer_id}-{peer}", daemon=True,
+            ).start()
+            try:
+                self._open_meshsub(peer, sock, session)
+            except Exception:
+                # gossip falls back to the envelope stream — same bytes
+                # at the service layer, just not the protobuf framing
+                pass
         if self.on_connect:
             self.on_connect(peer)
         return True
+
+    # ------------------------------------------------------------ meshsub
+
+    def _open_meshsub(self, peer: str, channel, session) -> None:
+        """Open + negotiate OUR /meshsub/1.1.0 send stream (libp2p
+        gossipsub keeps one unidirectional outbound stream per peer)."""
+        from .noise.multistream import negotiate_outbound
+
+        stream = session.open_stream()
+        negotiate_outbound(stream, [MESHSUB_PROTOCOL])
+        with self._lock:
+            if self._conns.get(peer) is not channel:
+                stream.close()  # superseded while negotiating
+                return
+            self._meshsub_out[peer] = (stream, threading.Lock())
+
+    def _stream_demux(self, peer: str, channel, session) -> None:
+        """Accept inbound substreams for the connection's lifetime and
+        dispatch by negotiated protocol (the libp2p behaviour's inbound
+        stream handler)."""
+        from .noise.multistream import MultistreamError, negotiate_inbound
+        from .noise.yamux import YamuxError
+
+        while not self._shutdown and session._running:
+            with self._lock:
+                if self._conns.get(peer) is not channel:
+                    return  # superseded
+            try:
+                stream = session.accept_stream(timeout=5.0)
+            except YamuxError:
+                continue
+            except Exception:
+                return
+            try:
+                proto = negotiate_inbound(stream, [MESHSUB_PROTOCOL])
+            except (MultistreamError, YamuxError, OSError):
+                try:
+                    stream.close()
+                except Exception:
+                    pass
+                continue
+            if proto == MESHSUB_PROTOCOL:
+                threading.Thread(
+                    target=self._meshsub_read_loop,
+                    args=(peer, channel, stream),
+                    name=f"meshsub-read-{self.peer_id}-{peer}", daemon=True,
+                ).start()
+
+    def _meshsub_read_loop(self, peer: str, channel, stream) -> None:
+        """Decode varint-delimited protobuf RPC frames into Envelopes.
+        A protocol violation (StrictNoSign field, bad framing) drops the
+        CONNECTION — the reference's gossipsub handler does the same for
+        invalid RPCs."""
+        from . import pb
+
+        violated = False
+        try:
+            while not self._shutdown:
+                rpc = pb.read_frame(lambda n: stream.recv_exact(n, timeout=None))
+                for env in _rpc_to_envs(peer, rpc):
+                    self.inbound.put(env)
+        except pb.PbError:
+            violated = True
+        except Exception:
+            pass
+        if violated:
+            with self._lock:
+                current = self._conns.get(peer) is channel
+            if current:
+                self._drop_conn(peer, channel)
 
     # ---------------------------------------------------------------- io
 
@@ -381,6 +575,7 @@ class TcpEndpoint:
             if self._conns.get(peer) is sock:
                 del self._conns[peer]
                 self._write_locks.pop(peer, None)
+                self._meshsub_out.pop(peer, None)
                 # the identity binding lives as long as the connection
                 self._peer_identities.pop(peer, None)
             else:
@@ -406,9 +601,19 @@ class TcpEndpoint:
         with self._lock:
             sock = self._conns.get(to)
             wlock = self._write_locks.get(to)
+            meshsub = (self._meshsub_out.get(to)
+                       if env.kind in MESHSUB_KINDS else None)
         if sock is None or wlock is None:
             return False
         try:
+            if meshsub is not None:
+                from . import pb
+
+                stream, mlock = meshsub
+                frame = pb.encode_frame(_env_to_rpc(env))
+                with mlock:
+                    stream.send(frame)
+                return True
             with wlock:
                 sock.sendall(_encode(env))
             return True

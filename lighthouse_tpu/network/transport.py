@@ -23,12 +23,44 @@ from typing import Callable, Dict, Optional, Set, Tuple
 
 @dataclass
 class Envelope:
-    kind: str  # "gossip" | "rpc_request" | "rpc_response"
+    # gossip-class kinds ("gossip", "ihave", "iwant", "graft", "prune",
+    # "subscribe", "unsubscribe") ride the real gossipsub protobuf wire on
+    # secured TCP connections; rpc kinds stay on the envelope stream
+    kind: str
     sender: str
     topic: Optional[str] = None  # gossip
     protocol: Optional[str] = None  # rpc
     request_id: int = 0
     data: bytes = b""
+
+
+# ---------------------------------------------------------- prune payload
+#
+# A PRUNE's envelope data carries the v1.1 backoff + peer-exchange records
+# (gossipsub rpc.proto ControlPrune: backoff seconds + PeerInfo list).  A
+# PX record is our dialable form "host:port|peer_id" — the information the
+# reference puts in a signed peer record.
+
+
+def encode_prune_data(backoff_secs: int, px_records: Optional[list] = None) -> bytes:
+    import struct as _struct
+
+    # clamp: the wire allows uint64 backoffs but anything beyond an hour is
+    # abuse — and must never raise out of a transport read loop
+    backoff = max(0, min(int(backoff_secs), 3600))
+    body = b"\n".join(r.encode() for r in (px_records or []))
+    return _struct.pack(">I", backoff) + body
+
+
+def decode_prune_data(data: bytes):
+    """Returns (backoff_secs, [px_record str])."""
+    import struct as _struct
+
+    if len(data) < 4:
+        return 60, []
+    (backoff,) = _struct.unpack(">I", data[:4])
+    records = [r.decode() for r in data[4:].split(b"\n") if r]
+    return backoff, records
 
 
 class Endpoint:

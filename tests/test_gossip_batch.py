@@ -282,24 +282,28 @@ def test_mesh_split_is_bounded_and_stable():
 
 
 def test_lazy_peers_pull_via_iwant():
-    """A 12-node full mesh: the publisher eagerly pushes to at most D peers;
-    every node still converges on the block (IHAVE -> IWANT pull)."""
-    from lighthouse_tpu.network.service import MESH_DEGREE
+    """A 14-node clique: the publisher eagerly pushes to its mesh only —
+    bounded by gossipsub v1.1's D_high (inbound GRAFTs legitimately grow
+    the mesh past D until the heartbeat prunes at D_high) — strictly fewer
+    than its 13 connected peers, so dissemination is NOT a flood; every
+    node still converges on the block (mesh push + IHAVE -> IWANT pull)."""
+    from lighthouse_tpu.network.service import MESH_DEGREE_HIGH
 
+    n_nodes = 14
     set_backend("fake")
     try:
         hub = Hub()
         harnesses = []
         nodes = []
-        for i in range(12):
+        for i in range(n_nodes):
             hs = BeaconChainHarness(
                 validator_count=16, fake_crypto=True, genesis_time=GENESIS_TIME
             )
             harnesses.append(hs)
             nodes.append(LocalNode(hub=hub, peer_id=f"m{i:02d}", harness=hs))
         try:
-            for i in range(12):
-                for j in range(i + 1, 12):
+            for i in range(n_nodes):
+                for j in range(i + 1, n_nodes):
                     hub.connect(f"m{i:02d}", f"m{j:02d}")
             for hs in harnesses:
                 hs.advance_slot()
@@ -307,7 +311,7 @@ def test_lazy_peers_pull_via_iwant():
             root = signed.message.hash_tree_root()
             harnesses[0].chain.process_block(signed)
             sent = nodes[0].publish_block(signed)
-            assert sent <= MESH_DEGREE, (
+            assert sent <= MESH_DEGREE_HIGH < n_nodes - 1, (
                 f"publisher eagerly pushed to {sent} peers (flood, not mesh)"
             )
             import time
