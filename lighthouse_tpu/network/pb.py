@@ -62,6 +62,16 @@ def read_uvarint(buf: bytes, pos: int) -> Tuple[int, int]:
             raise PbError("varint too long")
 
 
+def _utf8(val: bytes) -> str:
+    """Topic strings must be valid UTF-8; anything else is a framing
+    violation (PbError), not a stray UnicodeDecodeError that would slip
+    past the transport's violation handling."""
+    try:
+        return val.decode()
+    except UnicodeDecodeError as e:
+        raise PbError(f"invalid utf-8 in string field: {e}") from e
+
+
 def _key(field_no: int, wire_type: int) -> bytes:
     return write_uvarint((field_no << 3) | wire_type)
 
@@ -137,7 +147,7 @@ class SubOpts:
             if fno == 1 and wt == 0:
                 sub.subscribe = bool(val)
             elif fno == 2 and wt == 2:
-                sub.topic_id = val.decode()
+                sub.topic_id = _utf8(val)
         return sub
 
 
@@ -159,7 +169,7 @@ class Message:
             if fno == 2 and wt == 2:
                 msg.data = val
             elif fno == 4 and wt == 2:
-                msg.topic = val.decode()
+                msg.topic = _utf8(val)
                 saw_topic = True
             elif fno in (1, 3, 5, 6):
                 # StrictNoSign: from/seqno/signature/key MUST NOT be present
@@ -185,7 +195,7 @@ class ControlIHave:
         c = cls()
         for fno, wt, val in _fields(buf):
             if fno == 1 and wt == 2:
-                c.topic_id = val.decode()
+                c.topic_id = _utf8(val)
             elif fno == 2 and wt == 2:
                 c.message_ids.append(val)
         return c
@@ -219,7 +229,7 @@ class ControlGraft:
         c = cls()
         for fno, wt, val in _fields(buf):
             if fno == 1 and wt == 2:
-                c.topic_id = val.decode()
+                c.topic_id = _utf8(val)
         return c
 
 
@@ -269,7 +279,7 @@ class ControlPrune:
         c = cls()
         for fno, wt, val in _fields(buf):
             if fno == 1 and wt == 2:
-                c.topic_id = val.decode()
+                c.topic_id = _utf8(val)
             elif fno == 2 and wt == 2:
                 c.peers.append(PeerInfo.decode(val))
             elif fno == 3 and wt == 0:

@@ -184,10 +184,16 @@ class NetworkService:
 
         return key
 
-    def mesh_peers(self, topic: str, candidates) -> Tuple[list, list]:
-        """(mesh, lazy) split of ``candidates`` by deterministic rank."""
-        ranked = sorted(candidates, key=self._rank_key(topic))
-        return ranked[:MESH_DEGREE], ranked[MESH_DEGREE:MESH_DEGREE + LAZY_DEGREE]
+    def eager_lazy_split(self, topic: str, candidates, grafted) -> Tuple[list, list]:
+        """The dissemination split: the grafted mesh topped up by ranked
+        candidates to the target degree gets the full message; the next
+        LAZY_DEGREE ranked peers get IHAVE."""
+        grafted = set(grafted)
+        ranked = sorted((p for p in candidates if p not in grafted),
+                        key=self._rank_key(topic))
+        eager = list(grafted) + ranked[:max(0, MESH_DEGREE - len(grafted))]
+        lazy = [p for p in ranked if p not in eager][:LAZY_DEGREE]
+        return eager, lazy
 
     def _topic_candidates(self, topic: str, exclude: Optional[str], floor: float):
         """Connected peers eligible for ``topic`` traffic: above the score
@@ -220,10 +226,7 @@ class NetworkService:
         # Eager push: the grafted mesh, topped up by ranked candidates until
         # the target degree — a just-subscribed node has full delivery
         # before its first heartbeat forms the mesh.
-        ranked = sorted((p for p in candidates if p not in grafted),
-                        key=self._rank_key(topic))
-        eager = list(grafted) + ranked[:max(0, MESH_DEGREE - len(grafted))]
-        lazy = [p for p in ranked if p not in eager][:LAZY_DEGREE]
+        eager, lazy = self.eager_lazy_split(topic, candidates, grafted)
         env = Envelope(kind="gossip", sender=self.peer_id, topic=topic, data=compressed)
         n = 0
         for peer in eager:
@@ -377,6 +380,10 @@ class NetworkService:
     def _on_subscribe(self, env: Envelope) -> None:
         if not env.topic:
             return
+        # a queued announcement from an already-disconnected peer must not
+        # resurrect its peer_topics entry (disconnect cleanup ran first)
+        if env.sender not in self.endpoint.connected_peers():
+            return
         from .peer_manager import PeerAction
 
         with self._mesh_lock:
@@ -405,6 +412,8 @@ class NetworkService:
         topic, peer = env.topic, env.sender
         if not topic:
             return
+        if peer not in self.endpoint.connected_peers():
+            return  # stale GRAFT from a peer that already disconnected
         if topic not in self.subscriptions or self.peer_manager.score(peer) < 0:
             self._send_prune(peer, topic)
             return

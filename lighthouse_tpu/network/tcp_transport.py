@@ -265,6 +265,11 @@ class TcpEndpoint:
         self._peer_identities: Dict[str, bytes] = {}
         # peer id -> (host, listen_port) for re-dialing / peer exchange
         self.peer_listen_addrs: Dict[str, Tuple[str, int]] = {}
+        # insertion-ordered ids whose address came from an UNAUTHENTICATED
+        # PRUNE peer-exchange hint (bounded; only hints evict hints)
+        self._px_hinted: Dict[str, None] = {}
+        # peer -> live inbound meshsub reader count (DoS cap)
+        self._meshsub_readers: Dict[str, int] = {}
         # per-connection write mutex: sendall from multiple threads must not
         # interleave partial frames on the stream
         self._write_locks: Dict[str, threading.Lock] = {}
@@ -316,25 +321,38 @@ class TcpEndpoint:
         with self._lock:
             return dict(self.peer_listen_addrs)
 
+    MAX_PX_HINTS = 256  # unauthenticated PX may only fill this many slots
+
     def px_hint(self, peer: str, addr: Tuple[str, int]) -> None:
         """PRUNE peer-exchange hint: record a dialable address only for
         peers we know NOTHING about — PX comes from an arbitrary peer and
-        must never override an address learned from an established
-        connection (address-book poisoning).  Check and store are ONE
-        critical section: a concurrent authoritative store must win."""
+        must never override OR DISPLACE an address learned from an
+        established connection (address-book poisoning).  Hints live in a
+        bounded sub-budget and only ever evict other hints; check and
+        store are one critical section so a concurrent authoritative
+        store wins."""
         with self._lock:
             if peer in self.peer_listen_addrs or peer == self.peer_id:
                 return
+            while len(self._px_hinted) >= self.MAX_PX_HINTS:
+                victim = next(iter(self._px_hinted))
+                self._px_hinted.pop(victim, None)
+                self.peer_listen_addrs.pop(victim, None)
+            if len(self.peer_listen_addrs) >= self.MAX_KNOWN_ADDRS:
+                return  # book full of authoritative entries: drop the hint
+            self._px_hinted[peer] = None
             self.peer_listen_addrs[peer] = addr
-            while len(self.peer_listen_addrs) > self.MAX_KNOWN_ADDRS:
-                self.peer_listen_addrs.pop(next(iter(self.peer_listen_addrs)))
 
     def _store_peer_addr(self, peer: str, addr: Tuple[str, int]) -> None:
         with self._lock:
+            # an authoritative store upgrades any PX hint for this peer
+            self._px_hinted.pop(peer, None)
             self.peer_listen_addrs.pop(peer, None)
             self.peer_listen_addrs[peer] = addr
             while len(self.peer_listen_addrs) > self.MAX_KNOWN_ADDRS:
-                self.peer_listen_addrs.pop(next(iter(self.peer_listen_addrs)))
+                victim = next(iter(self.peer_listen_addrs))
+                self.peer_listen_addrs.pop(victim)
+                self._px_hinted.pop(victim, None)
 
     def _upgrade_outbound(self, sock: socket.socket):
         """Shared ladder (noise.upgrade_outbound) + the envelope stream,
@@ -524,6 +542,22 @@ class TcpEndpoint:
                     pass
                 continue
             if proto == MESHSUB_PROTOCOL:
+                # libp2p gossipsub keeps ONE inbound stream per peer (a
+                # replacement during re-negotiation makes two briefly);
+                # anything beyond that is a thread-exhaustion attack.
+                with self._lock:
+                    live = self._meshsub_readers.get(peer, 0)
+                    if live >= 2:
+                        over = True
+                    else:
+                        over = False
+                        self._meshsub_readers[peer] = live + 1
+                if over:
+                    try:
+                        stream.close()
+                    except Exception:
+                        pass
+                    continue
                 threading.Thread(
                     target=self._meshsub_read_loop,
                     args=(peer, channel, stream),
@@ -547,6 +581,13 @@ class TcpEndpoint:
             violated = True
         except Exception:
             pass
+        finally:
+            with self._lock:
+                live = self._meshsub_readers.get(peer, 0) - 1
+                if live > 0:
+                    self._meshsub_readers[peer] = live
+                else:
+                    self._meshsub_readers.pop(peer, None)
         if violated:
             with self._lock:
                 current = self._conns.get(peer) is channel

@@ -266,19 +266,26 @@ def test_aggregator_outside_committee_rejected():
 
 
 def test_mesh_split_is_bounded_and_stable():
+    """eager_lazy_split is the split _disseminate actually uses."""
     from lighthouse_tpu.network.service import LAZY_DEGREE, MESH_DEGREE, NetworkService
 
     harness, node = _mk_node(fake=True)
     svc = node.service
     peers = [f"p{i:02d}" for i in range(20)]
-    mesh, lazy = svc.mesh_peers("topic-a", peers)
-    assert len(mesh) == MESH_DEGREE and len(lazy) == LAZY_DEGREE
-    assert set(mesh).isdisjoint(lazy)
+    eager, lazy = svc.eager_lazy_split("topic-a", peers, grafted=())
+    assert len(eager) == MESH_DEGREE and len(lazy) == LAZY_DEGREE
+    assert set(eager).isdisjoint(lazy)
     # stable: the same split every call
-    assert svc.mesh_peers("topic-a", peers) == (mesh, lazy)
+    assert svc.eager_lazy_split("topic-a", peers, grafted=()) == (eager, lazy)
     # different topics pick different meshes (load spreading)
-    mesh_b, _ = svc.mesh_peers("topic-b", peers)
-    assert mesh_b != mesh
+    eager_b, _ = svc.eager_lazy_split("topic-b", peers, grafted=())
+    assert eager_b != eager
+    # grafted mesh members always receive the full message, and top-up
+    # only fills the remaining degree
+    grafted = set(peers[:3])
+    eager_g, lazy_g = svc.eager_lazy_split("topic-a", peers, grafted)
+    assert grafted <= set(eager_g) and len(eager_g) == MESH_DEGREE
+    assert set(eager_g).isdisjoint(lazy_g)
 
 
 def test_lazy_peers_pull_via_iwant():

@@ -95,6 +95,36 @@ def test_varint_edges():
     assert rpc.publish == [] and rpc.control is None
 
 
+def test_invalid_utf8_topic_is_a_framing_violation():
+    """Bad UTF-8 in a topic string must surface as PbError (the transport
+    drops the connection), not a stray UnicodeDecodeError that would slip
+    past the violation handling."""
+    bad_topic = b"\x22\x02\xff\xfe"  # Message.topic, invalid utf-8
+    buf = b"\x12" + bytes([len(b"\x12\x01x" + bad_topic)]) + b"\x12\x01x" + bad_topic
+    with pytest.raises(pb.PbError, match="utf-8"):
+        pb.RPC.decode(buf)
+    with pytest.raises(pb.PbError, match="utf-8"):
+        pb.SubOpts.decode(b"\x08\x01\x12\x01\xff")
+
+
+def test_px_hint_budget_never_displaces_authoritative():
+    """PX spam may only evict other PX hints, never addresses learned from
+    established connections."""
+    from lighthouse_tpu.network.tcp_transport import TcpEndpoint
+
+    ep = TcpEndpoint("pxbudget", secured=False)
+    try:
+        ep._store_peer_addr("real-peer", ("10.0.0.1", 9000))
+        for i in range(ep.MAX_PX_HINTS + 50):
+            ep.px_hint(f"fake{i}", ("6.6.6.6", 1000 + i))
+        book = ep.known_peer_addrs()
+        assert book["real-peer"] == ("10.0.0.1", 9000)
+        hinted = [p for p in book if p.startswith("fake")]
+        assert len(hinted) <= ep.MAX_PX_HINTS
+    finally:
+        ep.close()
+
+
 def test_prune_data_codec():
     data = encode_prune_data(90, ["1.2.3.4:9000|peerA", "5.6.7.8:9001|peerB"])
     backoff, px = decode_prune_data(data)
