@@ -1,0 +1,176 @@
+"""Pallas TPU kernel for batched BLS12-381 Fq multiplication.
+
+SURVEY §7 step 1 calls for the field core as Pallas kernels.  The XLA path
+(`ops/fq.py`) expresses ``fq_mul`` as one (batch, 54·54) @ (54·54, 107)
+int32 einsum plus elementwise folds, and leans on XLA fusion.  This module
+is the hand-scheduled alternative: ONE kernel per batch tile that keeps the
+whole pipeline — schoolbook convolution, two radix-2^8 carry folds, the
+``2^{8k} mod p`` reduction matmul, a final fold and the radix-2^16 recombine
+— in VMEM, touching HBM exactly once per operand (25 int32 in) and once for
+the result.  The XLA path materialises the (batch, 2916) outer product
+between two fusions; here it never leaves registers.
+
+Structure choices for the TPU vector/matrix units:
+
+- carry "shift by one limb" is a constant 128x128 matmul (``_SHIFT1``) —
+  Mosaic lowers lane-dim shifts poorly, matmuls perfectly;
+- the mod-p reduction is the same ``REDMAT8`` matmul as the XLA path,
+  zero-padded to 128 lanes;
+- the radix-2^8 -> 2^16 recombine is a constant selection matmul
+  (even lanes + 256·odd lanes).
+
+Everything is exact int32 arithmetic on redundant limbs — identical value
+semantics to ``ops/fq.py`` (bound discipline documented there; the kernel
+is bit-identical to the einsum path, asserted in tests on random and edge
+inputs in interpret mode).
+
+Reference semantics: the 381-bit modular multiply inside blst's pairing
+(`/root/reference/crypto/bls/src/impls/blst.rs:35-117` bottoms out there);
+this kernel is the TPU-native replacement for those assembly mul chains.
+
+Opt-in: set ``LIGHTHOUSE_TPU_PALLAS_FQ=1`` to route ``ops.fq.fq_mul``'s
+dedicated entry ``fq_mul_pallas`` — the A/B lever for
+``scripts/pallas_bench.py`` on real hardware.  Interpret mode (CPU tests)
+is selected automatically off-TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .fq import (
+    L16,
+    _CONV8,
+    _RED_OUT,
+    _red_rows,
+    fold16_2,
+    split16_to_8,
+)
+
+LANES = 128  # TPU lane width; every kernel-side matrix is 128x128
+_SPLIT8 = 54  # radix-2^8 operand length (25 limbs -> fold16_2 -> 27 -> x2)
+_BT = 128  # batch tile (sublane-friendly; 128x128 int32 tiles = 64 KiB)
+
+
+def _np_shift1() -> np.ndarray:
+    """S[i, i+1] = 1: ``x @ S`` moves every lane one position up (the
+    carry target of ``fold8``'s high byte)."""
+    s = np.zeros((LANES, LANES), np.int32)
+    for i in range(LANES - 1):
+        s[i, i + 1] = 1
+    return s
+
+
+def _np_redmat() -> np.ndarray:
+    """REDMAT8 rows for every lane position, zero-padded to 128x128."""
+    m = np.zeros((LANES, LANES), np.int32)
+    rows = _red_rows(LANES)  # (128, 48) canonical radix-2^8 limbs
+    m[:, :_RED_OUT] = rows
+    return m
+
+
+def _np_combine() -> np.ndarray:
+    """C[2j, j] = 1, C[2j+1, j] = 256: radix-2^8 pairs -> radix-2^16."""
+    c = np.zeros((LANES, LANES), np.int32)
+    for j in range(LANES // 2):
+        c[2 * j, j] = 1
+        c[2 * j + 1, j] = 256
+    return c
+
+
+_SHIFT1 = _np_shift1()
+_REDMAT = _np_redmat()
+_COMBINE = _np_combine()
+
+
+def _fold8_mm(x, shift1):
+    """One radix-2^8 carry fold as (mask, shift, matmul): exact for the
+    signed redundant limbs (arithmetic >> 8)."""
+    lo = x & 0xFF
+    hi = x >> 8
+    return lo + jax.lax.dot(
+        hi, shift1, preferred_element_type=jnp.int32
+    )
+
+
+def _fq_mul_kernel(a8_ref, b8_ref, shift1_ref, redmat_ref, combine_ref,
+                   out_ref):
+    """One batch tile: conv -> fold8 x2 -> REDMAT -> fold8 x2 -> combine."""
+    a8 = a8_ref[...]  # (BT, 128) int32, lanes >= _SPLIT8 are zero
+    b8 = b8_ref[...]
+    shift1 = shift1_ref[...]
+    redmat = redmat_ref[...]
+    combine = combine_ref[...]
+
+    # Schoolbook convolution, statically unrolled: lane k accumulates
+    # a8[i] * b8[k - i] — i.e. c = Σ_i a_i ⊙ roll(b, i).  The roll is one
+    # lane rotation per step (cheap VPU work, no matmul); wraparound never
+    # corrupts low lanes because b8's top nonzero lane is 53 and the
+    # largest rotation is 53 (53 + 53 = 106 < 128).
+    c = a8[:, 0][:, None] * b8
+    bs = b8
+    for i in range(1, _SPLIT8):
+        bs = jnp.roll(bs, 1, axis=-1)
+        c = c + a8[:, i][:, None] * bs
+
+    # fold8_2: two exact carry folds keep every lane in [-52, 307]
+    c = _fold8_mm(_fold8_mm(c, shift1), shift1)
+    # mod-p reduction: one constant matmul maps 109 used lanes -> 48
+    r = jax.lax.dot(c, redmat, preferred_element_type=jnp.int32)
+    r = _fold8_mm(_fold8_mm(r, shift1), shift1)
+    # radix-2^8 pairs -> 25 radix-2^16 limbs (lanes >= 25 become zero)
+    out_ref[...] = jax.lax.dot(r, combine, preferred_element_type=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _fq_mul_pallas_flat(a8p: jax.Array, b8p: jax.Array, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    n_tiles = a8p.shape[0] // _BT
+    consts = [jnp.asarray(_SHIFT1), jnp.asarray(_REDMAT), jnp.asarray(_COMBINE)]
+    const_spec = pl.BlockSpec((LANES, LANES), lambda i: (0, 0))
+    return pl.pallas_call(
+        _fq_mul_kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((_BT, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((_BT, LANES), lambda i: (i, 0)),
+            const_spec, const_spec, const_spec,
+        ],
+        out_specs=pl.BlockSpec((_BT, LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(a8p.shape, jnp.int32),
+        interpret=interpret,
+    )(a8p, b8p, *consts)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def fq_mul_pallas(a: jax.Array, b: jax.Array, *, interpret=None) -> jax.Array:
+    """Drop-in for ``ops.fq.fq_mul`` on (..., 25) int32 limb vectors.
+
+    Host-side prep (fold16_2 + radix split + lane pad) is cheap elementwise
+    work XLA fuses; the hot pipeline runs as one Pallas kernel per 128-row
+    batch tile.  ``interpret`` defaults to auto: False on TPU, True
+    elsewhere (tests)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    lead = a.shape[:-1]
+    a2 = a.reshape(-1, a.shape[-1])
+    b2 = b.reshape(-1, b.shape[-1])
+    a8 = split16_to_8(fold16_2(a2))  # (B, 54) exact
+    b8 = split16_to_8(fold16_2(b2))
+    n = a8.shape[0]
+    n_pad = max(_BT, ((n + _BT - 1) // _BT) * _BT)
+    a8p = jnp.zeros((n_pad, LANES), jnp.int32).at[:n, :_SPLIT8].set(a8)
+    b8p = jnp.zeros((n_pad, LANES), jnp.int32).at[:n, :_SPLIT8].set(b8)
+    out = _fq_mul_pallas_flat(a8p, b8p, interpret)
+    return out[:n, :L16].reshape(*lead, L16)

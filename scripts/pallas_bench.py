@@ -1,0 +1,86 @@
+"""A/B the Pallas fq_mul kernel against the XLA einsum path on the live
+platform (TPU when the tunnel is up; CPU interpret mode is NOT timed — it
+exists for correctness only).
+
+Usage:  python scripts/pallas_bench.py [batch ...]
+
+Writes one JSON line per batch size to stdout and .perf/pallas_fq.json:
+    {"batch": N, "einsum_us_per_mul": ..., "pallas_us_per_mul": ...,
+     "speedup": ..., "platform": "tpu"}
+
+The honest caveat printed with the result: on batch sizes where XLA already
+fuses the einsum pipeline well, the kernel may not win — the value is the
+measured number either way (SURVEY §7 step 1 asks for the Pallas path; the
+decision to adopt it in `_device_verify` is gated on THIS measurement).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    batches = [int(x) for x in sys.argv[1:]] or [1024, 8192]
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+        )
+    except Exception:
+        pass
+
+    from lighthouse_tpu.ops.fq import P, fq_mul, to_limbs16
+    from lighthouse_tpu.ops.pallas_fq import fq_mul_pallas
+
+    platform = jax.devices()[0].platform
+    if platform != "tpu":
+        print(json.dumps({"note": "not on tpu; pallas path would run in "
+                          "interpret mode — timing meaningless", "platform": platform}))
+    results = []
+    rng = np.random.default_rng(5)
+    einsum_mul = jax.jit(fq_mul)
+    for n in batches:
+        vals = np.stack([
+            to_limbs16(int.from_bytes(rng.bytes(47), "little") % P)
+            for _ in range(n)
+        ])
+        a = jnp.asarray(vals)
+        b = jnp.asarray(np.roll(vals, 1, axis=0))
+        row = {"batch": n, "platform": platform}
+        for name, fn in (("einsum", lambda: einsum_mul(a, b)),
+                         ("pallas", lambda: fq_mul_pallas(a, b, interpret=platform != "tpu"))):
+            try:
+                t0 = time.perf_counter()
+                out = fn()
+                jax.block_until_ready(out)
+                row[f"{name}_compile_plus_first_s"] = round(time.perf_counter() - t0, 2)
+                reps = 20
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    out = fn()
+                jax.block_until_ready(out)
+                row[f"{name}_us_per_mul"] = round(
+                    (time.perf_counter() - t0) / reps / n * 1e6, 3)
+            except Exception as e:
+                row[f"{name}_error"] = f"{type(e).__name__}: {e}"
+        if "einsum_us_per_mul" in row and "pallas_us_per_mul" in row:
+            row["speedup"] = round(row["einsum_us_per_mul"] / row["pallas_us_per_mul"], 3)
+        print(json.dumps(row))
+        results.append(row)
+    outdir = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".perf")
+    os.makedirs(outdir, exist_ok=True)
+    with open(os.path.join(outdir, "pallas_fq.json"), "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

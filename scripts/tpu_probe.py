@@ -63,7 +63,11 @@ def main() -> None:
     from lighthouse_tpu.ops.pairing import fe_is_one
     from lighthouse_tpu.ops.verify import _device_verify
 
-    for n_sets, n_keys, reps in [(1, 1, 2), (8, 2, 2), (128, 32, 5), (4096, 32, 2)]:
+    # No 4096 shape here: its HOST-side input build alone takes ~30x the
+    # 128 build (~50 min, observed r5) and wedged the probe past its
+    # timeout — the bench child covers the scale config with
+    # checkpointing, so the probe stops at the headline shape.
+    for n_sets, n_keys, reps in [(1, 1, 2), (8, 2, 2), (128, 32, 5)]:
         shape = f"{n_sets}x{n_keys}"
         try:
             t = time.time()
