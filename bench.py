@@ -40,6 +40,7 @@ Failure-containment contract (VERDICT r4 item 1 — "indestructible"):
 from __future__ import annotations
 
 import atexit
+import functools
 import json
 import os
 import re
@@ -195,12 +196,17 @@ def _child_main(force_cpu: bool) -> None:
         _checkpoint(out)
 
         # Scale config: 4,096 sets x 32-key committees (best-effort — a failure
-        # here must not void the headline number).
+        # here must not void the headline number).  Inputs are 128 distinct
+        # sets tiled with fresh per-set weights: building 4,096 distinct
+        # host signatures takes ~50 min and starved this config out of
+        # every bench window (device work is identical either way).
         try:
+            build = functools.partial(_build_example, tile_base=128)
             scale, warm = _bench_shape(
-                jax, _device_verify, fe_is_one, _build_example,
+                jax, _device_verify, fe_is_one, build,
                 SCALE_N_SETS, N_KEYS, SCALE_REPS, seed=5,
             )
+            out["scale_inputs_tiled"] = True
             out["sets_per_sec_4096x32"] = round(scale, 1)
             out["vs_baseline_4096x32"] = round(scale / BLST_64T_SETS_PER_SEC, 4)
             out["scale_warm_secs"] = round(warm, 1)

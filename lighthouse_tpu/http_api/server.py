@@ -1640,19 +1640,16 @@ def node_peer_by_id(ctx):
 
 @route("GET", "/lighthouse/health")
 def lighthouse_health(ctx):
+    """Process + machine health (reference common/system_health observation
+    surfaced by the /lighthouse/health endpoint)."""
     import os as _os
 
-    la = _os.getloadavg() if hasattr(_os, "getloadavg") else (0.0, 0.0, 0.0)
-    try:
-        import resource
+    from ..system_health import observe_all
 
-        maxrss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-    except ImportError:  # pragma: no cover
-        maxrss_kb = 0
-    return {"data": {
-        "sys_loadavg_1": la[0], "sys_loadavg_5": la[1], "sys_loadavg_15": la[2],
-        "pid": _os.getpid(), "pid_mem_resident_set_size": maxrss_kb * 1024,
-    }}
+    data = observe_all()
+    la = _os.getloadavg() if hasattr(_os, "getloadavg") else (0.0, 0.0, 0.0)
+    data["sys_loadavg_1"], data["sys_loadavg_5"], data["sys_loadavg_15"] = la
+    return {"data": data}
 
 
 @route("GET", "/lighthouse/ui/health")

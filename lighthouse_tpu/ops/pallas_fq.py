@@ -28,10 +28,10 @@ Reference semantics: the 381-bit modular multiply inside blst's pairing
 (`/root/reference/crypto/bls/src/impls/blst.rs:35-117` bottoms out there);
 this kernel is the TPU-native replacement for those assembly mul chains.
 
-Opt-in: set ``LIGHTHOUSE_TPU_PALLAS_FQ=1`` to route ``ops.fq.fq_mul``'s
-dedicated entry ``fq_mul_pallas`` — the A/B lever for
-``scripts/pallas_bench.py`` on real hardware.  Interpret mode (CPU tests)
-is selected automatically off-TPU.
+Opt-in by explicit call: ``fq_mul_pallas`` is the entry point, and
+``scripts/pallas_bench.py`` is the A/B lever on real hardware — adoption
+inside ``_device_verify`` is gated on that measurement.  Interpret mode
+(CPU tests) is selected automatically off-TPU.
 """
 
 from __future__ import annotations
@@ -44,7 +44,6 @@ import numpy as np
 
 from .fq import (
     L16,
-    _CONV8,
     _RED_OUT,
     _red_rows,
     fold16_2,

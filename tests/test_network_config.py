@@ -94,9 +94,18 @@ def test_monitoring_service_pushes_stats():
         svc = MonitoringService(endpoint=url, chain=harness.chain)
         assert svc.send_once()
         assert svc.sends == 1
+        assert len(received[0]) == 2  # beaconnode + system in one POST
         payload = received[0][0]
         assert payload["process"] == "beaconnode"
         assert payload["sync_beacon_head_slot"] == 2
+        # common ProcessMetrics block (monitoring_api/src/types.rs:64-70)
+        assert payload["client_name"] == "lighthouse-tpu"
+        assert payload["memory_process_bytes"] > 0
+        sysp = received[0][1]
+        assert sysp["process"] == "system"
+        assert sysp["memory_node_bytes_total"] > 0
+        assert sysp["cpu_threads"] >= 1
+        assert sysp["misc_os"] == "lin"
         # a dead endpoint must not raise
         svc_dead = MonitoringService(
             endpoint="http://127.0.0.1:1/nothing", chain=harness.chain
@@ -107,3 +116,38 @@ def test_monitoring_service_pushes_stats():
         set_backend("host")
         server.shutdown()
         server.server_close()
+
+
+def test_system_health_observations():
+    """system_health reads /proc without ever raising; core fields are
+    populated on this (Linux) box."""
+    from lighthouse_tpu.system_health import (
+        ProcessHealth,
+        SystemHealth,
+        observe_all,
+    )
+
+    ph = ProcessHealth.observe()
+    assert ph.pid > 0
+    assert ph.pid_num_threads >= 1
+    assert ph.pid_mem_resident_set_size > 0
+    sh = SystemHealth.observe()
+    assert sh.cpu_threads >= 1
+    assert sh.sys_virt_mem_total > 0
+    assert sh.disk_node_bytes_total > 0
+    assert sh.misc_node_boot_ts_seconds > 0
+    flat = observe_all()
+    assert flat["pid"] == ph.pid
+    assert "network_node_bytes_total_received" in flat
+
+
+def test_validator_process_payload():
+    from lighthouse_tpu.monitoring import collect_validator_stats
+
+    class FakeVC:
+        validators = ["a", "b", "c"]
+
+    p = collect_validator_stats(FakeVC())
+    assert p["process"] == "validator"
+    assert p["validator_total"] == 3
+    assert p["client_name"] == "lighthouse-tpu"
