@@ -19,7 +19,7 @@ DEFAULT_UPDATE_PERIOD_SECS = 60.0
 CLIENT_NAME = "lighthouse-tpu"
 
 
-CLIENT_VERSION = "5.2.1-tpu"
+from .. import __version__ as CLIENT_VERSION
 
 
 def _common_process_metrics() -> dict:

@@ -145,10 +145,26 @@ class SystemHealth:
             if len(bits) >= 10 and not bits[2].startswith(("loop", "ram")):
                 disk_rows.append(bits)
         names = {bits[2] for bits in disk_rows}
+
+        def _is_partition(name: str) -> bool:
+            """Kernel partition naming: a parent ending in a digit gets
+            'p<n>' partitions (nvme0n1 -> nvme0n1p1), otherwise bare digits
+            (sda -> sda1).  A plain prefix test would also swallow sibling
+            devices like dm-10 under dm-1."""
+            for parent in names:
+                if parent == name or not name.startswith(parent):
+                    continue
+                suffix = name[len(parent):]
+                if parent[-1].isdigit():
+                    if suffix[0] == "p" and suffix[1:].isdigit():
+                        return True
+                elif suffix.isdigit():
+                    return True
+            return False
+
         for bits in disk_rows:
-            name = bits[2]
-            if any(other != name and name.startswith(other) for other in names):
-                continue  # partition of a listed whole device
+            if _is_partition(bits[2]):
+                continue
             try:
                 h.disk_node_reads_total += int(bits[3])
                 h.disk_node_writes_total += int(bits[7])

@@ -12,6 +12,7 @@ at +2/3) for a real deployment.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import List, Optional
 
@@ -53,6 +54,7 @@ class ValidatorClient:
         slashing_db: Optional[SlashingProtectionDB] = None,
         fake_signatures: bool = False,
         fee_recipient: bytes = b"\x00" * 20,
+        graffiti_file_path: Optional[str] = None,
     ):
         self.spec = spec
         self.types = types
@@ -68,8 +70,14 @@ class ValidatorClient:
         self.attester = AttestationService(
             store=self.store, duties=self.duties, fallback=self.fallback, types=types
         )
+        graffiti_file = None
+        if graffiti_file_path is not None:
+            from .graffiti_file import GraffitiFile
+
+            graffiti_file = GraffitiFile(graffiti_file_path)
         self.blocks = BlockService(
-            store=self.store, duties=self.duties, fallback=self.fallback, types=types
+            store=self.store, duties=self.duties, fallback=self.fallback,
+            types=types, graffiti_file=graffiti_file,
         )
         self.sync_committee = SyncCommitteeService(
             store=self.store, duties=self.duties, fallback=self.fallback, types=types
@@ -80,6 +88,7 @@ class ValidatorClient:
         )
         self.doppelganger: Optional[DoppelgangerService] = None
         self._last_duties_epoch: Optional[int] = None
+        self.latencies: List[dict] = []  # last per-BN RTT measurements
 
     def enable_doppelganger_protection(self, start_epoch: int) -> None:
         """Block ALL signing until liveness checks prove no other instance is
@@ -174,5 +183,24 @@ class ValidatorClient:
             time.sleep(max(0.0, slot_start + 2 * sps / 3 - time.time()))
             safely("aggregate", self.attester.aggregate, slot)
             safely("sync contributions", self.sync_committee.aggregate, slot)
+            # 11/12ths through the slot: measure per-BN latency (reference
+            # latency.rs SLOT_DELAY_MULTIPLIER/DENOMINATOR) — duty traffic
+            # is done by now, so the probe reads steady-state RTT.  The
+            # measurement runs OFF the duty path (a blackholed BN's probe
+            # blocks ~10 s; serialized in-loop it would push every later
+            # duty past its deadline — the exact failure it exists to see).
+            time.sleep(max(0.0, slot_start + sps * 11 / 12 - time.time()))
+
+            def _measure():
+                out = safely("latency measurement",
+                             self.fallback.measure_latency) or []
+                self.latencies = out
+                for m in out:
+                    if m["latency"] is not None:
+                        log.info("beacon node latency", endpoint=m["endpoint"],
+                                 ms=round(m["latency"] * 1000, 1))
+
+            threading.Thread(target=_measure, name="vc-latency",
+                             daemon=True).start()
             time.sleep(max(0.0, slot_start + sps - time.time()))
             done += 1

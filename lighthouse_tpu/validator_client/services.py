@@ -44,6 +44,24 @@ class BeaconNodeFallback:
                 errors.append(f"{client.base_url}: {e}")
         raise NoViableBeaconNode("; ".join(errors))
 
+    def measure_latency(self) -> List[dict]:
+        """Round-trip time to every candidate BN (reference
+        ``latency.rs``/``measure_latency``: a cheap GET per candidate, run
+        11/12ths through the slot).  ``latency`` is None for unreachable
+        nodes."""
+        import time as _time
+
+        out = []
+        for client in self.clients:
+            t0 = _time.monotonic()
+            try:
+                client.node_version()
+                latency = _time.monotonic() - t0
+            except (ApiClientError, OSError):
+                latency = None
+            out.append({"endpoint": client.base_url, "latency": latency})
+        return out
+
 
 class AttesterDuty:
     __slots__ = (
@@ -450,13 +468,30 @@ class BlockService:
     def __init__(self, *, store: ValidatorStore, duties: DutiesService,
                  fallback: BeaconNodeFallback, types,
                  graffiti: bytes = b"lighthouse-tpu".ljust(32, b"\x00"),
-                 builder_proposals: bool = False):
+                 builder_proposals: bool = False, graffiti_file=None):
         self.store = store
         self.duties = duties
         self.fallback = fallback
         self.types = types
         self.graffiti = graffiti
         self.builder_proposals = builder_proposals
+        # reference precedence (graffiti_file.rs): per-validator file entry
+        # > file default > VC-level graffiti flag
+        self.graffiti_file = graffiti_file
+
+    def _graffiti_for(self, pubkey: bytes) -> bytes:
+        if self.graffiti_file is not None:
+            try:
+                g = self.graffiti_file.graffiti_for(pubkey)
+            except Exception as e:
+                # a broken file must not stop proposals — but it must be
+                # LOUD: the operator configured per-validator graffiti and
+                # is silently not getting it
+                log.warning("graffiti file unusable, using default: %s", e)
+                g = None
+            if g is not None:
+                return g
+        return self.graffiti
 
     def propose(self, slot: int) -> Optional[bytes]:
         """Produce, sign (slashing-gated) and publish a block if it is our
@@ -474,8 +509,9 @@ class BlockService:
                 return self._propose_blinded(slot, pubkey, reveal)
             except (ApiClientError, NoViableBeaconNode, KeyError, ValueError):
                 pass  # builder path unavailable: local production below
+        graffiti = self._graffiti_for(pubkey)
         resp = self.fallback.first_success(
-            lambda c: c.produce_block(slot, reveal, graffiti=self.graffiti)
+            lambda c: c.produce_block(slot, reveal, graffiti=graffiti)
         )
         fork = resp["version"]
         if resp.get("execution_payload_blinded"):
@@ -496,8 +532,9 @@ class BlockService:
         return root
 
     def _propose_blinded(self, slot: int, pubkey: bytes, reveal: bytes) -> bytes:
+        graffiti = self._graffiti_for(pubkey)
         resp = self.fallback.first_success(
-            lambda c: c.produce_blinded_block(slot, reveal, graffiti=self.graffiti)
+            lambda c: c.produce_blinded_block(slot, reveal, graffiti=graffiti)
         )
         fork = resp["version"]
         block = container_from_json(self.types.blinded_block[fork], resp["data"])

@@ -390,3 +390,75 @@ def test_preparation_service_routes_fee_recipient(vc_setup):
     assert summary["proposed"] is not None
     head = chain.get_block(chain.head_root)
     assert bytes(head.message.body.execution_payload.fee_recipient) == recipient
+
+
+# ------------------------------------------------- graffiti file + latency
+
+
+def test_graffiti_file_precedence(tmp_path):
+    """Per-validator entry > file default > VC graffiti (graffiti_file.rs)."""
+    from lighthouse_tpu.validator_client.graffiti_file import (
+        GraffitiFile,
+        GraffitiFileError,
+    )
+
+    pk = b"\xab" * 48
+    path = tmp_path / "graffiti.txt"
+    path.write_text(
+        "# comment\n"
+        "default: team default\n"
+        f"0x{pk.hex()}: my very own\n"
+    )
+    gf = GraffitiFile(str(path))
+    assert gf.graffiti_for(pk) == b"my very own".ljust(32, b"\x00")
+    assert gf.graffiti_for(b"\xcd" * 48) == b"team default".ljust(32, b"\x00")
+    # live reload: edits apply without restarting anything
+    path.write_text("default: changed\n")
+    assert gf.graffiti_for(pk) == b"changed".ljust(32, b"\x00")
+    # malformed lines are loud
+    path.write_text("not a mapping\n")
+    with pytest.raises(GraffitiFileError):
+        gf.graffiti_for(pk)
+    path.write_text("0x1234: short pubkey\n")
+    with pytest.raises(GraffitiFileError):
+        gf.graffiti_for(pk)
+    path.write_text("default: " + "x" * 33 + "\n")
+    with pytest.raises(GraffitiFileError):
+        gf.graffiti_for(pk)
+
+
+def test_graffiti_file_flows_into_block(vc_setup, tmp_path):
+    """A produced block carries the file graffiti for the proposer."""
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+    from lighthouse_tpu.validator_client.graffiti_file import GraffitiFile
+
+    set_backend("fake")
+    harness, server, vc = vc_setup
+    chain = harness.chain
+    path = tmp_path / "graffiti.txt"
+    path.write_text("default: from-the-file\n")
+    vc.blocks.graffiti_file = GraffitiFile(str(path))
+    try:
+        slot = harness.advance_slot()
+        summary = vc.run_slot(slot)
+        assert summary["proposed"] is not None
+        head = chain.get_block(chain.head_root)
+        assert bytes(head.message.body.graffiti).rstrip(b"\x00") == b"from-the-file"
+    finally:
+        vc.blocks.graffiti_file = None
+
+
+def test_latency_measurement(vc_setup):
+    """measure_latency reports an RTT per configured BN and None for dead
+    endpoints (latency.rs measure_latency)."""
+    from lighthouse_tpu.http_api import BeaconNodeHttpClient
+    from lighthouse_tpu.validator_client.services import BeaconNodeFallback
+
+    harness, server, vc = vc_setup
+    dead = BeaconNodeHttpClient("http://127.0.0.1:1")
+    dead.timeout = 0.3
+    fb = BeaconNodeFallback([vc.fallback.clients[0], dead])
+    out = fb.measure_latency()
+    assert len(out) == 2
+    assert out[0]["latency"] is not None and out[0]["latency"] < 5
+    assert out[1]["latency"] is None
