@@ -250,6 +250,10 @@ class ClientBuilder:
                 endpoint=self._monitoring_endpoint, chain=chain,
                 update_period=getattr(self, "_monitoring_period", 60.0),
             )
+        if http_server is not None and network_node is not None:
+            # VC subnet subscriptions reach the subnet service through the
+            # API (reference: http_api -> validator_subscriptions channel)
+            http_server.subnet_service = network_node.subnets
         client = Client(
             chain=chain, processor=processor, http_server=http_server,
             slasher=slasher, monitoring=monitoring, network_node=network_node,
@@ -361,6 +365,12 @@ class Client:
                     return
             try:
                 self.chain.per_slot_task()
+                node = self.network_node
+                if node is not None and getattr(node, "subnets", None) is not None:
+                    slot = self.chain.current_slot()
+                    node.subnets.prune(slot)
+                    node.subnets.update_epoch(
+                        slot // self.chain.spec.slots_per_epoch)
                 self._notify()
             except Exception as e:  # a tick must never kill the timer
                 log.warning("per-slot task failed: %s", e)

@@ -1231,11 +1231,20 @@ def aggregate_and_proofs(ctx):
 
 @route("POST", "/eth/v1/validator/beacon_committee_subscriptions", P0)
 def committee_subscriptions(ctx):
-    return None  # subnet backbone subscriptions are static in this stack
+    """Feed aggregator duty subscriptions to the subnet service (reference
+    subnet_service/attestation_subnets.rs); a no-op when the node runs
+    without networking (or with --subscribe-all-subnets)."""
+    subnets = getattr(ctx.server, "subnet_service", None)
+    if subnets is not None:
+        subnets.on_committee_subscriptions(ctx.body or [])
+    return None
 
 
 @route("POST", "/eth/v1/validator/sync_committee_subscriptions", P0)
 def sync_subscriptions(ctx):
+    subnets = getattr(ctx.server, "subnet_service", None)
+    if subnets is not None:
+        subnets.on_sync_committee_subscriptions(ctx.body or [])
     return None
 
 

@@ -31,6 +31,7 @@ class LocalNode:
         bls_backend: Optional[str] = None,
         enable_slasher: bool = False,
         endpoint=None,
+        subscribe_all_subnets: bool = True,
     ):
         if harness is not None:
             chain = harness.chain
@@ -71,10 +72,23 @@ class LocalNode:
         fork = type(chain.genesis_state).fork_name
         for topic in topics_mod.core_topics(digest, fork, chain.spec):
             self.service.subscribe(str(topic))
-        for subnet in range(chain.spec.attestation_subnet_count):
-            self.service.subscribe(
-                str(topics_mod.attestation_subnet_topic(digest, subnet))
-            )
+        # Attestation/sync subnets go through the subnet service (reference
+        # subnet_service/): backbone rotation + VC duty subscriptions.
+        # subscribe_all (the --subscribe-all-subnets flag) is the right
+        # default for small in-process networks, where 2 backbone subnets
+        # per node would partition subnet traffic.
+        import hashlib as _hashlib
+
+        from .subnet_service import SubnetService
+
+        self.subnets = SubnetService(
+            service=self.service, digest=digest, spec=chain.spec,
+            node_id=int.from_bytes(
+                _hashlib.sha256(peer_id.encode()).digest(), "big"),
+            subscribe_all=subscribe_all_subnets,
+        )
+        if not subscribe_all_subnets:
+            self.subnets.update_epoch(0)
 
     # ----------------------------------------------------------- discovery
 
@@ -96,6 +110,13 @@ class LocalNode:
             self.discv5.keypair, seq=1, ip=ip,
             udp=self.discv5.port, tcp=tcp_port,
         )
+        # The spec keys compute_subscribed_subnets to the DISCOVERY node id
+        # so peers can predict our backbone subnets from the ENR — re-seed
+        # the subnet service with the real identity and re-derive.
+        self.subnets.node_id = int.from_bytes(self.discv5.node_id, "big")
+        if not self.subnets.subscribe_all:
+            self.subnets.update_epoch(
+                self.chain.current_slot() // self.chain.spec.slots_per_epoch)
         self.discv5.start()
         return self.discv5
 

@@ -89,6 +89,7 @@ class ValidatorClient:
         self.doppelganger: Optional[DoppelgangerService] = None
         self._last_duties_epoch: Optional[int] = None
         self.latencies: List[dict] = []  # last per-BN RTT measurements
+        self._latency_slot = -1  # slot of the freshest completed probe
 
     def enable_doppelganger_protection(self, start_epoch: int) -> None:
         """Block ALL signing until liveness checks prove no other instance is
@@ -190,11 +191,17 @@ class ValidatorClient:
             # blocks ~10 s; serialized in-loop it would push every later
             # duty past its deadline — the exact failure it exists to see).
             time.sleep(max(0.0, slot_start + sps * 11 / 12 - time.time()))
+            probe_slot = slot
 
-            def _measure():
+            def _measure(my_slot=probe_slot):
                 out = safely("latency measurement",
                              self.fallback.measure_latency) or []
-                self.latencies = out
+                # a slow probe finishing AFTER a later slot's probe must not
+                # overwrite the fresher result (blackholed-BN threads can
+                # outlive their slot)
+                if my_slot >= self._latency_slot:
+                    self._latency_slot = my_slot
+                    self.latencies = out
                 for m in out:
                     if m["latency"] is not None:
                         log.info("beacon node latency", endpoint=m["endpoint"],

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Device-measurement pipeline: wait for the bench child to release the TPU,
+# then capture a stage split and the pallas A/B on real hardware.  Each
+# stage is individually time-bounded; results land in .perf/.
+cd "$(dirname "$0")/.." || exit 1
+echo "PIPELINE waiting for bench child $(date -u +%H:%M:%S)"
+while pgrep -f 'bench.py --child' > /dev/null; do sleep 20; done
+echo "PIPELINE device free $(date -u +%H:%M:%S)"
+mkdir -p .perf
+timeout 2400 python scripts/perf_stages.py --device --sets 128 --reps 3 \
+  --skip-dot-audit --out .perf/stages_128_tpu.json
+echo "PIPELINE perf_stages rc=$? $(date -u +%H:%M:%S)"
+timeout 1800 python scripts/pallas_bench.py 1024 8192
+echo "PIPELINE pallas_bench rc=$? $(date -u +%H:%M:%S)"
+timeout 1200 python scripts/kzg_bench.py --device 2>/dev/null \
+  || echo "PIPELINE kzg_bench skipped/failed"
+echo "PIPELINE done $(date -u +%H:%M:%S)"

@@ -22,7 +22,10 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# --device leaves the live platform (TPU tunnel) in charge; default pins
+# CPU because the axon sitecustomize otherwise hangs jax.devices().
+if "--device" not in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
 try:
     jax.config.update("jax_compilation_cache_dir", os.path.join(HERE, ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -78,10 +81,11 @@ def main() -> None:
         results.append(rec)
         print(json.dumps(rec), flush=True)
 
-    out = os.path.join(HERE, ".perf", "kzg_bench.json")
+    suffix = "_tpu" if jax.devices()[0].platform == "tpu" else ""
+    out = os.path.join(HERE, ".perf", f"kzg_bench{suffix}.json")
     os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "w") as f:
-        f.write(json.dumps({"platform": "cpu", "batches": results}) + "\n")
+        f.write(json.dumps({"platform": jax.devices()[0].platform, "batches": results}) + "\n")
 
 
 if __name__ == "__main__":

@@ -32,7 +32,10 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# --device leaves the live platform (the TPU tunnel) in charge; default
+# pins CPU because the axon sitecustomize otherwise hangs jax.devices().
+if "--device" not in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
 try:
     jax.config.update("jax_compilation_cache_dir", os.path.join(HERE, ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -156,6 +159,8 @@ def main() -> None:
     ap.add_argument("--reps", type=int, default=1)
     ap.add_argument("--out", default="")
     ap.add_argument("--skip-dot-audit", action="store_true")
+    ap.add_argument("--device", action="store_true",
+                    help="run on the live platform (TPU) instead of pinning CPU")
     args = ap.parse_args()
 
     n, k = args.sets, args.keys

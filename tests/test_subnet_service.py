@@ -1,0 +1,171 @@
+"""Subnet service: spec backbone rotation + duty-driven subscriptions.
+
+Reference: ``beacon_node/network/src/subnet_service/{attestation_subnets,
+sync_subnets}.rs`` and consensus-spec phase0 p2p ``compute_subscribed_subnets``.
+"""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.network.service import NetworkService
+from lighthouse_tpu.network.subnet_service import (
+    EPOCHS_PER_SUBNET_SUBSCRIPTION,
+    SUBNETS_PER_NODE,
+    SubnetService,
+    compute_subscribed_subnets,
+)
+from lighthouse_tpu.network.transport import Hub
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return minimal_spec()
+
+
+def test_backbone_is_deterministic_and_rotates(spec):
+    node_id = int.from_bytes(b"\x5a" * 32, "big")
+    subnets = compute_subscribed_subnets(node_id, epoch=10, spec=spec)
+    assert len(subnets) == SUBNETS_PER_NODE
+    assert all(0 <= s < spec.attestation_subnet_count for s in subnets)
+    # stable across epochs within the same subscription period
+    offset = node_id % EPOCHS_PER_SUBNET_SUBSCRIPTION
+    for e in (10, 10 + 5):
+        if (e + offset) // EPOCHS_PER_SUBNET_SUBSCRIPTION == (
+                10 + offset) // EPOCHS_PER_SUBNET_SUBSCRIPTION:
+            assert compute_subscribed_subnets(node_id, e, spec) == subnets
+    # deterministic coverage: many node ids spread over MANY subnets — the
+    # whole point of node-id-keyed backbones (a degenerate shuffle would
+    # park everyone on the same two)
+    union = set()
+    for i in range(32):
+        union.update(compute_subscribed_subnets(
+            int.from_bytes(bytes([i]) * 32, "big"), 10, spec))
+    assert len(union) > SUBNETS_PER_NODE * 4
+    # rotation: across many periods the set eventually changes
+    assert any(
+        compute_subscribed_subnets(
+            node_id, 10 + k * EPOCHS_PER_SUBNET_SUBSCRIPTION, spec) != subnets
+        for k in range(1, 6)
+    )
+
+
+def _mk(spec, subscribe_all=False):
+    hub = Hub()
+    svc = NetworkService(hub.register("subnet-node"))
+    sub = SubnetService(service=svc, digest=b"\x00\x01\x02\x03", spec=spec,
+                        node_id=int.from_bytes(b"\x77" * 32, "big"),
+                        subscribe_all=subscribe_all)
+    return svc, sub
+
+
+def test_subscribe_all_mode(spec):
+    svc, sub = _mk(spec, subscribe_all=True)
+    try:
+        att_topics = [t for t in svc.subscriptions if "beacon_attestation_" in t]
+        assert len(att_topics) == spec.attestation_subnet_count
+        assert sub.update_epoch(5) == sorted(range(spec.attestation_subnet_count))
+    finally:
+        svc.shutdown()
+
+
+def test_backbone_subscriptions_applied_and_rotated(spec):
+    svc, sub = _mk(spec)
+    try:
+        active = sub.update_epoch(0)
+        topics = {t for t in svc.subscriptions if "beacon_attestation_" in t}
+        assert len(topics) == len(active) == SUBNETS_PER_NODE
+        for s in active:
+            assert any(t.endswith(f"beacon_attestation_{s}/ssz_snappy")
+                       for t in topics)
+        # forcing a rotation far in the future swaps the set cleanly
+        sub.update_epoch(10 * EPOCHS_PER_SUBNET_SUBSCRIPTION)
+        topics2 = {t for t in svc.subscriptions if "beacon_attestation_" in t}
+        assert len(topics2) == SUBNETS_PER_NODE
+    finally:
+        svc.shutdown()
+
+
+def test_duty_subscription_lifecycle(spec):
+    svc, sub = _mk(spec)
+    try:
+        sub.update_epoch(0)
+        backbone = set(sub.active_attestation_subnets())
+        # choose an entry whose subnet is OUTSIDE the backbone
+        slot, committees_at_slot = 3, 4
+        target = None
+        for ci in range(spec.attestation_subnet_count):
+            subnet = (committees_at_slot * (slot % spec.slots_per_epoch) + ci) \
+                % spec.attestation_subnet_count
+            if subnet not in backbone:
+                target = (ci, subnet)
+                break
+        ci, subnet = target
+        n = sub.on_committee_subscriptions([
+            {"validator_index": "1", "committee_index": str(ci),
+             "committees_at_slot": str(committees_at_slot), "slot": str(slot),
+             "is_aggregator": True},
+            {"validator_index": "2", "committee_index": str(ci),
+             "committees_at_slot": str(committees_at_slot), "slot": str(slot),
+             "is_aggregator": False},  # non-aggregators don't subscribe
+        ])
+        assert n == 1
+        topic = f"beacon_attestation_{subnet}/ssz_snappy"
+        assert any(t.endswith(topic) for t in svc.subscriptions)
+        # expiry: pruning after the duty slot unsubscribes
+        sub.prune(current_slot=slot + 1)
+        assert not any(t.endswith(topic) for t in svc.subscriptions)
+        # backbone untouched by pruning
+        assert sub.active_attestation_subnets() == backbone
+    finally:
+        svc.shutdown()
+
+
+def test_sync_subscription_until_epoch(spec):
+    svc, sub = _mk(spec)
+    try:
+        n = sub.on_sync_committee_subscriptions([
+            {"validator_index": "7", "sync_committee_indices": ["0"],
+             "until_epoch": "2"},
+        ])
+        assert n == 1
+        assert any("sync_committee_0" in t for t in svc.subscriptions)
+        sub.prune(current_slot=2 * spec.slots_per_epoch)  # epoch 2 reached
+        assert not any("sync_committee_0" in t for t in svc.subscriptions)
+    finally:
+        svc.shutdown()
+
+
+def test_http_endpoint_feeds_subnet_service(spec):
+    """POST beacon_committee_subscriptions reaches the service through the
+    API server (client wiring: http_server.subnet_service)."""
+    import json
+    import urllib.request
+
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+    from lighthouse_tpu.http_api import HttpApiServer
+
+    set_backend("fake")
+    try:
+        harness = BeaconChainHarness(validator_count=8, fake_crypto=True)
+        svc, sub = _mk(spec)
+        server = HttpApiServer(harness.chain).start()
+        server.subnet_service = sub
+        try:
+            body = json.dumps([{
+                "validator_index": "1", "committee_index": "0",
+                "committees_at_slot": "1", "slot": "5", "is_aggregator": True,
+            }]).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/eth/v1/validator/beacon_committee_subscriptions",
+                data=body, headers={"Content-Type": "application/json"},
+                method="POST")
+            urllib.request.urlopen(req, timeout=5)
+            assert sub._duty_until_slot, "endpoint did not reach the service"
+        finally:
+            server.stop()
+            svc.shutdown()
+    finally:
+        set_backend("host")
