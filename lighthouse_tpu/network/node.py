@@ -117,6 +117,15 @@ class LocalNode:
         if not self.subnets.subscribe_all:
             self.subnets.update_epoch(
                 self.chain.current_slot() // self.chain.spec.slots_per_epoch)
+        # Seed the routing table from the persisted DHT (persisted_dht.rs:
+        # a restarted node re-joins without fresh bootstrap rounds).
+        from .persisted_dht import load_dht
+
+        for enr in load_dht(self.chain.store):
+            try:
+                self.discv5.add_enr(enr)
+            except Exception:
+                continue  # one stale record must not stop discovery
         self.discv5.start()
         return self.discv5
 
@@ -239,6 +248,13 @@ class LocalNode:
         self.service.shutdown()
         self.processor.shutdown()
         if getattr(self, "discv5", None) is not None:
+            # persist the routing table for the next start (persisted_dht.rs)
+            try:
+                from .persisted_dht import persist_dht
+
+                persist_dht(self.chain.store, list(self.discv5.table.values()))
+            except Exception:
+                pass  # persistence is best-effort; shutdown must proceed
             self.discv5.stop()
         if hasattr(self.endpoint, "close"):
             self.endpoint.close()  # socket-backed endpoints own OS resources

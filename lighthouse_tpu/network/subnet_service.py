@@ -65,7 +65,7 @@ class SubnetService:
         self.spec = spec
         self.node_id = node_id
         self.subscribe_all = subscribe_all
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self._backbone: Set[int] = set()
         # attestation subnet -> last slot it is needed for (duty-driven)
         self._duty_until_slot: Dict[int, int] = {}
@@ -98,16 +98,19 @@ class SubnetService:
         if self.subscribe_all:
             return sorted(self._backbone)
         want = set(compute_subscribed_subnets(self.node_id, epoch, self.spec))
+        # Decision AND side effect share one critical section: releasing
+        # the lock between them lets a concurrent duty subscription for a
+        # dropped subnet be immediately undone by our stale snapshot —
+        # silently unsubscribing an aggregator for its whole duty window.
         with self._lock:
             drop = self._backbone - want
             add = want - self._backbone
             self._backbone = want
-            duty_active = set(self._duty_until_slot)
-        for subnet in drop:
-            if subnet not in duty_active:
-                self._unsubscribe_att(subnet)
-        for subnet in add:
-            self._subscribe_att(subnet)
+            for subnet in drop:
+                if subnet not in self._duty_until_slot:
+                    self._unsubscribe_att(subnet)
+            for subnet in add:
+                self._subscribe_att(subnet)
         return sorted(want)
 
     # --------------------------------------------------------- duty-driven
@@ -135,8 +138,8 @@ class SubnetService:
                 known = subnet in self._backbone or subnet in self._duty_until_slot
                 prev = self._duty_until_slot.get(subnet, -1)
                 self._duty_until_slot[subnet] = max(prev, slot)
-            if not known and not self.subscribe_all:
-                self._subscribe_att(subnet)
+                if not known and not self.subscribe_all:
+                    self._subscribe_att(subnet)
             touched += 1
         return touched
 
@@ -156,12 +159,15 @@ class SubnetService:
                     self.spec.preset.sync_committee_size
                     // self.spec.sync_committee_subnet_count,
                 )
+                if not 0 <= subnet < self.spec.sync_committee_subnet_count:
+                    continue  # out-of-range index: never advertise a
+                    # nonexistent sync topic to the network
                 with self._lock:
                     fresh = subnet not in self._sync_until_epoch
                     prev = self._sync_until_epoch.get(subnet, -1)
                     self._sync_until_epoch[subnet] = max(prev, until_epoch)
-                if fresh:
-                    self.service.subscribe(self._sync_topic(subnet))
+                    if fresh:
+                        self.service.subscribe(self._sync_topic(subnet))
                 touched += 1
         return touched
 
@@ -170,24 +176,23 @@ class SubnetService:
     def prune(self, current_slot: int) -> None:
         """Drop expired duty subscriptions (called on the per-slot tick)."""
         current_epoch = current_slot // self.spec.slots_per_epoch
+        # expiry decision + unsubscribe in ONE critical section (see
+        # update_epoch: a stale snapshot applied after release races
+        # concurrent re-subscriptions for the same subnet)
         with self._lock:
             expired_att = [s for s, until in self._duty_until_slot.items()
                            if until < current_slot]
             for s in expired_att:
                 del self._duty_until_slot[s]
-            keep = self._backbone
+                if not self.subscribe_all and s not in self._backbone:
+                    self._unsubscribe_att(s)
             expired_sync = [s for s, until in self._sync_until_epoch.items()
                             if until <= current_epoch]
+            # sync subnets were never part of the subscribe-all initial set
+            # — their until_epoch contract holds in EVERY mode
             for s in expired_sync:
                 del self._sync_until_epoch[s]
-        if not self.subscribe_all:
-            for s in expired_att:
-                if s not in keep:
-                    self._unsubscribe_att(s)
-        # sync subnets were never part of the subscribe-all initial set —
-        # their until_epoch contract holds in EVERY mode
-        for s in expired_sync:
-            self.service.unsubscribe(self._sync_topic(s))
+                self.service.unsubscribe(self._sync_topic(s))
 
     # ----------------------------------------------------------- introspect
 

@@ -90,6 +90,7 @@ class ValidatorClient:
         self._last_duties_epoch: Optional[int] = None
         self.latencies: List[dict] = []  # last per-BN RTT measurements
         self._latency_slot = -1  # slot of the freshest completed probe
+        self._latency_lock = threading.Lock()
 
     def enable_doppelganger_protection(self, start_epoch: int) -> None:
         """Block ALL signing until liveness checks prove no other instance is
@@ -198,10 +199,13 @@ class ValidatorClient:
                              self.fallback.measure_latency) or []
                 # a slow probe finishing AFTER a later slot's probe must not
                 # overwrite the fresher result (blackholed-BN threads can
-                # outlive their slot)
-                if my_slot >= self._latency_slot:
-                    self._latency_slot = my_slot
-                    self.latencies = out
+                # outlive their slot); compare-and-set under the lock —
+                # unlocked, two finishing threads can interleave the check
+                # and the writes and reintroduce exactly this bug
+                with self._latency_lock:
+                    if my_slot >= self._latency_slot:
+                        self._latency_slot = my_slot
+                        self.latencies = out
                 for m in out:
                     if m["latency"] is not None:
                         log.info("beacon node latency", endpoint=m["endpoint"],

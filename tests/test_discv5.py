@@ -226,3 +226,36 @@ class TestLiveNodes:
             boot.stop(); newcomer.stop()
             for o in others:
                 o.stop()
+
+
+def test_persisted_dht_roundtrip():
+    """ENRs survive the store round-trip and a 'restarted' node seeds its
+    table from them (persisted_dht.rs load/persist/clear)."""
+    from lighthouse_tpu.network.discv5 import KeyPair
+    from lighthouse_tpu.network.discv5.enr import ENR
+    from lighthouse_tpu.network.persisted_dht import (
+        clear_dht,
+        load_dht,
+        persist_dht,
+    )
+    from lighthouse_tpu.store.kv import MemoryStore
+
+    store = MemoryStore()
+    enrs = [
+        ENR.build(KeyPair(), seq=i + 1, ip="10.0.0.%d" % (i + 1),
+                  udp=9000 + i, tcp=9100 + i)
+        for i in range(3)
+    ]
+    assert load_dht(store) == []
+    assert persist_dht(store, enrs) == 3
+    back = load_dht(store)
+    assert [e.node_id for e in back] == [e.node_id for e in enrs]
+    assert [e.seq for e in back] == [1, 2, 3]
+    # corrupt tail: keep the records that decode cleanly
+    from lighthouse_tpu.store.kv import DBColumn
+    from lighthouse_tpu.network.persisted_dht import DHT_DB_KEY
+    raw = store.get(DBColumn.DHT, DHT_DB_KEY)
+    store.put(DBColumn.DHT, DHT_DB_KEY, raw + b"\x00\x09garbage")
+    assert len(load_dht(store)) == 3
+    clear_dht(store)
+    assert load_dht(store) == []
