@@ -154,6 +154,7 @@ def _child_main(force_cpu: bool) -> None:
         devs = jax.devices()  # <-- known ~25-min tunnel hang point
         out["platform"] = devs[0].platform
         out["init_secs"] = round(time.perf_counter() - t_init, 2)
+        out["kernel_src_sha"] = _measured_src_sha()  # capture provenance
         _checkpoint(out)
 
         from __graft_entry__ import _build_example
@@ -242,14 +243,43 @@ def _read_json(path: str) -> dict:
         return {}
 
 
+# The sources whose content DEFINES the measured program: the fused
+# verifier's kernel stack + the batch builder.  pallas_fq.py and the
+# bench orchestration are deliberately NOT here — neither is on the
+# measured path, and invalidating a hard-won device capture because the
+# bench's own plumbing changed would discard a valid measurement.
+_MEASURED_PATH_FILES = (
+    "lighthouse_tpu/ops/fq.py",
+    "lighthouse_tpu/ops/tower.py",
+    "lighthouse_tpu/ops/ec.py",
+    "lighthouse_tpu/ops/pairing.py",
+    "lighthouse_tpu/ops/verify.py",
+    "__graft_entry__.py",
+)
+
+
+def _measured_src_sha() -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for rel in _MEASURED_PATH_FILES:
+        try:
+            with open(os.path.join(HERE, rel), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"missing:" + rel.encode())
+    return h.hexdigest()[:16]
+
+
 def _usable_probe_result() -> dict:
     """The probe loop's device capture, iff it is a DEVICE number measured
     against the CURRENT kernel sources.
 
     A cpu-platform fallback is rejected (not the number this file exists to
-    capture), and a file older than any of the kernel/bench sources is
-    rejected (a stale capture from a previous build must not be emitted as
-    this build's benchmark)."""
+    capture).  Provenance: the child records a content hash of the
+    measured-path sources (``kernel_src_sha``); a mismatch means the kernel
+    changed after the capture.  Captures from before the hash existed fall
+    back to an mtime comparison against the same file set."""
     probe = _read_json(PROBE_RESULT_FILE)
     if "value" not in probe or probe.get("platform") in (None, "cpu"):
         return {}
@@ -257,18 +287,20 @@ def _usable_probe_result() -> dict:
         captured = os.path.getmtime(PROBE_RESULT_FILE)
     except OSError:
         return {}
-    newest_src = 0.0
-    ops_dir = os.path.join(HERE, "lighthouse_tpu", "ops")
-    for d in (ops_dir,):
-        try:
-            for name in os.listdir(d):
-                if name.endswith(".py"):
-                    newest_src = max(newest_src, os.path.getmtime(os.path.join(d, name)))
-        except OSError:
-            pass
-    newest_src = max(newest_src, os.path.getmtime(os.path.abspath(__file__)))
-    if captured < newest_src:
-        return {}  # kernel or bench changed after the capture: stale
+    recorded = probe.get("kernel_src_sha")
+    if recorded is not None:
+        if recorded != _measured_src_sha():
+            return {}  # the measured program changed after the capture
+    else:
+        newest_src = 0.0
+        for rel in _MEASURED_PATH_FILES:
+            try:
+                newest_src = max(
+                    newest_src, os.path.getmtime(os.path.join(HERE, rel)))
+            except OSError:
+                pass
+        if captured < newest_src:
+            return {}
     probe["from_probe_loop"] = True
     probe["probe_result_age_s"] = round(time.time() - captured, 0)
     return probe
