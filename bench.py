@@ -254,6 +254,12 @@ _MEASURED_PATH_FILES = (
     "lighthouse_tpu/ops/ec.py",
     "lighthouse_tpu/ops/pairing.py",
     "lighthouse_tpu/ops/verify.py",
+    # transitive inputs that define the traced program and its test batch
+    "lighthouse_tpu/crypto/bls/params.py",
+    "lighthouse_tpu/crypto/bls/fields.py",
+    "lighthouse_tpu/crypto/bls/curve.py",
+    "lighthouse_tpu/crypto/bls/hash_to_curve.py",
+    "lighthouse_tpu/crypto/bls/_sswu_g2_iso.py",
     "__graft_entry__.py",
 )
 
@@ -262,6 +268,12 @@ def _measured_src_sha() -> str:
     import hashlib
 
     h = hashlib.sha256()
+    # the measurement-DEFINING bench constants (shape, reps, baseline) are
+    # part of provenance too: a capture at 128x32 must not survive a
+    # headline-shape change — but bench PLUMBING edits must not kill it,
+    # so hash the constants, not this file's bytes
+    h.update(repr((N_SETS, N_KEYS, REPS, SCALE_N_SETS, SCALE_REPS,
+                   BLST_64T_SETS_PER_SEC)).encode())
     for rel in _MEASURED_PATH_FILES:
         try:
             with open(os.path.join(HERE, rel), "rb") as f:
