@@ -15,15 +15,25 @@ while true; do
   if timeout 3000 python scripts/tpu_probe.py && \
      grep -q '"stage": "timed"' .tpu_probe/probe.log 2>/dev/null; then
     echo "PROBE_LOOP success after attempt=$attempt; firing device bench $(date -u +%H:%M:%S)"
-    # Stale results must not satisfy the capture check below.
-    rm -f .tpu_probe/bench_device_result.json
+    # Stale results must not satisfy the capture check below — but an
+    # EXISTING device capture is precious: set it aside and restore it if
+    # this run fails to produce a better one (the tunnel has died mid-run
+    # before; deleting the only good capture would throw the round away).
+    if [ -f .tpu_probe/bench_device_result.json ]; then
+      mv .tpu_probe/bench_device_result.json .tpu_probe/bench_device_result.prev
+    fi
     BENCH_RESULT_FILE="$PWD/.tpu_probe/bench_device_result.json" \
       timeout 3000 python bench.py --child
     echo "PROBE_LOOP bench child rc=$? done=$(date -u +%H:%M:%S)"
     if grep -q '"value"' .tpu_probe/bench_device_result.json 2>/dev/null && \
        ! grep -q '"platform": "cpu"' .tpu_probe/bench_device_result.json; then
       echo "PROBE_LOOP device bench result captured"
+      rm -f .tpu_probe/bench_device_result.prev
       break
+    fi
+    if [ -f .tpu_probe/bench_device_result.prev ]; then
+      echo "PROBE_LOOP restoring previous device capture"
+      mv .tpu_probe/bench_device_result.prev .tpu_probe/bench_device_result.json
     fi
     # Probe succeeded but bench didn't capture a DEVICE headline (a
     # cpu-platform fallback result doesn't count: bench.py main() rejects
