@@ -265,6 +265,48 @@ def run_database_manager(args) -> int:
         elif args.db_cmd == "compact":
             store.compact()
             print(json.dumps({"path": path, "compacted": True}))
+        elif args.db_cmd == "prune-payloads":
+            # Reference `lighthouse db prune-payloads`: rewrite stored
+            # post-merge blocks WITHOUT their execution payloads — the
+            # block streamer reconstructs them from the EL on read.
+            from .chain.block_streamer import blind_signed_block
+            from .types.containers import build_types
+
+            spec = _spec_for(args.network)
+            types = build_types(spec.preset)
+            pruned = skipped = 0
+            # iter_column snapshots its key list up front, so rewriting
+            # entries mid-iteration is safe without materializing every
+            # block's bytes at once
+            for key, raw in store.iter_column(DBColumn.BEACON_BLOCK):
+                fork, data = raw.split(b"\x00", 1)
+                if fork.startswith(b"blinded:"):
+                    skipped += 1  # already payload-free
+                    continue
+                fork_name = fork.decode()
+                reg = types.signed_block[fork_name]
+                signed = reg.from_ssz_bytes(data)
+                if not hasattr(signed.message.body, "execution_payload"):
+                    skipped += 1  # pre-merge fork: nothing to strip
+                    continue
+                blinded = blind_signed_block(signed, types)
+                out = (b"blinded:" + fork_name.encode() + b"\x00"
+                       + blinded.as_ssz_bytes())
+                store.put(DBColumn.BEACON_BLOCK, key, out)
+                pruned += 1
+            print(json.dumps({"path": path, "payloads_pruned": pruned,
+                              "skipped": skipped}))
+        elif args.db_cmd == "prune-blobs":
+            # Reference `lighthouse db prune-blobs`: drop sidecars below the
+            # retention horizon (--before-slot; the node's own periodic
+            # pruning uses the spec MIN_EPOCHS_FOR_BLOB_SIDECARS horizon).
+            from .store.hot_cold import prune_blob_column
+            from .types.containers import build_types
+
+            spec = _spec_for(args.network)
+            types = build_types(spec.preset)
+            pruned = prune_blob_column(store, types, args.before_slot)
+            print(json.dumps({"path": path, "blob_sets_pruned": pruned}))
     finally:
         store.close()
     return 0
@@ -516,6 +558,17 @@ def build_parser() -> argparse.ArgumentParser:
     for name in ("version", "inspect", "compact"):
         d = dbsub.add_parser(name)
         d.add_argument("--datadir", required=True)
+    # --network is REQUIRED on the destructive commands: decoding a
+    # mainnet db with the minimal preset rewrites valid blocks as garbage
+    pp = dbsub.add_parser("prune-payloads",
+                          help="strip execution payloads from stored blocks")
+    pp.add_argument("--datadir", required=True)
+    pp.add_argument("--network", required=True)
+    pb = dbsub.add_parser("prune-blobs",
+                          help="drop blob sidecars below a slot horizon")
+    pb.add_argument("--datadir", required=True)
+    pb.add_argument("--network", required=True)
+    pb.add_argument("--before-slot", type=int, required=True)
     db.set_defaults(func=run_database_manager)
 
     lcli = sub.add_parser("lcli", help="dev tools (transition timing, roots, ssz)")

@@ -29,6 +29,21 @@ CHUNK_SIZE = 128  # roots per freezer chunk (reference chunked_vector default)
 SCHEMA_VERSION = 1
 
 
+def prune_blob_column(kv: "KeyValueStore", types, horizon_slot: int) -> int:
+    """Delete every stored sidecar set whose block slot is below the
+    horizon; returns the number of blocks pruned.  Shared by the node's
+    periodic pruning (HotColdDB.prune_blobs) and `db prune-blobs` — one
+    owner of the on-disk framing (u32-be length || sidecar ssz, repeated)."""
+    pruned = 0
+    for key, raw in kv.iter_column(DBColumn.BLOB_SIDECAR):
+        n = int.from_bytes(raw[:4], "big")
+        sc = types.BlobSidecar.from_ssz_bytes(raw[4:4 + n])
+        if int(sc.signed_block_header.message.slot) < horizon_slot:
+            kv.delete(DBColumn.BLOB_SIDECAR, key)
+            pruned += 1
+    return pruned
+
+
 def _slot_key(slot: int) -> bytes:
     return struct.pack(">Q", slot)
 
@@ -184,14 +199,7 @@ class HotColdDB:
     def prune_blobs(self, horizon_slot: int) -> int:
         """Drop stored sidecars older than the retention horizon; returns
         the number of blocks pruned (spec MIN_EPOCHS_FOR_BLOB_SIDECARS...)."""
-        pruned = 0
-        for key, raw in list(self.hot.iter_column(DBColumn.BLOB_SIDECAR)):
-            n = int.from_bytes(raw[:4], "big")
-            sc = self.types.BlobSidecar.from_ssz_bytes(raw[4:4 + n])
-            if int(sc.signed_block_header.message.slot) < horizon_slot:
-                self.hot.delete(DBColumn.BLOB_SIDECAR, key)
-                pruned += 1
-        return pruned
+        return prune_blob_column(self.hot, self.types, horizon_slot)
 
     # ---------------------------------------------------------- hot states
 

@@ -19,8 +19,13 @@ while true; do
     # EXISTING device capture is precious: set it aside and restore it if
     # this run fails to produce a better one (the tunnel has died mid-run
     # before; deleting the only good capture would throw the round away).
-    if [ -f .tpu_probe/bench_device_result.json ]; then
+    # Only a DEVICE capture is worth preserving — a lingering cpu-platform
+    # fallback must be deleted, not endlessly "restored".
+    if grep -q '"value"' .tpu_probe/bench_device_result.json 2>/dev/null && \
+       ! grep -q '"platform": "cpu"' .tpu_probe/bench_device_result.json; then
       mv .tpu_probe/bench_device_result.json .tpu_probe/bench_device_result.prev
+    else
+      rm -f .tpu_probe/bench_device_result.json
     fi
     BENCH_RESULT_FILE="$PWD/.tpu_probe/bench_device_result.json" \
       timeout 3000 python bench.py --child

@@ -88,3 +88,67 @@ def test_db_manager_roundtrip(tmp_path, capsys):
     rc = cli.main(["db", "compact", "--datadir", str(datadir)])
     assert rc == 0
     assert json.loads(capsys.readouterr().out.strip())["compacted"] is True
+
+
+def test_db_prune_payloads_and_blobs(tmp_path, capsys):
+    """`db prune-payloads` rewrites stored full blocks as blinded (payload
+    reconstructible via the EL); `db prune-blobs` drops sidecars below the
+    horizon.  Reference `lighthouse db prune-payloads` / `prune-blobs`."""
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+    from lighthouse_tpu.store.kv import DBColumn
+    from lighthouse_tpu.store.lockbox_store import LockboxStore
+
+    set_backend("fake")
+    try:
+        harness = BeaconChainHarness(validator_count=8, fake_crypto=True)
+        harness.extend_chain(2)
+        types = harness.chain.types
+        datadir = tmp_path / "node"
+        datadir.mkdir()
+        store = LockboxStore(str(datadir / "chain.db"))
+        # copy BOTH chain blocks into the on-disk db — multi-entry
+        # prune/skip accounting must be exercised with more than one row
+        head = harness.chain.get_block(harness.chain.head_root)
+        parent = harness.chain.get_block(bytes(head.message.parent_root))
+        n_blocks = 0
+        for signed in (head, parent):
+            fork = type(signed).fork_name
+            store.put(DBColumn.BEACON_BLOCK, signed.message.hash_tree_root(),
+                      fork.encode() + b"\x00" + signed.as_ssz_bytes())
+            n_blocks += 1
+        assert n_blocks == 2
+        store.close()
+
+        rc = cli.main(["db", "prune-payloads", "--datadir", str(datadir),
+                       "--network", "minimal"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["payloads_pruned"] == n_blocks
+
+        # blinded on disk now; a second run skips them all
+        store = LockboxStore(str(datadir / "chain.db"))
+        raw = store.get(DBColumn.BEACON_BLOCK, harness.chain.head_root)
+        assert raw.startswith(b"blinded:")
+        fork = raw.split(b"\x00", 1)[0][len(b"blinded:"):].decode()
+        blinded = types.signed_blinded_block[fork].from_ssz_bytes(
+            raw.split(b"\x00", 1)[1])
+        assert hasattr(blinded.message.body, "execution_payload_header")
+        # a default sidecar (slot 0) sits below any positive horizon
+        sc = types.BlobSidecar()
+        store.put(DBColumn.BLOB_SIDECAR, b"r" * 32,
+                  len(sc.as_ssz_bytes()).to_bytes(4, "big") + sc.as_ssz_bytes())
+        store.close()
+        rc = cli.main(["db", "prune-payloads", "--datadir", str(datadir),
+                       "--network", "minimal"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["payloads_pruned"] == 0 and out["skipped"] == n_blocks
+
+        rc = cli.main(["db", "prune-blobs", "--datadir", str(datadir),
+                       "--network", "minimal", "--before-slot", "100"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["blob_sets_pruned"] == 1
+    finally:
+        set_backend("host")
