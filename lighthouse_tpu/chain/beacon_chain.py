@@ -729,6 +729,20 @@ class BeaconChain:
         self.validator_monitor.on_block_imported(
             int(block.slot), int(block.proposer_index)
         )
+        if (self.validator_monitor.monitored
+                and hasattr(block.body, "sync_aggregate")):
+            try:
+                committee = self._sync_committee_member_indices(state)
+                bits = block.body.sync_aggregate.sync_committee_bits
+                participating = {v for i, v in enumerate(committee) if bits[i]}
+                # per-VALIDATOR judgment: a member repeating across
+                # positions participates if ANY of its bits is set — a
+                # partially-aggregated contribution is not a miss
+                missing = set(committee) - participating
+                self.validator_monitor.on_sync_aggregate(
+                    int(block.slot), participating, missing)
+            except Exception:
+                pass  # monitoring must never block an import
 
         with metrics.BLOCK_FORK_CHOICE_SECONDS.time():
             self.recompute_head()
@@ -968,6 +982,27 @@ class BeaconChain:
         if msg_period == state_period + 1:
             return state.next_sync_committee
         return state.current_sync_committee
+
+    def _sync_committee_member_indices(self, state) -> List[int]:
+        """Validator indices of the CURRENT sync committee, position-aligned
+        with its pubkeys (cached per sync period — the pubkey scan is
+        O(validators) and the committee is stable for a whole period)."""
+        period = (
+            h.get_current_epoch(state, self.spec)
+            // self.spec.preset.epochs_per_sync_committee_period
+        )
+        cached = getattr(self, "_sync_indices_cache", None)
+        if cached is not None and cached[0] == period:
+            return cached[1]
+        by_pubkey = {
+            bytes(v.pubkey): i for i, v in enumerate(state.validators)
+        }
+        indices = [
+            by_pubkey.get(bytes(pk), -1)
+            for pk in state.current_sync_committee.pubkeys
+        ]
+        self._sync_indices_cache = (period, indices)
+        return indices
 
     def _sync_committee_positions(self, state, validator_index: int,
                                   slot: int) -> List[int]:
@@ -1815,6 +1850,28 @@ class BeaconChain:
         self.observed.prune(self.fork_choice.finalized_checkpoint[0],
                             self.spec.slots_per_epoch)
         self.validator_monitor.prune(slot // self.spec.slots_per_epoch)
+        # Missed-block tracking (validator_monitor.rs): once a slot has
+        # closed, a monitored expected proposer with no canonical block is
+        # a missed proposal.  Judged at a FULL slot's lag — a block
+        # routinely lands seconds into the next slot, and the once-per-slot
+        # guard would make that false miss permanent.  Only checkable when
+        # the head state can compute that slot's proposer shuffling.
+        prev = slot - 2
+        if (self.validator_monitor.monitored and prev > 0
+                and prev // self.spec.slots_per_epoch
+                == int(self.head_state.slot) // self.spec.slots_per_epoch):
+            try:
+                expected = h.get_beacon_proposer_index(
+                    self.head_state, self.spec, slot=prev)
+                canonical = self.block_root_at_slot(prev)
+                block_seen = (
+                    canonical is not None
+                    and self._blocks_slot(canonical) == prev
+                )
+                self.validator_monitor.on_proposal_outcome(
+                    prev, expected, block_seen)
+            except Exception:
+                pass  # monitoring must never break the tick
         f_slot = self.fork_choice.finalized_checkpoint[0] * self.spec.slots_per_epoch
         self.da_checker.prune(f_slot)
         # Blob retention horizon (spec MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS):

@@ -29,6 +29,18 @@ MONITORED_BLOCKS = metrics.counter(
 MONITORED_COUNT = metrics.gauge(
     "validator_monitor_validators", "number of monitored validators",
 )
+MONITORED_SYNC_HITS = metrics.counter(
+    "validator_monitor_sync_committee_hits_total",
+    "sync-aggregate inclusions for monitored sync-committee members",
+)
+MONITORED_SYNC_MISSES = metrics.counter(
+    "validator_monitor_sync_committee_misses_total",
+    "sync-aggregate misses for monitored sync-committee members",
+)
+MONITORED_PROPOSAL_MISSES = metrics.counter(
+    "validator_monitor_missed_blocks_total",
+    "slots where a monitored validator was proposer but no block landed",
+)
 SIMULATOR_HEAD_HITS = metrics.counter(
     "validator_monitor_attestation_simulator_head_attester_hits_total",
     "simulated attestations whose head vote matched the canonical chain",
@@ -76,6 +88,7 @@ class ValidatorMonitor:
         self._simulated: Dict[int, object] = {}
         self.simulator_stats = {"head_hits": 0, "head_misses": 0,
                                 "target_hits": 0, "target_misses": 0}
+        self._last_proposal_slot_checked: int = -1
 
     def register(self, indices: Iterable[int], current_epoch: int = 0) -> None:
         with self._lock:
@@ -89,6 +102,8 @@ class ValidatorMonitor:
                         "attestation_head_hits": 0, "attestation_head_misses": 0,
                         "attestation_target_hits": 0, "attestation_target_misses": 0,
                         "latest_attestation_inclusion_distance": 0,
+                        "sync_committee_hits": 0, "sync_committee_misses": 0,
+                        "proposal_hits": 0, "proposal_misses": 0,
                     })
             MONITORED_COUNT.set(len(self.monitored))
 
@@ -128,7 +143,54 @@ class ValidatorMonitor:
         if int(proposer_index) in self.monitored:
             with self._lock:
                 self._proposed[int(slot)] = int(proposer_index)
+                c = self._counters.get(int(proposer_index))
+                if c is not None:
+                    c["proposal_hits"] += 1
             MONITORED_BLOCKS.inc()
+
+    def on_sync_aggregate(self, slot: int, participating: Iterable[int],
+                          missing: Iterable[int]) -> None:
+        """Per imported post-altair block: which monitored sync-committee
+        members' bits were set / unset in its sync aggregate (reference
+        validator_monitor.rs register_sync_aggregate_in_block)."""
+        if not self.monitored:
+            return
+        hits = self.monitored.intersection(int(i) for i in participating)
+        misses = self.monitored.intersection(int(i) for i in missing)
+        if not hits and not misses:
+            return
+        with self._lock:
+            for v in hits:
+                c = self._counters.get(v)
+                if c is not None:
+                    c["sync_committee_hits"] += 1
+            for v in misses:
+                c = self._counters.get(v)
+                if c is not None:
+                    c["sync_committee_misses"] += 1
+        if hits:
+            MONITORED_SYNC_HITS.inc(len(hits))
+        if misses:
+            MONITORED_SYNC_MISSES.inc(len(misses))
+
+    def on_proposal_outcome(self, slot: int, proposer_index: int,
+                            block_seen: bool) -> None:
+        """Called once per CLOSED slot with the slot's expected proposer:
+        a monitored proposer with no canonical block is a missed block
+        (reference validator_monitor.rs missed-block tracking).  Proposal
+        HITS are counted at import (on_block_imported)."""
+        v = int(proposer_index)
+        with self._lock:
+            # idempotent per slot: the tick can fire more than once per slot
+            if int(slot) <= self._last_proposal_slot_checked:
+                return
+            self._last_proposal_slot_checked = int(slot)
+            if block_seen or v not in self.monitored:
+                return
+            c = self._counters.get(v)
+            if c is not None:
+                c["proposal_misses"] += 1
+        MONITORED_PROPOSAL_MISSES.inc()
 
     def _close_epochs(self, current_epoch: int) -> None:
         """Tally cumulative hit/miss counters for every epoch that can no
@@ -254,6 +316,10 @@ class ValidatorMonitor:
                         c["attestation_target_hits"], c["attestation_target_misses"]),
                     "latest_attestation_inclusion_distance":
                         c["latest_attestation_inclusion_distance"],
+                    "sync_committee_hits": c.get("sync_committee_hits", 0),
+                    "sync_committee_misses": c.get("sync_committee_misses", 0),
+                    "proposal_hits": c.get("proposal_hits", 0),
+                    "proposal_misses": c.get("proposal_misses", 0),
                 }
         return {"validators": out}
 

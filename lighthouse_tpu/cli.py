@@ -270,6 +270,7 @@ def run_database_manager(args) -> int:
             # post-merge blocks WITHOUT their execution payloads — the
             # block streamer reconstructs them from the EL on read.
             from .chain.block_streamer import blind_signed_block
+            from .store.hot_cold import decode_stored_block, encode_stored_block
             from .types.containers import build_types
 
             spec = _spec_for(args.network)
@@ -279,20 +280,14 @@ def run_database_manager(args) -> int:
             # entries mid-iteration is safe without materializing every
             # block's bytes at once
             for key, raw in store.iter_column(DBColumn.BEACON_BLOCK):
-                fork, data = raw.split(b"\x00", 1)
-                if fork.startswith(b"blinded:"):
-                    skipped += 1  # already payload-free
-                    continue
-                fork_name = fork.decode()
-                reg = types.signed_block[fork_name]
-                signed = reg.from_ssz_bytes(data)
-                if not hasattr(signed.message.body, "execution_payload"):
-                    skipped += 1  # pre-merge fork: nothing to strip
+                signed, is_blinded, _fork = decode_stored_block(types, raw)
+                if is_blinded or not hasattr(
+                        signed.message.body, "execution_payload"):
+                    skipped += 1  # payload-free already, or pre-merge
                     continue
                 blinded = blind_signed_block(signed, types)
-                out = (b"blinded:" + fork_name.encode() + b"\x00"
-                       + blinded.as_ssz_bytes())
-                store.put(DBColumn.BEACON_BLOCK, key, out)
+                store.put(DBColumn.BEACON_BLOCK, key,
+                          encode_stored_block(blinded, blinded=True))
                 pruned += 1
             print(json.dumps({"path": path, "payloads_pruned": pruned,
                               "skipped": skipped}))

@@ -29,6 +29,25 @@ CHUNK_SIZE = 128  # roots per freezer chunk (reference chunked_vector default)
 SCHEMA_VERSION = 1
 
 
+def encode_stored_block(signed_block, *, blinded: bool) -> bytes:
+    """The BEACON_BLOCK column's on-disk framing — ONE owner shared by the
+    store and `db prune-payloads`: ``[blinded:]<fork>\\x00<ssz>``."""
+    fork = type(signed_block).fork_name
+    prefix = b"blinded:" if blinded else b""
+    return prefix + fork.encode() + b"\x00" + signed_block.as_ssz_bytes()
+
+
+def decode_stored_block(types, raw: bytes):
+    """Inverse of ``encode_stored_block``; returns (signed_block_or_blinded,
+    is_blinded, fork_name)."""
+    fork, data = raw.split(b"\x00", 1)
+    if fork.startswith(b"blinded:"):
+        name = fork[len(b"blinded:"):].decode()
+        return types.signed_blinded_block[name].from_ssz_bytes(data), True, name
+    name = fork.decode()
+    return types.signed_block[name].from_ssz_bytes(data), False, name
+
+
 def prune_blob_column(kv: "KeyValueStore", types, horizon_slot: int) -> int:
     """Delete every stored sidecar set whose block slot is below the
     horizon; returns the number of blocks pruned.  Shared by the node's
@@ -142,17 +161,15 @@ class HotColdDB:
     # -------------------------------------------------------------- blocks
 
     def put_block(self, block_root: bytes, signed_block) -> None:
-        fork = type(signed_block).fork_name
-        payload = fork.encode() + b"\x00" + signed_block.as_ssz_bytes()
-        self.hot.put(DBColumn.BEACON_BLOCK, block_root, payload)
+        self.hot.put(DBColumn.BEACON_BLOCK, block_root,
+                     encode_stored_block(signed_block, blinded=False))
 
     def put_blinded_block(self, block_root: bytes, signed_blinded) -> None:
         """Persist a block WITHOUT its execution payload (how the reference
         stores every post-merge block; the beacon_block_streamer analog
         reconstructs the payload from the EL on read)."""
-        fork = type(signed_blinded).fork_name
-        payload = b"blinded:" + fork.encode() + b"\x00" + signed_blinded.as_ssz_bytes()
-        self.hot.put(DBColumn.BEACON_BLOCK, block_root, payload)
+        self.hot.put(DBColumn.BEACON_BLOCK, block_root,
+                     encode_stored_block(signed_blinded, blinded=True))
 
     def get_block(self, block_root: bytes):
         """The stored block — a signed full block, or a signed BLINDED block
@@ -161,11 +178,8 @@ class HotColdDB:
         raw = self.hot.get(DBColumn.BEACON_BLOCK, block_root)
         if raw is None:
             return None
-        fork, data = raw.split(b"\x00", 1)
-        if fork.startswith(b"blinded:"):
-            reg = self.types.signed_blinded_block[fork[len(b"blinded:"):].decode()]
-            return reg.from_ssz_bytes(data)
-        return self.types.signed_block[fork.decode()].from_ssz_bytes(data)
+        block, _blinded, _fork = decode_stored_block(self.types, raw)
+        return block
 
     def delete_block(self, block_root: bytes) -> None:
         self.hot.delete(DBColumn.BEACON_BLOCK, block_root)
