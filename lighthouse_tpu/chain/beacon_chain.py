@@ -983,6 +983,33 @@ class BeaconChain:
             return state.next_sync_committee
         return state.current_sync_committee
 
+    def _expected_proposer(self, slot: int) -> Optional[int]:
+        """The expected proposer at ``slot``, from an epoch-level cache
+        (reference ``beacon_proposer_cache.rs``): the whole epoch's mapping
+        is computed once while the head state can derive it, and survives
+        the head advancing into the next epoch — so the last slots of an
+        epoch stay checkable.  None when the shuffling is underivable
+        (e.g. a long outage with the head frozen epochs behind); a deep
+        reorg across the epoch boundary can stale one epoch's cache
+        (monitoring-grade accuracy, not consensus)."""
+        epoch = slot // self.spec.slots_per_epoch
+        cache = getattr(self, "_proposer_epoch_cache", None)
+        if cache is not None and cache[0] == epoch:
+            return cache[1].get(slot)
+        head_epoch = int(self.head_state.slot) // self.spec.slots_per_epoch
+        if head_epoch != epoch:
+            return None
+        start = epoch * self.spec.slots_per_epoch
+        mapping = {}
+        for s in range(start, start + self.spec.slots_per_epoch):
+            try:
+                mapping[s] = h.get_beacon_proposer_index(
+                    self.head_state, self.spec, slot=s)
+            except Exception:
+                continue
+        self._proposer_epoch_cache = (epoch, mapping)
+        return mapping.get(slot)
+
     def _sync_committee_member_indices(self, state) -> List[int]:
         """Validator indices of the CURRENT sync committee, position-aligned
         with its pubkeys (cached per sync period — the pubkey scan is
@@ -1166,6 +1193,101 @@ class BeaconChain:
         if not bls.verify_signature_sets(sig_sets):
             raise AttestationError("bad sync contribution signature(s)")
         self.sync_contribution_pool.insert_contribution(contribution)
+
+    # ------------------------------------------------- pool-operation gossip
+    #
+    # Reference gossip_methods.rs process_gossip_{voluntary_exit,
+    # proposer_slashing, attester_slashing, bls_to_execution_change}:
+    # dedup via the observed cache (IGNORE — return False, no forward),
+    # verify signatures through the BACKEND batch seam and apply on a
+    # head-state scratch (REJECT — raise ChainError, penalize), then pool.
+    # One table-driven body: the dedup key, signature-set builder,
+    # processor, and pool insert are the only per-kind parts — and the
+    # observe-after-verify discipline (an invalid op must never censor the
+    # validator's real one, observed_operations.rs) is enforced ONCE.
+
+    def _on_gossip_op(self, kind: str, op, key, sets_fn, process_fn,
+                      insert_fn, what: str) -> bool:
+        from ..crypto.bls import api as bls
+
+        if self.observed.operations.is_known(kind, key):
+            return False
+        scratch = self.head_state.copy()
+        try:
+            sig_sets = sets_fn(scratch)
+        except Exception as e:
+            raise ChainError(f"invalid {what}: {e}") from e
+        if not bls.verify_signature_sets(list(sig_sets)):
+            raise ChainError(f"invalid {what}: bad signature")
+        try:
+            process_fn(scratch)
+        except Exception as e:
+            raise ChainError(f"invalid {what}: {e}") from e
+        self.observed.operations.observe(kind, key)
+        insert_fn()
+        return True
+
+    def on_gossip_voluntary_exit(self, exit_) -> bool:
+        from ..consensus import signature_sets as sets
+        from ..consensus.per_block import process_voluntary_exit
+        from . import events as ev
+        from ..http_api.serde import to_json
+
+        def insert():
+            self.op_pool.insert_voluntary_exit(exit_)
+            self.events.publish(ev.TOPIC_EXIT, to_json(exit_))
+
+        return self._on_gossip_op(
+            "voluntary_exit", exit_, int(exit_.message.validator_index),
+            lambda st: [sets.voluntary_exit_signature_set(st, exit_, self.spec)],
+            lambda st: process_voluntary_exit(
+                st, exit_, self.types, self.spec, verify=False),
+            insert, "voluntary exit",
+        )
+
+    def on_gossip_proposer_slashing(self, slashing) -> bool:
+        from ..consensus import signature_sets as sets
+        from ..consensus.per_block import process_proposer_slashing
+
+        return self._on_gossip_op(
+            "proposer_slashing", slashing,
+            int(slashing.signed_header_1.message.proposer_index),
+            lambda st: sets.proposer_slashing_signature_sets(
+                st, slashing, self.spec),
+            lambda st: process_proposer_slashing(
+                st, slashing, self.types, self.spec, False),
+            lambda: self.op_pool.insert_proposer_slashing(slashing),
+            "proposer slashing",
+        )
+
+    def on_gossip_attester_slashing(self, slashing) -> bool:
+        from ..consensus import signature_sets as sets
+        from ..consensus.per_block import process_attester_slashing
+
+        return self._on_gossip_op(
+            "attester_slashing", slashing, slashing.hash_tree_root(),
+            lambda st: sets.attester_slashing_signature_sets(
+                st, slashing, self.spec),
+            lambda st: process_attester_slashing(
+                st, slashing, self.types, self.spec, False),
+            lambda: self.op_pool.insert_attester_slashing(slashing),
+            "attester slashing",
+        )
+
+    def on_gossip_bls_change(self, signed_change) -> bool:
+        from ..consensus import signature_sets as sets
+        from ..consensus.per_block import process_bls_to_execution_change
+
+        return self._on_gossip_op(
+            "bls_to_execution_change", signed_change,
+            int(signed_change.message.validator_index),
+            lambda st: [sets.bls_to_execution_change_signature_set(
+                st, signed_change, self.spec)],
+            lambda st: process_bls_to_execution_change(
+                st, signed_change, self.types, self.spec, False),
+            lambda: self.op_pool.insert_bls_to_execution_change(signed_change),
+            "bls change",
+        )
 
     def process_signed_contributions(self, signed_contributions) -> List[Optional[str]]:
         """Batch path for POST contribution_and_proofs: every contribution's
@@ -1854,22 +1976,21 @@ class BeaconChain:
         # closed, a monitored expected proposer with no canonical block is
         # a missed proposal.  Judged at a FULL slot's lag — a block
         # routinely lands seconds into the next slot, and the once-per-slot
-        # guard would make that false miss permanent.  Only checkable when
-        # the head state can compute that slot's proposer shuffling.
+        # guard would make that false miss permanent.  The epoch-level
+        # proposer cache keeps the last two slots of each epoch checkable
+        # after the head advances into the next one.
         prev = slot - 2
-        if (self.validator_monitor.monitored and prev > 0
-                and prev // self.spec.slots_per_epoch
-                == int(self.head_state.slot) // self.spec.slots_per_epoch):
+        if self.validator_monitor.monitored and prev > 0:
             try:
-                expected = h.get_beacon_proposer_index(
-                    self.head_state, self.spec, slot=prev)
-                canonical = self.block_root_at_slot(prev)
-                block_seen = (
-                    canonical is not None
-                    and self._blocks_slot(canonical) == prev
-                )
-                self.validator_monitor.on_proposal_outcome(
-                    prev, expected, block_seen)
+                expected = self._expected_proposer(prev)
+                if expected is not None:
+                    canonical = self.block_root_at_slot(prev)
+                    block_seen = (
+                        canonical is not None
+                        and self._blocks_slot(canonical) == prev
+                    )
+                    self.validator_monitor.on_proposal_outcome(
+                        prev, expected, block_seen)
             except Exception:
                 pass  # monitoring must never break the tick
         f_slot = self.fork_choice.finalized_checkpoint[0] * self.spec.slots_per_epoch

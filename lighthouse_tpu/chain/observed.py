@@ -119,6 +119,35 @@ class ObservedBlockProducers:
             )
 
 
+class ObservedOperations:
+    """Gossip dedup for pool operations (reference observed_operations.rs):
+    one exit per validator, one slashing per offending proposer, one BLS
+    change per validator — re-broadcasts are IGNOREd, not re-verified.
+    Attester slashings dedup on their ssz root (index-set supersets are the
+    pool's concern, not gossip's)."""
+
+    KINDS = ("voluntary_exit", "proposer_slashing", "attester_slashing",
+             "bls_to_execution_change")
+
+    def __init__(self) -> None:
+        self._seen = {kind: set() for kind in self.KINDS}
+
+    def is_known(self, kind: str, key) -> bool:
+        """Check WITHOUT marking — only verified ops get recorded (an
+        invalid op must never censor the validator's real one)."""
+        return key in self._seen[kind]
+
+    def observe(self, kind: str, key) -> None:
+        self._seen[kind].add(key)
+
+    def prune(self) -> None:
+        # exits/changes are one-shot per validator for the chain's life;
+        # only the unbounded slashing-root set needs a cap
+        seen = self._seen["attester_slashing"]
+        while len(seen) > 4096:
+            seen.pop()
+
+
 class ObservedCaches:
     """The bundle a chain owns, pruned together each finalization."""
 
@@ -133,6 +162,7 @@ class ObservedCaches:
         # aggregates/blocks, so doppelganger liveness MUST consult this too
         # (reference observed_attesters.rs ``ObservedBlockAttesters``).
         self.block_attesters = ObservedAttesters()
+        self.operations = ObservedOperations()
 
     def prune(self, finalized_epoch: int, slots_per_epoch: int) -> None:
         finalized_slot = finalized_epoch * slots_per_epoch
@@ -141,6 +171,7 @@ class ObservedCaches:
         self.aggregates.prune(finalized_slot)
         self.block_producers.prune(finalized_slot)
         self.block_attesters.prune(finalized_epoch)
+        self.operations.prune()
 
     def validator_seen_at_epoch(self, epoch: int, index: int,
                                 slots_per_epoch: int) -> bool:

@@ -252,8 +252,13 @@ class ClientBuilder:
             )
         if http_server is not None and network_node is not None:
             # VC subnet subscriptions reach the subnet service through the
-            # API (reference: http_api -> validator_subscriptions channel)
+            # API (reference: http_api -> validator_subscriptions channel),
+            # and API-published objects gossip out through the node
+            # (reference publish_blocks.rs: gossip first, then self-import)
             http_server.subnet_service = network_node.subnets
+            http_server.publish_block_fn = network_node.publish_block
+            http_server.publish_attestation_fn = network_node.publish_attestation
+            http_server.publish_operation_fn = network_node.publish_operation
         client = Client(
             chain=chain, processor=processor, http_server=http_server,
             slasher=slasher, monitoring=monitoring, network_node=network_node,

@@ -879,22 +879,28 @@ def pool_attestations_get_v2(ctx):
             "data": [to_json(a) for a in _pool_attestations(ctx)]}
 
 
+def _publish_op(ctx, kind: str, op) -> None:
+    """Gossip a freshly-pooled operation out (reference publish flow); a
+    node without networking simply has no hook installed."""
+    publish = getattr(ctx.server, "publish_operation_fn", None)
+    if publish is not None:
+        publish(kind, op)
+
+
 @route("POST", "/eth/v1/beacon/pool/voluntary_exits", P0)
 def pool_exits_post(ctx):
-    from ..consensus.per_block import process_voluntary_exit
+    from ..chain.beacon_chain import ChainError
 
     chain = ctx.chain
     exit_ = container_from_json(chain.types.SignedVoluntaryExit, ctx.body)
-    # Validate against a head-state scratch before pooling (the reference's
-    # verify_operation path).
+    # Validation + dedup + pooling + SSE share ONE owner with the gossip
+    # path (the reference's verify_operation path).
     try:
-        process_voluntary_exit(
-            chain.head_state.copy(), exit_, chain.types, chain.spec, verify=True
-        )
-    except Exception as e:
-        raise _bad(f"invalid voluntary exit: {e}")
-    chain.op_pool.insert_voluntary_exit(exit_)
-    chain.events.publish(ev.TOPIC_EXIT, to_json(exit_))
+        fresh = chain.on_gossip_voluntary_exit(exit_)
+    except ChainError as e:
+        raise _bad(str(e))
+    if fresh:
+        _publish_op(ctx, "voluntary_exit", exit_)
     return None
 
 
@@ -905,9 +911,16 @@ def pool_exits_get(ctx):
 
 @route("POST", "/eth/v1/beacon/pool/proposer_slashings", P0)
 def pool_proposer_slashings_post(ctx):
+    from ..chain.beacon_chain import ChainError
+
     chain = ctx.chain
     slashing = container_from_json(chain.types.ProposerSlashing, ctx.body)
-    chain.op_pool.insert_proposer_slashing(slashing)
+    try:
+        fresh = chain.on_gossip_proposer_slashing(slashing)
+    except ChainError as e:
+        raise _bad(str(e))
+    if fresh:
+        _publish_op(ctx, "proposer_slashing", slashing)
     return None
 
 
@@ -918,9 +931,16 @@ def pool_proposer_slashings_get(ctx):
 
 @route("POST", "/eth/v1/beacon/pool/attester_slashings", P0)
 def pool_attester_slashings_post(ctx):
+    from ..chain.beacon_chain import ChainError
+
     chain = ctx.chain
     slashing = container_from_json(chain.types.AttesterSlashing, ctx.body)
-    chain.op_pool.insert_attester_slashing(slashing)
+    try:
+        fresh = chain.on_gossip_attester_slashing(slashing)
+    except ChainError as e:
+        raise _bad(str(e))
+    if fresh:
+        _publish_op(ctx, "attester_slashing", slashing)
     return None
 
 
@@ -935,10 +955,17 @@ def pool_attester_slashings_post_v2(ctx):
     (electra slashings carry IndexedAttestationElectra)."""
     chain = ctx.chain
     version = (ctx.headers.get("Eth-Consensus-Version") or "").lower()
+    from ..chain.beacon_chain import ChainError
+
     cls = (chain.types.AttesterSlashingElectra if version == "electra"
            else chain.types.AttesterSlashing)
     slashing = container_from_json(cls, ctx.body)
-    chain.op_pool.insert_attester_slashing(slashing)
+    try:
+        fresh = chain.on_gossip_attester_slashing(slashing)
+    except ChainError as e:
+        raise _bad(str(e))
+    if fresh:
+        _publish_op(ctx, "attester_slashing", slashing)
     return None
 
 
@@ -952,10 +979,28 @@ def pool_attester_slashings_get_v2(ctx):
 
 @route("POST", "/eth/v1/beacon/pool/bls_to_execution_changes", P0)
 def pool_bls_changes_post(ctx):
+    from ..chain.beacon_chain import ChainError
+
     chain = ctx.chain
-    for change_json in ctx.body or []:
-        change = container_from_json(chain.types.SignedBLSToExecutionChange, change_json)
-        chain.op_pool.insert_bls_to_execution_change(change)
+    # Beacon-API batch contract: process EVERY item, report per-index
+    # failures — one bad change must not drop the valid ones after it.
+    failures = []
+    for i, change_json in enumerate(ctx.body or []):
+        try:
+            change = container_from_json(
+                chain.types.SignedBLSToExecutionChange, change_json)
+            fresh = chain.on_gossip_bls_change(change)
+        except (ChainError, KeyError, ValueError) as e:
+            failures.append({"index": i, "message": str(e)})
+            continue
+        if fresh:
+            _publish_op(ctx, "bls_to_execution_change", change)
+    if failures:
+        raise ApiError(400, json.dumps({
+            "code": 400,
+            "message": "error processing bls_to_execution_changes",
+            "failures": failures,
+        }))
     return None
 
 

@@ -231,6 +231,12 @@ class LocalNode:
         )
         return self.service.publish(str(topic), sidecar.as_ssz_bytes())
 
+    def publish_operation(self, kind: str, op) -> int:
+        """Gossip a pool operation on its global topic (voluntary_exit /
+        proposer_slashing / attester_slashing / bls_to_execution_change)."""
+        topic = topics_mod.GossipTopic(self.router.fork_digest, kind)
+        return self.service.publish(str(topic), op.as_ssz_bytes())
+
     def publish_attestation(self, attestation) -> int:
         subnet = topics_mod.compute_subnet_for_attestation(
             self.chain.head_state,

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from ..chain.beacon_chain import AttestationError, BlockError
+from ..chain.beacon_chain import AttestationError, BlockError, ChainError
 from ..consensus import helpers as h
 from ..scheduler import BeaconProcessor, W, WorkEvent
 from . import rpc as rpc_mod
@@ -125,7 +125,88 @@ class Router:
                     item=item,
                 )
             )
-        # other kinds (exits, slashings, ...) are op-pool work — later milestone
+        elif kind in self._OP_WORK_TYPES:
+            item = (kind, topic, uncompressed, compressed, sender)
+            self.processor.send(
+                WorkEvent(
+                    work_type=self._OP_WORK_TYPES[kind],
+                    process=lambda _=None, it=item: self._process_gossip_operation(*it),
+                )
+            )
+        elif kind in (topics_mod.LIGHT_CLIENT_FINALITY_UPDATE,
+                      topics_mod.LIGHT_CLIENT_OPTIMISTIC_UPDATE):
+            wt = (W.GOSSIP_LIGHT_CLIENT_FINALITY_UPDATE
+                  if kind == topics_mod.LIGHT_CLIENT_FINALITY_UPDATE
+                  else W.GOSSIP_LIGHT_CLIENT_OPTIMISTIC_UPDATE)
+            item = (kind, topic, uncompressed, compressed, sender)
+            self.processor.send(
+                WorkEvent(
+                    work_type=wt,
+                    process=lambda _=None, it=item: self._process_gossip_lc_update(*it),
+                )
+            )
+
+    _OP_WORK_TYPES = {
+        topics_mod.VOLUNTARY_EXIT: W.GOSSIP_VOLUNTARY_EXIT,
+        topics_mod.PROPOSER_SLASHING: W.GOSSIP_PROPOSER_SLASHING,
+        topics_mod.ATTESTER_SLASHING: W.GOSSIP_ATTESTER_SLASHING,
+        topics_mod.BLS_TO_EXECUTION_CHANGE: W.GOSSIP_BLS_TO_EXECUTION_CHANGE,
+        topics_mod.SYNC_COMMITTEE_CONTRIBUTION_AND_PROOF: W.GOSSIP_SYNC_CONTRIBUTION,
+    }
+
+    def _process_gossip_operation(self, kind: str, topic: str,
+                                  uncompressed: bytes, compressed: bytes,
+                                  sender: str) -> None:
+        """Pool-operation gossip (reference gossip_methods.rs
+        process_gossip_{voluntary_exit,proposer_slashing,attester_slashing,
+        bls_to_execution_change} + process_gossip_sync_contribution):
+        decode, verify via the chain (dedup -> drop; invalid -> penalize),
+        pool, and forward only what validated fresh."""
+        chain = self.chain
+        try:
+            if kind == topics_mod.VOLUNTARY_EXIT:
+                op = chain.types.SignedVoluntaryExit.from_ssz_bytes(uncompressed)
+                fresh = chain.on_gossip_voluntary_exit(op)
+            elif kind == topics_mod.PROPOSER_SLASHING:
+                op = chain.types.ProposerSlashing.from_ssz_bytes(uncompressed)
+                fresh = chain.on_gossip_proposer_slashing(op)
+            elif kind == topics_mod.ATTESTER_SLASHING:
+                # electra slashings carry the EIP-7549 committee-spanning
+                # container on the SAME topic (the v2 HTTP route's switch)
+                fork = chain.spec.fork_name_at_slot(chain.current_slot())
+                cls = (chain.types.AttesterSlashingElectra
+                       if fork == "electra" else chain.types.AttesterSlashing)
+                op = cls.from_ssz_bytes(uncompressed)
+                fresh = chain.on_gossip_attester_slashing(op)
+            elif kind == topics_mod.BLS_TO_EXECUTION_CHANGE:
+                op = chain.types.SignedBLSToExecutionChange.from_ssz_bytes(
+                    uncompressed)
+                fresh = chain.on_gossip_bls_change(op)
+            else:  # sync contribution-and-proof
+                signed = chain.types.SignedContributionAndProof.from_ssz_bytes(
+                    uncompressed)
+                (err,) = chain.process_signed_contributions([signed])
+                if err is not None:
+                    # IGNORE vs REJECT (p2p spec): a contribution outside
+                    # the slot window is normal propagation lag, not peer
+                    # misbehavior — penalizing it would bleed honest peers
+                    if "outside the current-slot window" not in err:
+                        self.service.peer_manager.report(
+                            sender, PeerAction.LOW_TOLERANCE,
+                            f"bad sync contribution: {err}")
+                    return
+                fresh = True
+        except ChainError as e:
+            self.service.peer_manager.report(
+                sender, PeerAction.LOW_TOLERANCE, f"bad {kind}: {e}")
+            return
+        except Exception:
+            self.service.peer_manager.report(
+                sender, PeerAction.LOW_TOLERANCE, f"undecodable {kind}")
+            return
+        if fresh:
+            self.service.forward(topic, compressed, exclude=sender,
+                                 uncompressed=uncompressed)
 
     def _process_gossip_block(
         self, topic: str, uncompressed: bytes, compressed: bytes, sender: str
@@ -197,6 +278,24 @@ class Router:
                 self.fork_digest, topics_mod.LIGHT_CLIENT_OPTIMISTIC_UPDATE
             )
             self.service.publish(str(t), opt.as_ssz_bytes())
+
+    def _process_gossip_lc_update(self, kind: str, topic: str,
+                                  uncompressed: bytes, compressed: bytes,
+                                  sender: str) -> None:
+        """Light-client update gossip (reference
+        light_client_{finality,optimistic}_update_verification.rs / p2p
+        spec): a received update is valid iff it EQUALS the one this node's
+        LC server computed from its own view — forward on match, IGNORE
+        (no penalty: views can lag) otherwise."""
+        cache = self.chain.lc_cache
+        ours = (cache.latest_finality_update
+                if kind == topics_mod.LIGHT_CLIENT_FINALITY_UPDATE
+                else cache.latest_optimistic_update)
+        if ours is None:
+            return  # no local view to validate against: IGNORE
+        if ours.as_ssz_bytes() == uncompressed:
+            self.service.forward(topic, compressed, exclude=sender,
+                                 uncompressed=uncompressed)
 
     def _process_gossip_blob(
         self, topic: str, uncompressed: bytes, compressed: bytes, sender: str

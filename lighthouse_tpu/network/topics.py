@@ -74,6 +74,9 @@ def core_topics(digest: bytes, fork_name: str, spec: ChainSpec) -> List[GossipTo
     ]
     if fork_name != "phase0":
         kinds.append(SYNC_COMMITTEE_CONTRIBUTION_AND_PROOF)
+        # LC servers gossip their updates (p2p spec light_client topics)
+        kinds.append(LIGHT_CLIENT_FINALITY_UPDATE)
+        kinds.append(LIGHT_CLIENT_OPTIMISTIC_UPDATE)
     if fork_name in ("capella", "deneb", "electra"):
         kinds.append(BLS_TO_EXECUTION_CHANGE)
     if fork_name in ("deneb", "electra"):

@@ -356,3 +356,74 @@ class TestConvergence:
         finally:
             for n in nodes:
                 n.shutdown()
+
+
+class TestOpGossip:
+    """Pool-operation gossip handlers (reference gossip_methods.rs
+    process_gossip_voluntary_exit / proposer_slashing / attester_slashing /
+    bls_to_execution_change): validate, dedup, pool, forward."""
+
+    def test_exit_propagates_into_peer_pool(self):
+        hub, na, nb = two_nodes()
+        try:
+            hub.connect("a", "b")
+            # an exit valid against the head state needs an old-enough
+            # validator: rewind the gate by publishing at epoch 0 with
+            # shard_committee_period satisfied via spec on minimal... the
+            # harness genesis validators activate at epoch 0, so craft the
+            # exit and relax nothing: validity is exercised in
+            # test_op_pool; HERE we assert the gossip path end to end with
+            # a valid-by-construction exit.
+            spec = na.chain.spec
+            state = na.chain.head_state
+            # make the exit pass process_voluntary_exit: validator must be
+            # active and past shard_committee_period epochs since activation
+            # — minimal spec shard_committee_period=64 epochs is too long to
+            # simulate, so instead drive the handler directly with a
+            # monkeypatched verifier to prove pool+forward plumbing, and
+            # separately assert the REJECT path penalizes.
+            exit_msg = na.chain.types.VoluntaryExit(epoch=0, validator_index=5)
+            signed = na.chain.types.SignedVoluntaryExit(
+                message=exit_msg, signature=na.harness._canned_sig)
+            import lighthouse_tpu.consensus.per_block as pb_mod
+            orig = pb_mod.process_voluntary_exit
+            pb_mod.process_voluntary_exit = lambda *a, **k: None
+            try:
+                assert na.chain.on_gossip_voluntary_exit(signed) is True
+                # duplicate: dedup'd, not re-verified
+                assert na.chain.on_gossip_voluntary_exit(signed) is False
+                na.publish_operation("voluntary_exit", signed)
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    if nb.chain.op_pool._voluntary_exits:
+                        break
+                    time.sleep(0.05)
+            finally:
+                pb_mod.process_voluntary_exit = orig
+            assert 5 in nb.chain.op_pool._voluntary_exits, (
+                "exit gossip never reached the peer's op pool")
+        finally:
+            na.shutdown()
+            nb.shutdown()
+
+    def test_invalid_op_gossip_penalizes_sender(self):
+        hub, na, nb = two_nodes()
+        try:
+            hub.connect("a", "b")
+            # an exit for a validator index that does not exist: REJECT
+            exit_msg = na.chain.types.VoluntaryExit(epoch=0, validator_index=9999)
+            signed = na.chain.types.SignedVoluntaryExit(
+                message=exit_msg, signature=na.harness._canned_sig)
+            na.publish_operation("voluntary_exit", signed)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                p = nb.service.peer_manager._peer("a")
+                if p is not None and p.score < 0:
+                    break
+                time.sleep(0.05)
+            assert nb.service.peer_manager._peer("a").score < 0, (
+                "invalid exit should penalize the sender")
+            assert not nb.chain.op_pool._voluntary_exits
+        finally:
+            na.shutdown()
+            nb.shutdown()
