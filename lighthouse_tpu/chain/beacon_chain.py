@@ -1207,12 +1207,13 @@ class BeaconChain:
     # validator's real one, observed_operations.rs) is enforced ONCE.
 
     def _on_gossip_op(self, kind: str, op, key, sets_fn, process_fn,
-                      insert_fn, what: str) -> bool:
+                      insert_fn, what: str, scratch=None) -> bool:
         from ..crypto.bls import api as bls
 
         if self.observed.operations.is_known(kind, key):
             return False
-        scratch = self.head_state.copy()
+        if scratch is None:
+            scratch = self.head_state.copy()
         try:
             sig_sets = sets_fn(scratch)
         except Exception as e:
@@ -1231,11 +1232,10 @@ class BeaconChain:
         from ..consensus import signature_sets as sets
         from ..consensus.per_block import process_voluntary_exit
         from . import events as ev
-        from ..http_api.serde import to_json
 
         def insert():
             self.op_pool.insert_voluntary_exit(exit_)
-            self.events.publish(ev.TOPIC_EXIT, to_json(exit_))
+            self.events.publish(ev.TOPIC_EXIT, ev.exit_event_payload(exit_))
 
         return self._on_gossip_op(
             "voluntary_exit", exit_, int(exit_.message.validator_index),
@@ -1274,7 +1274,11 @@ class BeaconChain:
             "attester slashing",
         )
 
-    def on_gossip_bls_change(self, signed_change) -> bool:
+    def on_gossip_bls_change(self, signed_change, scratch=None) -> bool:
+        """``scratch``: batch callers (the HTTP route) pass ONE shared
+        scratch state so N changes cost one head-state copy, not N — and
+        later items validate against the post-earlier-items state, the
+        batch-application semantics."""
         from ..consensus import signature_sets as sets
         from ..consensus.per_block import process_bls_to_execution_change
 
@@ -1286,7 +1290,7 @@ class BeaconChain:
             lambda st: process_bls_to_execution_change(
                 st, signed_change, self.types, self.spec, False),
             lambda: self.op_pool.insert_bls_to_execution_change(signed_change),
-            "bls change",
+            "bls change", scratch=scratch,
         )
 
     def process_signed_contributions(self, signed_contributions) -> List[Optional[str]]:

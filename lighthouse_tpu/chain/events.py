@@ -100,3 +100,15 @@ class EventBus:
             "state": "0x" + state_root.hex(),
             "execution_optimistic": False,
         })
+
+
+def exit_event_payload(exit_) -> dict:
+    """SSE payload for a pooled voluntary exit (the chain layer builds
+    event dicts itself — no dependency on the HTTP serializer)."""
+    return {
+        "message": {
+            "epoch": str(int(exit_.message.epoch)),
+            "validator_index": str(int(exit_.message.validator_index)),
+        },
+        "signature": "0x" + bytes(exit_.signature).hex(),
+    }

@@ -985,12 +985,13 @@ def pool_bls_changes_post(ctx):
     # Beacon-API batch contract: process EVERY item, report per-index
     # failures — one bad change must not drop the valid ones after it.
     failures = []
+    scratch = chain.head_state.copy() if ctx.body else None  # one copy per batch
     for i, change_json in enumerate(ctx.body or []):
         try:
             change = container_from_json(
                 chain.types.SignedBLSToExecutionChange, change_json)
-            fresh = chain.on_gossip_bls_change(change)
-        except (ChainError, KeyError, ValueError) as e:
+            fresh = chain.on_gossip_bls_change(change, scratch=scratch)
+        except (ChainError, KeyError, ValueError, TypeError) as e:
             failures.append({"index": i, "message": str(e)})
             continue
         if fresh:

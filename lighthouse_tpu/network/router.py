@@ -172,8 +172,13 @@ class Router:
                 fresh = chain.on_gossip_proposer_slashing(op)
             elif kind == topics_mod.ATTESTER_SLASHING:
                 # electra slashings carry the EIP-7549 committee-spanning
-                # container on the SAME topic (the v2 HTTP route's switch)
-                fork = chain.spec.fork_name_at_slot(chain.current_slot())
+                # container; the TOPIC's digest names the fork (wallclock
+                # would misdecode cross-fork messages at the transition)
+                digest = topics_mod.GossipTopic.parse(topic).fork_digest
+                fork = topics_mod.fork_name_for_digest(
+                    digest, bytes(chain.genesis_state.genesis_validators_root),
+                    chain.spec,
+                ) or chain.spec.fork_name_at_slot(chain.current_slot())
                 cls = (chain.types.AttesterSlashingElectra
                        if fork == "electra" else chain.types.AttesterSlashing)
                 op = cls.from_ssz_bytes(uncompressed)

@@ -55,6 +55,11 @@ class RpcError(ValueError):
     pass
 
 
+class RpcSelfLimited(RpcError):
+    """Our OWN outbound throttle refused/timed out the request — the peer
+    did nothing wrong and must not be penalized for it."""
+
+
 @dataclass
 class Status:
     """Reference ``StatusMessage`` — the handshake that drives sync."""

@@ -70,6 +70,9 @@ class NetworkService:
         self.peer_id = endpoint.peer_id
         self.peer_manager = peer_manager if peer_manager is not None else PeerManager()
         self.rate_limiter = rate_limiter if rate_limiter is not None else RPCRateLimiter()
+        # outbound throttle (self_limiter.rs): same quotas as we enforce
+        # on peers — never send what we ourselves would reject
+        self.self_limiter = RPCRateLimiter()
         self.subscriptions: set = set()
         # gossipsub mesh state (reference vendored gossipsub behaviour.rs):
         # peer_topics — which topics each connected peer announced via
@@ -271,7 +274,29 @@ class NetworkService:
         self, peer: str, protocol: str, request, timeout: float = 5.0
     ) -> List[Tuple[int, bytes, Optional[bytes]]]:
         """Blocking request; returns the response chunk list
-        ``[(result, payload, context_bytes)]``."""
+        ``[(result, payload, context_bytes)]``.
+
+        Outbound requests pass a SELF rate limiter first (reference
+        ``rpc/self_limiter.rs``): we never send faster than peers are
+        allowed to receive, so our own sync bursts cannot get us penalized
+        or disconnected.  In this synchronous stack "queueing" = waiting
+        for tokens, bounded by the request's own timeout."""
+        from .rate_limiter import RateLimitExceeded, request_cost
+
+        deadline = time.monotonic() + timeout
+        cost = request_cost(protocol, request)
+        while True:
+            try:
+                self.self_limiter.allow(peer, protocol, cost)
+                break
+            except RateLimitExceeded as e:
+                if e.fatal:
+                    raise rpc_mod.RpcSelfLimited(
+                        f"request to {peer} exceeds the {protocol} quota")
+                if time.monotonic() >= deadline:
+                    raise rpc_mod.RpcSelfLimited(
+                        f"self-rate-limited to {peer} ({protocol})")
+                time.sleep(0.05)
         with self._req_lock:
             rid = self._next_request_id
             self._next_request_id += 1

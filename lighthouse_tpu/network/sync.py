@@ -102,6 +102,8 @@ class SyncManager:
                         rpc_mod.BlocksByRangeRequest(start_slot=start, count=BATCH_SLOTS),
                         timeout=10.0,
                     )
+                except rpc_mod.RpcSelfLimited:
+                    break  # OUR outbound throttle: retry next round, no blame
                 except rpc_mod.RpcError:
                     self.service.peer_manager.report(peer, PeerAction.MID_TOLERANCE, "sync rpc failed")
                     break
@@ -115,6 +117,8 @@ class SyncManager:
                         self._import_with_blobs(peer, signed)
                         self.router._publish_light_client_updates()
                     except BlockError as e:
+                        if any(t in str(e) for t in self._TRANSIENT_BLOCK_ERRORS):
+                            return  # not the peer's fault (incl. OUR throttle)
                         self.service.peer_manager.report(
                             peer, PeerAction.LOW_TOLERANCE, f"bad sync block: {e}"
                         )
@@ -140,6 +144,8 @@ class SyncManager:
                 peer, rpc_mod.BLOBS_BY_ROOT,
                 rpc_mod.BlobsByRootRequest(ids=ids), timeout=10.0,
             )
+        except rpc_mod.RpcSelfLimited:
+            raise BlockError("pending availability: blob fetch self-limited")
         except rpc_mod.RpcError as e:
             raise BlockError(f"peer did not serve blobs: {e}") from e
         sidecars = []

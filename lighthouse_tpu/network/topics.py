@@ -88,6 +88,18 @@ def attestation_subnet_topic(digest: bytes, subnet_id: int) -> GossipTopic:
     return GossipTopic(digest, f"{BEACON_ATTESTATION_PREFIX}{subnet_id}")
 
 
+def fork_name_for_digest(digest: bytes, genesis_validators_root: bytes,
+                         spec: ChainSpec):
+    """Which fork a topic's digest belongs to (reference types/topics.rs
+    fork-digest mapping) — None for an unknown digest."""
+    for fork in ("phase0", "altair", "bellatrix", "capella", "deneb",
+                 "electra"):
+        version = spec.fork_version_for(fork)
+        if h.compute_fork_digest(version, genesis_validators_root) == digest:
+            return fork
+    return None
+
+
 def compute_subnet_for_attestation(state, slot: int, committee_index: int, spec: ChainSpec) -> int:
     """Spec ``compute_subnet_for_attestation``."""
     committees_per_slot = h.get_committee_count_per_slot(

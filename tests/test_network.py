@@ -427,3 +427,44 @@ class TestOpGossip:
         finally:
             na.shutdown()
             nb.shutdown()
+
+
+class TestSelfRateLimiter:
+    """Outbound self-throttle (reference rpc/self_limiter.rs): our own
+    request bursts wait for quota instead of tripping the peer's limiter."""
+
+    def test_burst_throttled_but_succeeds(self):
+        hub, na, nb = two_nodes()
+        try:
+            hub.connect("a", "b")
+            from lighthouse_tpu.network import rpc as rpc_mod
+            from lighthouse_tpu.network.rate_limiter import Quota, RPCRateLimiter
+
+            # tight quota: 2 status requests per second
+            na.service.self_limiter = RPCRateLimiter(
+                quotas={rpc_mod.STATUS: Quota(2, 1.0)})
+            t0 = time.monotonic()
+            for _ in range(4):
+                chunks = na.service.request(
+                    "b", rpc_mod.STATUS, na.router.local_status(), timeout=5.0)
+                assert chunks and chunks[0][0] == rpc_mod.SUCCESS
+            elapsed = time.monotonic() - t0
+            assert elapsed >= 0.8, (
+                f"4 requests against a 2/s self-quota finished in {elapsed:.2f}s "
+                "— the self limiter never throttled")
+        finally:
+            na.shutdown()
+            nb.shutdown()
+
+    def test_oversize_request_fatal(self):
+        hub, na, nb = two_nodes()
+        try:
+            hub.connect("a", "b")
+            from lighthouse_tpu.network import rpc as rpc_mod
+
+            huge = rpc_mod.BlocksByRangeRequest(start_slot=0, count=10**6)
+            with pytest.raises(rpc_mod.RpcError, match="quota"):
+                na.service.request("b", rpc_mod.BLOCKS_BY_RANGE, huge, timeout=2.0)
+        finally:
+            na.shutdown()
+            nb.shutdown()
