@@ -52,6 +52,8 @@ class BackfillSync:
                     rpc_mod.BlocksByRangeRequest(start_slot=start, count=count),
                     timeout=10.0,
                 )
+            except rpc_mod.RpcSelfLimited:
+                break  # OUR outbound throttle: resume next round, no blame
             except rpc_mod.RpcError:
                 self.service.peer_manager.report(
                     peer, PeerAction.MID_TOLERANCE, "backfill rpc failed"
@@ -118,6 +120,8 @@ class BackfillSync:
                 ),
                 timeout=10.0,
             )
+        except rpc_mod.RpcSelfLimited:
+            return  # OUR outbound throttle, not the peer's failure
         except rpc_mod.RpcError:
             self.service.peer_manager.report(
                 peer, PeerAction.HIGH_TOLERANCE, "backfill blobs unavailable"

@@ -313,7 +313,10 @@ class NetworkService:
             with self._req_lock:
                 self._pending.pop(rid, None)
             raise rpc_mod.RpcError(f"peer {peer} unreachable")
-        if not entry["done"].wait(timeout):
+        # ONE budget covers throttle wait + network wait: time spent in the
+        # self-limiter above comes out of the same deadline, so the caller
+        # never blocks past its own timeout.
+        if not entry["done"].wait(max(0.0, deadline - time.monotonic())):
             with self._req_lock:
                 self._pending.pop(rid, None)
             raise rpc_mod.RpcError(f"request to {peer} timed out ({protocol})")

@@ -117,7 +117,14 @@ class SyncManager:
                         self._import_with_blobs(peer, signed)
                         self.router._publish_light_client_updates()
                     except BlockError as e:
-                        if any(t in str(e) for t in self._TRANSIENT_BLOCK_ERRORS):
+                        # Narrower than _TRANSIENT_BLOCK_ERRORS on purpose:
+                        # the bare "blob" fragment there would also excuse a
+                        # peer that fails to serve sidecars for its OWN
+                        # blocks — that stays penalized.  Self-limited blob
+                        # fetches match "pending availability".
+                        if any(t in str(e) for t in
+                               ("future slot", "pending availability",
+                                "unknown parent")):
                             return  # not the peer's fault (incl. OUR throttle)
                         self.service.peer_manager.report(
                             peer, PeerAction.LOW_TOLERANCE, f"bad sync block: {e}"

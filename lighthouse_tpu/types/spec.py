@@ -14,6 +14,7 @@ the reference embeds from the specs repo.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass, field, fields as dc_fields
 from typing import Dict, Optional
 
@@ -137,11 +138,21 @@ MINIMAL_PRESET = Preset(
     max_deposit_requests_per_payload=4,
     max_withdrawal_requests_per_payload=2,
     max_consolidation_requests_per_payload=1,
-    max_pending_partials_per_withdrawals_sweep=2,
+    max_pending_partials_per_withdrawals_sweep=1,
+    pending_partial_withdrawals_limit=64,
+    pending_consolidations_limit=64,
 )
 
-# Gnosis runs mainnet preset sizes (gnosis chain differs in ChainSpec values).
-GNOSIS_PRESET = MAINNET_PRESET
+# Gnosis preset (presets/gnosis/*.yaml): mainnet sizes except the faster
+# epoch geometry and smaller withdrawals sweep.
+GNOSIS_PRESET = dataclasses.replace(
+    MAINNET_PRESET,
+    name="gnosis",
+    slots_per_epoch=16,
+    epochs_per_sync_committee_period=512,
+    max_withdrawals_per_payload=8,
+    max_validators_per_withdrawals_sweep=8192,
+)
 
 
 @dataclass
@@ -315,6 +326,11 @@ def minimal_spec(**overrides) -> ChainSpec:
         churn_limit_quotient=32,
         shard_committee_period=64,
         min_validator_withdrawability_delay=256,
+        # minimal-preset penalty parameters (presets/minimal/phase0.yaml —
+        # they differ from mainnet and were silently inheriting it)
+        inactivity_penalty_quotient=2**25,
+        min_slashing_penalty_quotient=64,
+        proportional_slashing_multiplier=2,
         altair_fork_epoch=0,
         bellatrix_fork_epoch=0,
         capella_fork_epoch=0,
@@ -347,7 +363,6 @@ def gnosis_spec() -> ChainSpec:
         deneb_fork_version=bytes.fromhex("04000064"),
         deneb_fork_epoch=889856,
         base_reward_factor=25,
-        max_blobs_per_block=2,
     )
 
 
