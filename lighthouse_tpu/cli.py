@@ -15,6 +15,7 @@ import logging
 import os
 import signal
 import sys
+import threading
 import time
 from typing import List, Optional
 
@@ -325,6 +326,35 @@ def run_lcli(args) -> int:
             subprocess.run(cmd, check=True)
         return 0
 
+    if args.lcli_cmd == "mock-el":
+        # Reference `lcli mock-el`: a standalone fake execution engine a
+        # beacon node can point its --execution-endpoint at for testing.
+        import secrets as _secrets
+
+        from .execution_layer.mock_server import MockEngineServer
+
+        if args.jwt_output:
+            secret = _secrets.token_bytes(32)
+            # owner-only: the secret authenticates engine-API calls
+            fd = os.open(args.jwt_output,
+                         os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "w") as f:
+                f.write("0x" + secret.hex())
+        else:
+            secret = bytes.fromhex(
+                _read_password(args.jwt_secret, "jwt secret (hex): ")
+                .removeprefix("0x"))
+        server = MockEngineServer(secret, port=args.port).start()
+        print(json.dumps({"endpoint": server.url,
+                          "jwt_secret_file": args.jwt_output or "(provided)"}))
+        sys.stdout.flush()
+        stop = threading.Event()
+        for s in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(s, lambda *_: stop.set())
+        stop.wait()
+        server.stop()
+        return 0
+
     if args.lcli_cmd == "skip-slots":
         spec = _spec_for(args.network)
         types = build_types(spec.preset)
@@ -583,6 +613,12 @@ def build_parser() -> argparse.ArgumentParser:
         r.add_argument("--network", default="minimal")
         r.add_argument("--fork", default="capella")
         r.add_argument("file")
+    me = lsub.add_parser("mock-el", help="run a standalone fake execution engine")
+    me.add_argument("--port", type=int, default=0)
+    me.add_argument("--jwt-output", default="",
+                    help="write a fresh jwt secret here (hex)")
+    me.add_argument("--jwt-secret", default="",
+                    help="file holding an existing jwt secret (hex)")
     ps = lsub.add_parser("parse-ssz")
     ps.add_argument("--network", default="minimal")
     ps.add_argument("type_name")

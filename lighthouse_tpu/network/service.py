@@ -297,6 +297,12 @@ class NetworkService:
                     raise rpc_mod.RpcSelfLimited(
                         f"self-rate-limited to {peer} ({protocol})")
                 time.sleep(0.05)
+        if deadline - time.monotonic() < 0.25:
+            # the throttle consumed (almost) the whole budget: the network
+            # wait below would time out instantly and be misread as the
+            # PEER timing out — keep the attribution on our own limiter
+            raise rpc_mod.RpcSelfLimited(
+                f"self-rate-limited to {peer} ({protocol}): no budget left")
         with self._req_lock:
             rid = self._next_request_id
             self._next_request_id += 1

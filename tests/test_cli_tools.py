@@ -2,6 +2,8 @@
 db version/inspect/compact and lcli root/ssz/skip-slot tools."""
 
 import json
+import os
+import sys
 
 import pytest
 
@@ -152,3 +154,31 @@ def test_db_prune_payloads_and_blobs(tmp_path, capsys):
         assert out["blob_sets_pruned"] == 1
     finally:
         set_backend("host")
+
+
+def test_lcli_mock_el_serves_engine_api(tmp_path):
+    """`lcli mock-el` runs a standalone fake EL a BN can connect to
+    (reference `lcli mock-el`)."""
+    import subprocess
+
+    jwt_path = tmp_path / "jwt.hex"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lighthouse_tpu", "lcli", "mock-el",
+         "--jwt-output", str(jwt_path)],
+        stdout=subprocess.PIPE, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+    )
+    try:
+        line = proc.stdout.readline().decode()
+        info = json.loads(line)
+        assert info["endpoint"].startswith("http://127.0.0.1:")
+        secret = bytes.fromhex(jwt_path.read_text().removeprefix("0x"))
+        assert len(secret) == 32
+        # a real engine-API exchange through the spawned process
+        from lighthouse_tpu.execution_layer.engine_api import EngineApiClient
+        client = EngineApiClient(info["endpoint"], secret)
+        caps = client.exchange_capabilities()
+        assert any("engine_newPayload" in c for c in caps)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)

@@ -23,9 +23,8 @@ FAR_FUTURE = 2**64 - 1
 # YAML keys that name compile-time SSZ geometry or features we deliberately
 # express differently (documented, not silently skipped).
 EXPECTED_ABSENT = {
-    # phase0 constants folded into containers / helpers
-    "SAFE_SLOTS_TO_UPDATE_JUSTIFIED",  # pre-Bellatrix fork-choice, removed
-    "EPOCHS_PER_SYNC_COMMITTEE_PERIOD",  # present; probe both cases below
+    # pre-Bellatrix fork-choice constant the spec itself removed
+    "SAFE_SLOTS_TO_UPDATE_JUSTIFIED",
 }
 
 
