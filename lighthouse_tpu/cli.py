@@ -333,6 +333,9 @@ def run_lcli(args) -> int:
 
         from .execution_layer.mock_server import MockEngineServer
 
+        if args.jwt_output and args.jwt_secret:
+            raise SystemExit("--jwt-output and --jwt-secret are exclusive: "
+                             "generate a fresh secret OR reuse an existing one")
         if args.jwt_output:
             secret = _secrets.token_bytes(32)
             # owner-only: the secret authenticates engine-API calls
@@ -341,9 +344,14 @@ def run_lcli(args) -> int:
             with os.fdopen(fd, "w") as f:
                 f.write("0x" + secret.hex())
         else:
-            secret = bytes.fromhex(
-                _read_password(args.jwt_secret, "jwt secret (hex): ")
-                .removeprefix("0x"))
+            raw = _read_password(args.jwt_secret, "jwt secret (hex): ")
+            try:
+                secret = bytes.fromhex(raw.removeprefix("0x"))
+            except ValueError as e:
+                raise SystemExit(f"invalid jwt secret hex: {e}")
+            if len(secret) != 32:
+                raise SystemExit(
+                    f"jwt secret must be 32 bytes, got {len(secret)}")
         server = MockEngineServer(secret, port=args.port).start()
         print(json.dumps({"endpoint": server.url,
                           "jwt_secret_file": args.jwt_output or "(provided)"}))

@@ -285,6 +285,7 @@ class NetworkService:
 
         deadline = time.monotonic() + timeout
         cost = request_cost(protocol, request)
+        throttled = False
         while True:
             try:
                 self.self_limiter.allow(peer, protocol, cost)
@@ -296,11 +297,14 @@ class NetworkService:
                 if time.monotonic() >= deadline:
                     raise rpc_mod.RpcSelfLimited(
                         f"self-rate-limited to {peer} ({protocol})")
+                throttled = True
                 time.sleep(0.05)
-        if deadline - time.monotonic() < 0.25:
+        if throttled and deadline - time.monotonic() < 0.25:
             # the throttle consumed (almost) the whole budget: the network
             # wait below would time out instantly and be misread as the
-            # PEER timing out — keep the attribution on our own limiter
+            # PEER timing out — keep the attribution on our own limiter.
+            # Only when the limiter actually waited: a small CALLER timeout
+            # alone is not our throttle's fault.
             raise rpc_mod.RpcSelfLimited(
                 f"self-rate-limited to {peer} ({protocol}): no budget left")
         with self._req_lock:

@@ -96,20 +96,14 @@ def _fold8_mm(x, shift1):
     )
 
 
-def _fq_mul_kernel(a8_ref, b8_ref, shift1_ref, redmat_ref, combine_ref,
-                   out_ref):
-    """One batch tile: conv -> fold8 x2 -> REDMAT -> fold8 x2 -> combine."""
-    a8 = a8_ref[...]  # (BT, 128) int32, lanes >= _SPLIT8 are zero
-    b8 = b8_ref[...]
-    shift1 = shift1_ref[...]
-    redmat = redmat_ref[...]
-    combine = combine_ref[...]
+def _mul_pipeline(a8, b8, shift1, redmat, combine):
+    """conv -> fold8 x2 -> REDMAT -> fold8 x2 -> combine, all in VMEM.
 
-    # Schoolbook convolution, statically unrolled: lane k accumulates
-    # a8[i] * b8[k - i] — i.e. c = Σ_i a_i ⊙ roll(b, i).  The roll is one
-    # lane rotation per step (cheap VPU work, no matmul); wraparound never
-    # corrupts low lanes because b8's top nonzero lane is 53 and the
-    # largest rotation is 53 (53 + 53 = 106 < 128).
+    Schoolbook convolution, statically unrolled: lane k accumulates
+    a8[i] * b8[k - i] — i.e. c = Σ_i a_i ⊙ roll(b, i).  The roll is one
+    lane rotation per step (cheap VPU work, no matmul); wraparound never
+    corrupts low lanes because b8's top nonzero lane is 53 and the
+    largest rotation is 53 (53 + 53 = 106 < 128)."""
     c = a8[:, 0][:, None] * b8
     bs = b8
     for i in range(1, _SPLIT8):
@@ -122,7 +116,33 @@ def _fq_mul_kernel(a8_ref, b8_ref, shift1_ref, redmat_ref, combine_ref,
     r = jax.lax.dot(c, redmat, preferred_element_type=jnp.int32)
     r = _fold8_mm(_fold8_mm(r, shift1), shift1)
     # radix-2^8 pairs -> 25 radix-2^16 limbs (lanes >= 25 become zero)
-    out_ref[...] = jax.lax.dot(r, combine, preferred_element_type=jnp.int32)
+    return jax.lax.dot(r, combine, preferred_element_type=jnp.int32)
+
+
+def _fq_mul_kernel(a8_ref, b8_ref, shift1_ref, redmat_ref, combine_ref,
+                   out_ref):
+    """One batch tile of base-field multiplies."""
+    out_ref[...] = _mul_pipeline(
+        a8_ref[...], b8_ref[...],
+        shift1_ref[...], redmat_ref[...], combine_ref[...],
+    )
+
+
+def _fq2_mul_kernel(a0_ref, a1_ref, b0_ref, b1_ref, sa_ref, sb_ref,
+                    shift1_ref, redmat_ref, combine_ref,
+                    out0_ref, out1_ref):
+    """One batch tile of Fq2 Karatsuba: THREE mul pipelines and the
+    recombination (t0 - t1, t2 - t0 - t1) fused in one kernel — the XLA
+    path round-trips the stacked products through HBM between the fq_mul
+    and the subtractions; here they never leave VMEM."""
+    shift1 = shift1_ref[...]
+    redmat = redmat_ref[...]
+    combine = combine_ref[...]
+    t0 = _mul_pipeline(a0_ref[...], b0_ref[...], shift1, redmat, combine)
+    t1 = _mul_pipeline(a1_ref[...], b1_ref[...], shift1, redmat, combine)
+    t2 = _mul_pipeline(sa_ref[...], sb_ref[...], shift1, redmat, combine)
+    out0_ref[...] = t0 - t1
+    out1_ref[...] = t2 - t0 - t1
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -146,6 +166,53 @@ def _fq_mul_pallas_flat(a8p: jax.Array, b8p: jax.Array, interpret: bool):
     )(a8p, b8p, *consts)
 
 
+def _stage_operand(x: jax.Array, n: int, n_pad: int) -> jax.Array:
+    """Host-side operand staging shared by every kernel entry: exact
+    fold16_2 + radix-2^8 split, zero-padded to (n_pad, LANES)."""
+    x8 = split16_to_8(fold16_2(x))  # (n, 54) exact
+    return jnp.zeros((n_pad, LANES), jnp.int32).at[:n, :_SPLIT8].set(x8)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _fq2_mul_pallas_flat(operands, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    n_tiles = operands[0].shape[0] // _BT
+    consts = [jnp.asarray(_SHIFT1), jnp.asarray(_REDMAT), jnp.asarray(_COMBINE)]
+    const_spec = pl.BlockSpec((LANES, LANES), lambda i: (0, 0))
+    tile_spec = pl.BlockSpec((_BT, LANES), lambda i: (i, 0))
+    out = jax.ShapeDtypeStruct(operands[0].shape, jnp.int32)
+    return pl.pallas_call(
+        _fq2_mul_kernel,
+        grid=(n_tiles,),
+        in_specs=[tile_spec] * 6 + [const_spec] * 3,
+        out_specs=[tile_spec, tile_spec],
+        out_shape=[out, out],
+        interpret=interpret,
+    )(*operands, *consts)
+
+
+def fq2_mul_pallas(a: jax.Array, b: jax.Array, *, interpret=None) -> jax.Array:
+    """Drop-in for ``ops.tower.fq2_mul`` on (..., 2, 25) int32 elements:
+    Karatsuba's three products and the recombination fused in one kernel."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    lead = a.shape[:-2]
+    a2 = a.reshape(-1, 2, a.shape[-1])
+    b2 = b.reshape(-1, 2, b.shape[-1])
+    n = a2.shape[0]
+    n_pad = max(_BT, ((n + _BT - 1) // _BT) * _BT)
+
+    a0, a1 = a2[:, 0, :], a2[:, 1, :]
+    b0, b1 = b2[:, 0, :], b2[:, 1, :]
+    operands = [_stage_operand(x, n, n_pad)
+                for x in (a0, a1, b0, b1, a0 + a1, b0 + b1)]
+    out0, out1 = _fq2_mul_pallas_flat(operands, interpret)
+    return jnp.stack(
+        [out0[:n, :L16], out1[:n, :L16]], axis=-2
+    ).reshape(*lead, 2, L16)
+
+
 def _on_tpu() -> bool:
     try:
         return jax.devices()[0].platform == "tpu"
@@ -165,11 +232,9 @@ def fq_mul_pallas(a: jax.Array, b: jax.Array, *, interpret=None) -> jax.Array:
     lead = a.shape[:-1]
     a2 = a.reshape(-1, a.shape[-1])
     b2 = b.reshape(-1, b.shape[-1])
-    a8 = split16_to_8(fold16_2(a2))  # (B, 54) exact
-    b8 = split16_to_8(fold16_2(b2))
-    n = a8.shape[0]
+    n = a2.shape[0]
     n_pad = max(_BT, ((n + _BT - 1) // _BT) * _BT)
-    a8p = jnp.zeros((n_pad, LANES), jnp.int32).at[:n, :_SPLIT8].set(a8)
-    b8p = jnp.zeros((n_pad, LANES), jnp.int32).at[:n, :_SPLIT8].set(b8)
+    a8p = _stage_operand(a2, n, n_pad)
+    b8p = _stage_operand(b2, n, n_pad)
     out = _fq_mul_pallas_flat(a8p, b8p, interpret)
     return out[:n, :L16].reshape(*lead, L16)

@@ -39,7 +39,8 @@ def main() -> None:
         pass
 
     from lighthouse_tpu.ops.fq import P, fq_mul, to_limbs16
-    from lighthouse_tpu.ops.pallas_fq import fq_mul_pallas
+    from lighthouse_tpu.ops.pallas_fq import fq2_mul_pallas, fq_mul_pallas
+    from lighthouse_tpu.ops.tower import fq2_mul
 
     platform = jax.devices()[0].platform
     if platform != "tpu":
@@ -55,9 +56,14 @@ def main() -> None:
         ])
         a = jnp.asarray(vals)
         b = jnp.asarray(np.roll(vals, 1, axis=0))
+        a2 = jnp.stack([a, jnp.asarray(np.roll(vals, 2, axis=0))], axis=-2)
+        b2 = jnp.stack([b, jnp.asarray(np.roll(vals, 3, axis=0))], axis=-2)
+        einsum_mul2 = jax.jit(fq2_mul)
         row = {"batch": n, "platform": platform}
         for name, fn in (("einsum", lambda: einsum_mul(a, b)),
-                         ("pallas", lambda: fq_mul_pallas(a, b, interpret=platform != "tpu"))):
+                         ("pallas", lambda: fq_mul_pallas(a, b, interpret=platform != "tpu")),
+                         ("einsum_fq2", lambda: einsum_mul2(a2, b2)),
+                         ("pallas_fq2", lambda: fq2_mul_pallas(a2, b2, interpret=platform != "tpu"))):
             try:
                 t0 = time.perf_counter()
                 out = fn()
@@ -74,6 +80,9 @@ def main() -> None:
                 row[f"{name}_error"] = f"{type(e).__name__}: {e}"
         if "einsum_us_per_mul" in row and "pallas_us_per_mul" in row:
             row["speedup"] = round(row["einsum_us_per_mul"] / row["pallas_us_per_mul"], 3)
+        if "einsum_fq2_us_per_mul" in row and "pallas_fq2_us_per_mul" in row:
+            row["speedup_fq2"] = round(
+                row["einsum_fq2_us_per_mul"] / row["pallas_fq2_us_per_mul"], 3)
         print(json.dumps(row))
         results.append(row)
     outdir = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".perf")
