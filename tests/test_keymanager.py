@@ -30,7 +30,9 @@ def rig():
         keys=[interop_secret_key(0)], spec=spec, genesis_validators_root=GVR
     )
     server = KeymanagerServer(store=store, genesis_validators_root=GVR).start()
-    client = KeymanagerClient(server.url, server.token)
+    # generous timeout: keystore import does scrypt work server-side, and a
+    # loaded CI box can push one request past the 5 s default (observed flake)
+    client = KeymanagerClient(server.url, server.token, timeout=30.0)
     yield store, server, client
     server.stop()
 

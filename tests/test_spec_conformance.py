@@ -132,3 +132,18 @@ def test_mainnet_config_yaml_fork_schedule():
         if a != b:
             mismatches.append(f"{key}: ours={a!r} yaml={b!r}")
     assert not mismatches, "\n".join(mismatches)
+
+
+def test_reference_testnet_dir_loads():
+    """The reference's own environment-test testnet_dir (a mainnet-preset
+    config with a customised genesis count) loads through our
+    --testnet-dir path and yields the customised spec."""
+    from lighthouse_tpu.network_config import Eth2NetworkConfig
+
+    path = os.path.join(os.path.dirname(PRESET_DIR), "testnet_dir")
+    cfg = Eth2NetworkConfig.from_testnet_dir(path)
+    spec = cfg.spec
+    assert spec.preset.name == "mainnet"
+    assert spec.min_genesis_active_validator_count == 100000  # customised
+    assert spec.genesis_fork_version == bytes.fromhex("00000000")
+    assert spec.seconds_per_slot == 12
