@@ -38,6 +38,11 @@ DEFAULT_QUOTAS: Dict[str, Quota] = {
     rpc_mod.BLOCKS_BY_ROOT: Quota(128, 10.0),  # tokens are ROOTS
     rpc_mod.BLOBS_BY_RANGE: Quota(768, 10.0),
     rpc_mod.BLOBS_BY_ROOT: Quota(128, 10.0),
+    # light-client serving does per-request state reads (bootstrap walks
+    # Merkle branches) — quota it like the reference does
+    rpc_mod.LIGHT_CLIENT_BOOTSTRAP: Quota(1, 10.0),
+    rpc_mod.LIGHT_CLIENT_OPTIMISTIC_UPDATE: Quota(1, 10.0),
+    rpc_mod.LIGHT_CLIENT_FINALITY_UPDATE: Quota(1, 10.0),
 }
 
 

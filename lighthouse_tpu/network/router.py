@@ -473,6 +473,25 @@ class Router:
             return self._serve_blobs_by_range(request, sender)
         if protocol == rpc_mod.BLOBS_BY_ROOT:
             return self._serve_blobs_by_root(request, sender)
+        if protocol == rpc_mod.LIGHT_CLIENT_BOOTSTRAP:
+            bootstrap = self.chain.produce_light_client_bootstrap(
+                bytes(request.root))
+            if bootstrap is None:
+                return [rpc_mod.encode_response_chunk(
+                    rpc_mod.RESOURCE_UNAVAILABLE, b"")]
+            return [self._lc_chunk(bootstrap, int(bootstrap.header.beacon.slot))]
+        if protocol in (rpc_mod.LIGHT_CLIENT_OPTIMISTIC_UPDATE,
+                        rpc_mod.LIGHT_CLIENT_FINALITY_UPDATE):
+            update = (
+                self.chain.lc_cache.latest_optimistic_update
+                if protocol == rpc_mod.LIGHT_CLIENT_OPTIMISTIC_UPDATE
+                else self.chain.lc_cache.latest_finality_update
+            )
+            if update is None:
+                return [rpc_mod.encode_response_chunk(
+                    rpc_mod.RESOURCE_UNAVAILABLE, b"")]
+            return [self._lc_chunk(
+                update, int(update.attested_header.beacon.slot))]
         if protocol == rpc_mod.PEER_EXCHANGE:
             return self._serve_peer_exchange(request, sender)
         return [rpc_mod.encode_response_chunk(rpc_mod.INVALID_REQUEST, b"unknown protocol")]
@@ -526,6 +545,18 @@ class Router:
         return [rpc_mod.serve_peer_exchange(
             self.service.endpoint, sender, req.max_peers
         )]
+
+    def _lc_chunk(self, payload, slot: int) -> bytes:
+        """Context bytes name the fork of the PAYLOAD's era — LC container
+        schemas differ per era, so the startup digest would mislead a
+        client decoding a pre-transition bootstrap after a fork."""
+        spec = self.chain.spec
+        version = spec.fork_version_for(
+            spec.fork_name_at_epoch(slot // spec.slots_per_epoch))
+        context = h.compute_fork_digest(
+            version, bytes(self.chain.genesis_state.genesis_validators_root))
+        return rpc_mod.encode_response_chunk(
+            rpc_mod.SUCCESS, payload.as_ssz_bytes(), context_bytes=context)
 
     def _block_chunk(self, signed_block) -> bytes:
         epoch = int(signed_block.message.slot) // self.chain.spec.slots_per_epoch

@@ -42,6 +42,20 @@ METADATA = _pid("metadata/2")
 # Not a consensus-spec protocol: this transport's discovery analog (the role
 # discv5 plays for the reference) — peers exchange known listen addresses.
 PEER_EXCHANGE = _pid("peer_exchange/1")
+# light-client req/resp (reference rpc/protocol.rs SupportedProtocol::
+# LightClient{Bootstrap,OptimisticUpdate,FinalityUpdate}V1)
+LIGHT_CLIENT_BOOTSTRAP = _pid("light_client_bootstrap/1")
+LIGHT_CLIENT_OPTIMISTIC_UPDATE = _pid("light_client_optimistic_update/1")
+LIGHT_CLIENT_FINALITY_UPDATE = _pid("light_client_finality_update/1")
+
+# Protocols whose SUCCESS chunks carry 4 context bytes (fork digest of the
+# payload's era).  ONE owner: the router encodes and the service decodes
+# from this same set — editing only one side silently corrupts decoding.
+CONTEXT_PROTOCOLS = frozenset({
+    BLOCKS_BY_RANGE, BLOCKS_BY_ROOT, BLOBS_BY_RANGE, BLOBS_BY_ROOT,
+    LIGHT_CLIENT_BOOTSTRAP, LIGHT_CLIENT_OPTIMISTIC_UPDATE,
+    LIGHT_CLIENT_FINALITY_UPDATE,
+})
 
 SUCCESS = 0
 INVALID_REQUEST = 1
@@ -255,6 +269,23 @@ def serve_peer_exchange(endpoint, sender: str, max_peers) -> bytes:
     return encode_response_chunk(SUCCESS, encode_peer_entries(entries))
 
 
+@dataclass
+class LightClientBootstrapRequest:
+    """Request body = the block root to bootstrap from (spec
+    light_client_bootstrap req/resp)."""
+
+    root: bytes
+
+    def to_bytes(self) -> bytes:
+        return bytes(self.root)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "LightClientBootstrapRequest":
+        if len(data) != 32:
+            raise RpcError("light_client_bootstrap request must be 32 bytes")
+        return cls(data)
+
+
 REQUEST_TYPES = {
     STATUS: Status,
     GOODBYE: Goodbye,
@@ -265,6 +296,9 @@ REQUEST_TYPES = {
     BLOBS_BY_RANGE: BlobsByRangeRequest,
     BLOBS_BY_ROOT: BlobsByRootRequest,
     PEER_EXCHANGE: PeerExchangeRequest,
+    LIGHT_CLIENT_BOOTSTRAP: LightClientBootstrapRequest,
+    LIGHT_CLIENT_OPTIMISTIC_UPDATE: type(None),  # empty request body
+    LIGHT_CLIENT_FINALITY_UPDATE: type(None),
 }
 
 

@@ -683,11 +683,6 @@ class NetworkService:
                 self._pending.pop(env.request_id, None)
             entry["done"].set()
             return
-        has_context = entry["protocol"] in (
-            rpc_mod.BLOCKS_BY_RANGE,
-            rpc_mod.BLOCKS_BY_ROOT,
-            rpc_mod.BLOBS_BY_RANGE,
-            rpc_mod.BLOBS_BY_ROOT,
-        )
+        has_context = entry["protocol"] in rpc_mod.CONTEXT_PROTOCOLS
         result, payload, context, _ = rpc_mod.decode_response_chunk(env.data, has_context)
         entry["chunks"].append((result, payload, context))

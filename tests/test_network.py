@@ -468,3 +468,60 @@ class TestSelfRateLimiter:
         finally:
             na.shutdown()
             nb.shutdown()
+
+
+class TestLightClientRpc:
+    """Light-client req/resp (reference rpc/protocol.rs LightClient*V1):
+    bootstrap by root, latest optimistic + finality updates."""
+
+    def test_bootstrap_and_updates_served(self):
+        hub, na, nb = two_nodes()
+        try:
+            hub.connect("a", "b")
+            from lighthouse_tpu.network import rpc as rpc_mod
+            from lighthouse_tpu.network.rate_limiter import Quota
+
+            # production default is 1 request / 10 s per LC protocol (state
+            # reads per request); this test makes four back-to-back, so
+            # relax BOTH sides' limiters for the duration
+            for proto in (rpc_mod.LIGHT_CLIENT_BOOTSTRAP,
+                          rpc_mod.LIGHT_CLIENT_OPTIMISTIC_UPDATE,
+                          rpc_mod.LIGHT_CLIENT_FINALITY_UPDATE):
+                na.service.rate_limiter.quotas[proto] = Quota(16, 10.0)
+                nb.service.self_limiter.quotas[proto] = Quota(16, 10.0)
+
+            # build a couple of blocks so node A has LC data
+            for _ in range(3):
+                slot = na.harness.advance_slot()
+                nb.harness.advance_slot()
+                signed = na.harness.produce_signed_block(slot=slot)
+                na.chain.process_block(signed)
+            root = na.chain.head_root
+            chunks = nb.service.request(
+                "a", rpc_mod.LIGHT_CLIENT_BOOTSTRAP,
+                rpc_mod.LightClientBootstrapRequest(root=root), timeout=10.0)
+            assert chunks and chunks[0][0] == rpc_mod.SUCCESS
+            result, payload, context = chunks[0]
+            assert context == na.router.fork_digest
+            bootstrap = na.chain.produce_light_client_bootstrap(root)
+            assert payload == bootstrap.as_ssz_bytes()
+
+            chunks = nb.service.request(
+                "a", rpc_mod.LIGHT_CLIENT_OPTIMISTIC_UPDATE, None, timeout=10.0)
+            assert chunks and chunks[0][0] == rpc_mod.SUCCESS
+            assert chunks[0][1] == na.chain.lc_cache.latest_optimistic_update.as_ssz_bytes()
+
+            chunks = nb.service.request(
+                "a", rpc_mod.LIGHT_CLIENT_FINALITY_UPDATE, None, timeout=10.0)
+            # finality update may be unavailable before any finalization
+            assert chunks[0][0] in (rpc_mod.SUCCESS, rpc_mod.RESOURCE_UNAVAILABLE)
+
+            # unknown root: RESOURCE_UNAVAILABLE, not an error teardown
+            chunks = nb.service.request(
+                "a", rpc_mod.LIGHT_CLIENT_BOOTSTRAP,
+                rpc_mod.LightClientBootstrapRequest(root=b"\xee" * 32),
+                timeout=10.0)
+            assert chunks[0][0] == rpc_mod.RESOURCE_UNAVAILABLE
+        finally:
+            na.shutdown()
+            nb.shutdown()
