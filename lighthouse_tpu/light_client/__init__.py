@@ -226,3 +226,62 @@ class LightClientStore:
         _require_valid_header(update.attested_header, "attested", self.spec)
         if int(update.attested_header.beacon.slot) > int(self.optimistic_header.beacon.slot):
             self.optimistic_header = update.attested_header.copy()
+
+
+class RpcLightClient:
+    """A verifying light client that syncs OVER THE WIRE: bootstrap and
+    updates arrive through the spec light-client req/resp protocols
+    (reference: the LC server protocols in rpc/protocol.rs consumed by
+    light-client processes) instead of a local chain handle."""
+
+    def __init__(self, *, service, peer: str, types, spec,
+                 genesis_validators_root: bytes):
+        self.service = service
+        self.peer = peer
+        self.types = types
+        self.spec = spec
+        self.store = LightClientStore(types, spec, genesis_validators_root)
+
+    def _request(self, protocol, request):
+        """Returns (ssz_payload, era_name): the chunk's context bytes name
+        the payload's fork era — LC container schemas differ per era, so
+        decoding with a fixed-era type would misparse post-fork payloads."""
+        from ..network import rpc as rpc_mod
+        from ..network.topics import fork_name_for_digest
+
+        chunks = self.service.request(self.peer, protocol, request, timeout=10.0)
+        if not chunks or chunks[0][0] != rpc_mod.SUCCESS:
+            raise LightClientError(
+                f"peer {self.peer} could not serve {protocol}")
+        _result, payload, context = chunks[0]
+        era = None
+        if context:
+            era = fork_name_for_digest(
+                context, bytes(self.store.genesis_validators_root), self.spec)
+        if era is None:
+            raise LightClientError(
+                f"peer {self.peer} sent an unknown fork context for {protocol}")
+        return payload, era
+
+    def sync_from_peer(self, trusted_block_root: bytes) -> None:
+        """Bootstrap from a trusted root, then apply the peer's latest
+        optimistic update — all fetched and VERIFIED over RPC.  The update
+        half is best-effort: a peer with no update yet, a transport
+        hiccup, or an update from a not-yet-applicable sync period leaves
+        the verified bootstrapped state standing."""
+        from ..network import rpc as rpc_mod
+
+        raw, era = self._request(
+            rpc_mod.LIGHT_CLIENT_BOOTSTRAP,
+            rpc_mod.LightClientBootstrapRequest(root=trusted_block_root),
+        )
+        lc = self.types.light_client[era]
+        self.store.bootstrap(trusted_block_root, lc["bootstrap"].from_ssz_bytes(raw))
+        try:
+            raw, era = self._request(
+                rpc_mod.LIGHT_CLIENT_OPTIMISTIC_UPDATE, None)
+            lc = self.types.light_client[era]
+            self.store.process_optimistic_update(
+                lc["optimistic_update"].from_ssz_bytes(raw))
+        except (LightClientError, rpc_mod.RpcError):
+            return  # optional follow-up: bootstrapped state stands

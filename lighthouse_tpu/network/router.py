@@ -546,24 +546,25 @@ class Router:
             self.service.endpoint, sender, req.max_peers
         )]
 
-    def _lc_chunk(self, payload, slot: int) -> bytes:
-        """Context bytes name the fork of the PAYLOAD's era — LC container
-        schemas differ per era, so the startup digest would mislead a
-        client decoding a pre-transition bootstrap after a fork."""
+    def _context_for_slot(self, slot: int) -> bytes:
+        """Fork digest of the era ``slot`` belongs to — the context bytes
+        every forked-payload chunk carries (container schemas differ per
+        era; the startup digest would mislead post-transition clients)."""
         spec = self.chain.spec
         version = spec.fork_version_for(
             spec.fork_name_at_epoch(slot // spec.slots_per_epoch))
-        context = h.compute_fork_digest(
+        return h.compute_fork_digest(
             version, bytes(self.chain.genesis_state.genesis_validators_root))
+
+    def _lc_chunk(self, payload, slot: int) -> bytes:
         return rpc_mod.encode_response_chunk(
-            rpc_mod.SUCCESS, payload.as_ssz_bytes(), context_bytes=context)
+            rpc_mod.SUCCESS, payload.as_ssz_bytes(),
+            context_bytes=self._context_for_slot(slot))
 
     def _block_chunk(self, signed_block) -> bytes:
-        epoch = int(signed_block.message.slot) // self.chain.spec.slots_per_epoch
-        version = self.chain.spec.fork_version_for(self.chain.spec.fork_name_at_epoch(epoch))
-        context = h.compute_fork_digest(version, bytes(self.chain.genesis_state.genesis_validators_root))
         return rpc_mod.encode_response_chunk(
-            rpc_mod.SUCCESS, signed_block.as_ssz_bytes(), context_bytes=context
+            rpc_mod.SUCCESS, signed_block.as_ssz_bytes(),
+            context_bytes=self._context_for_slot(int(signed_block.message.slot)),
         )
 
     def _serve_blocks_by_range(self, req: rpc_mod.BlocksByRangeRequest, sender: str) -> List[bytes]:

@@ -256,3 +256,55 @@ class TestExecutionHeaders:
             == upd.hash_tree_root()
         assert container_from_json(cls, to_json(upd)).hash_tree_root() \
             == upd.hash_tree_root()
+
+
+def test_rpc_light_client_syncs_over_wire():
+    """A verifying light client bootstraps and follows a peer ENTIRELY over
+    the spec light-client req/resp protocols — no local chain handle."""
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+    from lighthouse_tpu.light_client import RpcLightClient
+    from lighthouse_tpu.network.node import LocalNode
+    from lighthouse_tpu.network.transport import Hub
+    from lighthouse_tpu.network import rpc as rpc_mod
+    from lighthouse_tpu.network.rate_limiter import Quota
+
+    set_backend("fake")
+    try:
+        hub = Hub()
+        GEN = 1_600_000_000
+        ha = BeaconChainHarness(validator_count=16, fake_crypto=True,
+                                genesis_time=GEN)
+        hb = BeaconChainHarness(validator_count=16, fake_crypto=True,
+                                genesis_time=GEN)
+        na = LocalNode(hub=hub, peer_id="serve", harness=ha)
+        nb = LocalNode(hub=hub, peer_id="watch", harness=hb)
+        hub.connect("serve", "watch")
+        try:
+            for proto in (rpc_mod.LIGHT_CLIENT_BOOTSTRAP,
+                          rpc_mod.LIGHT_CLIENT_OPTIMISTIC_UPDATE):
+                na.service.rate_limiter.quotas[proto] = Quota(8, 10.0)
+                nb.service.self_limiter.quotas[proto] = Quota(8, 10.0)
+            trusted = None
+            for _ in range(3):
+                slot = ha.advance_slot()
+                hb.advance_slot()
+                signed = ha.produce_signed_block(slot=slot)
+                ha.chain.process_block(signed)
+                if trusted is None:
+                    trusted = ha.chain.head_root
+            lc = RpcLightClient(
+                service=nb.service, peer="serve", types=ha.chain.types,
+                spec=ha.chain.spec,
+                genesis_validators_root=ha.chain.genesis_validators_root)
+            lc.sync_from_peer(trusted)
+            # the wire-synced store follows the serving chain's view
+            assert lc.store.finalized_header is not None
+            opt = ha.chain.lc_cache.latest_optimistic_update
+            assert (bytes(lc.store.optimistic_header.beacon.hash_tree_root())
+                    == bytes(opt.attested_header.beacon.hash_tree_root()))
+        finally:
+            na.shutdown()
+            nb.shutdown()
+    finally:
+        set_backend("host")
