@@ -28,6 +28,14 @@ def _spec_for(network: str):
     return SPECS[network]()
 
 
+def _write_secret_file(path: str, text: str) -> None:
+    """Owner-only (0600) secret write — keys and tokens must never be
+    world-readable."""
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        f.write(text)
+
+
 def _read_password(path, prompt: str) -> str:
     if path:
         with open(path) as f:
@@ -326,6 +334,41 @@ def run_lcli(args) -> int:
             subprocess.run(cmd, check=True)
         return 0
 
+    if args.lcli_cmd == "generate-bootnode-enr":
+        # Reference `lcli generate-bootnode-enr`: mint a bootnode identity —
+        # a fresh secp256k1 key + the signed ENR advertising ip/udp/tcp —
+        # into an output dir (refusing to clobber an existing one).
+        from .network.discv5 import KeyPair
+        from .network.discv5.enr import ENR, EnrError
+
+        if os.path.exists(args.output_dir):
+            raise SystemExit(f"{args.output_dir} already exists, will not override")
+        for port in (args.udp_port, args.tcp_port):
+            if not 1 <= port <= 65535:
+                raise SystemExit(f"port {port} outside 1..65535 (EIP-778 "
+                                 "fields are 16-bit; a wider value mints an "
+                                 "ENR conforming peers reject)")
+        keypair = KeyPair()
+        try:
+            # build (validating the ip) BEFORE creating the directory: a
+            # failure must not leave a half-made dir the clobber guard
+            # then refuses on the corrected rerun
+            enr = ENR.build(keypair, seq=1, ip=args.ip,
+                            udp=args.udp_port, tcp=args.tcp_port)
+        except (ValueError, EnrError) as e:
+            raise SystemExit(f"cannot build ENR: {e}")
+        os.makedirs(args.output_dir)
+        with open(os.path.join(args.output_dir, "enr.dat"), "w") as f:
+            f.write(enr.to_text())
+        # fixed-width 32-byte key: hex() drops leading zeros and can emit
+        # odd-length strings bytes.fromhex chokes on
+        _write_secret_file(os.path.join(args.output_dir, "key"),
+                           f"0x{keypair.priv:064x}")
+        print(json.dumps({"enr": enr.to_text(),
+                          "node_id": "0x" + keypair.node_id.hex(),
+                          "output_dir": args.output_dir}))
+        return 0
+
     if args.lcli_cmd == "mock-el":
         # Reference `lcli mock-el`: a standalone fake execution engine a
         # beacon node can point its --execution-endpoint at for testing.
@@ -339,10 +382,7 @@ def run_lcli(args) -> int:
         if args.jwt_output:
             secret = _secrets.token_bytes(32)
             # owner-only: the secret authenticates engine-API calls
-            fd = os.open(args.jwt_output,
-                         os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-            with os.fdopen(fd, "w") as f:
-                f.write("0x" + secret.hex())
+            _write_secret_file(args.jwt_output, "0x" + secret.hex())
         else:
             raw = _read_password(args.jwt_secret, "jwt secret (hex): ")
             try:
@@ -621,6 +661,12 @@ def build_parser() -> argparse.ArgumentParser:
         r.add_argument("--network", default="minimal")
         r.add_argument("--fork", default="capella")
         r.add_argument("file")
+    ge = lsub.add_parser("generate-bootnode-enr",
+                         help="mint a bootnode key + signed ENR")
+    ge.add_argument("--ip", required=True)
+    ge.add_argument("--udp-port", type=int, required=True)
+    ge.add_argument("--tcp-port", type=int, required=True)
+    ge.add_argument("--output-dir", required=True)
     me = lsub.add_parser("mock-el", help="run a standalone fake execution engine")
     me.add_argument("--port", type=int, default=0)
     me.add_argument("--jwt-output", default="",

@@ -182,3 +182,26 @@ def test_lcli_mock_el_serves_engine_api(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=5)
+
+
+def test_lcli_generate_bootnode_enr(tmp_path, capsys):
+    """`lcli generate-bootnode-enr` mints a decodable signed ENR + key
+    (reference lcli generate_bootnode_enr.rs)."""
+    from lighthouse_tpu.network.discv5.enr import ENR
+
+    out_dir = tmp_path / "bootnode"
+    rc = cli.main(["lcli", "generate-bootnode-enr", "--ip", "10.1.2.3",
+                   "--udp-port", "9000", "--tcp-port", "9001",
+                   "--output-dir", str(out_dir)])
+    assert rc == 0
+    info = json.loads(capsys.readouterr().out.strip())
+    enr = ENR.from_text((out_dir / "enr.dat").read_text())
+    assert info["enr"] == enr.to_text()
+    assert enr.ip() == "10.1.2.3" and enr.udp_port() == 9000
+    key = (out_dir / "key").read_text()
+    assert key.startswith("0x") and int(key, 16) > 0
+    # refuses to clobber
+    with pytest.raises(SystemExit):
+        cli.main(["lcli", "generate-bootnode-enr", "--ip", "10.1.2.3",
+                  "--udp-port", "9000", "--tcp-port", "9001",
+                  "--output-dir", str(out_dir)])
