@@ -164,9 +164,7 @@ def run_validator_client(args) -> int:
         token_path = os.path.join(args.keystore_dir, "api-token.txt")
         # owner-only: the token grants key deletion/import (reference writes
         # api-token.txt 0600)
-        fd = os.open(token_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-        with os.fdopen(fd, "w") as f:
-            f.write(keymanager.token)
+        _write_secret_file(token_path, keymanager.token)
         print(f"keymanager API on {keymanager.url} (token in {token_path})")
     print("validator client running (ctrl-c to stop)")
     try:

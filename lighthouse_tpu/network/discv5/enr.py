@@ -60,7 +60,12 @@ class ENR:
             b"secp256k1": keypair.compressed_pub,
         }
         if ip is not None:
-            pairs[b"ip"] = bytes(int(x) for x in ip.split("."))
+            octets = ip.split(".")
+            if len(octets) != 4 or not all(
+                    o.isdigit() and 0 <= int(o) <= 255 for o in octets):
+                raise EnrError(f"not an IPv4 address: {ip!r} (EIP-778 ip "
+                               "must be exactly 4 bytes)")
+            pairs[b"ip"] = bytes(int(x) for x in octets)
         if udp is not None:
             pairs[b"udp"] = rlp.encode_uint(udp)
         if tcp is not None:

@@ -167,3 +167,36 @@ def test_device_epoch_backend_matches_numpy():
     assert list(a.balances) == list(b.balances)
     assert list(a.inactivity_scores) == list(b.inactivity_scores)
     assert a.hash_tree_root() == b.hash_tree_root()
+
+
+def test_compare_fields_names_divergent_leaves():
+    """compare_fields (reference common/compare_fields): a state mismatch
+    names the exact differing fields instead of a bare root mismatch."""
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+    from lighthouse_tpu.types.compare_fields import (
+        assert_states_equal,
+        compare_fields,
+    )
+
+    set_backend("fake")
+    try:
+        h_ = BeaconChainHarness(validator_count=8, fake_crypto=True)
+        a = h_.chain.head_state
+        assert compare_fields(a, a.copy()) == []
+        assert_states_equal(a, a.copy())
+
+        b = a.copy()
+        b.slot = int(a.slot) + 5
+        b.balances[3] = int(a.balances[3]) - 7
+        diffs = compare_fields(a, b)
+        assert any(d.startswith("slot:") for d in diffs), diffs
+        assert any(d.startswith("balances[3]:") for d in diffs), diffs
+        try:
+            assert_states_equal(a, b)
+        except AssertionError as e:
+            assert "slot" in str(e) and "balances[3]" in str(e)
+        else:
+            raise AssertionError("expected a named-field mismatch")
+    finally:
+        set_backend("host")
