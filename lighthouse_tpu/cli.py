@@ -341,11 +341,6 @@ def run_lcli(args) -> int:
 
         if os.path.exists(args.output_dir):
             raise SystemExit(f"{args.output_dir} already exists, will not override")
-        for port in (args.udp_port, args.tcp_port):
-            if not 1 <= port <= 65535:
-                raise SystemExit(f"port {port} outside 1..65535 (EIP-778 "
-                                 "fields are 16-bit; a wider value mints an "
-                                 "ENR conforming peers reject)")
         keypair = KeyPair()
         try:
             # build (validating the ip) BEFORE creating the directory: a

@@ -66,10 +66,13 @@ class ENR:
                 raise EnrError(f"not an IPv4 address: {ip!r} (EIP-778 ip "
                                "must be exactly 4 bytes)")
             pairs[b"ip"] = bytes(int(x) for x in octets)
-        if udp is not None:
-            pairs[b"udp"] = rlp.encode_uint(udp)
-        if tcp is not None:
-            pairs[b"tcp"] = rlp.encode_uint(tcp)
+        for name, port in ((b"udp", udp), (b"tcp", tcp)):
+            if port is None:
+                continue
+            if not 1 <= port <= 65535:
+                raise EnrError(f"{name.decode()} port {port} outside "
+                               "1..65535 (EIP-778 fields are 16-bit)")
+            pairs[name] = rlp.encode_uint(port)
         if extra:
             pairs.update(extra)
         content = cls._content_rlp(seq, pairs)

@@ -251,6 +251,23 @@ class LocalNode:
         return self.processor.wait_idle(timeout)
 
     def shutdown(self) -> None:
+        # Goodbye(1 = client shutdown) to every peer BEFORE tearing the
+        # stack down (reference: lighthouse sends Goodbye on shutdown so
+        # peers drop the connection cleanly instead of scoring a timeout).
+        from . import rpc as rpc_mod
+        from .transport import Envelope
+
+        goodbye = rpc_mod.Goodbye(reason=1)
+        for peer in list(self.endpoint.connected_peers()):
+            try:
+                self.endpoint.send(peer, Envelope(
+                    kind="rpc_request", sender=self.peer_id,
+                    protocol=rpc_mod.GOODBYE, request_id=0,
+                    data=rpc_mod.encode_request(rpc_mod.GOODBYE, goodbye),
+                ))
+            except Exception:
+                continue  # best-effort PER PEER; one failure must not
+                # silence the goodbye to everyone else
         self.service.shutdown()
         self.processor.shutdown()
         if getattr(self, "discv5", None) is not None:

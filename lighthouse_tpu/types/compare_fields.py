@@ -62,9 +62,9 @@ def _walk(a: Any, b: Any, path: str, diffs: List[str], max_diffs: int) -> None:
             diffs.append(f"{path}: {_fmt(a)} != {_fmt(b)}")
         return
     try:
-        equal = int(a) == int(b)
-    except (TypeError, ValueError):
-        equal = a == b
+        equal = bool(a == b)
+    except TypeError:
+        equal = a is b  # non-comparable same-type leaves: identity only
     if not equal:
         diffs.append(f"{path}: {_fmt(a)} != {_fmt(b)}")
 

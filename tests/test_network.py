@@ -525,3 +525,25 @@ class TestLightClientRpc:
         finally:
             na.shutdown()
             nb.shutdown()
+
+
+def test_goodbye_on_shutdown():
+    """A shutting-down node says Goodbye(1): the peer disconnects it
+    cleanly instead of scoring a dead connection."""
+    hub, na, nb = two_nodes()
+    try:
+        hub.connect("a", "b")
+        time.sleep(0.3)
+        assert "a" in nb.service.endpoint.connected_peers()
+        na.shutdown()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if "a" not in nb.service.endpoint.connected_peers():
+                break
+            time.sleep(0.05)
+        assert "a" not in nb.service.endpoint.connected_peers()
+        # a clean goodbye is not misbehavior
+        p = nb.service.peer_manager._peer("a")
+        assert p is None or p.score >= 0
+    finally:
+        nb.shutdown()
