@@ -290,6 +290,36 @@ class BeaconChain:
         self.execution_engine = (
             execution_engine if execution_engine is not None else MockExecutionEngine()
         )
+        if hasattr(self.execution_engine, "on_payload_attributes"):
+            # SSE payload_attributes (reference events.rs topic): emit what
+            # rides forkchoiceUpdated so external builders can prepare
+            from . import events as ev
+
+            def _emit_payload_attributes(fork, st, attributes):
+                try:
+                    proposer = h.get_beacon_proposer_index(st, self.spec)
+                except Exception:
+                    proposer = 0
+                exec_header = getattr(
+                    st, "latest_execution_payload_header", None)
+                self.events.publish(ev.TOPIC_PAYLOAD_ATTRIBUTES, {
+                    "version": fork,
+                    "data": {
+                        # beacon-API SsePayloadAttributes shape
+                        "proposer_index": str(int(proposer)),
+                        "proposal_slot": str(int(st.slot)),
+                        "parent_block_number": str(
+                            int(exec_header.block_number) if exec_header else 0),
+                        "parent_block_root": "0x" + bytes(
+                            st.latest_block_header.hash_tree_root()).hex(),
+                        "parent_block_hash": "0x" + (
+                            bytes(exec_header.block_hash).hex()
+                            if exec_header else "00" * 32),
+                        "payload_attributes": attributes,
+                    },
+                })
+
+            self.execution_engine.on_payload_attributes = _emit_payload_attributes
         self.kzg = kzg
         self.genesis_state = genesis_state
         self.genesis_time = int(genesis_state.genesis_time)

@@ -20,11 +20,13 @@ TOPIC_FINALIZED = "finalized_checkpoint"
 TOPIC_EXIT = "voluntary_exit"
 TOPIC_BLOB_SIDECAR = "blob_sidecar"
 TOPIC_CHAIN_REORG = "chain_reorg"
+TOPIC_PAYLOAD_ATTRIBUTES = "payload_attributes"
 
 ALL_TOPICS = (
     TOPIC_HEAD,
     TOPIC_BLOCK,
     TOPIC_ATTESTATION,
+    TOPIC_PAYLOAD_ATTRIBUTES,
     TOPIC_FINALIZED,
     TOPIC_EXIT,
     TOPIC_BLOB_SIDECAR,

@@ -20,6 +20,8 @@ from ..types.spec import ChainSpec
 
 
 class MockExecutionEngine:
+    on_payload_attributes = None  # SSE hook, set by the chain
+
     def __init__(self) -> None:
         self.invalid_hashes: Set[bytes] = set()
         self.offline = False
@@ -55,6 +57,18 @@ class MockExecutionEngine:
             parent_hash = b"\x00" * 32
         timestamp = compute_timestamp_at_slot(state, state.slot, spec)
         prev_randao = h.get_randao_mix(state, h.get_current_epoch(state, spec), spec)
+        if self.on_payload_attributes is not None:
+            # mirror the real EL's SSE hook (same attribute shape) so
+            # harness runs emit structurally identical events
+            try:
+                self.on_payload_attributes(fork, state, {
+                    "timestamp": hex(timestamp),
+                    "prevRandao": "0x" + bytes(prev_randao).hex(),
+                    "suggestedFeeRecipient": "0x" + bytes(
+                        suggested_fee_recipient or b"\x00" * 20).hex(),
+                })
+            except Exception:
+                pass
         block_hash = sha256(
             b"mock-el" + parent_hash + int(state.slot).to_bytes(8, "little")
         ).digest()

@@ -67,6 +67,9 @@ class ExecutionLayer:
         # an unfinalized block is final.
         self.latest_finalized_hash: bytes = b"\x00" * 32
         self._last_get_payload_response: Dict = {}
+        # set by the chain: called with (fork, state, attributes) whenever
+        # production sends forkchoiceUpdated WITH payload attributes
+        self.on_payload_attributes = None
 
     # -------------------------------------------------- chain integration
 
@@ -170,6 +173,13 @@ class ExecutionLayer:
             attributes["parentBeaconBlockRoot"] = (
                 "0x" + state.latest_block_header.hash_tree_root().hex()
             )
+        if self.on_payload_attributes is not None:
+            # SSE payload_attributes (reference events.rs): external
+            # builders watch exactly what rides forkchoiceUpdated
+            try:
+                self.on_payload_attributes(fork, state, attributes)
+            except Exception:
+                pass  # an SSE consumer must never break production
         result = self.notify_forkchoice_updated(
             head_block_hash=parent_hash,
             # Never report an unfinalized block as final to the EL — use the

@@ -465,3 +465,30 @@ def test_r5_validator_inclusion_previous_epoch():
             server.stop()
     finally:
         set_backend("host")
+
+
+def test_payload_attributes_sse_topic():
+    """Block production emits the payload_attributes SSE event (reference
+    events.rs topic — external builders watch what rides fcU)."""
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.chain import events as ev
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+
+    set_backend("fake")
+    chain = sub = None
+    try:
+        harness = BeaconChainHarness(validator_count=8, fake_crypto=True)
+        chain = harness.chain
+        sub = chain.events.subscribe([ev.TOPIC_PAYLOAD_ATTRIBUTES])
+        slot = harness.advance_slot()
+        chain.process_block(harness.produce_signed_block(slot=slot))
+        got = sub.poll(timeout=5)
+        assert got is not None and got[0] == ev.TOPIC_PAYLOAD_ATTRIBUTES
+        data = got[1]["data"]
+        assert data["proposal_slot"] == str(slot)
+        assert "proposer_index" in data and "parent_block_hash" in data
+        assert "timestamp" in data["payload_attributes"]
+    finally:
+        if chain is not None and sub is not None:
+            chain.events.unsubscribe(sub)
+        set_backend("host")
