@@ -5,13 +5,15 @@ The role of the reference's ``lighthouse_network`` service composition
 (`service/mod.rs`): owns the transport endpoint, the peer manager, topic
 subscriptions, the seen-message cache, and RPC request/response correlation.
 
-Gossip here is validated-then-flooded: inbound messages are deduplicated by
-the eth2 message-id (SHA256(domain + uncompressed payload)[:20]), handed to
-the router for validation, and forwarded to all connected peers only after
-the router accepts — the same accept/reject propagation gating gossipsub
-gives the reference (mesh degree/IWANT machinery is fabric-level detail the
-in-process hub doesn't need; peer scoring still applies via the router's
-reports).
+Gossip is real gossipsub v1.1 behaviour: inbound messages dedup by the
+eth2 message-id (SHA256(domain + uncompressed payload)[:20]), route to the
+router for validation, and forward only after acceptance — into a mesh
+maintained by SubOpts subscription exchange and heartbeat GRAFT/PRUNE
+between D_low/D_high with v1.1 prune backoff + peer exchange, plus
+IHAVE/IWANT lazy pull and score-threshold gates.  On secured TCP
+connections these envelopes ride the wire as ``/meshsub/1.1.0`` protobuf
+RPC frames (``tcp_transport`` + ``pb``); on the in-process hub they stay
+envelopes — same behaviour either way.
 """
 
 from __future__ import annotations
