@@ -45,6 +45,7 @@ MCACHE_SIZE = 512  # message cache entries servable via IWANT
 IWANT_RETRY_SECS = 5.0  # re-pull window when an advertiser never delivers
 HEARTBEAT_SECS = 1.0  # gossipsub heartbeat_interval
 PRUNE_BACKOFF_SECS = 60  # v1.1 prune_backoff: no re-graft window
+MAX_PROMISES_PER_PEER = 32  # outstanding IWANTs we owe any one advertiser
 PX_PEERS = 16  # v1.1 prune_peers: peer-exchange records per PRUNE
 
 # Gossipsub v1.1 peer-score thresholds (reference PeerScoreThresholds /
@@ -88,7 +89,12 @@ class NetworkService:
         self._last_heartbeat = 0.0
         self._seen: "OrderedDict[bytes, None]" = OrderedDict()
         self._mcache: "OrderedDict[bytes, Tuple[str, bytes]]" = OrderedDict()
-        self._iwant_pending: "OrderedDict[bytes, float]" = OrderedDict()
+        # mid -> (sent_at, advertiser, topic): a peer whose IHAVE we
+        # pulled owes us the message (gossip_promises.rs); broken promises
+        # take the mild behaviour penalty, NEVER a violation-grade strike
+        # (an honest peer's mcache eviction between IHAVE and IWANT is
+        # normal churn)
+        self._iwant_pending: "OrderedDict[bytes, Tuple[float, str, str]]" = OrderedDict()
         self._seen_lock = threading.Lock()
         self._req_lock = threading.Lock()
         self._next_request_id = 1
@@ -152,6 +158,12 @@ class NetworkService:
         if topic not in self.subscriptions:
             return
         self.subscriptions.discard(topic)
+        # promises for the topic we are LEAVING are void, not broken —
+        # the delivery would be dropped at the subscription gate
+        with self._seen_lock:
+            for mid in [m for m, (_t, _a, t_) in self._iwant_pending.items()
+                        if t_ == topic]:
+                del self._iwant_pending[mid]
         # gossipsub LEAVE: PRUNE every mesh member, then announce
         with self._mesh_lock:
             members = self.mesh.pop(topic, set())
@@ -352,6 +364,7 @@ class NetworkService:
             if now - self._last_heartbeat >= HEARTBEAT_SECS:
                 self._last_heartbeat = now
                 self._mesh_heartbeat(now)
+                self._expire_gossip_promises(now)
             if env is None:
                 continue
             try:
@@ -589,19 +602,56 @@ class NetworkService:
         with self._seen_lock:
             if mid in self._seen or mid in self._mcache:
                 return
-            pending_at = self._iwant_pending.get(mid)
-            if pending_at is not None and now - pending_at < IWANT_RETRY_SECS:
+            pending = self._iwant_pending.get(mid)
+            if pending is not None and now - pending[0] < IWANT_RETRY_SECS:
                 return  # an earlier pull is still in flight
-            # (re)pull: a prior advertiser may have disconnected or evicted
-            # the entry before answering — later IHAVEs must be able to retry
-            self._iwant_pending.pop(mid, None)
-            self._iwant_pending[mid] = now
+            # per-peer cap (reference caps IHAVEs per heartbeat): an
+            # IHAVE-spammer must not evict everyone else's promise
+            # tracking — excess adverts are simply not pulled
+            outstanding = sum(
+                1 for (_t, adv, _topic) in self._iwant_pending.values()
+                if adv == env.sender)
+            if outstanding >= MAX_PROMISES_PER_PEER:
+                return
+            stale = self._iwant_pending.pop(mid, None)
+            self._iwant_pending[mid] = (now, env.sender, env.topic)
+            evicted = []
+            if stale is not None:
+                # replacing an EXPIRED promise: its advertiser broke it —
+                # a re-advertising attacker must not reset its own clock
+                evicted.append(stale[1])
             while len(self._iwant_pending) > MCACHE_SIZE:
-                self._iwant_pending.popitem(last=False)
+                _mid, (_t, adv, _topic) = self._iwant_pending.popitem(last=False)
+                evicted.append(adv)
+        from .peer_manager import PeerAction
+
+        for advertiser in evicted:
+            self.peer_manager.report(
+                advertiser, PeerAction.HIGH_TOLERANCE, "broken gossip promise")
         self.endpoint.send(
             env.sender,
             Envelope(kind="iwant", sender=self.peer_id, topic=env.topic, data=mid),
         )
+
+    def _expire_gossip_promises(self, now: float) -> None:
+        """v1.1 gossip promises (reference gossip_promises.rs): an
+        advertiser that never delivers after our IWANT is penalized — an
+        attacker spamming IHAVEs for messages it won't serve wastes our
+        pull budget and delays real delivery."""
+        from .peer_manager import PeerAction
+
+        with self._seen_lock:
+            broken = [(mid, adv) for mid, (t, adv, _topic)
+                      in self._iwant_pending.items()
+                      if now - t >= IWANT_RETRY_SECS]
+            for mid, _ in broken:
+                del self._iwant_pending[mid]
+        for _mid, advertiser in broken:
+            # mild behaviour penalty (reference applies a quadratic
+            # behaviour_penalty, not a violation strike): honest churn
+            # costs -1; a persistent promise-breaker still accumulates out
+            self.peer_manager.report(
+                advertiser, PeerAction.HIGH_TOLERANCE, "broken gossip promise")
 
     def _on_iwant(self, env: Envelope) -> None:
         """Serve a cached message to a puller (gossipsub handle_iwant)."""

@@ -336,3 +336,41 @@ def test_lazy_peers_pull_via_iwant():
                 n.shutdown()
     finally:
         set_backend("host")
+
+
+def test_broken_gossip_promise_penalized():
+    """A peer that advertises via IHAVE but never answers our IWANT is
+    penalized (gossipsub v1.1 gossip_promises.rs)."""
+    import time as _time
+
+    from lighthouse_tpu.network.service import IWANT_RETRY_SECS, NetworkService
+    from lighthouse_tpu.network.transport import Envelope, Hub
+
+    hub = Hub()
+    svc = NetworkService(hub.register("victim"))
+    liar = hub.register("liar")
+    hub.connect("victim", "liar")
+    try:
+        svc.subscribe("topic-p")
+        # a fabricated IHAVE for a message the liar will never serve
+        svc.endpoint.inbound.put(Envelope(
+            kind="ihave", sender="liar", topic="topic-p", data=b"\x42" * 20))
+        deadline = _time.monotonic() + IWANT_RETRY_SECS + 5
+        while _time.monotonic() < deadline:
+            if svc.peer_manager.score("liar") < 0:
+                break
+            _time.sleep(0.2)
+        assert svc.peer_manager.score("liar") < 0, (
+            "unfulfilled IHAVE advert was never penalized")
+        # and the IWANT actually went out to the advertiser (skipping
+        # subscription/mesh control envelopes sent on connect)
+        deadline = _time.monotonic() + 5
+        got = None
+        while _time.monotonic() < deadline:
+            env = liar.inbound.get(timeout=1)
+            if env.kind == "iwant":
+                got = env
+                break
+        assert got is not None and got.data == b"\x42" * 20
+    finally:
+        svc.shutdown()
