@@ -376,6 +376,7 @@ class Client:
                     node.subnets.prune(slot)
                     node.subnets.update_epoch(
                         slot // self.chain.spec.slots_per_epoch)
+                    node.refresh_subnet_advertisement()
                 self._notify()
             except Exception as e:  # a tick must never kill the timer
                 log.warning("per-slot task failed: %s", e)

@@ -106,17 +106,29 @@ class LocalNode:
         # the discovery socket's when the fabric binds a wildcard) — peers
         # dial what the ENR says.
         ip = self.discv5.ip if host in ("0.0.0.0", "") else host
-        self.discv5.enr = ENR.build(
-            self.discv5.keypair, seq=1, ip=ip,
-            udp=self.discv5.port, tcp=tcp_port,
-        )
+        from .subnet_service import attnets_bitfield
+
         # The spec keys compute_subscribed_subnets to the DISCOVERY node id
         # so peers can predict our backbone subnets from the ENR — re-seed
-        # the subnet service with the real identity and re-derive.
+        # the subnet service BEFORE minting the ENR, or the record would
+        # advertise the stale (peer-id-derived) backbone.
         self.subnets.node_id = int.from_bytes(self.discv5.node_id, "big")
         if not self.subnets.subscribe_all:
             self.subnets.update_epoch(
                 self.chain.current_slot() // self.chain.spec.slots_per_epoch)
+        active = self.subnets.active_attestation_subnets()
+        self._enr_ip, self._enr_tcp = ip, tcp_port
+        self._advertised_subnets = set(active)
+        self.discv5.enr = ENR.build(
+            self.discv5.keypair, seq=1, ip=ip,
+            udp=self.discv5.port, tcp=tcp_port,
+            extra={b"attnets": attnets_bitfield(active)},
+        )
+        # the SAME bits in req/resp metadata — one encoder, so the two
+        # advertisements cannot drift
+        self.router.metadata.attnets = int.from_bytes(
+            attnets_bitfield(active), "little")
+        self.router.metadata.seq_number += 1
         # Seed the routing table from the persisted DHT (persisted_dht.rs:
         # a restarted node re-joins without fresh bootstrap rounds).
         from .persisted_dht import load_dht
@@ -128,6 +140,30 @@ class LocalNode:
                 continue  # one stale record must not stop discovery
         self.discv5.start()
         return self.discv5
+
+    def refresh_subnet_advertisement(self) -> bool:
+        """Re-mint the ENR (seq+1) and bump MetaData.seq_number when the
+        active subnet set changed (backbone rotation / duty expiry) — a
+        stale record makes peers dial us for subnets we left.  Called from
+        the per-slot tick; returns True when a refresh happened."""
+        if getattr(self, "discv5", None) is None:
+            return False
+        from .discv5.enr import ENR
+        from .subnet_service import attnets_bitfield
+
+        active = set(self.subnets.active_attestation_subnets())
+        if active == self._advertised_subnets:
+            return False
+        self._advertised_subnets = active
+        self.discv5.enr = ENR.build(
+            self.discv5.keypair, seq=self.discv5.enr.seq + 1,
+            ip=self._enr_ip, udp=self.discv5.port, tcp=self._enr_tcp,
+            extra={b"attnets": attnets_bitfield(active)},
+        )
+        self.router.metadata.attnets = int.from_bytes(
+            attnets_bitfield(active), "little")
+        self.router.metadata.seq_number += 1
+        return True
 
     def _dial_new_addrs(self, addrs, max_new: int) -> int:
         """Dial every address not already known, up to ``max_new`` — the
@@ -149,30 +185,39 @@ class LocalNode:
                 break
         return dialed
 
-    def discover_peers_discv5(self, boot_enrs, max_new: int = 8) -> int:
+    def discover_peers_discv5(self, boot_enrs, max_new: int = 8,
+                              prefer_subnets=None) -> int:
         """One discv5 discovery round: bootstrap FINDNODE sweeps against the
-        boot ENRs, then dial every discovered record that advertises a TCP
-        port.  Returns #dialed."""
+        boot ENRs, then dial discovered records that advertise a TCP port —
+        records advertising any of ``prefer_subnets`` in their attnets
+        field first (reference discovery/subnet_predicate.rs; defaults to
+        our own active subnets when running a real backbone).  Returns
+        #dialed."""
         from .discv5 import rlp as discv5_rlp
+        from .subnet_service import subnet_predicate
 
         if getattr(self, "discv5", None) is None:
             return 0
+        if prefer_subnets is None and not self.subnets.subscribe_all:
+            prefer_subnets = self.subnets.active_attestation_subnets()
         for boot in boot_enrs:
             try:
                 self.discv5.bootstrap(boot)
             except Exception:
                 continue
-        addrs = []
+        preferred, rest = [], []
         for enr in list(self.discv5.table.values()):
             tcp_raw = enr.pairs.get(b"tcp")
             ip = enr.ip()
             if not tcp_raw or ip is None:
                 continue
             try:
-                addrs.append((ip, discv5_rlp.decode_uint(tcp_raw)))
+                addr = (ip, discv5_rlp.decode_uint(tcp_raw))
             except Exception:
                 continue  # one malformed record must not veto the round
-        return self._dial_new_addrs(addrs, max_new)
+            (preferred if subnet_predicate(enr, prefer_subnets or ())
+             else rest).append(addr)
+        return self._dial_new_addrs(preferred + rest, max_new)
 
     def discover_peers(self, max_new: int = 8) -> int:
         """One discovery round (the FINDNODE sweep a discv5 node runs):

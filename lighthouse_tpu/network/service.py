@@ -621,8 +621,12 @@ class NetworkService:
                 # a re-advertising attacker must not reset its own clock
                 evicted.append(stale[1])
             while len(self._iwant_pending) > MCACHE_SIZE:
-                _mid, (_t, adv, _topic) = self._iwant_pending.popitem(last=False)
-                evicted.append(adv)
+                _mid, (t0, adv, _topic) = self._iwant_pending.popitem(last=False)
+                if now - t0 >= IWANT_RETRY_SECS:
+                    # only an already-EXPIRED promise is broken; an
+                    # in-window eviction is our own capacity problem, not
+                    # the advertiser's fault
+                    evicted.append(adv)
         from .peer_manager import PeerAction
 
         for advertiser in evicted:

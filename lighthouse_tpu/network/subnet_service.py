@@ -199,3 +199,39 @@ class SubnetService:
     def active_attestation_subnets(self) -> Set[int]:
         with self._lock:
             return set(self._backbone) | set(self._duty_until_slot)
+
+
+# ----------------------------------------------------- ENR attnets field
+
+
+def attnets_bitfield(subnets, count: int = 64) -> bytes:
+    """SSZ Bitvector[64] bytes for the eth2 ENR ``attnets`` entry: bit i
+    set = subscribed to attestation subnet i (consensus-spec p2p ENR
+    structure)."""
+    bits = bytearray((count + 7) // 8)
+    for s in subnets:
+        s = int(s)
+        if 0 <= s < count:
+            bits[s // 8] |= 1 << (s % 8)
+    return bytes(bits)
+
+
+def enr_attnets(enr) -> set:
+    """Attestation subnets an ENR advertises (empty when the field is
+    absent — pre-fork records; the predicate must not hard-fail them)."""
+    raw = enr.pairs.get(b"attnets")
+    if not raw:
+        return set()
+    out = set()
+    for i in range(len(raw) * 8):
+        if raw[i // 8] & (1 << (i % 8)):
+            out.add(i)
+    return out
+
+
+def subnet_predicate(enr, wanted) -> bool:
+    """True when the ENR advertises ANY of the wanted attestation subnets
+    (reference discovery/subnet_predicate.rs)."""
+    if not wanted:
+        return True
+    return bool(enr_attnets(enr) & set(int(s) for s in wanted))

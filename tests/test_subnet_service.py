@@ -169,3 +169,91 @@ def test_http_endpoint_feeds_subnet_service(spec):
             svc.shutdown()
     finally:
         set_backend("host")
+
+
+def test_attnets_bitfield_and_predicate(spec):
+    from lighthouse_tpu.network.discv5 import KeyPair
+    from lighthouse_tpu.network.discv5.enr import ENR
+    from lighthouse_tpu.network.subnet_service import (
+        attnets_bitfield,
+        enr_attnets,
+        subnet_predicate,
+    )
+
+    bits = attnets_bitfield({3, 17, 63})
+    assert len(bits) == 8
+    enr = ENR.build(KeyPair(), seq=1, ip="10.0.0.1", udp=9000,
+                    extra={b"attnets": bits})
+    assert enr_attnets(enr) == {3, 17, 63}
+    assert subnet_predicate(enr, {17, 40})
+    assert not subnet_predicate(enr, {4, 40})
+    assert subnet_predicate(enr, set())  # nothing wanted: everyone matches
+    # pre-fork records without the field never hard-fail
+    bare = ENR.build(KeyPair(), seq=1, ip="10.0.0.2", udp=9001)
+    assert enr_attnets(bare) == set()
+    assert not subnet_predicate(bare, {1})
+
+
+def test_node_enr_advertises_backbone(spec):
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+    from lighthouse_tpu.network.node import LocalNode
+    from lighthouse_tpu.network.subnet_service import enr_attnets
+    from lighthouse_tpu.network.tcp_transport import TcpEndpoint
+
+    set_backend("fake")
+    try:
+        h = BeaconChainHarness(validator_count=8, fake_crypto=True)
+        node = LocalNode(peer_id="attnet-node", harness=h,
+                         endpoint=TcpEndpoint("attnet-node"),
+                         subscribe_all_subnets=False)
+        try:
+            node.enable_discv5()
+            advertised = enr_attnets(node.discv5.enr)
+            # the ENR advertises the discovery-id-derived backbone, and the
+            # req/resp metadata bitfield agrees with it
+            assert advertised == node.subnets.active_attestation_subnets()
+            meta_bits = {i for i in range(64)
+                         if node.router.metadata.attnets >> i & 1}
+            assert meta_bits == advertised
+        finally:
+            node.shutdown()
+    finally:
+        set_backend("host")
+
+
+def test_enr_refresh_on_rotation(spec):
+    """When the active subnet set changes, the node re-mints its ENR with
+    a bumped seq and updates MetaData — a stale record would have peers
+    dialing us for subnets we left."""
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+    from lighthouse_tpu.network.node import LocalNode
+    from lighthouse_tpu.network.subnet_service import enr_attnets
+    from lighthouse_tpu.network.tcp_transport import TcpEndpoint
+
+    set_backend("fake")
+    try:
+        h = BeaconChainHarness(validator_count=8, fake_crypto=True)
+        node = LocalNode(peer_id="rot-node", harness=h,
+                         endpoint=TcpEndpoint("rot-node"),
+                         subscribe_all_subnets=False)
+        try:
+            node.enable_discv5()
+            seq0 = node.discv5.enr.seq
+            meta0 = node.router.metadata.seq_number
+            # no change -> no refresh
+            assert node.refresh_subnet_advertisement() is False
+            # force a duty subscription onto a new subnet -> refresh
+            backbone = node.subnets.active_attestation_subnets()
+            new_subnet = next(s for s in range(64) if s not in backbone)
+            with node.subnets._lock:
+                node.subnets._duty_until_slot[new_subnet] = 10**9
+            assert node.refresh_subnet_advertisement() is True
+            assert node.discv5.enr.seq == seq0 + 1
+            assert node.router.metadata.seq_number == meta0 + 1
+            assert new_subnet in enr_attnets(node.discv5.enr)
+        finally:
+            node.shutdown()
+    finally:
+        set_backend("host")
