@@ -117,12 +117,15 @@ class LocalNode:
             self.subnets.update_epoch(
                 self.chain.current_slot() // self.chain.spec.slots_per_epoch)
         active = self.subnets.active_attestation_subnets()
+        sync_active = self.subnets.active_sync_subnets()
         self._enr_ip, self._enr_tcp = ip, tcp_port
-        self._advertised_subnets = set(active)
+        self._advertised_subnets = (set(active), set(sync_active))
         self.discv5.enr = ENR.build(
             self.discv5.keypair, seq=1, ip=ip,
             udp=self.discv5.port, tcp=tcp_port,
-            extra={b"attnets": attnets_bitfield(active)},
+            extra={b"attnets": attnets_bitfield(active),
+                   b"syncnets": attnets_bitfield(
+                       sync_active, self.chain.spec.sync_committee_subnet_count)},
         )
         # the SAME bits in req/resp metadata — one encoder, so the two
         # advertisements cannot drift
@@ -152,16 +155,23 @@ class LocalNode:
         from .subnet_service import attnets_bitfield
 
         active = set(self.subnets.active_attestation_subnets())
-        if active == self._advertised_subnets:
+        sync_active = set(self.subnets.active_sync_subnets())
+        if (active, sync_active) == self._advertised_subnets:
             return False
-        self._advertised_subnets = active
+        self._advertised_subnets = (active, sync_active)
         self.discv5.enr = ENR.build(
             self.discv5.keypair, seq=self.discv5.enr.seq + 1,
             ip=self._enr_ip, udp=self.discv5.port, tcp=self._enr_tcp,
-            extra={b"attnets": attnets_bitfield(active)},
+            extra={b"attnets": attnets_bitfield(active),
+                   b"syncnets": attnets_bitfield(
+                       sync_active, self.chain.spec.sync_committee_subnet_count)},
         )
         self.router.metadata.attnets = int.from_bytes(
             attnets_bitfield(active), "little")
+        self.router.metadata.syncnets = int.from_bytes(
+            attnets_bitfield(sync_active,
+                             self.chain.spec.sync_committee_subnet_count),
+            "little")
         self.router.metadata.seq_number += 1
         return True
 

@@ -200,6 +200,10 @@ class SubnetService:
         with self._lock:
             return set(self._backbone) | set(self._duty_until_slot)
 
+    def active_sync_subnets(self) -> Set[int]:
+        with self._lock:
+            return set(self._sync_until_epoch)
+
 
 # ----------------------------------------------------- ENR attnets field
 
