@@ -131,6 +131,10 @@ class LocalNode:
         # advertisements cannot drift
         self.router.metadata.attnets = int.from_bytes(
             attnets_bitfield(active), "little")
+        self.router.metadata.syncnets = int.from_bytes(
+            attnets_bitfield(sync_active,
+                             self.chain.spec.sync_committee_subnet_count),
+            "little")
         self.router.metadata.seq_number += 1
         # Seed the routing table from the persisted DHT (persisted_dht.rs:
         # a restarted node re-joins without fresh bootstrap rounds).
