@@ -160,6 +160,7 @@ def run_validator_client(args) -> int:
             store=vc.store,
             genesis_validators_root=vc.store.genesis_validators_root,
             port=args.keymanager_port,
+            preparation=vc.preparation, blocks=vc.blocks,
         ).start()
         token_path = os.path.join(args.keystore_dir, "api-token.txt")
         # owner-only: the token grants key deletion/import (reference writes

@@ -17,6 +17,7 @@ Routes (keymanager-specs):
 from __future__ import annotations
 
 import json
+import re
 import secrets
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -26,15 +27,47 @@ from .validator_store import ValidatorStore
 from .web3signer import Web3SignerClient
 
 
+_SETTINGS_ROUTE = re.compile(
+    r"/eth/v1/validator/(0x[0-9a-fA-F]{96})/"
+    r"(feerecipient|gas_limit|graffiti)$")
+
+
 class KeymanagerServer:
     def __init__(self, *, store: ValidatorStore, genesis_validators_root: bytes,
-                 port: int = 0, token: Optional[str] = None):
+                 port: int = 0, token: Optional[str] = None,
+                 preparation=None, blocks=None):
         self.store = store
         self.genesis_validators_root = bytes(genesis_validators_root)
         self.token = token if token is not None else secrets.token_hex(16)
         self._port = port
         self._server: Optional[ThreadingHTTPServer] = None
         self._remote_urls: Dict[bytes, str] = {}
+        # per-validator settings (keymanager-specs feerecipient/gas_limit/
+        # graffiti routes).  When the VC's services are wired in, settings
+        # are LIVE: fee recipients flow into proposer preparations and
+        # graffiti overrides the file/flag at proposal time.
+        self.preparation = preparation
+        self.blocks = blocks
+        # standalone fallback stores, used only when the corresponding VC
+        # service is not wired in (ONE owner per setting otherwise)
+        self._fee_recipients: Dict[bytes, bytes] = {}
+        self._gas_limits: Dict[bytes, int] = {}
+        self._graffiti: Dict[bytes, bytes] = {}
+
+    def _fee_map(self) -> Dict[bytes, bytes]:
+        if self.preparation is not None:
+            return self.preparation.per_validator
+        return self._fee_recipients
+
+    def _purge_validator_settings(self, pubkey: bytes) -> None:
+        """A deleted key's settings must not survive to a future
+        re-import (a new operator would silently inherit them)."""
+        self._fee_map().pop(pubkey, None)
+        self._fee_recipients.pop(pubkey, None)
+        self._gas_limits.pop(pubkey, None)
+        self._graffiti.pop(pubkey, None)
+        if self.blocks is not None:
+            self.blocks.keymanager_graffiti.pop(pubkey, None)
 
     # ------------------------------------------------------------ handlers
 
@@ -75,6 +108,8 @@ class KeymanagerServer:
             # typed endpoint: only LOCAL keystores; remote keys have their
             # own DELETE with different (no-protection-export) semantics
             removed = self.store.remove_local_key(pk)
+            if removed:
+                self._purge_validator_settings(pk)
             statuses.append({"status": "deleted" if removed else "not_found"})
         # Per keymanager-specs, deletion returns the protection history so
         # keys can migrate without double-sign risk.
@@ -111,8 +146,65 @@ class KeymanagerServer:
             pk = bytes.fromhex(p[2:])
             removed = self.store.remove_remote_key(pk)
             self._remote_urls.pop(pk, None)
+            if removed:
+                self._purge_validator_settings(pk)
             statuses.append({"status": "deleted" if removed else "not_found"})
         return {"data": statuses}
+
+    def _validator_setting(self, method: str, pubkey: bytes, kind: str,
+                           body: dict):
+        """keymanager-specs per-validator settings.  GET returns the value,
+        POST sets (202), DELETE resets (204)."""
+        hexkey = "0x" + pubkey.hex()
+        if kind == "feerecipient":
+            if method == "GET":
+                cur = self._fee_map().get(pubkey)
+                if cur is None and self.preparation is not None:
+                    # the EFFECTIVE value: the VC-level default applies
+                    # when no per-validator override exists
+                    cur = self.preparation.fee_recipient
+                return 200, {"data": {"pubkey": hexkey,
+                                      "ethaddress": "0x" + (cur or b"\x00" * 20).hex()}}
+            if method == "POST":
+                addr = bytes.fromhex(str(body["ethaddress"])[2:])
+                if len(addr) != 20:
+                    raise ValueError("ethaddress must be 20 bytes")
+                self._fee_map()[pubkey] = addr
+                return 202, None
+            self._fee_map().pop(pubkey, None)
+            return 204, None
+        if kind == "gas_limit":
+            if method == "GET":
+                return 200, {"data": {"pubkey": hexkey,
+                                      "gas_limit": str(self._gas_limits.get(
+                                          pubkey, 30_000_000))}}
+            if method == "POST":
+                self._gas_limits[pubkey] = int(body["gas_limit"])
+                return 202, None
+            self._gas_limits.pop(pubkey, None)
+            return 204, None
+        # graffiti — the SERVER owns the setting (it must round-trip even
+        # standalone); the block service mirror makes it live at proposal
+        if method == "GET":
+            cur = self._graffiti.get(pubkey)
+            if cur is None and self.blocks is not None:
+                cur = self.blocks._graffiti_for(pubkey)  # effective value
+            return 200, {"data": {"pubkey": hexkey,
+                                  "graffiti": (cur or b"").rstrip(b"\x00").decode(
+                                      "utf-8", "replace")}}
+        if method == "POST":
+            raw = str(body["graffiti"]).encode()
+            if len(raw) > 32:
+                raise ValueError("graffiti exceeds 32 bytes")
+            padded = raw.ljust(32, b"\x00")
+            self._graffiti[pubkey] = padded
+            if self.blocks is not None:
+                self.blocks.keymanager_graffiti[pubkey] = padded
+            return 202, None
+        self._graffiti.pop(pubkey, None)
+        if self.blocks is not None:
+            self.blocks.keymanager_graffiti.pop(pubkey, None)
+        return 204, None
 
     # -------------------------------------------------------------- server
 
@@ -163,6 +255,16 @@ class KeymanagerServer:
                             self._reply(200, km._import_remotekeys(body))
                         else:
                             self._reply(200, km._delete_remotekeys(body))
+                        return
+                    m = _SETTINGS_ROUTE.search(path)
+                    if m:
+                        pubkey = bytes.fromhex(m.group(1)[2:])
+                        if not km.store.has_key(pubkey):
+                            self._reply(404, {"message": "unknown validator"})
+                            return
+                        code, obj = km._validator_setting(
+                            method, pubkey, m.group(2), body)
+                        self._reply(code, obj)
                         return
                 except (ValueError, KeyError) as e:
                     self._reply(400, {"message": str(e)})

@@ -475,11 +475,15 @@ class BlockService:
         self.types = types
         self.graffiti = graffiti
         self.builder_proposals = builder_proposals
-        # reference precedence (graffiti_file.rs): per-validator file entry
-        # > file default > VC-level graffiti flag
+        # reference precedence: keymanager-set graffiti > per-validator
+        # file entry > file default > VC-level graffiti flag
         self.graffiti_file = graffiti_file
+        self.keymanager_graffiti = {}  # pubkey -> 32-byte graffiti
 
     def _graffiti_for(self, pubkey: bytes) -> bytes:
+        km = self.keymanager_graffiti.get(bytes(pubkey))
+        if km is not None:
+            return km
         if self.graffiti_file is not None:
             try:
                 g = self.graffiti_file.graffiti_for(pubkey)

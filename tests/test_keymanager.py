@@ -123,3 +123,60 @@ def test_validator_manager_cli_roundtrip(rig, tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "0x" + keystore["pubkey"] in out
+
+
+def test_per_validator_settings_routes(rig):
+    """keymanager-specs feerecipient/gas_limit/graffiti per-validator
+    routes: GET/POST/DELETE, live-wired into the VC services."""
+    from lighthouse_tpu.validator_client.services import (
+        BeaconNodeFallback,
+        BlockService,
+        DutiesService,
+        PreparationService,
+    )
+
+    store, server, client = rig
+    pk = store.pubkeys[0]
+    hexkey = "0x" + pk.hex()
+
+    class _NoBn:
+        base_url = "http://127.0.0.1:1"
+
+    fallback = BeaconNodeFallback([_NoBn()])
+    duties = DutiesService(store=store, fallback=fallback)
+    prep = PreparationService(store=store, duties=duties, fallback=fallback)
+    blocks = BlockService(store=store, duties=duties, fallback=fallback,
+                          types=None)
+    server.preparation = prep
+    server.blocks = blocks
+
+    # fee recipient
+    assert client._request("GET", f"/eth/v1/validator/{hexkey}/feerecipient")[
+        "data"]["ethaddress"] == "0x" + "00" * 20
+    client._request("POST", f"/eth/v1/validator/{hexkey}/feerecipient",
+                    {"ethaddress": "0x" + "42" * 20})
+    assert prep.per_validator[pk] == b"\x42" * 20
+    assert client._request("GET", f"/eth/v1/validator/{hexkey}/feerecipient")[
+        "data"]["ethaddress"] == "0x" + "42" * 20
+    client._request("DELETE", f"/eth/v1/validator/{hexkey}/feerecipient")
+    assert pk not in prep.per_validator
+
+    # gas limit
+    client._request("POST", f"/eth/v1/validator/{hexkey}/gas_limit",
+                    {"gas_limit": "25000000"})
+    assert client._request("GET", f"/eth/v1/validator/{hexkey}/gas_limit")[
+        "data"]["gas_limit"] == "25000000"
+
+    # graffiti: keymanager-set value takes top precedence at proposal time
+    client._request("POST", f"/eth/v1/validator/{hexkey}/graffiti",
+                    {"graffiti": "km-set"})
+    assert blocks._graffiti_for(pk).rstrip(b"\x00") == b"km-set"
+    assert client._request("GET", f"/eth/v1/validator/{hexkey}/graffiti")[
+        "data"]["graffiti"] == "km-set"
+    client._request("DELETE", f"/eth/v1/validator/{hexkey}/graffiti")
+    assert blocks._graffiti_for(pk) == blocks.graffiti
+
+    # unknown validator: 404
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError):
+        client._request("GET", f"/eth/v1/validator/0x{'ee' * 48}/feerecipient")
