@@ -1351,6 +1351,16 @@ class BeaconChain:
                 results[i] = "bad sync contribution signature(s)"
                 continue
             self.sync_contribution_pool.insert_contribution(contribution)
+            # SSE contribution_and_proof (reference events.rs): verified
+            # contributions stream to subscribers
+            from . import events as ev
+
+            self.events.publish(ev.TOPIC_CONTRIBUTION_AND_PROOF, {
+                "slot": str(int(contribution.slot)),
+                "beacon_block_root": "0x" + bytes(
+                    contribution.beacon_block_root).hex(),
+                "subcommittee_index": str(int(contribution.subcommittee_index)),
+            })
         return results
 
     def apply_verified_aggregate(self, cand: "AggregateCandidate") -> None:

@@ -21,12 +21,14 @@ TOPIC_EXIT = "voluntary_exit"
 TOPIC_BLOB_SIDECAR = "blob_sidecar"
 TOPIC_CHAIN_REORG = "chain_reorg"
 TOPIC_PAYLOAD_ATTRIBUTES = "payload_attributes"
+TOPIC_CONTRIBUTION_AND_PROOF = "contribution_and_proof"
 
 ALL_TOPICS = (
     TOPIC_HEAD,
     TOPIC_BLOCK,
     TOPIC_ATTESTATION,
     TOPIC_PAYLOAD_ATTRIBUTES,
+    TOPIC_CONTRIBUTION_AND_PROOF,
     TOPIC_FINALIZED,
     TOPIC_EXIT,
     TOPIC_BLOB_SIDECAR,
