@@ -112,6 +112,8 @@ def _checkpoint(out: dict) -> None:
 
 
 def _bench_shape(jax, _device_verify, fe_is_one, build, n_sets, n_keys, reps, seed):
+    from lighthouse_tpu import metrics
+
     batch = build(n_sets=n_sets, n_keys=n_keys, seed=seed)
     # Warmup / compile.
     t0 = time.perf_counter()
@@ -120,12 +122,48 @@ def _bench_shape(jax, _device_verify, fe_is_one, build, n_sets, n_keys, reps, se
     warm = time.perf_counter() - t0
     assert fe_is_one(fe), f"benchmark batch ({n_sets}x{n_keys}) failed to verify"
 
+    # Pipelined throughput (dispatch all reps, block once) — the headline
+    # number's semantics.  Each dispatch and the final wait also feed the
+    # device stage-timer histograms, so the BENCH artifact can attribute a
+    # regression to dispatch vs device-execution time (ISSUE 2).
     t0 = time.perf_counter()
     for _ in range(reps):
+        t_d = time.perf_counter()
         fe, w_z = _device_verify(*batch)
+        metrics.DEVICE_DISPATCH_SECONDS.observe(time.perf_counter() - t_d)
+    t_w = time.perf_counter()
     jax.block_until_ready((fe, w_z))
+    metrics.DEVICE_BLOCK_UNTIL_READY_SECONDS.observe(time.perf_counter() - t_w)
     dt = (time.perf_counter() - t0) / reps
     return n_sets / dt, warm
+
+
+def _stage_timer_stats() -> dict:
+    """Raw (count, sum) of the four device-batch stage timers."""
+    from lighthouse_tpu import metrics
+
+    return {
+        key: hist.stats()
+        for key, hist in (
+            ("setup", metrics.DEVICE_BATCH_SETUP_SECONDS),
+            ("dispatch", metrics.DEVICE_DISPATCH_SECONDS),
+            ("wait", metrics.DEVICE_BLOCK_UNTIL_READY_SECONDS),
+            ("verdict", metrics.DEVICE_VERDICT_SECONDS),
+        )
+    }
+
+
+def _stage_timer_summary(since: dict = None) -> dict:
+    """Count+sum of the stage timers (setup / dispatch / wait / verdict),
+    as the DELTA against ``since`` — each BENCH shape reports only its own
+    observations, so attribution isn't diluted by the smoke/other shapes."""
+    out = {}
+    for key, (n, total) in _stage_timer_stats().items():
+        if since is not None:
+            n0, t0 = since[key]
+            n, total = n - n0, total - t0
+        out[key] = {"count": n, "sum_s": round(total, 4)}
+    return out
 
 
 def _child_main(force_cpu: bool) -> None:
@@ -168,6 +206,7 @@ def _child_main(force_cpu: bool) -> None:
             # 16 sets is ~20 s; compile of this bucket is warm in .jax_cache
             # from the device-bucket tests.  Full 128x32 on this 1-core host
             # (~160 s/rep + compile) is exactly what overran the r4 budget.
+            base = _stage_timer_stats()
             value, warm = _bench_shape(
                 jax, _device_verify, fe_is_one, _build_example,
                 CPU_QUICK_N_SETS, N_KEYS, CPU_QUICK_REPS, seed=3,
@@ -176,6 +215,7 @@ def _child_main(force_cpu: bool) -> None:
             out["cpu_extrapolated"] = True
             out["cpu_measured_shape"] = f"{CPU_QUICK_N_SETS}x{N_KEYS}"
             out["cpu_warm_secs"] = round(warm, 1)
+            out["stage_timers"] = _stage_timer_summary(base)
             _checkpoint(out)
             return
 
@@ -189,11 +229,13 @@ def _child_main(force_cpu: bool) -> None:
         _checkpoint(out)
 
         # Headline: 128 sets x 32-key committees.
+        base = _stage_timer_stats()
         headline, warm = _bench_shape(
             jax, _device_verify, fe_is_one, _build_example, N_SETS, N_KEYS, REPS, seed=3
         )
         out["value"] = headline
         out["headline_warm_secs"] = round(warm, 1)
+        out["stage_timers"] = _stage_timer_summary(base)
         _checkpoint(out)
 
         # Scale config: 4,096 sets x 32-key committees (best-effort — a failure
@@ -203,6 +245,7 @@ def _child_main(force_cpu: bool) -> None:
         # every bench window (device work is identical either way).
         try:
             build = functools.partial(_build_example, tile_base=128)
+            base = _stage_timer_stats()
             scale, warm = _bench_shape(
                 jax, _device_verify, fe_is_one, build,
                 SCALE_N_SETS, N_KEYS, SCALE_REPS, seed=5,
@@ -211,6 +254,7 @@ def _child_main(force_cpu: bool) -> None:
             out["sets_per_sec_4096x32"] = round(scale, 1)
             out["vs_baseline_4096x32"] = round(scale / BLST_64T_SETS_PER_SEC, 4)
             out["scale_warm_secs"] = round(warm, 1)
+            out["stage_timers_4096x32"] = _stage_timer_summary(base)
         except Exception as e:
             out["scale_bench_error"] = f"{type(e).__name__}: {e}"
     except Exception as e:
@@ -346,7 +390,8 @@ def _final_emit() -> None:
         for k in ("platform", "init_secs", "smoke_sets_per_sec_1x1", "smoke_warm_secs",
                   "headline_warm_secs", "sets_per_sec_4096x32", "vs_baseline_4096x32",
                   "scale_warm_secs", "scale_bench_error", "cpu_extrapolated",
-                  "cpu_measured_shape", "cpu_warm_secs", "from_probe_loop"):
+                  "cpu_measured_shape", "cpu_warm_secs", "from_probe_loop",
+                  "stage_timers", "stage_timers_4096x32"):
             if k in result:
                 extra[k] = result[k]
         _emit(result["value"], result["value"] / BLST_64T_SETS_PER_SEC, extra)

@@ -21,7 +21,7 @@ import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
-from .. import metrics
+from .. import metrics, tracing
 
 from ..logs import get_logger
 
@@ -581,13 +581,19 @@ class BeaconChain:
         ``process_block`` + ``:3362 import_block``): state catch-up, bulk
         signature verification, state-root check, payload notify, fork choice,
         persistence, head recompute."""
-        with metrics.BLOCK_IMPORT_SECONDS.time():
+        with tracing.span(
+            "block_import", hist=metrics.BLOCK_IMPORT_SECONDS,
+            slot=int(signed_block.message.slot),
+        ):
             return self._process_block_inner(signed_block, block_delay_seconds)
 
     def process_block_with_blobs(self, signed_block, sidecars,
                                  block_delay_seconds: Optional[float] = None) -> bytes:
         """Import a block together with its blob sidecars (RPC/API path)."""
-        with metrics.BLOCK_IMPORT_SECONDS.time():
+        with tracing.span(
+            "block_import", hist=metrics.BLOCK_IMPORT_SECONDS,
+            slot=int(signed_block.message.slot),
+        ):
             return self._process_block_inner(
                 signed_block, block_delay_seconds, sidecars=sidecars
             )
@@ -596,6 +602,10 @@ class BeaconChain:
         t_import = time.perf_counter()
         block = signed_block.message
         block_root = block.hash_tree_root()
+        tracing.annotate(root="0x" + block_root.hex()[:16])
+        # Key the whole trace by this import's slot, whatever span is the
+        # root (work:gossip_block, http_request, or block_import itself).
+        tracing.annotate_trace(slot=int(block.slot))
         if block_root in self._blocks or block_root == self.genesis_block_root:
             return block_root  # duplicate import is a no-op
         current_slot = self.current_slot()
@@ -614,7 +624,7 @@ class BeaconChain:
             from .da import BlobError
 
             try:
-                with metrics.BLOCK_DA_CHECK_SECONDS.time():
+                with tracing.span("da_check", hist=metrics.BLOCK_DA_CHECK_SECONDS):
                     status, result = self.da_checker.check_availability(
                         signed_block, sidecars=sidecars
                     )
@@ -643,7 +653,9 @@ class BeaconChain:
 
         state = parent_state.copy()
         try:
-            with metrics.BLOCK_STATE_TRANSITION_SECONDS.time():
+            with tracing.span(
+                "state_transition", hist=metrics.BLOCK_STATE_TRANSITION_SECONDS
+            ):
                 state = state_transition(
                     state,
                     signed_block,
@@ -666,6 +678,8 @@ class BeaconChain:
         self._block_delays[block_root] = float(block_delay_seconds)
         while len(self._block_delays) > 128:
             self._block_delays.popitem(last=False)
+        metrics.BLOCK_ARRIVAL_DELAY_SECONDS.observe(float(block_delay_seconds))
+        tracing.annotate(arrival_delay_s=round(float(block_delay_seconds), 3))
         if hasattr(block.body, "execution_payload"):
             ph = bytes(block.body.execution_payload.block_hash)
             optimistic = getattr(self.execution_engine, "optimistic_hashes", None)
@@ -684,14 +698,15 @@ class BeaconChain:
                     self.otb_store.register(block_root, int(block.slot))
         else:
             payload_status = ExecutionStatus.IRRELEVANT
-        self.fork_choice.on_block(
-            current_slot=current_slot,
-            block=block,
-            block_root=block_root,
-            state=state,
-            payload_verification_status=payload_status,
-            block_delay_seconds=block_delay_seconds,
-        )
+        with tracing.span("fork_choice", hist=metrics.BLOCK_FORK_CHOICE_SECONDS):
+            self.fork_choice.on_block(
+                current_slot=current_slot,
+                block=block,
+                block_root=block_root,
+                state=state,
+                payload_verification_status=payload_status,
+                block_delay_seconds=block_delay_seconds,
+            )
         # The block is fully verified: attestations to it can be produced
         # NOW, before the store write / head recompute below (reference
         # early_attester_cache.rs — the 4 s attestation deadline must not
@@ -700,7 +715,7 @@ class BeaconChain:
             block_root, signed_block, state, self.types, self.spec,
             blobs=blob_sidecars,
         )
-        with metrics.BLOCK_STORE_WRITE_SECONDS.time():
+        with tracing.span("store_write", hist=metrics.BLOCK_STORE_WRITE_SECONDS):
             self._store_block(block_root, signed_block, state)
         self.observed_block_roots.add(block_root)
         self.pre_finalization_cache.block_processed(block_root)
@@ -774,8 +789,7 @@ class BeaconChain:
             except Exception:
                 pass  # monitoring must never block an import
 
-        with metrics.BLOCK_FORK_CHOICE_SECONDS.time():
-            self.recompute_head()
+        self.recompute_head()
         if self.head_root == block_root:
             # Score strictly against the CANONICAL chain: only the block
             # that fork choice just made head may consume simulated votes
@@ -790,6 +804,13 @@ class BeaconChain:
             # block); the slot-start vote stands only for empty slots.
             self.simulate_attestation()
         self.events.block(slot=int(block.slot), block_root=block_root)
+        # Import-completion delay against the block's OWN slot start (the
+        # reference's beacon_block_delay_imported figure — arrival delay
+        # plus everything the pipeline added on top).
+        metrics.BLOCK_IMPORTED_DELAY_SECONDS.observe(max(
+            0.0,
+            self.slot_clock._seconds() - self.slot_clock.start_of(int(block.slot)),
+        ))
         # Reference beacon_chain.rs logs every import with slot/root/delay
         # (the notifier and Siren both read these).
         log.info(
@@ -1393,6 +1414,16 @@ class BeaconChain:
         """Apply an already-signature-verified candidate to fork choice and
         the aggregation pool, and record it in the observed caches."""
         data = cand.attestation.data
+        if not is_from_block:
+            # Slot-relative attestation delay (reference unagg/agg delay
+            # histograms): how late after ITS slot's start this attestation
+            # reached fork choice.  Block-carried attestations are
+            # historical by construction and would only skew the figure.
+            metrics.ATTESTATION_ARRIVAL_DELAY_SECONDS.observe(max(
+                0.0,
+                self.slot_clock._seconds()
+                - self.slot_clock.start_of(int(data.slot)),
+            ))
         self.fork_choice.on_attestation(
             current_slot=self.current_slot(),
             attestation_slot=int(data.slot),
@@ -1464,7 +1495,7 @@ class BeaconChain:
         state = self.get_state(head_root)
         if state is None or int(state.slot) >= next_slot:
             return False
-        with metrics.STATE_ADVANCE_SECONDS.time():
+        with tracing.span("state_advance", hist=metrics.STATE_ADVANCE_SECONDS):
             advanced = process_slots(
                 state.copy(), next_slot, self.types, self.spec
             )
@@ -1856,7 +1887,7 @@ class BeaconChain:
 
     def recompute_head(self) -> bytes:
         """Reference ``canonical_head.rs:496`` ``recompute_head_at_slot``."""
-        with metrics.HEAD_RECOMPUTE_SECONDS.time():
+        with tracing.span("head_recompute", hist=metrics.HEAD_RECOMPUTE_SECONDS):
             return self._recompute_head_inner()
 
     def _recompute_head_inner(self) -> bytes:

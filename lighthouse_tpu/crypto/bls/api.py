@@ -337,12 +337,16 @@ def verify_signature_sets(signature_sets: Iterable[SignatureSet], seed: Optional
     `seed` pins the random weights for reproducibility in tests; production use
     leaves it None (host CSPRNG — randomness must stay host-side, blst.rs:52-57).
     """
-    from ... import metrics
-    from .backends import get_backend
+    from ... import metrics, tracing
+    from .backends import backend_name, get_backend
 
     sets = list(signature_sets)
+    backend = get_backend()
     metrics.DEVICE_BATCH_INVOCATIONS.inc()
     metrics.SIGNATURE_SETS_VERIFIED.inc(len(sets))
     metrics.ATTESTATION_BATCH_SIZE.observe(len(sets))
-    with metrics.ATTESTATION_BATCH_SECONDS.time():
-        return get_backend().verify_signature_sets(sets, seed=seed)
+    with tracing.span(
+        "device_batch", hist=metrics.ATTESTATION_BATCH_SECONDS,
+        n_sets=len(sets), backend=backend_name(),
+    ):
+        return backend.verify_signature_sets(sets, seed=seed)

@@ -11,6 +11,12 @@ from typing import Optional
 
 
 def verify_signature_sets(sets, seed: Optional[bytes] = None) -> bool:
+    from .... import tracing
     from ....ops.verify import verify_signature_sets_device
 
-    return verify_signature_sets_device(sets, seed=seed)
+    sets = list(sets)
+    # The device-side parent span: the four stage spans recorded inside
+    # verify_signature_sets_device (setup/dispatch/wait/verdict) nest here,
+    # so a trace shows host-vs-device time for THIS batch at a glance.
+    with tracing.span("device_verify", backend="jax", n_sets=len(sets)):
+        return verify_signature_sets_device(sets, seed=seed)

@@ -20,7 +20,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
-from .. import metrics
+from .. import metrics, tracing
 from ..chain import events as ev
 from ..consensus import helpers as h
 from ..types.spec import FAR_FUTURE_EPOCH
@@ -222,6 +222,8 @@ def route(method: str, pattern: str, priority: str = P1):
 
 
 def match_route(method: str, path: str):
+    """-> (priority, fn, params, pattern) — the pattern (route template) is
+    the bounded-cardinality label the HTTP metrics series use."""
     path_segs = path.strip("/").split("/")
     for m, pattern, priority, fn in ROUTES:
         if m != method:
@@ -238,7 +240,7 @@ def match_route(method: str, path: str):
                 ok = False
                 break
         if ok:
-            return priority, fn, params
+            return priority, fn, params, pattern
     return None
 
 
@@ -2126,6 +2128,42 @@ def lighthouse_ui_validator_info(ctx):
     return {"data": {"validators": info}}
 
 
+# ------------------------------------------------------------ traces routes
+# The span-tracing surface (tracing.py): per-event span trees for the
+# block-import → device-batch pipeline, the per-trace complement of the
+# aggregate /metrics histograms.
+
+
+@route("GET", "/lighthouse/traces", P1)
+def lighthouse_traces(ctx):
+    """Recent completed-trace summaries, newest first.  Query params:
+    ``root`` (root-span name, e.g. ``block_import`` or ``work:gossip_block``),
+    ``slot`` (root's slot field), ``limit``."""
+    slot = ctx.q1("slot")
+    try:
+        limit = int(ctx.q1("limit", "64"))
+    except ValueError:
+        raise _bad("limit must be an integer")
+    traces = tracing.TRACES.recent(
+        limit=max(1, min(limit, 512)),
+        root=ctx.q1("root"),
+        slot=None if slot is None else int(slot),
+    )
+    return {"data": [tracing.trace_summary(t) for t in traces]}
+
+
+@route("GET", "/lighthouse/traces/{trace_id}", P1)
+def lighthouse_trace_by_id(ctx):
+    """One full span tree; ``?format=chrome`` emits Chrome trace-event JSON
+    loadable in Perfetto / chrome://tracing."""
+    trace = tracing.TRACES.get(ctx.params["trace_id"])
+    if trace is None:
+        raise _not_found(f"trace {ctx.params['trace_id']}")
+    if ctx.q1("format") == "chrome":
+        return tracing.trace_to_chrome(trace)
+    return {"data": tracing.trace_to_dict(trace)}
+
+
 # ------------------------------------------------------------------ server
 
 
@@ -2152,8 +2190,29 @@ class _Handler(BaseHTTPRequestHandler):
     def _handle(self, method: str) -> None:
         parsed = urlparse(self.path)
         path = parsed.path
-        with metrics.HTTP_REQUEST_SECONDS.time():
-            metrics.HTTP_REQUESTS.inc(method=method)
+        # Resolve the route TEMPLATE first: the metrics label must be
+        # bounded-cardinality (templates + the three streaming endpoints +
+        # "unmatched"), never the raw client-controlled path.
+        if path in ("/metrics", "/eth/v1/events", "/lighthouse/logs"):
+            route, m = path, None
+        else:
+            m = match_route(method, path)
+            route = m[3] if m is not None else "unmatched"
+        metrics.HTTP_REQUESTS.inc(method=method, route=route)
+        labels = {"method": method, "route": route}
+        # One seam feeds both the request histogram and the trace ring.
+        # Streaming endpoints, 404s, and the traces API itself (observing
+        # the observer) are timed but not traced.  The root name carries the
+        # route template so each route gets its OWN bounded sub-ring — a
+        # health-check poller must not evict the rare block-publish trace.
+        if m is not None and not route.startswith("/lighthouse/traces"):
+            timer = tracing.span(
+                f"http:{method} {route}", hist=metrics.HTTP_REQUEST_SECONDS,
+                hist_labels=labels, **labels,
+            )
+        else:
+            timer = metrics.HTTP_REQUEST_SECONDS.time(**labels)
+        with timer:
             try:
                 if path == "/metrics" and method == "GET":
                     body = metrics.render_prometheus().encode()
@@ -2174,11 +2233,10 @@ class _Handler(BaseHTTPRequestHandler):
                 body = None
                 length = int(self.headers.get("Content-Length") or 0)
                 raw = self.rfile.read(length) if length else b""
-                m = match_route(method, path)
                 if m is None:
                     self._write_json(404, {"code": 404, "message": f"NOT_FOUND: {path}"})
                     return
-                priority, fn, params = m
+                priority, fn, params, _ = m
                 if raw:
                     ctype = (self.headers.get("Content-Type") or "").lower()
                     if "application/octet-stream" in ctype:

@@ -16,10 +16,24 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 _REGISTRY_LOCK = threading.Lock()
 _REGISTRY: "Dict[str, _Metric]" = {}
+
+# Registration conflicts (same name, different kind or help) recorded for
+# scripts/check_metrics.py — the registry itself stays first-wins.
+DUPLICATE_REGISTRATIONS: List[Tuple[str, str, str]] = []
+
+# Callbacks run before each render (process metrics and other sampled-on-
+# scrape values register here; see system_health.py).
+_COLLECTORS: List[Callable[[], None]] = []
+
+
+def register_collector(fn: Callable[[], None]) -> None:
+    with _REGISTRY_LOCK:
+        if fn not in _COLLECTORS:
+            _COLLECTORS.append(fn)
 
 
 def _labels_key(labels: Optional[dict]) -> Tuple:
@@ -41,10 +55,19 @@ class _Metric:
         raise NotImplementedError
 
 
+def _escape_label_value(v) -> str:
+    """Escape a label value per the Prometheus text exposition format:
+    backslash, double-quote, and line-feed must be escaped inside the
+    double-quoted value."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
 def _fmt_labels(key: Tuple) -> str:
     if not key:
         return ""
-    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+    return "{" + ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key) + "}"
 
 
 class Counter(_Metric):
@@ -56,7 +79,14 @@ class Counter(_Metric):
             self._series[key] = self._series.get(key, 0.0) + value
 
     def get(self, **labels) -> float:
-        return self._series.get(_labels_key(labels), 0.0)
+        with self._lock:
+            return self._series.get(_labels_key(labels), 0.0)
+
+    def set_total(self, value: float, **labels) -> None:
+        """Overwrite the running total — for collectors mirroring an external
+        monotonic counter (e.g. /proc CPU seconds) onto the registry."""
+        with self._lock:
+            self._series[_labels_key(labels)] = float(value)
 
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
@@ -83,7 +113,8 @@ class Gauge(_Metric):
         self.inc(-value, **labels)
 
     def get(self, **labels) -> float:
-        return self._series.get(_labels_key(labels), 0.0)
+        with self._lock:
+            return self._series.get(_labels_key(labels), 0.0)
 
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
@@ -123,8 +154,9 @@ class Histogram(_Metric):
 
     def stats(self, **labels) -> Tuple[int, float]:
         """(count, total_seconds) for a label set."""
-        s = self._series.get(_labels_key(labels))
-        return (0, 0.0) if s is None else (s["n"], s["sum"])
+        with self._lock:
+            s = self._series.get(_labels_key(labels))
+            return (0, 0.0) if s is None else (s["n"], s["sum"])
 
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
@@ -165,6 +197,12 @@ def _register(metric: _Metric) -> _Metric:
     with _REGISTRY_LOCK:
         existing = _REGISTRY.get(metric.name)
         if existing is not None:
+            if existing.kind != metric.kind or (
+                metric.help and existing.help != metric.help
+            ):
+                DUPLICATE_REGISTRATIONS.append(
+                    (metric.name, existing.kind, metric.kind)
+                )
             return existing
         _REGISTRY[metric.name] = metric
         return metric
@@ -184,8 +222,18 @@ def histogram(name: str, help_text: str = "", buckets: Tuple[float, ...] = DEFAU
 
 def render_prometheus() -> str:
     """The full registry in Prometheus text exposition format."""
+    # Ensure the standard process-metric collector is registered (lazy: a
+    # top-level import would be circular — system_health imports metrics).
+    from .. import system_health  # noqa: F401
+
     with _REGISTRY_LOCK:
+        collectors = list(_COLLECTORS)
         metrics = list(_REGISTRY.values())
+    for fn in collectors:
+        try:
+            fn()
+        except Exception:
+            pass  # a broken collector must never take /metrics down
     lines: List[str] = []
     for m in metrics:
         lines.extend(m.render())
@@ -202,7 +250,7 @@ BLOCK_STATE_TRANSITION_SECONDS = histogram(
     "beacon_block_state_transition_seconds", "state_transition() inside import"
 )
 BLOCK_FORK_CHOICE_SECONDS = histogram(
-    "beacon_block_fork_choice_seconds", "fork choice on_block + head recompute"
+    "beacon_block_fork_choice_seconds", "fork choice on_block inside import"
 )
 EPOCH_PROCESSING_SECONDS = histogram(
     "beacon_epoch_processing_seconds", "per-epoch processing time"
@@ -220,8 +268,14 @@ SIGNATURE_SETS_VERIFIED = counter(
 DEVICE_BATCH_INVOCATIONS = counter(
     "beacon_device_batch_invocations_total", "batched device program invocations"
 )
-HTTP_REQUESTS = counter("http_api_requests_total", "Beacon API requests")
-HTTP_REQUEST_SECONDS = histogram("http_api_request_seconds", "Beacon API request time")
+HTTP_REQUESTS = counter(
+    "http_api_requests_total",
+    "Beacon API requests, by method and route template",
+)
+HTTP_REQUEST_SECONDS = histogram(
+    "http_api_request_seconds",
+    "Beacon API request time, by method and route template",
+)
 
 # Device batch pipeline stages (reference metrics.rs:247-271 batch setup /
 # verify timers) — exactly what TPU perf debugging needs: where a slow batch
@@ -256,4 +310,29 @@ HEAD_RECOMPUTE_SECONDS = histogram(
 STATE_ADVANCE_SECONDS = histogram(
     "beacon_state_advance_seconds",
     "tail-of-slot head-state pre-advance (state_advance_timer role)",
+)
+
+# Scheduler queue wait: enqueue→drain per work class (reference
+# beacon_processor queue latency metrics) — fed by the same seam that
+# records the per-trace queue_wait span.
+QUEUE_WAIT_SECONDS = histogram(
+    "beacon_processor_queue_wait_seconds",
+    "enqueue-to-drain wait in the priority queues, by work class",
+)
+
+# Slot-relative delay observability (reference block_times_cache +
+# metrics.rs beacon_block_delay_* / attestation delay families): every
+# figure is measured against the SLOT CLOCK's start of the object's own
+# slot, not wall-clock-since-receipt.
+BLOCK_ARRIVAL_DELAY_SECONDS = histogram(
+    "beacon_block_arrival_delay_seconds",
+    "block receipt relative to its own slot start",
+)
+BLOCK_IMPORTED_DELAY_SECONDS = histogram(
+    "beacon_block_imported_delay_seconds",
+    "block import completion relative to its own slot start",
+)
+ATTESTATION_ARRIVAL_DELAY_SECONDS = histogram(
+    "beacon_attestation_arrival_delay_seconds",
+    "gossip/API attestation application relative to its slot start",
 )

@@ -167,22 +167,35 @@ def verify_signature_sets_device(sets, seed: Optional[bytes] = None) -> bool:
     Instrumented per stage (setup / dispatch / block-until-ready / verdict —
     reference metrics.rs:247-271): the dispatch timer measures only the
     async enqueue; the block-until-ready timer is the device execution
-    window a TPU perf investigation cares about."""
-    from .. import metrics
+    window a TPU perf investigation cares about.  Each stage span feeds its
+    histogram AND the active trace (tracing.py), with batch-size and bucket
+    fields, so a slow batch inside a block import is attributable."""
+    from .. import metrics, tracing
 
     sets = list(sets)
     if not sets:
         return False
-    with metrics.DEVICE_BATCH_SETUP_SECONDS.time():
+    with tracing.span(
+        "device_batch_setup", hist=metrics.DEVICE_BATCH_SETUP_SECONDS,
+        n_sets=len(sets),
+    ):
         rands = _rand_scalars(len(sets), seed)
         batch = build_batch(sets, rands)
     if batch is None:
         return False
-    with metrics.DEVICE_DISPATCH_SECONDS.time():
+    # compiled-program shape: (n_sets_bucket, max_keys_bucket)
+    nb, kb = int(batch[0][0].shape[0]), int(batch[0][0].shape[1])
+    with tracing.span(
+        "device_batch_dispatch", hist=metrics.DEVICE_DISPATCH_SECONDS,
+        n_bucket=nb, k_bucket=kb,
+    ):
         fe, w_z = _device_verify(*batch)
-    with metrics.DEVICE_BLOCK_UNTIL_READY_SECONDS.time():
+    with tracing.span(
+        "device_batch_wait", hist=metrics.DEVICE_BLOCK_UNTIL_READY_SECONDS,
+        n_bucket=nb, k_bucket=kb,
+    ):
         jax.block_until_ready((fe, w_z))
-    with metrics.DEVICE_VERDICT_SECONDS.time():
+    with tracing.span("device_batch_verdict", hist=metrics.DEVICE_VERDICT_SECONDS):
         if tower.fq2_from_limbs(np.asarray(w_z)).is_zero():
             # W at infinity: Miller value was poisoned; decide on the host.
             from ..crypto.bls.backends import host

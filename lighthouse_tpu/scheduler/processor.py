@@ -38,6 +38,7 @@ from .work import (
 
 
 from .. import metrics as _gm
+from .. import tracing
 
 # Per-work-class series on /metrics (reference: the beacon_processor's
 # per-queue event counters, task_executor's per-task metrics).
@@ -98,6 +99,11 @@ class BeaconProcessor:
         was dropped (reference: queue-full drop + metric)."""
         if event.work_type not in self._drain_set:
             raise ValueError(f"unknown work type {event.work_type!r} (not in DRAIN_ORDER)")
+        # Carry the sender's trace context across the thread hop; stamp the
+        # enqueue instant for the worker-side queue-wait span.
+        if event.trace_parent is None:
+            event.trace_parent = tracing.current_span()
+        event.enqueued_at = time.perf_counter()
         with self._lock:
             if self._shutdown:
                 return False
@@ -159,21 +165,33 @@ class BeaconProcessor:
 
     def _run_worker(self, batch: List[WorkEvent]) -> None:
         wt = batch[0].work_type
+        token = tracing.attach(batch[0].trace_parent)
         try:
-            if len(batch) > 1 and batch[0].process_batch is not None:
-                batch_wt = BATCH_RULES[wt][0]
-                self.metrics.bump(self.metrics.batches, batch_wt)
-                self.metrics.bump(self.metrics.batch_items, batch_wt, len(batch))
-                batch[0].process_batch([ev.item for ev in batch])
-                self.metrics.bump(self.metrics.processed, wt, len(batch))
-            else:
-                for ev in batch:
-                    ev.process(ev.item)
-                    self.metrics.bump(self.metrics.processed, wt)
+            with tracing.span(f"work:{wt}", n_items=len(batch)):
+                # enqueue→drain wait, measured from the OLDEST event in the
+                # drained batch (its wait bounds everyone else's).
+                tracing.record_span(
+                    "queue_wait",
+                    start_pc=min(ev.enqueued_at for ev in batch),
+                    hist=_gm.QUEUE_WAIT_SECONDS,
+                    hist_labels={"work": wt},
+                    work=wt,
+                )
+                if len(batch) > 1 and batch[0].process_batch is not None:
+                    batch_wt = BATCH_RULES[wt][0]
+                    self.metrics.bump(self.metrics.batches, batch_wt)
+                    self.metrics.bump(self.metrics.batch_items, batch_wt, len(batch))
+                    batch[0].process_batch([ev.item for ev in batch])
+                    self.metrics.bump(self.metrics.processed, wt, len(batch))
+                else:
+                    for ev in batch:
+                        ev.process(ev.item)
+                        self.metrics.bump(self.metrics.processed, wt)
         except Exception:
             # A worker panic must not kill the node (reference logs + metric).
             self.metrics.bump(self.metrics.dropped, wt, len(batch))
         finally:
+            tracing.detach(token)
             with self._lock:
                 self._active_workers -= 1
                 if self._active_workers == 0 and self._all_empty():

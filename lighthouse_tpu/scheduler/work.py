@@ -125,3 +125,8 @@ class WorkEvent:
     drop_during_sync: bool = False
     # Batch handler: called with a list of items when coalesced.
     process_batch: Optional[Callable[..., Any]] = None
+    # Trace carriage across the enqueue→worker thread hop: the sender's
+    # active span (stamped by BeaconProcessor.send unless pre-set) and the
+    # enqueue instant, from which the worker records the queue-wait span.
+    trace_parent: Any = None
+    enqueued_at: float = 0.0

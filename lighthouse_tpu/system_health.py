@@ -17,6 +17,8 @@ import os
 import time
 from dataclasses import asdict, dataclass
 
+from . import metrics as _metrics
+
 _CLK_TCK = os.sysconf("SC_CLK_TCK") if hasattr(os, "sysconf") else 100
 _PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
 
@@ -193,3 +195,57 @@ def observe_all(disk_path: str = "/") -> dict:
     out.update(asdict(SystemHealth.observe(disk_path)))
     out["observed_at_ms"] = int(time.time() * 1000)
     return out
+
+
+# ------------------------------------------------- standard process metrics
+# The three series every stock Grafana "process" dashboard expects, sampled
+# on scrape via the registry's collector hook.
+
+PROCESS_CPU_SECONDS = _metrics.counter(
+    "process_cpu_seconds_total", "Total user and system CPU time of this process"
+)
+PROCESS_RESIDENT_MEMORY = _metrics.gauge(
+    "process_resident_memory_bytes", "Resident memory size of this process"
+)
+PROCESS_START_TIME = _metrics.gauge(
+    "process_start_time_seconds", "Start time of this process since unix epoch"
+)
+
+
+def _process_start_time() -> float:
+    """Epoch start time from /proc (starttime ticks since boot + btime);
+    falls back to this module's import time."""
+    try:
+        stat = _read("/proc/self/stat")
+        rest = stat.rsplit(")", 1)[1].split()
+        start_ticks = int(rest[19])  # starttime: field 22 of /proc/self/stat
+        for line in _read("/proc/stat").splitlines():
+            if line.startswith("btime"):
+                return int(line.split()[1]) + start_ticks / _CLK_TCK
+    except (IndexError, ValueError):
+        pass
+    return _IMPORT_TIME
+
+
+_IMPORT_TIME = time.time()
+_START_TIME = _process_start_time()
+
+
+def _process_cpu_seconds() -> float:
+    """utime+stime as FLOAT seconds — ProcessHealth's integer field would
+    make rate(process_cpu_seconds_total[...]) step in whole-second jumps."""
+    try:
+        rest = _read("/proc/self/stat").rsplit(")", 1)[1].split()
+        return (int(rest[11]) + int(rest[12])) / _CLK_TCK
+    except (IndexError, ValueError):
+        return 0.0
+
+
+def _collect_process_metrics() -> None:
+    h = ProcessHealth.observe()
+    PROCESS_CPU_SECONDS.set_total(_process_cpu_seconds())
+    PROCESS_RESIDENT_MEMORY.set(float(h.pid_mem_resident_set_size))
+    PROCESS_START_TIME.set(_START_TIME)
+
+
+_metrics.register_collector(_collect_process_metrics)

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Metrics-registry lint (ISSUE 2 satellite).
+
+Imports the metric-registering modules and fails (exit 1) on:
+
+- metric names not matching the Prometheus grammar ``[a-z_:][a-z0-9_:]*``
+  (lowercase enforced on top of the spec: this codebase's convention),
+- missing help text,
+- duplicate registrations that disagree on kind or help (silent first-wins
+  would otherwise hide the conflict forever),
+- a rendered exposition output that fails a line-level parse.
+
+Run from the repo root: ``python scripts/check_metrics.py``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+NAME_RE = re.compile(r"^[a-z_:][a-z0-9_:]*$")
+SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*")*\})?'
+    r' [-+0-9.eE]+(e[-+]?[0-9]+)?$'
+)
+
+# Every module that registers metrics at import time.  Chain/ops modules
+# use the constants in lighthouse_tpu.metrics, so this list stays short;
+# add a module here when it grows its own counter()/histogram() calls.
+REGISTERING_MODULES = (
+    "lighthouse_tpu.metrics",
+    "lighthouse_tpu.system_health",
+    "lighthouse_tpu.scheduler.processor",
+    "lighthouse_tpu.monitoring",
+)
+
+
+def main() -> int:
+    errors = []
+    for mod in REGISTERING_MODULES:
+        try:
+            importlib.import_module(mod)
+        except Exception as e:
+            errors.append(f"cannot import {mod}: {type(e).__name__}: {e}")
+    from lighthouse_tpu import metrics
+
+    for name, metric in sorted(metrics._REGISTRY.items()):
+        if not NAME_RE.match(name):
+            errors.append(f"{name}: name does not match [a-z_:][a-z0-9_:]*")
+        if not metric.help.strip():
+            errors.append(f"{name}: missing help text")
+
+    for name, old_kind, new_kind in metrics.DUPLICATE_REGISTRATIONS:
+        errors.append(
+            f"{name}: conflicting re-registration ({old_kind} vs {new_kind} "
+            "or differing help text)"
+        )
+
+    for line in metrics.render_prometheus().splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            if not re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*", line):
+                errors.append(f"unparseable comment line: {line!r}")
+        elif not SAMPLE_RE.match(line):
+            errors.append(f"unparseable sample line: {line!r}")
+
+    if errors:
+        for e in errors:
+            print(f"check_metrics: FAIL: {e}", file=sys.stderr)
+        return 1
+    print(f"check_metrics: OK ({len(metrics._REGISTRY)} metrics)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
