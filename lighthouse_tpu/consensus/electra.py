@@ -17,6 +17,7 @@ from typing import List
 
 from ..types.spec import FAR_FUTURE_EPOCH, ChainSpec
 from . import helpers as h
+from . import safe_arith as sa
 from . import signature_sets as sets
 
 GENESIS_SLOT = 0
@@ -79,10 +80,15 @@ def process_withdrawal_request(state, request, types, spec: ChainSpec) -> None:
             h.initiate_validator_exit(state, index, spec)
         return
     has_sufficient_eb = int(v.effective_balance) >= spec.min_activation_balance
-    has_excess = int(state.balances[index]) > spec.min_activation_balance + pending_balance
+    has_excess = int(state.balances[index]) > sa.safe_add(
+        spec.min_activation_balance, pending_balance
+    )
     if h.has_compounding_withdrawal_credential(v, spec) and has_sufficient_eb and has_excess:
         to_withdraw = min(
-            int(state.balances[index]) - spec.min_activation_balance - pending_balance,
+            sa.safe_sub(
+                sa.safe_sub(int(state.balances[index]), spec.min_activation_balance),
+                pending_balance,
+            ),
             amount,
         )
         exit_queue_epoch = h.compute_exit_epoch_and_update_churn(state, to_withdraw, spec)
@@ -224,8 +230,9 @@ def process_pending_deposits(state, types, spec: ChainSpec) -> None:
     from .per_block import _pubkey_index_map
 
     next_epoch = h.get_current_epoch(state, spec) + 1
-    available = int(state.deposit_balance_to_consume) + h.get_activation_exit_churn_limit(
-        state, spec
+    available = sa.safe_add(
+        int(state.deposit_balance_to_consume),
+        h.get_activation_exit_churn_limit(state, spec),
     )
     processed_amount = 0
     next_deposit_index = 0
@@ -259,10 +266,12 @@ def process_pending_deposits(state, types, spec: ChainSpec) -> None:
         elif is_exited:
             deposits_to_postpone.append(deposit)
         else:
-            is_churn_limit_reached = processed_amount + int(deposit.amount) > available
+            is_churn_limit_reached = (
+                sa.safe_add(processed_amount, int(deposit.amount)) > available
+            )
             if is_churn_limit_reached:
                 break
-            processed_amount += int(deposit.amount)
+            processed_amount = sa.safe_add(processed_amount, int(deposit.amount))
             _apply_pending_deposit(state, deposit, types, spec)
         next_deposit_index += 1
 
@@ -270,7 +279,7 @@ def process_pending_deposits(state, types, spec: ChainSpec) -> None:
         list(state.pending_deposits)[next_deposit_index:] + deposits_to_postpone
     )
     if is_churn_limit_reached:
-        state.deposit_balance_to_consume = available - processed_amount
+        state.deposit_balance_to_consume = sa.safe_sub(available, processed_amount)
     else:
         state.deposit_balance_to_consume = 0
 

@@ -96,10 +96,13 @@ def initialize_beacon_state_from_eth1(
 
 
 def _finalize_genesis_validators(state, spec: ChainSpec) -> None:
+    from . import safe_arith as sa
+
     for index, v in enumerate(state.validators):
-        balance = state.balances[index]
+        balance = int(state.balances[index])
         v.effective_balance = min(
-            balance - balance % spec.effective_balance_increment, spec.max_effective_balance
+            sa.safe_sub(balance, sa.safe_mod(balance, spec.effective_balance_increment)),
+            spec.max_effective_balance,
         )
         if v.effective_balance == spec.max_effective_balance:
             v.activation_eligibility_epoch = GENESIS_EPOCH

@@ -34,6 +34,7 @@ from ..types.spec import (
     ChainSpec,
 )
 from ..types.ssz import hash_two
+from . import safe_arith as sa
 from .shuffling import compute_shuffled_index, shuffle_list
 
 MAX_RANDOM_BYTE = 2**8 - 1
@@ -187,11 +188,14 @@ def get_total_active_balance(state, spec: ChainSpec) -> int:
 
 
 def increase_balance(state, index: int, delta: int) -> None:
-    state.balances[index] += int(delta)
+    # Checked: a balance past u64 is an invalid block, not a bignum
+    # (reference mutators.rs increase_balance -> safe_add_assign).
+    state.balances[index] = sa.safe_add(int(state.balances[index]), int(delta))
 
 
 def decrease_balance(state, index: int, delta: int) -> None:
-    state.balances[index] = max(0, state.balances[index] - int(delta))
+    # Spec decrease_balance saturates at zero by definition.
+    state.balances[index] = sa.saturating_sub(int(state.balances[index]), int(delta))
 
 
 # ----------------------------------------------------- committee shuffling
@@ -274,7 +278,8 @@ def compute_proposer_index(state, indices: Sequence[int], seed: bytes, spec: Cha
     while True:
         candidate = int(indices[compute_shuffled_index(i % total, total, seed, spec.preset.shuffle_round_count)])
         random_byte = hash(seed + uint_to_bytes(i // 32))[i % 32]
-        if state.validators[candidate].effective_balance * MAX_RANDOM_BYTE >= max_eb * random_byte:
+        lhs = sa.safe_mul(int(state.validators[candidate].effective_balance), MAX_RANDOM_BYTE)
+        if lhs >= sa.safe_mul(max_eb, random_byte):
             return candidate
         i += 1
 
@@ -441,9 +446,13 @@ def slash_validator(
     v = state.validators[slashed_index]
     v.slashed = True
     v.withdrawable_epoch = max(
-        v.withdrawable_epoch, epoch + spec.preset.epochs_per_slashings_vector
+        v.withdrawable_epoch,
+        epoch + spec.preset.epochs_per_slashings_vector,  # safe-arith: ok(epoch arithmetic, not gwei)
     )
-    state.slashings[epoch % spec.preset.epochs_per_slashings_vector] += v.effective_balance
+    slash_slot = epoch % spec.preset.epochs_per_slashings_vector
+    state.slashings[slash_slot] = sa.safe_add(
+        int(state.slashings[slash_slot]), int(v.effective_balance)
+    )
 
     if fork == "phase0":
         min_quotient = spec.min_slashing_penalty_quotient
@@ -463,15 +472,17 @@ def slash_validator(
         if fork == "electra"
         else spec.whistleblower_reward_quotient
     )
-    whistleblower_reward = v.effective_balance // wb_quotient
+    whistleblower_reward = sa.safe_div(int(v.effective_balance), wb_quotient)
     if fork == "phase0":
-        proposer_reward = whistleblower_reward // spec.proposer_reward_quotient
+        proposer_reward = sa.safe_div(whistleblower_reward, spec.proposer_reward_quotient)
     else:
         from ..types.spec import PROPOSER_WEIGHT, WEIGHT_DENOMINATOR
 
-        proposer_reward = whistleblower_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
+        proposer_reward = sa.safe_div(
+            sa.safe_mul(whistleblower_reward, PROPOSER_WEIGHT), WEIGHT_DENOMINATOR
+        )
     increase_balance(state, proposer_index, proposer_reward)
-    increase_balance(state, whistleblower_index, whistleblower_reward - proposer_reward)
+    increase_balance(state, whistleblower_index, sa.safe_sub(whistleblower_reward, proposer_reward))
 
 
 # ----------------------------------------------------------------- altair
@@ -486,16 +497,17 @@ def has_flag(flags: int, flag_index: int) -> bool:
 
 
 def get_base_reward_per_increment(state, spec: ChainSpec) -> int:
-    return (
-        spec.effective_balance_increment
-        * spec.base_reward_factor
-        // spec.integer_squareroot(get_total_active_balance(state, spec))
+    return sa.safe_div(
+        sa.safe_mul(spec.effective_balance_increment, spec.base_reward_factor),
+        spec.integer_squareroot(get_total_active_balance(state, spec)),
     )
 
 
 def get_base_reward(state, index: int, spec: ChainSpec) -> int:
-    increments = state.validators[index].effective_balance // spec.effective_balance_increment
-    return increments * get_base_reward_per_increment(state, spec)
+    increments = sa.safe_div(
+        int(state.validators[index].effective_balance), spec.effective_balance_increment
+    )
+    return sa.safe_mul(increments, get_base_reward_per_increment(state, spec))
 
 
 def get_attestation_participation_flag_indices(
@@ -540,7 +552,8 @@ def get_next_sync_committee_indices(state, spec: ChainSpec) -> List[int]:
         shuffled = compute_shuffled_index(i % n, n, seed, spec.preset.shuffle_round_count)
         candidate = int(active[shuffled])
         random_byte = hash(seed + uint_to_bytes(i // 32))[i % 32]
-        if state.validators[candidate].effective_balance * MAX_RANDOM_BYTE >= max_eb * random_byte:
+        lhs = sa.safe_mul(int(state.validators[candidate].effective_balance), MAX_RANDOM_BYTE)
+        if lhs >= sa.safe_mul(max_eb, random_byte):
             out.append(candidate)
         i += 1
     return out
@@ -620,9 +633,9 @@ def get_balance_churn_limit(state, spec: ChainSpec) -> int:
     """Per-epoch churn in GWEI (EIP-7251 replaces count-based churn)."""
     churn = max(
         spec.min_per_epoch_churn_limit_electra,
-        get_total_active_balance(state, spec) // spec.churn_limit_quotient,
+        sa.safe_div(get_total_active_balance(state, spec), spec.churn_limit_quotient),
     )
-    return churn - churn % spec.effective_balance_increment
+    return sa.safe_sub(churn, sa.safe_mod(churn, spec.effective_balance_increment))
 
 
 def get_activation_exit_churn_limit(state, spec: ChainSpec) -> int:
@@ -653,11 +666,13 @@ def compute_exit_epoch_and_update_churn(state, exit_balance: int, spec: ChainSpe
     else:
         balance_to_consume = int(state.exit_balance_to_consume)
     if exit_balance > balance_to_consume:
-        balance_to_process = exit_balance - balance_to_consume
-        additional_epochs = (balance_to_process - 1) // per_epoch_churn + 1
+        balance_to_process = sa.safe_sub(exit_balance, balance_to_consume)
+        additional_epochs = sa.safe_div(sa.safe_sub(balance_to_process, 1), per_epoch_churn) + 1
         earliest += additional_epochs
-        balance_to_consume += additional_epochs * per_epoch_churn
-    state.exit_balance_to_consume = balance_to_consume - exit_balance
+        balance_to_consume = sa.safe_add(
+            balance_to_consume, sa.safe_mul(additional_epochs, per_epoch_churn)
+        )
+    state.exit_balance_to_consume = sa.safe_sub(balance_to_consume, exit_balance)
     state.earliest_exit_epoch = earliest
     return earliest
 
@@ -675,11 +690,15 @@ def compute_consolidation_epoch_and_update_churn(
     else:
         balance_to_consume = int(state.consolidation_balance_to_consume)
     if consolidation_balance > balance_to_consume:
-        balance_to_process = consolidation_balance - balance_to_consume
-        additional_epochs = (balance_to_process - 1) // per_epoch_churn + 1
+        balance_to_process = sa.safe_sub(consolidation_balance, balance_to_consume)
+        additional_epochs = sa.safe_div(sa.safe_sub(balance_to_process, 1), per_epoch_churn) + 1
         earliest += additional_epochs
-        balance_to_consume += additional_epochs * per_epoch_churn
-    state.consolidation_balance_to_consume = balance_to_consume - consolidation_balance
+        balance_to_consume = sa.safe_add(
+            balance_to_consume, sa.safe_mul(additional_epochs, per_epoch_churn)
+        )
+    state.consolidation_balance_to_consume = sa.safe_sub(
+        balance_to_consume, consolidation_balance
+    )
     state.earliest_consolidation_epoch = earliest
     return earliest
 
@@ -695,7 +714,7 @@ def switch_to_compounding_validator(state, index: int, types, spec: ChainSpec) -
 def queue_excess_active_balance(state, index: int, types, spec: ChainSpec) -> None:
     balance = int(state.balances[index])
     if balance > spec.min_activation_balance:
-        excess = balance - spec.min_activation_balance
+        excess = sa.safe_sub(balance, spec.min_activation_balance)
         state.balances[index] = spec.min_activation_balance
         v = state.validators[index]
         state.pending_deposits = list(state.pending_deposits) + [
@@ -744,7 +763,8 @@ def get_expected_withdrawals_electra(state, types, spec: ChainSpec):
         has_excess = int(state.balances[vidx]) > spec.min_activation_balance
         if v.exit_epoch == FAR_FUTURE_EPOCH and has_sufficient_eb and has_excess:
             withdrawable = min(
-                int(state.balances[vidx]) - spec.min_activation_balance, int(w.amount)
+                sa.safe_sub(int(state.balances[vidx]), spec.min_activation_balance),
+                int(w.amount),
             )
             withdrawals.append(types.Withdrawal(
                 index=withdrawal_index,
@@ -764,7 +784,7 @@ def get_expected_withdrawals_electra(state, types, spec: ChainSpec):
         partially_withdrawn = sum(
             int(w.amount) for w in withdrawals if int(w.validator_index) == validator_index
         )
-        balance = int(state.balances[validator_index]) - partially_withdrawn
+        balance = sa.safe_sub(int(state.balances[validator_index]), partially_withdrawn)
         if is_fully_withdrawable_validator_electra(v, balance, epoch, spec):
             withdrawals.append(types.Withdrawal(
                 index=withdrawal_index,
@@ -778,7 +798,7 @@ def get_expected_withdrawals_electra(state, types, spec: ChainSpec):
                 index=withdrawal_index,
                 validator_index=validator_index,
                 address=bytes(v.withdrawal_credentials)[12:],
-                amount=balance - get_max_effective_balance(v, spec),
+                amount=sa.safe_sub(balance, get_max_effective_balance(v, spec)),
             ))
             withdrawal_index += 1
         if len(withdrawals) == spec.preset.max_withdrawals_per_payload:
@@ -815,7 +835,7 @@ def get_expected_withdrawals(state, types, spec: ChainSpec):
                     index=withdrawal_index,
                     validator_index=validator_index,
                     address=bytes(v.withdrawal_credentials)[12:],
-                    amount=balance - spec.max_effective_balance,
+                    amount=sa.safe_sub(balance, spec.max_effective_balance),
                 )
             )
             withdrawal_index += 1

@@ -30,7 +30,9 @@ from ..types.spec import (
 )
 from ..types.ssz import hash_two
 from . import helpers as h
+from . import safe_arith as sa
 from . import signature_sets as sets
+from .safe_arith import ArithError
 
 DEPOSIT_CONTRACT_TREE_DEPTH = 32
 
@@ -110,7 +112,36 @@ def per_block_processing(
     ``payload_verifier``: optional callable(payload) -> bool, the
     execution-engine notify_new_payload seam (fake-EL in tests, engine API in
     the beacon node).
+
+    A spec-arithmetic overflow anywhere in block processing means the block
+    is INVALID (reference ``BlockProcessingError::ArithError``) — surfaced as
+    ``BlockProcessingError``, never a wrapped value or a bare crash.
     """
+    try:
+        _per_block_processing(
+            state,
+            signed_block,
+            types,
+            spec,
+            strategy=strategy,
+            verify_block_root=verify_block_root,
+            block_root=block_root,
+            payload_verifier=payload_verifier,
+        )
+    except ArithError as e:
+        raise BlockProcessingError(f"arithmetic out of u64 range: {e}") from e
+
+
+def _per_block_processing(
+    state,
+    signed_block,
+    types,
+    spec: ChainSpec,
+    strategy: str,
+    verify_block_root: bool,
+    block_root: Optional[bytes],
+    payload_verifier,
+) -> None:
     block = signed_block.message
     if block.slot != state.slot:
         raise BlockProcessingError(f"block slot {block.slot} != state slot {state.slot}")
@@ -387,18 +418,22 @@ def process_attestation(state, attestation, types, spec: ChainSpec, verify: bool
     base_reward_per_increment = h.get_base_reward_per_increment(state, spec)
     proposer_reward_numerator = 0
     for i in indexed.attesting_indices:
-        increments = state.validators[i].effective_balance // spec.effective_balance_increment
-        base_reward = increments * base_reward_per_increment
+        increments = sa.safe_div(
+            int(state.validators[i].effective_balance), spec.effective_balance_increment
+        )
+        base_reward = sa.safe_mul(increments, base_reward_per_increment)
         ep = participation[i]
         for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
             if flag_index in flags and not h.has_flag(ep, flag_index):
                 ep = h.add_flag(ep, flag_index)
-                proposer_reward_numerator += base_reward * weight
+                proposer_reward_numerator = sa.safe_add(
+                    proposer_reward_numerator, sa.safe_mul(base_reward, weight)
+                )
         participation[i] = ep
     proposer_reward_denominator = (
         (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT) * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT
     )
-    proposer_reward = proposer_reward_numerator // proposer_reward_denominator
+    proposer_reward = sa.safe_div(proposer_reward_numerator, proposer_reward_denominator)
     h.increase_balance(state, h.get_beacon_proposer_index(state, spec), proposer_reward)
 
 
@@ -432,7 +467,8 @@ def get_validator_from_deposit(pubkey, withdrawal_credentials, amount, types,
     else:
         cap = spec.max_effective_balance
     effective_balance = min(
-        amount - amount % spec.effective_balance_increment, cap
+        sa.safe_sub(int(amount), sa.safe_mod(int(amount), spec.effective_balance_increment)),
+        cap,
     )
     return types.Validator(
         pubkey=bytes(pubkey),
@@ -565,23 +601,25 @@ def process_bls_to_execution_change(state, signed_change, types, spec: ChainSpec
 def sync_participant_reward(state, spec: ChainSpec) -> int:
     """Spec per-participant sync reward — the ONE definition shared by the
     transition and the rewards APIs (chain/rewards.py)."""
-    total_active_increments = (
-        h.get_total_active_balance(state, spec) // spec.effective_balance_increment
+    total_active_increments = sa.safe_div(
+        h.get_total_active_balance(state, spec), spec.effective_balance_increment
     )
-    total_base_rewards = (
-        h.get_base_reward_per_increment(state, spec) * total_active_increments
+    total_base_rewards = sa.safe_mul(
+        h.get_base_reward_per_increment(state, spec), total_active_increments
     )
-    max_participant_rewards = (
-        total_base_rewards * SYNC_REWARD_WEIGHT // WEIGHT_DENOMINATOR
-        // spec.slots_per_epoch
+    max_participant_rewards = sa.safe_div(
+        sa.safe_div(
+            sa.safe_mul(total_base_rewards, SYNC_REWARD_WEIGHT), WEIGHT_DENOMINATOR
+        ),
+        spec.slots_per_epoch,
     )
-    return max_participant_rewards // spec.preset.sync_committee_size
+    return sa.safe_div(max_participant_rewards, spec.preset.sync_committee_size)
 
 
 def sync_proposer_reward_per_bit(state, spec: ChainSpec) -> int:
-    return (
-        sync_participant_reward(state, spec) * PROPOSER_WEIGHT
-        // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+    return sa.safe_div(
+        sa.safe_mul(sync_participant_reward(state, spec), PROPOSER_WEIGHT),
+        WEIGHT_DENOMINATOR - PROPOSER_WEIGHT,
     )
 
 

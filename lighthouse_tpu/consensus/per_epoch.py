@@ -29,8 +29,12 @@ from ..types.spec import (
     FAR_FUTURE_EPOCH,
 )
 from . import helpers as h
+from . import safe_arith as sa
+from .safe_arith import ArithError
 
 BASE_REWARDS_PER_EPOCH = 4  # phase0
+
+_I64_MAX = np.iinfo(np.int64).max
 
 
 # ----------------------------------------------------------- array extract
@@ -71,6 +75,22 @@ def _participation_array(lst, n: int) -> np.ndarray:
     return np.fromiter(lst, dtype=np.int64, count=n)
 
 
+def _balances_array(state, n: int) -> np.ndarray:
+    """Balances as an int64 array.  A u64 balance past 2**63-1 is legal for
+    the spec but unrepresentable on the int64 device path — surface that as
+    a typed ArithError instead of numpy's bare OverflowError.
+
+    Known, deliberate divergence: the reference (u64 throughout) would
+    process such a state; this build's epoch vector contract is int64, so
+    it rejects it typed instead.  2**63 gwei is ~70x all ETH in existence —
+    reachable only on adversarial custom networks, where a loud typed error
+    beats a silent wrong answer."""
+    try:
+        return np.fromiter(state.balances, dtype=np.int64, count=n)
+    except OverflowError as e:
+        raise ArithError(f"balance exceeds int64 device range: {e}") from e
+
+
 # ------------------------------------------------- justification (shared)
 
 
@@ -101,11 +121,11 @@ def compute_justification_and_finalization(
     just rotated), so a lazy root is simply never evaluated."""
     bits = [False] + list(bits)[:-1]
     justified = None
-    if previous_target_balance * 3 >= total_active_balance * 2:
+    if sa.safe_mul(previous_target_balance, 3) >= sa.safe_mul(total_active_balance, 2):
         root = previous_boundary_root() if callable(previous_boundary_root) else previous_boundary_root
         justified = (previous_epoch, root)
         bits[1] = True
-    if current_target_balance * 3 >= total_active_balance * 2:
+    if sa.safe_mul(current_target_balance, 3) >= sa.safe_mul(total_active_balance, 2):
         root = current_boundary_root() if callable(current_boundary_root) else current_boundary_root
         justified = (current_epoch, root)
         bits[0] = True
@@ -199,6 +219,7 @@ def _epoch_deltas_numpy(
         )
 
     increment = spec.effective_balance_increment
+    # safe-arith: ok(int64 vector: eb/increment <= 2048, brpi <= increment)
     base_reward = (arrays.effective_balance // increment) * base_reward_per_increment
     active_increments = total_active_balance // increment
     rewards = np.zeros(n, dtype=np.int64)
@@ -212,20 +233,43 @@ def _epoch_deltas_numpy(
         ) // increment
         if not in_leak:
             flag_rewards = (
+                # safe-arith: ok(int64 vector: reward < base_reward <= eb)
                 base_reward * weight * participating_increments
                 // (active_increments * WEIGHT_DENOMINATOR)
             )
+            # safe-arith: ok(int64 vector accumulate, bounded by 4*base_reward)
             rewards += np.where(eligible & participating, flag_rewards, 0)
         if flag_index != TIMELY_HEAD_FLAG_INDEX:
-            penalties += np.where(
+            penalties += np.where(  # safe-arith: ok(int64 vector accumulate)
+                # safe-arith: ok(int64 vector: weight <= 64, base_reward bounded)
                 eligible & ~participating, base_reward * weight // WEIGHT_DENOMINATOR, 0
             )
-    inactivity_penalty = (
-        arrays.effective_balance * new_inactivity
-        // (spec.inactivity_score_bias * quotient)
-    )
+    # Inactivity scores grow without bound during a leak; eb * score can
+    # silently wrap int64 (~2.9e8 score at 32-ETH eb).  Past that bound,
+    # compute the penalty term exactly in Python ints.  Clamp to 2**62, NOT
+    # _I64_MAX: the clamped value still dwarfs any real balance (so the
+    # validator drains to zero through the max(0, ...) floor downstream),
+    # while leaving headroom so the `penalties +=` accumulation and the
+    # `rewards - penalties` combine below cannot themselves wrap int64.
+    max_eb = int(arrays.effective_balance.max()) if n else 0
+    max_inact = int(new_inactivity.max()) if n else 0
+    denom = spec.inactivity_score_bias * quotient
+    if max_eb and max_inact and max_eb * max_inact > _I64_MAX:
+        inactivity_penalty = np.fromiter(
+            (
+                min(int(e) * int(s) // denom, 2**62)
+                for e, s in zip(arrays.effective_balance, new_inactivity)
+            ),
+            dtype=np.int64,
+            count=n,
+        )
+    else:
+        inactivity_penalty = (
+            arrays.effective_balance * new_inactivity // denom
+        )  # safe-arith: ok(int64 vector path, overflow-guarded above)
+    # safe-arith: ok(int64 vector accumulate + combine, terms bounded above)
     penalties += np.where(eligible & ~prev_target, inactivity_penalty, 0)
-    return new_inactivity, rewards - penalties
+    return new_inactivity, rewards - penalties  # safe-arith: ok(int64 vector combine)
 
 
 _EPOCH_BACKEND = "numpy"
@@ -242,9 +286,18 @@ def set_epoch_backend(name: str) -> None:
 
 def epoch_deltas(arrays, prev_part, inactivity, **kwargs):
     if _EPOCH_BACKEND == "device":
-        from ..ops.epoch_device import epoch_deltas_device
+        # The device kernel is fixed int64 and wraps silently on overflow.
+        # new_inactivity <= inactivity + bias, so bound-check the worst-case
+        # eb * score product on the host and fall back to the exact numpy
+        # path (overflow-guarded) when it can't be represented.
+        n = arrays.n
+        max_eb = int(arrays.effective_balance.max()) if n else 0
+        max_inact = int(inactivity.max()) if n else 0
+        spec = kwargs["spec"]
+        if max_eb * (max_inact + spec.inactivity_score_bias) <= _I64_MAX:
+            from ..ops.epoch_device import epoch_deltas_device
 
-        return epoch_deltas_device(arrays, prev_part, inactivity, **kwargs)
+            return epoch_deltas_device(arrays, prev_part, inactivity, **kwargs)
     return _epoch_deltas_numpy(arrays, prev_part, inactivity, **kwargs)
 
 
@@ -265,7 +318,7 @@ def process_epoch_altair(state, types, spec: ChainSpec) -> None:
     previous_epoch = h.get_previous_epoch(state, spec)
     prev_part = _participation_array(state.previous_epoch_participation, n)
     curr_part = _participation_array(state.current_epoch_participation, n)
-    balances = np.fromiter(state.balances, dtype=np.int64, count=n)
+    balances = _balances_array(state, n)
 
     increment = spec.effective_balance_increment
     total_active_balance = max(
@@ -295,8 +348,9 @@ def process_epoch_altair(state, types, spec: ChainSpec) -> None:
     # (numpy, or the jnp device kernel in ops/epoch_device.py).
     if current_epoch > GENESIS_EPOCH:
         inactivity = np.fromiter(state.inactivity_scores, dtype=np.int64, count=n)
-        base_reward_per_increment = (
-            increment * spec.base_reward_factor // spec.integer_squareroot(total_active_balance)
+        base_reward_per_increment = sa.safe_div(
+            sa.safe_mul(increment, spec.base_reward_factor),
+            spec.integer_squareroot(total_active_balance),
         )
         fork = type(state).fork_name
         quotient = (
@@ -314,6 +368,7 @@ def process_epoch_altair(state, types, spec: ChainSpec) -> None:
             spec=spec,
         )
         state.inactivity_scores = [int(x) for x in new_inactivity]
+        # safe-arith: ok(int64 vector apply, deltas bounded by guarded pass)
         balances = np.maximum(0, balances + balance_delta)
         state.balances = [int(x) for x in balances]
 
@@ -408,11 +463,12 @@ def process_epoch_phase0(state, types, spec: ChainSpec) -> None:
         rewards, penalties = _phase0_attestation_deltas(
             state, arrays, total_active_balance, spec
         )
-        balances = np.fromiter(state.balances, dtype=np.int64, count=n)
+        balances = _balances_array(state, n)
+        # safe-arith: ok(int64 vector apply, phase0 deltas bounded)
         balances = np.maximum(0, balances + rewards - penalties)
         state.balances = [int(x) for x in balances]
     else:
-        balances = np.fromiter(state.balances, dtype=np.int64, count=n)
+        balances = _balances_array(state, n)
 
     _process_registry_updates(state, arrays, spec)
     _process_slashings(state, arrays, balances, total_active_balance, spec)
@@ -546,22 +602,35 @@ def _process_slashings(
         multiplier = spec.proportional_slashing_multiplier_altair
     else:
         multiplier = spec.proportional_slashing_multiplier_bellatrix
-    adjusted_total = min(sum(int(x) for x in state.slashings) * multiplier, total_balance)
+    adjusted_total = min(
+        sa.safe_mul(sum(int(x) for x in state.slashings), multiplier), total_balance
+    )
     increment = spec.effective_balance_increment
-    target_epoch = epoch + spec.preset.epochs_per_slashings_vector // 2
+    target_epoch = epoch + spec.preset.epochs_per_slashings_vector // 2  # safe-arith: ok(epoch arithmetic, not gwei)
     mask = arrays.slashed & (arrays.withdrawable_epoch == target_epoch)
     if not mask.any():
         return
-    if fork == "electra":
-        # EIP-7251: per-increment penalty (avoids the u64 overflow of the
-        # eb * adjusted_total product at 2048-ETH effective balances)
-        penalty_per_increment = adjusted_total // (total_balance // increment)
-        penalty = (arrays.effective_balance // increment) * penalty_per_increment
-    else:
-        penalty_numerator = (arrays.effective_balance // increment) * adjusted_total
-        penalty = penalty_numerator // total_balance * increment
+    # Exact Python-int penalties for the (few) validators being slashed this
+    # epoch: the eb//increment * adjusted_total product wraps int64 on large
+    # registries, and the reference computes this with checked u64 math.
+    penalty_per_increment = (
+        sa.safe_div(adjusted_total, total_balance // increment)
+        if fork == "electra"
+        else 0
+    )
     for index in np.nonzero(mask)[0]:
-        h.decrease_balance(state, int(index), int(penalty[index]))
+        idx = int(index)
+        increments_i = int(arrays.effective_balance[idx]) // increment
+        if fork == "electra":
+            # EIP-7251: per-increment penalty (avoids the u64 overflow of
+            # the eb * adjusted_total product at 2048-ETH effective balances)
+            penalty_i = sa.safe_mul(increments_i, penalty_per_increment)
+        else:
+            penalty_numerator = sa.safe_mul(increments_i, adjusted_total)
+            penalty_i = sa.safe_mul(
+                sa.safe_div(penalty_numerator, total_balance), increment
+            )
+        h.decrease_balance(state, idx, penalty_i)
 
 
 def _process_eth1_data_reset(state, spec: ChainSpec) -> None:
@@ -577,14 +646,19 @@ def _process_effective_balance_updates(state, arrays: EpochArrays, spec: ChainSp
     upward = hysteresis_increment * spec.preset.hysteresis_upward_multiplier
     is_electra = type(state).fork_name == "electra"
     for index, v in enumerate(state.validators):
-        balance = state.balances[index]
-        if balance + downward < v.effective_balance or v.effective_balance + upward < balance:
+        balance = int(state.balances[index])
+        if (
+            sa.safe_add(balance, downward) < v.effective_balance
+            or sa.safe_add(int(v.effective_balance), upward) < balance
+        ):
             cap = (
                 h.get_max_effective_balance(v, spec)  # EIP-7251 per-credential cap
                 if is_electra
                 else spec.max_effective_balance
             )
-            v.effective_balance = min(balance - balance % increment, cap)
+            v.effective_balance = min(
+                sa.safe_sub(balance, sa.safe_mod(balance, increment)), cap
+            )
 
 
 def _process_slashings_reset(state, spec: ChainSpec) -> None:

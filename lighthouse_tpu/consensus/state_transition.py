@@ -7,8 +7,9 @@ shape).
 from __future__ import annotations
 
 from ..types.spec import ChainSpec
-from .per_block import BlockSignatureStrategy, per_block_processing
+from .per_block import BlockProcessingError, BlockSignatureStrategy, per_block_processing
 from .per_slot import process_slots
+from .safe_arith import ArithError
 
 
 class StateRootMismatch(ValueError):
@@ -29,7 +30,13 @@ def state_transition(
     object if a fork upgrade happened during slot processing)."""
     block = signed_block.message
     if state.slot < block.slot:
-        state = process_slots(state, block.slot, types, spec)
+        try:
+            state = process_slots(state, block.slot, types, spec)
+        except ArithError as e:
+            # Epoch-processing overflow while advancing to the block's slot:
+            # the block that forced the advance is invalid, same contract as
+            # per_block_processing.
+            raise BlockProcessingError(f"arithmetic out of u64 range: {e}") from e
     per_block_processing(
         state,
         signed_block,

@@ -36,6 +36,17 @@ class Router:
         self.processor = processor if processor is not None else BeaconProcessor(max_workers=2)
         self.sync = sync_manager
         self.slasher = slasher
+        # drop_during_sync enforcement: while range sync is running, stale
+        # gossip (attestations/aggregates/contributions/LC updates) is
+        # discarded at enqueue (reference beacon_processor lib.rs).  The
+        # lambda reads self.sync dynamically — SyncManager attaches itself
+        # to the router after construction.
+        if self.processor.is_syncing is None:
+            from .sync import SyncState
+
+            self.processor.is_syncing = (
+                lambda: self.sync is not None and self.sync.state == SyncState.SYNCING
+            )
         service.on_gossip = self.on_gossip
         service.on_rpc_request = self.on_rpc_request
         service.on_peer_connected = self.on_peer_connected
@@ -123,6 +134,7 @@ class Router:
                     process=lambda it: self._process_gossip_attestations([it]),
                     process_batch=self._process_gossip_attestations,
                     item=item,
+                    drop_during_sync=True,
                 )
             )
         elif kind in self._OP_WORK_TYPES:
@@ -131,6 +143,11 @@ class Router:
                 WorkEvent(
                     work_type=self._OP_WORK_TYPES[kind],
                     process=lambda _=None, it=item: self._process_gossip_operation(*it),
+                    # current-slot-scoped work is worthless mid-sync; pool ops
+                    # (exits/slashings/changes) stay valid and are kept
+                    drop_during_sync=(
+                        kind == topics_mod.SYNC_COMMITTEE_CONTRIBUTION_AND_PROOF
+                    ),
                 )
             )
         elif kind in (topics_mod.LIGHT_CLIENT_FINALITY_UPDATE,
@@ -143,6 +160,7 @@ class Router:
                 WorkEvent(
                     work_type=wt,
                     process=lambda _=None, it=item: self._process_gossip_lc_update(*it),
+                    drop_during_sync=True,
                 )
             )
 

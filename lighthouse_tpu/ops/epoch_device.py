@@ -124,7 +124,9 @@ def epoch_deltas_device(
 ):
     """numpy in, numpy out — the device analog of the per_epoch numpy block.
     Returns ``(new_inactivity, balance_delta)`` (int64 arrays)."""
-    with jax.enable_x64(True):
+    from jax.experimental import enable_x64
+
+    with enable_x64():
         out = _deltas_kernel(
             jnp.asarray(arrays.effective_balance, dtype=jnp.int64),
             jnp.asarray(arrays.activation_epoch, dtype=jnp.int64),

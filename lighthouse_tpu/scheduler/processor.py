@@ -54,6 +54,10 @@ WORK_EVENTS_DROPPED = _gm.counter(
     "beacon_processor_work_events_dropped_total",
     "work events dropped (full queue or worker panic), by work class",
 )
+DROPPED_DURING_SYNC = _gm.counter(
+    "beacon_processor_dropped_during_sync_total",
+    "gossip work discarded because the node is syncing, by work class",
+)
 
 
 @dataclass
@@ -61,23 +65,36 @@ class ProcessorMetrics:
     received: Dict[str, int] = field(default_factory=dict)
     processed: Dict[str, int] = field(default_factory=dict)
     dropped: Dict[str, int] = field(default_factory=dict)
+    dropped_during_sync: Dict[str, int] = field(default_factory=dict)
     batches: Dict[str, int] = field(default_factory=dict)
     batch_items: Dict[str, int] = field(default_factory=dict)
 
     def bump(self, table: Dict[str, int], key: str, n: int = 1) -> None:
         table[key] = table.get(key, 0) + n
-        # mirror the three event tables onto the Prometheus registry
+        # mirror the event tables onto the Prometheus registry
         if table is self.received:
             WORK_EVENTS_RECEIVED.inc(n, work=key)
         elif table is self.processed:
             WORK_EVENTS_PROCESSED.inc(n, work=key)
         elif table is self.dropped:
             WORK_EVENTS_DROPPED.inc(n, work=key)
+        elif table is self.dropped_during_sync:
+            DROPPED_DURING_SYNC.inc(n, work=key)
 
 
 class BeaconProcessor:
-    def __init__(self, max_workers: int = 4, queue_lengths: Optional[dict] = None):
+    def __init__(
+        self,
+        max_workers: int = 4,
+        queue_lengths: Optional[dict] = None,
+        is_syncing: Optional[Callable[[], bool]] = None,
+    ):
+        """``is_syncing``: zero-arg callable consulted on enqueue; while it
+        returns True, events flagged ``drop_during_sync`` are discarded
+        (reference ``beacon_processor`` drops stale gossip during sync
+        instead of queueing work the chain can't use yet)."""
         self.max_workers = max(1, max_workers)
+        self.is_syncing = is_syncing
         self._drain_set = frozenset(DRAIN_ORDER)
         self._queues: Dict[str, deque] = {}
         self._limits = dict(DEFAULT_QUEUE_LENGTHS)
@@ -99,6 +116,12 @@ class BeaconProcessor:
         was dropped (reference: queue-full drop + metric)."""
         if event.work_type not in self._drain_set:
             raise ValueError(f"unknown work type {event.work_type!r} (not in DRAIN_ORDER)")
+        # Stale-while-syncing gossip is discarded, not queued: attestations
+        # and aggregates against a head we don't have yet would only fail
+        # later and crowd out the sync work itself.
+        if event.drop_during_sync and self.is_syncing is not None and self.is_syncing():
+            self.metrics.bump(self.metrics.dropped_during_sync, event.work_type)
+            return False
         # Carry the sender's trace context across the thread hop; stamp the
         # enqueue instant for the worker-side queue-wait span.
         if event.trace_parent is None:

@@ -1,0 +1,21 @@
+"""Repo-specific consensus-safety static analysis (ISSUE 3 tentpole).
+
+Three AST passes, one runner (``scripts/check_static.py``):
+
+- ``safe_arith_pass``  — raw arithmetic on spec-typed (gwei/balance/reward)
+  quantities in ``lighthouse_tpu/consensus/`` must route through
+  ``consensus/safe_arith.py`` or carry a ``# safe-arith: ok(<reason>)``
+  pragma (reference: the ``safe_arith`` crate + clippy's
+  ``arithmetic_side_effects`` deny in ``consensus/``).
+- ``lock_order_pass``  — extracts the lock-acquisition graph from
+  ``with lock:`` blocks across chain/scheduler/network/store, flags
+  acquisition-order cycles (deadlock potential) and blocking calls made
+  while holding a lock.
+- ``device_purity_pass`` — flags host side effects (print/log/metrics/
+  time/host randomness/global mutation) and unguarded 64-bit dtypes inside
+  ``jax.jit``-decorated or Pallas kernel functions in ``lighthouse_tpu/ops/``.
+
+See ANALYSIS.md for the pragma/baseline workflow.
+"""
+
+from .common import Violation  # noqa: F401

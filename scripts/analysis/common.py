@@ -1,0 +1,129 @@
+"""Shared plumbing for the static-analysis passes: violations, pragma
+suppression, file iteration, and the enclosing-scope visitor base."""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+#: ``# <pass>: ok(<reason>)`` — trailing on the offending line (or any line
+#: the offending expression spans), or standalone on the line just above it.
+PRAGMA_RE = re.compile(
+    r"#\s*(safe-arith|lock-order|device-purity):\s*ok\(([^)]*)\)"
+)
+
+
+@dataclass(frozen=True)
+class Violation:
+    pass_name: str  # safe-arith | lock-order | device-purity
+    path: str  # repo-relative, forward slashes
+    line: int
+    code: str  # e.g. raw-arith, lock-cycle, blocking-call, host-effect
+    context: str  # enclosing Class.function qualname (or module-level tag)
+    message: str
+
+    @property
+    def baseline_key(self) -> str:
+        """Line numbers drift; suppression keys on the stable coordinates
+        (pass, file, enclosing scope, violation code)."""
+        return f"{self.pass_name}|{self.path}|{self.context}|{self.code}"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: [{self.pass_name}/{self.code}] "
+            f"{self.context}: {self.message}"
+        )
+
+
+class PragmaIndex:
+    """Which source lines carry which pass's ``ok(...)`` pragma."""
+
+    def __init__(self, source: str):
+        self.by_pass: Dict[str, Set[int]] = {}
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            for m in PRAGMA_RE.finditer(text):
+                self.by_pass.setdefault(m.group(1), set()).add(lineno)
+
+    def suppresses(self, pass_name: str, node: ast.AST) -> bool:
+        lines = self.by_pass.get(pass_name)
+        if not lines:
+            return False
+        start = getattr(node, "lineno", None)
+        if start is None:
+            return False
+        end = getattr(node, "end_lineno", start) or start
+        # pragma anywhere on the expression's span, on the line above it, or
+        # on the line just after it (trailing the closing paren of a
+        # multi-line expression)
+        return bool(lines.intersection(range(start - 1, end + 2)))
+
+
+def iter_py_files(root: str, rel_dirs: Tuple[str, ...]) -> Iterator[Tuple[str, str]]:
+    """Yield ``(abs_path, rel_path)`` for every .py file under the given
+    repo-relative directories, sorted for deterministic output."""
+    for rel_dir in rel_dirs:
+        base = os.path.join(root, rel_dir)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames.sort()
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                abs_path = os.path.join(dirpath, fn)
+                yield abs_path, os.path.relpath(abs_path, root).replace(os.sep, "/")
+
+
+def parse_file(abs_path: str) -> Tuple[ast.Module, str, PragmaIndex]:
+    with open(abs_path, "r", encoding="utf-8") as f:
+        source = f.read()
+    return ast.parse(source, filename=abs_path), source, PragmaIndex(source)
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """The rightmost identifier of an expression: ``state.balances[i]`` →
+    ``balances``; ``foo`` → ``foo``; literals/calls → None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Subscript):
+        return terminal_name(node.value)
+    return None
+
+
+def dotted_path(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for pure attribute chains rooted at a Name, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor that tracks the enclosing Class.function qualname."""
+
+    def __init__(self) -> None:
+        self._scope: List[str] = []
+
+    @property
+    def context(self) -> str:
+        return ".".join(self._scope) if self._scope else "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef

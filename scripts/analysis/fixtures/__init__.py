@@ -1,0 +1,3 @@
+# Seeded-violation fixtures for the static-analysis self-test.  These files
+# are parsed (never imported) by scripts/check_static.py --self-test to prove
+# each pass still fires; they are excluded from the normal tree scan.

@@ -1,0 +1,34 @@
+"""Seeded violations for the device-purity pass (parsed, never imported).
+
+Expected findings inside the jitted function: host-effect (print, time,
+metrics), host-randomness (np.random), global-mutation, and unguarded-x64.
+The pragma'd line must NOT be flagged.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_TRACE_CACHE = {}
+SOME_COUNTER = None
+
+
+@jax.jit
+def impure_kernel(x):
+    print("tracing", x)  # SEEDED: host-effect (print)
+    t0 = time.perf_counter()  # SEEDED: host-effect (trace-time clock)
+    noise = np.random.random()  # SEEDED: host-randomness
+    SOME_COUNTER.inc(1)  # SEEDED: host-effect (metrics)
+    _TRACE_CACHE["last"] = x  # SEEDED: global-mutation
+    wide = x.astype(jnp.int64)  # SEEDED: unguarded-x64
+    ok = x.astype(jnp.int32)  # fine: 32-bit
+    allowed = jnp.float64  # device-purity: ok(fixture: suppressed)
+    return wide + ok + noise + t0
+
+
+def host_helper():
+    # not jitted: host effects are fine here
+    print("host side")
+    return np.random.random()

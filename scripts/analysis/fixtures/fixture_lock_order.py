@@ -1,0 +1,77 @@
+"""Seeded violations for the lock-order pass (parsed, never imported).
+
+Expected findings: one lock-cycle pair (a→b in one method, b→a in
+another), one lock-self-cycle via a same-class helper call, and one
+blocking-call (sleep under lock).  The pragma'd sleep must NOT be flagged.
+"""
+
+import threading
+import time
+
+
+class InvertedOrders:
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+
+    def forward(self):
+        with self.lock_a:
+            with self.lock_b:  # SEEDED: lock-cycle (a then b)
+                return 1
+
+    def backward(self):
+        with self.lock_b:
+            with self.lock_a:  # SEEDED: lock-cycle (b then a)
+                return 2
+
+
+class SelfDeadlock:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            return self._helper()  # SEEDED: lock-self-cycle (re-acquires)
+
+    def _helper(self):
+        with self._lock:
+            return 3
+
+
+class MultiHopInversion:
+    """The A->B edge only exists through an UNLOCKED intermediate method —
+    proves the acquires fixpoint propagates past one call level."""
+
+    def __init__(self):
+        self.lock_c = threading.Lock()
+        self.lock_d = threading.Lock()
+
+    def entry(self):
+        with self.lock_c:
+            self._intermediate()  # SEEDED: lock-cycle (c then, transitively, d)
+
+    def _intermediate(self):
+        # no lock held here: must still propagate _deep's acquisitions
+        return self._deep()
+
+    def _deep(self):
+        with self.lock_d:
+            return 4
+
+    def inverted(self):
+        with self.lock_d:
+            with self.lock_c:  # SEEDED: lock-cycle (d then c)
+                return 5
+
+
+class BlocksUnderLock:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def slow(self):
+        with self._lock:
+            time.sleep(1.0)  # SEEDED: blocking-call
+
+    def allowed(self):
+        with self._lock:
+            time.sleep(0.0)  # lock-order: ok(fixture: intentional, bounded)

@@ -1,0 +1,24 @@
+"""Seeded violations for the safe-arith pass (parsed, never imported).
+
+Expected findings: raw-arith on lines marked SEEDED below; the pragma'd
+line must NOT be flagged (proves suppression works).
+"""
+
+
+def unchecked_reward_math(state, index, spec):
+    balance = state.balances[index]
+    reward = balance * spec.base_reward_factor  # SEEDED: raw-arith (mult)
+    state.balances[index] = balance + reward  # SEEDED: raw-arith (add)
+    penalty = balance - reward  # SEEDED: raw-arith (sub)
+    state.balances[index] -= penalty  # SEEDED: raw-arith (augassign)
+    shifted = reward << 3  # SEEDED: raw-arith (shift)
+    return shifted
+
+
+def suppressed_vector_math(balances, deltas):
+    # the pragma must suppress this one
+    return balances + deltas  # safe-arith: ok(fixture: guarded vector path)
+
+
+def untyped_quantities_are_fine(a, b):
+    return a + b * 3  # no spec-typed operand: not flagged

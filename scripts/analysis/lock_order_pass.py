@@ -1,0 +1,302 @@
+"""Lock-order / blocking-call auditor.
+
+Statically extracts the lock-acquisition graph from ``with lock:`` blocks
+across the concurrent subsystems (chain, scheduler, network, store) and
+flags:
+
+- ``lock-cycle``       — two locks acquired in both orders somewhere in the
+  tree (the classic AB/BA deadlock; the reference's ``TimeoutRwLock``
+  discipline exists precisely because these present as silent stalls);
+- ``lock-self-cycle``  — re-acquiring a non-reentrant lock already held
+  (directly nested, or via a same-class method call while holding it);
+- ``blocking-call``    — socket/file I/O, ``sleep``, device dispatch, or
+  ``.result()`` executed while holding a lock (head-of-line blocking for
+  every other thread contending on it).
+
+Model: a "lock" is a ``self.<attr>`` assigned from ``TimeoutLock`` /
+``threading.Lock`` / ``RLock`` / ``Condition`` anywhere in a class; its
+identity is ``Class.attr`` (per-class, so same-named locks on different
+classes never alias).  Held-sets are tracked lexically through ``with``
+nesting, and one level interprocedurally: calls to same-class methods
+propagate the callee's acquired-lock set (computed to a fixpoint), which
+is what catches "helper re-acquires the lock the caller already holds".
+Cross-object calls are out of scope (documented in ANALYSIS.md).
+
+``Condition.wait()`` releases the lock while waiting and is not flagged.
+Suppress intentional sites with ``# lock-order: ok(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+from .common import (
+    PragmaIndex,
+    Violation,
+    iter_py_files,
+    parse_file,
+    terminal_name,
+)
+
+PASS = "lock-order"
+
+SCAN_DIRS = (
+    "lighthouse_tpu/chain",
+    "lighthouse_tpu/scheduler",
+    "lighthouse_tpu/network",
+    "lighthouse_tpu/store",
+)
+
+LOCK_CTORS = frozenset({"TimeoutLock", "Lock", "RLock", "Condition"})
+REENTRANT_CTORS = frozenset({"RLock"})
+
+#: Call names that block the calling thread (receiver-based heuristics;
+#: ``.wait()`` is excluded — Condition.wait releases the held lock).
+BLOCKING_ATTRS = frozenset(
+    {
+        "sleep",
+        "result",
+        "recv",
+        "recvfrom",
+        "recv_into",
+        "accept",
+        "connect",
+        "sendall",
+        "urlopen",
+        "block_until_ready",
+        "wait_idle",
+    }
+)
+BLOCKING_NAMES = frozenset({"sleep", "urlopen", "open"})
+
+
+class _LockDef:
+    def __init__(self, cls: str, attr: str, reentrant: bool, line: int):
+        self.label = f"{cls}.{attr}"
+        self.attr = attr
+        self.reentrant = reentrant
+        self.line = line
+
+
+def _find_lock_defs(cls_node: ast.ClassDef) -> Dict[str, _LockDef]:
+    """``self.X = TimeoutLock(...)`` (or threading.Lock/RLock/Condition)
+    anywhere in the class body → lock attr X."""
+    locks: Dict[str, _LockDef] = {}
+    for node in ast.walk(cls_node):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        ctor = terminal_name(node.value.func)
+        if ctor not in LOCK_CTORS:
+            continue
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                locks[target.attr] = _LockDef(
+                    cls_node.name, target.attr, ctor in REENTRANT_CTORS, node.lineno
+                )
+    return locks
+
+
+class _MethodWalker(ast.NodeVisitor):
+    """Walks one method, tracking the held-lock stack through ``with``
+    nesting; records direct acquisitions, acquisition edges, same-class
+    call sites made while holding, and blocking calls while holding."""
+
+    def __init__(self, cls: str, method: str, locks: Dict[str, _LockDef],
+                 rel_path: str, pragmas: PragmaIndex):
+        self.cls = cls
+        self.method = method
+        self.locks = locks
+        self.rel_path = rel_path
+        self.pragmas = pragmas
+        self.held: List[str] = []
+        self.acquired: Set[str] = set()  # all locks this method acquires directly
+        # (held_label, acquired_label, lineno, node)
+        self.edges: List[Tuple[str, str, int, ast.AST]] = []
+        # (held_labels, callee_method, lineno, node)
+        self.self_calls: List[Tuple[Tuple[str, ...], str, int, ast.AST]] = []
+        self.blocking: List[Tuple[str, str, int, ast.AST]] = []  # (held, what, line, node)
+        self.direct_self_nest: List[Tuple[str, int, ast.AST]] = []
+
+    def _lock_of(self, expr: ast.AST) -> Optional[_LockDef]:
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            return self.locks.get(expr.attr)
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        entered: List[str] = []
+        for item in node.items:
+            lock = self._lock_of(item.context_expr)
+            if lock is None:
+                continue
+            if lock.label in self.held and not lock.reentrant:
+                self.direct_self_nest.append((lock.label, node.lineno, node))
+            for held in self.held:
+                self.edges.append((held, lock.label, node.lineno, node))
+            self.held.append(lock.label)
+            self.acquired.add(lock.label)
+            entered.append(lock.label)
+        self.generic_visit(node)
+        for _ in entered:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        # self.method(...) calls are recorded UNCONDITIONALLY (empty held
+        # tuple when unlocked) so the acquires_all fixpoint sees multi-hop
+        # chains through unlocked intermediates; edges/self-cycles are only
+        # emitted for entries whose held set is non-empty.
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+        ):
+            self.self_calls.append((tuple(self.held), func.attr, node.lineno, node))
+        if self.held:
+            # blocking call while holding
+            what = None
+            if isinstance(func, ast.Name) and func.id in BLOCKING_NAMES:
+                what = func.id
+            elif isinstance(func, ast.Attribute) and func.attr in BLOCKING_ATTRS:
+                # "a,b".join-style false positives: skip constant receivers
+                if not isinstance(func.value, ast.Constant):
+                    recv = terminal_name(func.value)
+                    what = f"{recv}.{func.attr}" if recv else func.attr
+            if what is not None:
+                self.blocking.append((self.held[-1], what, node.lineno, node))
+        # nested defs (worker closures) run outside the lock scope — don't
+        # treat their bodies as executing under the current held set
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # A nested function's body executes when *called*, not where it is
+        # defined — analyze it with an empty held stack.
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+def _method_nodes(cls_node: ast.ClassDef):
+    for item in cls_node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield item
+
+
+def run(root: str, scan_dirs: Tuple[str, ...] = SCAN_DIRS) -> List[Violation]:
+    violations: List[Violation] = []
+    # Global acquisition graph: (from_label, to_label) -> witness list
+    edge_witness: Dict[Tuple[str, str], List[Tuple[str, str, int]]] = defaultdict(list)
+    lock_reentrant: Dict[str, bool] = {}
+
+    for abs_path, rel_path in iter_py_files(root, scan_dirs):
+        tree, _, pragmas = parse_file(abs_path)
+        for cls_node in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+            locks = _find_lock_defs(cls_node)
+            if not locks:
+                continue
+            for lock in locks.values():
+                lock_reentrant[lock.label] = lock.reentrant
+
+            walkers: Dict[str, _MethodWalker] = {}
+            for m in _method_nodes(cls_node):
+                w = _MethodWalker(cls_node.name, m.name, locks, rel_path, pragmas)
+                w.visit(m)
+                walkers[m.name] = w
+
+            # Fixpoint: locks transitively acquired by each method via
+            # same-class calls.
+            acquires_all: Dict[str, Set[str]] = {
+                name: set(w.acquired) for name, w in walkers.items()
+            }
+            changed = True
+            while changed:
+                changed = False
+                for name, w in walkers.items():
+                    for _, callee, _, _ in w.self_calls:
+                        for lbl in acquires_all.get(callee, ()):
+                            if lbl not in acquires_all[name]:
+                                acquires_all[name].add(lbl)
+                                changed = True
+
+            for name, w in walkers.items():
+                ctx = f"{cls_node.name}.{name}"
+                for held, acquired, line, node in w.edges:
+                    if pragmas.suppresses(PASS, node):
+                        continue
+                    edge_witness[(held, acquired)].append((rel_path, ctx, line))
+                for label, line, node in w.direct_self_nest:
+                    if pragmas.suppresses(PASS, node):
+                        continue
+                    violations.append(
+                        Violation(
+                            PASS, rel_path, line, "lock-self-cycle", ctx,
+                            f"`with {label}` nested inside a region already "
+                            f"holding {label} (non-reentrant: deadlock)",
+                        )
+                    )
+                for held_labels, callee, line, node in w.self_calls:
+                    if pragmas.suppresses(PASS, node):
+                        continue
+                    for lbl in acquires_all.get(callee, ()):
+                        for held in held_labels:
+                            if lbl == held and not lock_reentrant.get(lbl, False):
+                                violations.append(
+                                    Violation(
+                                        PASS, rel_path, line, "lock-self-cycle",
+                                        ctx,
+                                        f"calls self.{callee}() which re-acquires "
+                                        f"{lbl} already held here (deadlock)",
+                                    )
+                                )
+                            elif lbl != held:
+                                edge_witness[(held, lbl)].append(
+                                    (rel_path, f"{ctx} -> {callee}", line)
+                                )
+                for held, what, line, node in w.blocking:
+                    if pragmas.suppresses(PASS, node):
+                        continue
+                    violations.append(
+                        Violation(
+                            PASS, rel_path, line, "blocking-call", ctx,
+                            f"blocking call `{what}(...)` while holding {held}; "
+                            "move it outside the critical section or annotate "
+                            "`# lock-order: ok(<reason>)`",
+                        )
+                    )
+
+    # AB/BA inversions: for each unordered pair with edges in both
+    # directions, emit one violation per direction's first witness.
+    seen_pairs: Set[Tuple[str, str]] = set()
+    for (a, b) in list(edge_witness):
+        if (b, a) not in edge_witness or a == b:
+            continue
+        pair = (min(a, b), max(a, b))
+        if pair in seen_pairs:
+            continue
+        seen_pairs.add(pair)
+        for frm, to in ((a, b), (b, a)):
+            path, ctx, line = edge_witness[(frm, to)][0]
+            other = edge_witness[(to, frm)][0]
+            violations.append(
+                Violation(
+                    PASS, path, line, "lock-cycle", ctx,
+                    f"acquires {to} while holding {frm}, but "
+                    f"{other[0]}:{other[2]} ({other[1]}) acquires {frm} while "
+                    f"holding {to} — inconsistent order can deadlock",
+                )
+            )
+    return violations

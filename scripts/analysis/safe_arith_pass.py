@@ -1,0 +1,150 @@
+"""Safe-arith auditor: raw arithmetic on spec-typed quantities in
+``lighthouse_tpu/consensus/`` must route through ``consensus/safe_arith.py``.
+
+The reference denies unchecked arithmetic in its ``consensus/`` tree
+(clippy ``arithmetic_side_effects``) and routes every spec operation
+through the ``safe_arith`` crate, so a u64 overflow is a typed error that
+invalidates the block.  This pass is the Python analog: it flags
+overflow/underflow-capable operators (``+ - * ** <<`` and their augmented
+forms) where either operand is a *gwei-typed* quantity — identified by the
+identifier's underscore components (``balance``, ``reward``, ``penalty``,
+``amount``, ``slashing`` …).
+
+Routing through ``safe_arith`` removes the raw operator, so compliant code
+is simply not flagged.  Intentional raw arithmetic (the int64 numpy/device
+vector paths, which carry their own overflow guards) is annotated
+``# safe-arith: ok(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from .common import (
+    PragmaIndex,
+    ScopedVisitor,
+    Violation,
+    iter_py_files,
+    parse_file,
+    terminal_name,
+)
+
+PASS = "safe-arith"
+
+#: Directories scanned (repo-relative).
+SCAN_DIRS = ("lighthouse_tpu/consensus",)
+
+#: The module allowed to do raw u64 arithmetic (it IS the checked layer).
+EXEMPT_FILES = ("lighthouse_tpu/consensus/safe_arith.py",)
+
+#: An identifier is spec-typed when any underscore-delimited component of
+#: its rightmost name matches one of these gwei-quantity words.
+TAINT_WORDS = frozenset(
+    {
+        "balance",
+        "balances",
+        "reward",
+        "rewards",
+        "penalty",
+        "penalties",
+        "amount",
+        "amounts",
+        "slashing",
+        "slashings",
+        "gwei",
+        "excess",
+        "churn",
+    }
+)
+
+#: Operators that can leave the u64 domain.  Floor-div/mod can only shrink
+#: a u64 (division by zero is caught at the safe_div/safe_mod callsites),
+#: so they are not flagged.
+OVERFLOW_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Pow, ast.LShift)
+
+#: Taint looks through these wrappers: ``int(balance) - x`` is still
+#: balance arithmetic.
+TRANSPARENT_CALLS = frozenset({"int", "min", "max", "abs"})
+
+
+def _is_tainted(node: ast.AST) -> bool:
+    name = terminal_name(node)
+    if name is not None:
+        return bool(TAINT_WORDS.intersection(name.lower().split("_")))
+    if isinstance(node, ast.Call):
+        fn = terminal_name(node.func)
+        if fn in TRANSPARENT_CALLS:
+            return any(_is_tainted(a) for a in node.args)
+    if isinstance(node, ast.BinOp):
+        return _is_tainted(node.left) or _is_tainted(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_tainted(node.operand)
+    return False
+
+
+class _Auditor(ScopedVisitor):
+    def __init__(self, rel_path: str, pragmas: PragmaIndex):
+        super().__init__()
+        self.rel_path = rel_path
+        self.pragmas = pragmas
+        self.violations: List[Violation] = []
+
+    def _flag(self, node: ast.AST, op: ast.AST, detail: str) -> None:
+        if self.pragmas.suppresses(PASS, node):
+            return
+        op_sym = {
+            ast.Add: "+",
+            ast.Sub: "-",
+            ast.Mult: "*",
+            ast.Pow: "**",
+            ast.LShift: "<<",
+        }[type(op)]
+        self.violations.append(
+            Violation(
+                pass_name=PASS,
+                path=self.rel_path,
+                line=node.lineno,
+                code="raw-arith",
+                context=self.context,
+                message=(
+                    f"raw `{op_sym}` on spec-typed quantity ({detail}); route "
+                    "through consensus/safe_arith or annotate "
+                    "`# safe-arith: ok(<reason>)`"
+                ),
+            )
+        )
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        if isinstance(node.op, OVERFLOW_OPS):
+            tainted = [
+                side
+                for side in (node.left, node.right)
+                if _is_tainted(side)
+            ]
+            if tainted:
+                names = ", ".join(
+                    filter(None, (terminal_name(t) for t in tainted))
+                ) or "expression"
+                self._flag(node, node.op, names)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if isinstance(node.op, OVERFLOW_OPS) and (
+            _is_tainted(node.target) or _is_tainted(node.value)
+        ):
+            name = terminal_name(node.target) or "target"
+            self._flag(node, node.op, f"augmented assign to {name}")
+        self.generic_visit(node)
+
+
+def run(root: str, scan_dirs: Tuple[str, ...] = SCAN_DIRS) -> List[Violation]:
+    violations: List[Violation] = []
+    for abs_path, rel_path in iter_py_files(root, scan_dirs):
+        if rel_path in EXEMPT_FILES:
+            continue
+        tree, _, pragmas = parse_file(abs_path)
+        auditor = _Auditor(rel_path, pragmas)
+        auditor.visit(tree)
+        violations.extend(auditor.violations)
+    return violations

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Consensus-safety static analysis runner (ISSUE 3 tentpole).
+
+Aggregates the three AST passes in ``scripts/analysis/``:
+
+- safe-arith     — raw arithmetic on spec-typed quantities in consensus/
+- lock-order     — lock-acquisition-order cycles + blocking calls under locks
+- device-purity  — host side effects / unguarded x64 inside jit/Pallas code
+
+Exit 0 when the tree is clean (modulo the committed baseline) AND every
+pass still fires on its seeded-violation fixture; exit 1 otherwise.  Pure
+AST analysis: nothing under ``lighthouse_tpu/`` is imported, so this runs
+in milliseconds and needs no JAX/device environment.
+
+Usage:
+    python scripts/check_static.py                 # self-test + tree scan
+    python scripts/check_static.py --update-baseline
+    python scripts/check_static.py --no-self-test  # tree scan only
+
+Suppression workflow (see ANALYSIS.md):
+- pragma the line:  ``# safe-arith: ok(<reason>)`` (likewise lock-order /
+  device-purity) — preferred for intentional, reviewed sites;
+- or baseline it:   ``--update-baseline`` rewrites
+  ``scripts/analysis/baseline.txt`` with every current finding.  New code
+  should not grow the baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from collections import Counter
+from typing import List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+
+from analysis import device_purity_pass, lock_order_pass, safe_arith_pass  # noqa: E402
+from analysis.common import Violation, iter_py_files  # noqa: E402
+
+BASELINE_PATH = os.path.join(REPO_ROOT, "scripts", "analysis", "baseline.txt")
+FIXTURES = ("scripts/analysis/fixtures",)
+
+PASSES = (safe_arith_pass, lock_order_pass, device_purity_pass)
+
+#: codes each pass MUST produce on its fixture (proves the lint fires) and
+#: strings that must NOT appear (proves pragma suppression works).
+SELF_TEST = {
+    "safe-arith": {
+        "must_fire": {"raw-arith": 5},
+        "must_not_flag_context": {"suppressed_vector_math", "untyped_quantities_are_fine"},
+    },
+    "lock-order": {
+        # 2 cycle pairs (AB/BA lexical + the multi-hop c/d inversion), each
+        # reported once per direction
+        "must_fire": {"lock-cycle": 4, "lock-self-cycle": 1, "blocking-call": 1},
+        "must_not_flag_context": {"BlocksUnderLock.allowed"},
+    },
+    "device-purity": {
+        "must_fire": {
+            "host-effect": 3,
+            "host-randomness": 1,
+            "global-mutation": 1,
+            "unguarded-x64": 1,
+        },
+        "must_not_flag_context": set(),
+    },
+}
+
+
+def run_self_test() -> List[str]:
+    """Each pass must fire its expected codes on the seeded fixtures."""
+    errors: List[str] = []
+    for mod in PASSES:
+        name = mod.PASS
+        found = mod.run(REPO_ROOT, FIXTURES)
+        by_code = Counter(v.code for v in found)
+        spec = SELF_TEST[name]
+        for code, want in spec["must_fire"].items():
+            got = by_code.get(code, 0)
+            if got < want:
+                errors.append(
+                    f"self-test: {name} pass fired {code} x{got}, expected >= {want} "
+                    "on its fixture — the lint has gone blind"
+                )
+        for ctx in spec["must_not_flag_context"]:
+            hits = [v for v in found if ctx in v.context]
+            for v in hits:
+                errors.append(
+                    f"self-test: {name} flagged pragma-suppressed/clean site: {v.render()}"
+                )
+    return errors
+
+
+def scan_tree(errors: List[str]) -> List[Violation]:
+    out: List[Violation] = []
+    for mod in PASSES:
+        n_files = sum(1 for _ in iter_py_files(REPO_ROOT, mod.SCAN_DIRS))
+        if n_files == 0:
+            errors.append(
+                f"{mod.PASS}: scan dirs {mod.SCAN_DIRS} match no files — "
+                "package moved? the pass has gone blind"
+            )
+        out.extend(mod.run(REPO_ROOT))
+    return out
+
+
+def load_baseline() -> Counter:
+    if not os.path.exists(BASELINE_PATH):
+        return Counter()
+    with open(BASELINE_PATH, "r", encoding="utf-8") as f:
+        keys = [
+            line.strip()
+            for line in f
+            if line.strip() and not line.lstrip().startswith("#")
+        ]
+    return Counter(keys)
+
+
+def write_baseline(violations: List[Violation]) -> None:
+    keys = sorted(v.baseline_key for v in violations)
+    with open(BASELINE_PATH, "w", encoding="utf-8") as f:
+        f.write(
+            "# check_static.py baseline — pre-existing findings, suppressed.\n"
+            "# One `pass|path|scope|code` key per line (duplicates = count).\n"
+            "# Regenerate with: python scripts/check_static.py --update-baseline\n"
+            "# New code should NOT grow this file: fix or pragma instead.\n"
+        )
+        for k in keys:
+            f.write(k + "\n")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline with every current finding")
+    ap.add_argument("--no-self-test", action="store_true",
+                    help="skip the fixture self-test")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also list baselined findings")
+    args = ap.parse_args()
+
+    errors: List[str] = []
+    if not args.no_self_test:
+        errors.extend(run_self_test())
+
+    violations = scan_tree(errors)
+    if args.update_baseline:
+        write_baseline(violations)
+        print(f"check_static: baseline rewritten with {len(violations)} findings")
+        # still report self-test failures: a blind lint must not be baselined
+        for e in errors:
+            print(f"check_static: FAIL: {e}", file=sys.stderr)
+        return 1 if errors else 0
+
+    baseline = load_baseline()
+    budget = Counter(baseline)
+    fresh: List[Violation] = []
+    suppressed = 0
+    for v in sorted(violations, key=lambda v: (v.path, v.line)):
+        if budget[v.baseline_key] > 0:
+            budget[v.baseline_key] -= 1
+            suppressed += 1
+            if args.verbose:
+                print(f"check_static: baselined: {v.render()}")
+        else:
+            fresh.append(v)
+
+    stale = +budget  # baseline entries nothing matched anymore
+    for key, n in sorted(stale.items()):
+        print(f"check_static: note: stale baseline entry x{n}: {key} "
+              "(finding fixed? run --update-baseline)", file=sys.stderr)
+
+    for v in fresh:
+        print(f"check_static: FAIL: {v.render()}", file=sys.stderr)
+    for e in errors:
+        print(f"check_static: FAIL: {e}", file=sys.stderr)
+
+    if fresh or errors:
+        print(
+            f"check_static: {len(fresh)} new finding(s), "
+            f"{len(errors)} self-test failure(s) "
+            f"({suppressed} baselined). See ANALYSIS.md for the workflow.",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"check_static: OK (3 passes, {len(violations)} finding(s) "
+        f"all baselined/pragma'd, self-test "
+        f"{'skipped' if args.no_self_test else 'fired'})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""Tier-1 gate for the repo's own static checks (ISSUE 3 satellite):
+``scripts/check_static.py`` (safe-arith / lock-order / device-purity AST
+passes + fixture self-test) and ``scripts/check_metrics.py`` (metrics
+registry lint) both run inside the test suite, so a regression in either
+gates the whole suite — same pattern the reference uses by running clippy
+deny-lists in CI next to the unit tests."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script: str, *args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", script), *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+class TestCheckStatic:
+    def test_tree_is_clean_and_passes_fire(self):
+        """Exit 0 == no un-baselined findings AND every pass still fires on
+        its seeded-violation fixture (a blind lint also fails)."""
+        res = _run("check_static.py")
+        assert res.returncode == 0, (
+            f"check_static.py failed:\n{res.stdout}\n{res.stderr}"
+        )
+        assert "OK" in res.stdout
+
+    def test_fixtures_detected_without_baseline(self):
+        """The self-test alone (fixtures only) must detect every seeded
+        violation class — proven by the runner's own expectations."""
+        res = _run("check_static.py", "--no-self-test")
+        assert res.returncode == 0, (
+            f"tree scan (no self-test) failed:\n{res.stdout}\n{res.stderr}"
+        )
+
+
+class TestCheckMetrics:
+    def test_metrics_registry_lint(self):
+        res = _run("check_metrics.py")
+        assert res.returncode == 0, (
+            f"check_metrics.py failed:\n{res.stdout}\n{res.stderr}"
+        )
+        assert "OK" in res.stdout

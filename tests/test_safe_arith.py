@@ -1,0 +1,268 @@
+"""Checked u64 spec arithmetic (ISSUE 3): unit + property tests for
+``consensus/safe_arith.py``, and the overflow-rejection contract — a block
+whose deposit/balance/slashing math leaves the u64 domain is rejected as
+INVALID (typed ``BlockProcessingError``), never crashed through and never
+silently wrapped (the reference ``consensus/safe_arith`` contract)."""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.consensus import helpers as h
+from lighthouse_tpu.consensus import safe_arith as sa
+from lighthouse_tpu.consensus.per_block import (
+    BlockProcessingError,
+    BlockSignatureStrategy,
+    apply_deposit,
+    per_block_processing,
+)
+from lighthouse_tpu.consensus.safe_arith import ArithError, U64_MAX
+
+# ------------------------------------------------------------------- unit
+
+
+class TestSafeOps:
+    def test_add(self):
+        assert sa.safe_add(1, 2) == 3
+        assert sa.safe_add(U64_MAX, 0) == U64_MAX
+        with pytest.raises(ArithError):
+            sa.safe_add(U64_MAX, 1)
+
+    def test_sub(self):
+        assert sa.safe_sub(5, 5) == 0
+        with pytest.raises(ArithError):
+            sa.safe_sub(5, 6)
+        assert sa.saturating_sub(5, 6) == 0
+        assert sa.saturating_sub(6, 5) == 1
+
+    def test_mul(self):
+        assert sa.safe_mul(0, U64_MAX) == 0
+        assert sa.safe_mul(2**32, 2**31) < 2**64
+        with pytest.raises(ArithError):
+            sa.safe_mul(2**32, 2**32)
+
+    def test_div_mod(self):
+        assert sa.safe_div(7, 2) == 3
+        assert sa.safe_mod(7, 2) == 1
+        with pytest.raises(ArithError):
+            sa.safe_div(7, 0)
+        with pytest.raises(ArithError):
+            sa.safe_mod(7, 0)
+
+    def test_pow_shift(self):
+        assert sa.safe_pow(2, 63) == 2**63
+        with pytest.raises(ArithError):
+            sa.safe_pow(2, 64)
+        with pytest.raises(ArithError):
+            sa.safe_pow(2, 10**9)  # bails before computing a giant int
+        assert sa.safe_shl(1, 63) == 2**63
+        with pytest.raises(ArithError):
+            sa.safe_shl(1, 64)
+        assert sa.safe_shr(2**63, 63) == 1
+        with pytest.raises(ArithError):
+            sa.safe_shr(2**63, 70)  # out-of-range shift rejects, not 0
+
+    def test_checked_u64(self):
+        assert sa.checked_u64(U64_MAX) == U64_MAX
+        with pytest.raises(ArithError):
+            sa.checked_u64(U64_MAX + 1)
+        with pytest.raises(ArithError):
+            sa.checked_u64(-1)
+
+    def test_error_is_typed_value_error(self):
+        # chain error mapping relies on ArithError <: ValueError
+        assert issubclass(ArithError, ValueError)
+
+
+class TestSafeOpsProperty:
+    """Seeded randomized property: each op agrees with Python big-int math
+    exactly when (and only when) the true result is representable as u64;
+    otherwise it raises ArithError — never wraps, never returns."""
+
+    BOUNDARY = [0, 1, 2, 2**31, 2**32 - 1, 2**32, 2**63 - 1, 2**63, U64_MAX - 1, U64_MAX]
+
+    def _values(self, rng, n=300):
+        vals = list(self.BOUNDARY)
+        vals += [rng.randrange(0, 2**64) for _ in range(n)]
+        vals += [rng.randrange(0, 2**34) for _ in range(n)]
+        return vals
+
+    def test_add_sub_mul_agree_with_bigint(self):
+        rng = random.Random(0xA11CE)
+        vals = self._values(rng)
+        for _ in range(2000):
+            a, b = rng.choice(vals), rng.choice(vals)
+            for op, ref in ((sa.safe_add, a + b), (sa.safe_sub, a - b), (sa.safe_mul, a * b)):
+                if 0 <= ref <= U64_MAX:
+                    assert op(a, b) == ref
+                else:
+                    with pytest.raises(ArithError):
+                        op(a, b)
+
+    def test_div_mod_agree_with_bigint(self):
+        rng = random.Random(0xB0B)
+        vals = self._values(rng)
+        for _ in range(1000):
+            a, b = rng.choice(vals), rng.choice(vals)
+            if b == 0:
+                with pytest.raises(ArithError):
+                    sa.safe_div(a, b)
+            else:
+                assert sa.safe_div(a, b) == a // b
+                assert sa.safe_mod(a, b) == a % b
+
+    def test_saturating_sub_never_raises(self):
+        rng = random.Random(0xCAFE)
+        vals = self._values(rng)
+        for _ in range(1000):
+            a, b = rng.choice(vals), rng.choice(vals)
+            assert sa.saturating_sub(a, b) == max(0, a - b)
+
+
+# -------------------------------------------------- state-level contracts
+
+
+@pytest.fixture(scope="module")
+def harness():
+    from lighthouse_tpu.chain.harness import BeaconChainHarness
+
+    return BeaconChainHarness(validator_count=16, fake_crypto=True)
+
+
+class TestBalanceMutatorContracts:
+    def test_increase_balance_overflow_is_typed(self, harness):
+        state = harness.head_state.copy()
+        state.balances[0] = U64_MAX - 10
+        with pytest.raises(ArithError):
+            h.increase_balance(state, 0, 11)
+        # and no silent wrap happened
+        assert int(state.balances[0]) == U64_MAX - 10
+
+    def test_decrease_balance_saturates(self, harness):
+        state = harness.head_state.copy()
+        state.balances[0] = 5
+        h.decrease_balance(state, 0, 10**18)
+        assert int(state.balances[0]) == 0
+
+    def test_slashings_accumulator_overflow_is_typed(self, harness):
+        state = harness.head_state.copy()
+        spec = harness.spec
+        epoch = h.get_current_epoch(state, spec)
+        state.slashings[epoch % spec.preset.epochs_per_slashings_vector] = U64_MAX
+        with pytest.raises(ArithError):
+            h.slash_validator(state, 1, spec)
+
+    def test_deposit_topup_overflow_is_typed(self, harness):
+        """A top-up deposit pushing an existing validator past u64 must be
+        a typed error, not a bignum balance."""
+        state = harness.head_state.copy()
+        types, spec = harness.types, harness.spec
+        state.balances[2] = U64_MAX - 1
+        deposit = types.Deposit(
+            proof=[b"\x00" * 32] * 33,
+            data=types.DepositData(
+                pubkey=bytes(state.validators[2].pubkey),
+                withdrawal_credentials=bytes(state.validators[2].withdrawal_credentials),
+                amount=32 * 10**9,
+                signature=b"\x00" * 96,
+            ),
+        )
+        with pytest.raises(ArithError):
+            apply_deposit(state, deposit, types, spec, verify_proof=False)
+
+
+class TestOverflowingBlockIsInvalid:
+    """End-to-end: a block processed onto a state whose balances sit at the
+    u64 edge must be REJECTED as BlockProcessingError — the sync-aggregate /
+    attestation reward path overflows, and the error surfaces typed."""
+
+    def test_block_rejected_not_crashed(self, harness):
+        harness.advance_slot()
+        signed = harness.produce_signed_block()
+        pre_state, _ = harness.chain.state_at_slot(int(signed.message.slot))
+        st = pre_state.copy()
+        for i in range(len(st.balances)):
+            st.balances[i] = U64_MAX - 1
+        with pytest.raises(BlockProcessingError) as ei:
+            per_block_processing(
+                st,
+                signed,
+                harness.types,
+                harness.spec,
+                strategy=BlockSignatureStrategy.NO_VERIFICATION,
+            )
+        assert "u64" in str(ei.value)
+        # import the block for real so the harness chain stays consistent
+        harness.chain.process_block(signed)
+
+    def test_randomized_near_max_balances_always_typed(self, harness):
+        """Property sweep: random single-validator balances near the u64
+        boundary either process fine or fail with BlockProcessingError —
+        never any other exception, never a balance above U64_MAX."""
+        rng = random.Random(0xD00D)
+        harness.advance_slot()
+        signed = harness.produce_signed_block()
+        pre_state, _ = harness.chain.state_at_slot(int(signed.message.slot))
+        for _ in range(8):
+            st = pre_state.copy()
+            victim = rng.randrange(len(st.balances))
+            st.balances[victim] = U64_MAX - rng.randrange(0, 10**9)
+            try:
+                per_block_processing(
+                    st,
+                    signed,
+                    harness.types,
+                    harness.spec,
+                    strategy=BlockSignatureStrategy.NO_VERIFICATION,
+                )
+            except BlockProcessingError:
+                pass  # rejected: the only acceptable failure mode
+            assert all(0 <= int(b) <= U64_MAX for b in st.balances)
+        harness.chain.process_block(signed)
+
+
+class TestInactivityPenaltyOverflowGuard:
+    """Regression for the epoch-processing int64 guard: when inactivity
+    scores are huge (long leak), the exact-int fallback must DRAIN the
+    validator (delta <= 0, no int64 wrap anywhere) — never enrich it."""
+
+    def test_huge_inactivity_scores_drain_not_enrich(self):
+        import numpy as np
+
+        from lighthouse_tpu.consensus import per_epoch as pe
+        from lighthouse_tpu.types.spec import minimal_spec
+
+        spec = minimal_spec()
+        n = 4
+
+        class Arrays:
+            pass
+
+        arrays = Arrays()
+        arrays.n = n
+        arrays.effective_balance = np.full(n, 32 * 10**9, dtype=np.int64)
+        arrays.activation_epoch = np.zeros(n, dtype=np.int64)
+        arrays.exit_epoch = np.full(n, 2**62, dtype=np.int64)
+        arrays.withdrawable_epoch = np.full(n, 2**62, dtype=np.int64)
+        arrays.slashed = np.zeros(n, dtype=bool)
+        arrays.active_mask = lambda e: pe.EpochArrays.active_mask(arrays, e)
+        arrays.eligible_mask = lambda e: pe.EpochArrays.eligible_mask(arrays, e)
+
+        prev_part = np.zeros(n, dtype=np.int64)  # nobody participated
+        # scores big enough that eb * score wraps int64 (the guard's branch)
+        inactivity = np.full(n, 10**10, dtype=np.int64)
+        new_inact, delta = pe._epoch_deltas_numpy(
+            arrays, prev_part, inactivity,
+            previous_epoch=10,
+            in_leak=True,
+            base_reward_per_increment=1000,
+            total_active_balance=int(arrays.effective_balance.sum()),
+            quotient=spec.inactivity_penalty_quotient_altair,
+            spec=spec,
+        )
+        # every eligible non-participant is penalized, never enriched
+        assert (delta < 0).all()
+        # and applying the delta can only drain a real balance, not wrap it
+        balances = np.full(n, 32 * 10**9, dtype=np.int64)
+        applied = np.maximum(0, balances + delta)
+        assert (applied == 0).all()

@@ -159,3 +159,64 @@ class TestReprocess:
             assert rq.block_imported(root) == 0
         finally:
             rq.shutdown()
+
+
+class TestDropDuringSync:
+    """drop_during_sync enforcement (reference beacon_processor: stale gossip
+    is discarded while the node is syncing, with a per-class drop metric)."""
+
+    def test_flagged_work_dropped_while_syncing(self):
+        syncing = [True]
+        p = BeaconProcessor(max_workers=1, is_syncing=lambda: syncing[0])
+        try:
+            ran = threading.Event()
+            ev = WorkEvent(
+                work_type=W.GOSSIP_ATTESTATION,
+                process=lambda _: ran.set(),
+                drop_during_sync=True,
+            )
+            assert p.send(ev) is False
+            assert not ran.wait(0.2)
+            assert p.metrics.dropped_during_sync[W.GOSSIP_ATTESTATION] == 1
+            # never even counted as received — it was discarded at ingress
+            assert W.GOSSIP_ATTESTATION not in p.metrics.received
+
+            # unflagged work (a block) still flows while syncing
+            done = threading.Event()
+            assert p.send(
+                WorkEvent(work_type=W.GOSSIP_BLOCK, process=lambda _: done.set())
+            )
+            assert done.wait(5.0)
+
+            # once synced, the same flagged work is processed again
+            syncing[0] = False
+            done2 = threading.Event()
+            assert p.send(
+                WorkEvent(
+                    work_type=W.GOSSIP_ATTESTATION,
+                    process=lambda _: done2.set(),
+                    drop_during_sync=True,
+                )
+            )
+            assert done2.wait(5.0)
+            assert p.metrics.dropped_during_sync[W.GOSSIP_ATTESTATION] == 1
+        finally:
+            p.shutdown()
+
+    def test_prometheus_counter_bumped(self):
+        from lighthouse_tpu.scheduler import processor as proc_mod
+
+        p = BeaconProcessor(max_workers=1, is_syncing=lambda: True)
+        try:
+            before = proc_mod.DROPPED_DURING_SYNC.get(work=W.GOSSIP_AGGREGATE)
+            p.send(
+                WorkEvent(
+                    work_type=W.GOSSIP_AGGREGATE,
+                    process=lambda _: None,
+                    drop_during_sync=True,
+                )
+            )
+            after = proc_mod.DROPPED_DURING_SYNC.get(work=W.GOSSIP_AGGREGATE)
+            assert after == before + 1
+        finally:
+            p.shutdown()
