@@ -166,6 +166,24 @@ def _stage_timer_summary(since: dict = None) -> dict:
     return out
 
 
+def _device_telemetry_summary() -> dict:
+    """Compile counts, occupancy, and host-fallback tallies accumulated in
+    this child (device_telemetry.py) — next to ``stage_timers`` so a
+    round-over-round regression is attributable to recompiles vs padding
+    waste vs execution without re-running anything."""
+    from lighthouse_tpu import device_telemetry
+
+    s = device_telemetry.summary()
+    return {
+        "programs": [
+            {k: p[k] for k in ("op", "shape", "compile_seconds", "invocations")}
+            for p in s["programs"]
+        ],
+        "occupancy": s["occupancy"],
+        "host_fallbacks": s["host_fallbacks"],
+    }
+
+
 def _child_main(force_cpu: bool) -> None:
     """Run the bench; checkpoint after each milestone; always exit 0."""
     os.environ.setdefault("JAX_ENABLE_X64", "0")
@@ -216,6 +234,7 @@ def _child_main(force_cpu: bool) -> None:
             out["cpu_measured_shape"] = f"{CPU_QUICK_N_SETS}x{N_KEYS}"
             out["cpu_warm_secs"] = round(warm, 1)
             out["stage_timers"] = _stage_timer_summary(base)
+            out["device_telemetry"] = _device_telemetry_summary()
             _checkpoint(out)
             return
 
@@ -236,6 +255,7 @@ def _child_main(force_cpu: bool) -> None:
         out["value"] = headline
         out["headline_warm_secs"] = round(warm, 1)
         out["stage_timers"] = _stage_timer_summary(base)
+        out["device_telemetry"] = _device_telemetry_summary()
         _checkpoint(out)
 
         # Scale config: 4,096 sets x 32-key committees (best-effort — a failure
@@ -255,6 +275,7 @@ def _child_main(force_cpu: bool) -> None:
             out["vs_baseline_4096x32"] = round(scale / BLST_64T_SETS_PER_SEC, 4)
             out["scale_warm_secs"] = round(warm, 1)
             out["stage_timers_4096x32"] = _stage_timer_summary(base)
+            out["device_telemetry"] = _device_telemetry_summary()  # cumulative
         except Exception as e:
             out["scale_bench_error"] = f"{type(e).__name__}: {e}"
     except Exception as e:
@@ -391,7 +412,7 @@ def _final_emit() -> None:
                   "headline_warm_secs", "sets_per_sec_4096x32", "vs_baseline_4096x32",
                   "scale_warm_secs", "scale_bench_error", "cpu_extrapolated",
                   "cpu_measured_shape", "cpu_warm_secs", "from_probe_loop",
-                  "stage_timers", "stage_timers_4096x32"):
+                  "stage_timers", "stage_timers_4096x32", "device_telemetry"):
             if k in result:
                 extra[k] = result[k]
         _emit(result["value"], result["value"] / BLST_64T_SETS_PER_SEC, extra)

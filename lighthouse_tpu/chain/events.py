@@ -10,6 +10,7 @@ from __future__ import annotations
 import queue
 import threading
 
+from .. import metrics
 from ..timeout_lock import TimeoutLock
 from typing import Dict, List, Optional, Tuple
 
@@ -41,6 +42,8 @@ class EventSubscription:
         self.topics = set(topics)
         self.q: "queue.Queue[Tuple[str, dict]]" = queue.Queue(maxsize=maxsize)
         self.dropped = 0
+        self.dropped_by_topic: Dict[str, int] = {}
+        self.sent = 0  # bumped by the SSE writer on each delivered event
 
     def poll(self, timeout: Optional[float] = None) -> Optional[Tuple[str, dict]]:
         try:
@@ -76,7 +79,32 @@ class EventBus:
                 try:
                     sub.q.put_nowait((topic, data))
                 except queue.Full:
+                    # Slow consumer: drop rather than stall the chain — but
+                    # never silently (per-subscriber tallies + a per-topic
+                    # counter, so a lossy /eth/v1/events stream is visible
+                    # on /metrics before a user reports missing heads).
                     sub.dropped += 1
+                    sub.dropped_by_topic[topic] = (
+                        sub.dropped_by_topic.get(topic, 0) + 1
+                    )
+                    metrics.SSE_EVENTS_DROPPED.inc(topic=topic)
+
+    def summary(self) -> List[dict]:
+        """Per-subscriber state for the operator surface
+        (``GET /lighthouse/events/subscribers``)."""
+        with self._lock:
+            subs = list(self._subs)
+        return [
+            {
+                "topics": sorted(sub.topics),
+                "queue_depth": sub.q.qsize(),
+                "queue_capacity": sub.q.maxsize,
+                "sent": sub.sent,
+                "dropped": sub.dropped,
+                "dropped_by_topic": dict(sub.dropped_by_topic),
+            }
+            for sub in subs
+        ]
 
     # Convenience emitters mirroring the reference's EventKind variants.
 

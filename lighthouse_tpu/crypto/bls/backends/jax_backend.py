@@ -9,6 +9,23 @@ from __future__ import annotations
 
 from typing import Optional
 
+_platform: Optional[str] = None
+
+
+def _device_platform() -> str:
+    """The executing device platform (cached — jax.devices() is cheap after
+    backend init, but the span field should cost a dict lookup, not a
+    client call, on every batch)."""
+    global _platform
+    if _platform is None:
+        try:
+            import jax
+
+            _platform = jax.devices()[0].platform
+        except Exception:
+            _platform = "unknown"
+    return _platform
+
 
 def verify_signature_sets(sets, seed: Optional[bytes] = None) -> bool:
     from .... import tracing
@@ -17,6 +34,11 @@ def verify_signature_sets(sets, seed: Optional[bytes] = None) -> bool:
     sets = list(sets)
     # The device-side parent span: the four stage spans recorded inside
     # verify_signature_sets_device (setup/dispatch/wait/verdict) nest here,
-    # so a trace shows host-vs-device time for THIS batch at a glance.
-    with tracing.span("device_verify", backend="jax", n_sets=len(sets)):
+    # and the callee stamps its flight-recorder seq (and host-fallback flag,
+    # when taken) onto this span — so a trace tree and the
+    # /lighthouse/device/batches ring cross-reference in both directions.
+    with tracing.span(
+        "device_verify", backend="jax", platform=_device_platform(),
+        n_sets=len(sets),
+    ):
         return verify_signature_sets_device(sets, seed=seed)

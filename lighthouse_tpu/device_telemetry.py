@@ -1,0 +1,426 @@
+"""Device-layer telemetry: compile-cache inventory, padding-waste accounting,
+a batch flight recorder, and the on-demand profiler hook.
+
+PR 2 gave the *host-side* pipeline span tracing — we can see that
+``device_batch_wait`` was slow, not *why*.  The three usual suspects on an
+accelerator are invisible without dedicated accounting:
+
+- **cold XLA compiles** — the bucketed jit entry points (``ops/verify.py``,
+  ``ops/epoch_device.py``, ``ops/sha256_device.py``) compile one executable
+  per static shape; a first-seen ``(n_bucket, k_bucket)`` pays seconds of
+  trace+compile inside what the histograms record as "dispatch".  Each
+  entry point reports its dispatch through :func:`note_dispatch`, which
+  keeps a host-side mirror of the jit cache (op, shape) → inventory entry,
+  increments ``device_program_compiles_total{op,shape}`` exactly once per
+  shape, and feeds ``device_program_compile_seconds`` on the compiling call.
+- **padding waste** — batches are padded up to bucket shapes; a 33-set
+  batch in a 64-bucket wastes half the device.  :func:`record_batch`
+  accounts ``live/nb`` occupancy into ``device_batch_occupancy_ratio``
+  histograms plus wasted-lane counters, making ``K_BUCKETS``/``N_BUCKETS``
+  tuning data-driven.
+- **device memory pressure** — :func:`device_memory_stats` samples
+  ``device.memory_stats()`` per device; a registered collector mirrors the
+  figures onto ``device_memory_bytes{device,stat}`` gauges on every scrape.
+
+Every dispatched batch also lands in the bounded :class:`FlightRecorder`
+ring (op, bucket shape, live sizes, per-stage durations, occupancy,
+verdict, host-fallback flag, **trace id**), served by
+``GET /lighthouse/device`` (summary) and ``GET /lighthouse/device/batches``.
+The trace id links each record to its PR 2 span tree, so
+``/lighthouse/traces/{id}`` and ``/lighthouse/device/batches``
+cross-reference in both directions (the trace carries ``flight_seq``).
+
+``POST /lighthouse/device/profile?seconds=N`` wraps ``jax.profiler.trace``
+via :func:`capture_profile` for a Perfetto-loadable device dump (a clean
+501 on CPU, where the device tracer has nothing to say).
+
+Everything here is HOST-side bookkeeping called strictly outside the jit
+boundary — the device-purity pass (``scripts/analysis/device_purity_pass``)
+stays at zero findings by construction.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import metrics, tracing
+
+FLIGHT_RECORDER_CAPACITY = int(
+    os.environ.get("LIGHTHOUSE_TPU_FLIGHT_RECORDER_CAPACITY", "256")
+)
+
+#: Hard cap on one profiler capture — the HTTP task spawner allows 30 s per
+#: handler, and the capture sleeps for its whole window.
+MAX_PROFILE_SECONDS = 10.0
+
+
+def _shape_label(shape: Tuple[int, ...]) -> str:
+    return "x".join(str(int(s)) for s in shape)
+
+
+def active_trace_id() -> Optional[str]:
+    """Trace id of the active span's trace (None outside any trace)."""
+    sp = tracing.current_span()
+    return sp.trace.trace_id if sp is not None else None
+
+
+# ------------------------------------------------------- compile-cache mirror
+
+
+class CompileCache:
+    """Host-side mirror of the jit executable caches.
+
+    jax caches one executable per (function, static shape); this mirror keys
+    the same way — ``(op, shape)`` — so "first seen here" == "compiled
+    there" for the bucketed entry points, whose dtypes are fixed.  The
+    compiling call's dispatch duration approximates trace+compile time
+    (subsequent dispatches of the same shape are sub-millisecond enqueues).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._programs: Dict[Tuple[str, Tuple[int, ...]], dict] = {}
+
+    def note_dispatch(self, op: str, shape: Tuple[int, ...], seconds: float) -> bool:
+        """Record one dispatch of ``op`` at ``shape``; True iff first seen
+        (the compiling call)."""
+        shape = tuple(int(s) for s in shape)
+        now = time.time()
+        with self._lock:
+            entry = self._programs.get((op, shape))
+            if entry is not None:
+                entry["invocations"] += 1
+                entry["last_used_ms"] = int(now * 1000)
+                return False
+            self._programs[(op, shape)] = {
+                "op": op,
+                "shape": _shape_label(shape),
+                "compile_seconds": round(seconds, 4),
+                "invocations": 1,
+                "first_seen_ms": int(now * 1000),
+                "last_used_ms": int(now * 1000),
+            }
+        metrics.DEVICE_PROGRAM_COMPILES.inc(op=op, shape=_shape_label(shape))
+        metrics.DEVICE_PROGRAM_COMPILE_SECONDS.observe(seconds, op=op)
+        return True
+
+    def inventory(self) -> List[dict]:
+        with self._lock:
+            return sorted(
+                (dict(e) for e in self._programs.values()),
+                key=lambda e: (e["op"], e["shape"]),
+            )
+
+    def clear(self) -> None:
+        """Reset the MIRROR only (tests) — jax's own cache is untouched, so
+        a cleared mirror over-counts 'compiles' until shapes re-register."""
+        with self._lock:
+            self._programs.clear()
+
+
+COMPILE_CACHE = CompileCache()
+
+
+def note_dispatch(op: str, shape: Tuple[int, ...], seconds: float) -> bool:
+    return COMPILE_CACHE.note_dispatch(op, shape, seconds)
+
+
+# ------------------------------------------------------------ flight recorder
+
+
+class FlightRecorder:
+    """Bounded ring of the last N device-batch records."""
+
+    def __init__(self, capacity: int = FLIGHT_RECORDER_CAPACITY):
+        self.capacity = capacity
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def record(self, entry: dict) -> dict:
+        with self._lock:
+            self._seq += 1
+            entry["seq"] = self._seq
+            self._ring.append(entry)
+        return entry
+
+    def recent(self, limit: int = 64, op: Optional[str] = None,
+               trace_id: Optional[str] = None) -> List[dict]:
+        """Newest-first records, optionally filtered by op / trace id."""
+        with self._lock:
+            records = list(self._ring)
+        records.reverse()
+        if op is not None:
+            records = [r for r in records if r.get("op") == op]
+        if trace_id is not None:
+            records = [r for r in records if r.get("trace_id") == trace_id]
+        return [dict(r) for r in records[:max(1, limit)]]
+
+    @property
+    def recorded_total(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+FLIGHT_RECORDER = FlightRecorder()
+
+# Host-fallback tally by reason (also on the Prometheus counter; kept here
+# so the /lighthouse/device summary needs no registry introspection).
+_FALLBACKS: Dict[str, int] = {}
+_FALLBACKS_LOCK = threading.Lock()
+
+
+def record_batch(
+    *,
+    op: str,
+    shape: Tuple[int, ...],
+    n_live: int,
+    live_keys: Optional[int] = None,
+    stages: Optional[Dict[str, float]] = None,
+    verdict: Optional[bool] = None,
+    host_fallback: bool = False,
+    fallback_reason: Optional[str] = None,
+    trace_id: Optional[str] = None,
+    compiled: bool = False,
+) -> dict:
+    """Account one dispatched device batch: occupancy histograms +
+    wasted-lane counters + a flight-recorder entry.  Returns the entry
+    (with its ``seq``) so callers can stamp the linkage on their span."""
+    shape = tuple(int(s) for s in shape)
+    nb = shape[0]
+    entry: Dict[str, Any] = {
+        "t_ms": int(time.time() * 1000),
+        "op": op,
+        "shape": _shape_label(shape),
+        "n_live": int(n_live),
+        "compiled": bool(compiled),
+        "host_fallback": bool(host_fallback),
+        "trace_id": trace_id,
+    }
+    if stages:
+        entry["stages_s"] = {k: round(float(v), 6) for k, v in stages.items()}
+    if verdict is not None:
+        entry["verdict"] = bool(verdict)
+    if fallback_reason is not None:
+        entry["fallback_reason"] = fallback_reason
+
+    if nb > 0:
+        set_ratio = min(1.0, n_live / nb)
+        entry["occupancy_sets"] = round(set_ratio, 4)
+        metrics.DEVICE_BATCH_OCCUPANCY_RATIO.observe(set_ratio, op=op, axis="sets")
+        metrics.DEVICE_BATCH_WASTED_LANES.inc(max(0, nb - n_live), op=op, axis="sets")
+    if live_keys is not None and len(shape) >= 2 and nb * shape[1] > 0:
+        lanes = nb * shape[1]
+        key_ratio = min(1.0, live_keys / lanes)
+        entry["live_keys"] = int(live_keys)
+        entry["occupancy_keys"] = round(key_ratio, 4)
+        metrics.DEVICE_BATCH_OCCUPANCY_RATIO.observe(key_ratio, op=op, axis="keys")
+        metrics.DEVICE_BATCH_WASTED_LANES.inc(
+            max(0, lanes - live_keys), op=op, axis="keys"
+        )
+    if host_fallback:
+        reason = fallback_reason or "unknown"
+        with _FALLBACKS_LOCK:
+            _FALLBACKS[reason] = _FALLBACKS.get(reason, 0) + 1
+    return FLIGHT_RECORDER.record(entry)
+
+
+def host_fallback_counts() -> Dict[str, int]:
+    with _FALLBACKS_LOCK:
+        return dict(_FALLBACKS)
+
+
+# ------------------------------------------------------------- device memory
+
+
+def device_memory_stats() -> List[dict]:
+    """Per-device ``memory_stats()`` snapshot.  CPU devices report nothing
+    (None / NotImplementedError); the summary still lists them so "no
+    memory telemetry on this platform" is explicit, not absent."""
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception:
+        return []
+    out = []
+    for d in devices:
+        entry: Dict[str, Any] = {
+            "id": int(d.id),
+            "platform": d.platform,
+            "kind": getattr(d, "device_kind", ""),
+        }
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            entry["stats"] = {
+                k: int(v) for k, v in stats.items() if isinstance(v, (int, float))
+            }
+        out.append(entry)
+    return out
+
+
+def _collect_device_memory() -> None:
+    """Scrape-time collector: mirror memory_stats onto gauges."""
+    for entry in device_memory_stats():
+        for stat, value in entry.get("stats", {}).items():
+            if "bytes" in stat:
+                metrics.DEVICE_MEMORY_BYTES.set(
+                    value, device=str(entry["id"]), stat=stat
+                )
+
+
+metrics.register_collector(_collect_device_memory)
+
+
+# ------------------------------------------------------------------ summary
+
+
+def _percentiles(values: List[float]) -> Optional[dict]:
+    if not values:
+        return None
+    values = sorted(values)
+    n = len(values)
+
+    def pick(q: float) -> float:
+        return values[min(n - 1, int(q * n))]
+
+    return {
+        "n": n,
+        "min": round(values[0], 4),
+        "p50": round(pick(0.50), 4),
+        "p90": round(pick(0.90), 4),
+        "p99": round(pick(0.99), 4),
+        "max": round(values[-1], 4),
+    }
+
+
+def summary() -> dict:
+    """The ``GET /lighthouse/device`` payload: compiled-program inventory,
+    occupancy percentiles over the flight-recorder window, host-fallback
+    tallies, device memory."""
+    records = FLIGHT_RECORDER.recent(limit=FLIGHT_RECORDER.capacity)
+    # Percentiles are grouped per op, matching the labeled histograms: an
+    # unpadded op (epoch_deltas always runs at occupancy 1.0) must not
+    # dilute the padding-waste signal of the bucketed ones.
+    occ: Dict[str, dict] = {}
+    for r in records:
+        if "occupancy_sets" not in r and "occupancy_keys" not in r:
+            continue
+        per_op = occ.setdefault(r["op"], {"sets": [], "keys": []})
+        if "occupancy_sets" in r:
+            per_op["sets"].append(r["occupancy_sets"])
+        if "occupancy_keys" in r:
+            per_op["keys"].append(r["occupancy_keys"])
+    occ = {
+        op: {axis: _percentiles(vals) for axis, vals in axes.items() if vals}
+        for op, axes in occ.items()
+    }
+    return {
+        "programs": COMPILE_CACHE.inventory(),
+        "occupancy": occ,
+        "host_fallbacks": host_fallback_counts(),
+        "flight_recorder": {
+            "capacity": FLIGHT_RECORDER.capacity,
+            "stored": len(FLIGHT_RECORDER),
+            "recorded_total": FLIGHT_RECORDER.recorded_total,
+        },
+        "memory": device_memory_stats(),
+    }
+
+
+def reset_for_tests() -> None:
+    """Clear all module state (compile mirror, ring, fallback tallies)."""
+    COMPILE_CACHE.clear()
+    FLIGHT_RECORDER.clear()
+    with _FALLBACKS_LOCK:
+        _FALLBACKS.clear()
+
+
+# ----------------------------------------------------------------- profiler
+
+
+class ProfilerUnavailable(RuntimeError):
+    """The device tracer cannot produce anything useful here (CPU)."""
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already in flight — one at a time."""
+
+
+_PROFILE_LOCK = threading.Lock()
+
+#: Dump directories retained under the profile root — older captures are
+#: pruned before each new one, so repeated POSTs can't fill /tmp.
+PROFILE_RETAIN = int(os.environ.get("LIGHTHOUSE_TPU_PROFILE_RETAIN", "8"))
+
+
+def _prune_profiles(root: str) -> None:
+    import shutil
+
+    try:
+        dumps = sorted(
+            e for e in os.listdir(root) if e.startswith("profile_")
+        )
+    except OSError:
+        return
+    for stale in dumps[: max(0, len(dumps) - (PROFILE_RETAIN - 1))]:
+        shutil.rmtree(os.path.join(root, stale), ignore_errors=True)
+
+
+def capture_profile(seconds: float, out_root: Optional[str] = None) -> dict:
+    """Capture ``seconds`` of ``jax.profiler.trace`` into a fresh directory
+    and return its path (loadable in Perfetto / TensorBoard).
+
+    Raises :class:`ProfilerUnavailable` on CPU — the device tracer has no
+    device activity to record there, and libtpu/plugin tracing is absent —
+    unless ``LIGHTHOUSE_TPU_FORCE_PROFILER=1`` (CI exercising the path).
+    Raises :class:`ProfilerBusy` when a capture is already running.
+    """
+    seconds = max(0.05, min(float(seconds), MAX_PROFILE_SECONDS))
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu" and not os.environ.get("LIGHTHOUSE_TPU_FORCE_PROFILER"):
+        raise ProfilerUnavailable(
+            "device profiling is unavailable on the cpu backend "
+            "(no device tracer; set LIGHTHOUSE_TPU_FORCE_PROFILER=1 to force)"
+        )
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        raise ProfilerBusy("a profiler capture is already in progress")
+    try:
+        root = out_root or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "lighthouse_tpu_profiles"
+        )
+        _prune_profiles(root)
+        path = os.path.join(root, f"profile_{int(time.time() * 1000)}")
+        os.makedirs(path, exist_ok=True)
+        t0 = time.perf_counter()
+        try:
+            with jax.profiler.trace(path):
+                time.sleep(seconds)
+        except Exception as e:
+            raise ProfilerUnavailable(f"jax.profiler.trace failed: {e}")
+        return {
+            "path": path,
+            "seconds": round(time.perf_counter() - t0, 3),
+            "platform": platform,
+            "hint": "load the trace in Perfetto (ui.perfetto.dev) or "
+                    "`tensorboard --logdir` on the returned path",
+        }
+    finally:
+        _PROFILE_LOCK.release()

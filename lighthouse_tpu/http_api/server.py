@@ -2164,6 +2164,72 @@ def lighthouse_trace_by_id(ctx):
     return {"data": tracing.trace_to_dict(trace)}
 
 
+# ------------------------------------------------------------ device routes
+# The device telemetry surface (device_telemetry.py): compile-cache
+# inventory, padding-waste occupancy, the batch flight recorder, device
+# memory, and the on-demand profiler — the "why was device_batch_wait
+# slow" complement of the traces API.
+
+
+@route("GET", "/lighthouse/device", P1)
+def lighthouse_device(ctx):
+    """Device telemetry summary: compiled-program inventory (op, bucket
+    shape, compile seconds, invocation counts), occupancy percentiles over
+    the flight-recorder window, host-fallback tallies, and per-device
+    ``memory_stats()``."""
+    from .. import device_telemetry
+
+    return {"data": device_telemetry.summary()}
+
+
+@route("GET", "/lighthouse/device/batches", P1)
+def lighthouse_device_batches(ctx):
+    """Recent device-batch flight-recorder records, newest first.  Query
+    params: ``op`` (e.g. ``bls_verify``), ``trace_id`` (cross-reference
+    from ``/lighthouse/traces/{id}``), ``limit``."""
+    from .. import device_telemetry
+
+    try:
+        limit = int(ctx.q1("limit", "64"))
+    except ValueError:
+        raise _bad("limit must be an integer")
+    return {"data": device_telemetry.FLIGHT_RECORDER.recent(
+        limit=max(1, min(limit, device_telemetry.FLIGHT_RECORDER.capacity)),
+        op=ctx.q1("op"),
+        trace_id=ctx.q1("trace_id"),
+    )}
+
+
+@route("POST", "/lighthouse/device/profile", P1)
+def lighthouse_device_profile(ctx):
+    """Capture ``?seconds=N`` (default 3, capped at 10 — the API task
+    spawner allows 30 s per handler) of ``jax.profiler.trace`` and return
+    the dump directory for Perfetto.  501 on CPU, 409 when a capture is
+    already running."""
+    from .. import device_telemetry
+
+    try:
+        seconds = float(ctx.q1("seconds", "3"))
+    except ValueError:
+        raise _bad("seconds must be a number")
+    if seconds <= 0:
+        raise _bad("seconds must be positive")
+    try:
+        return {"data": device_telemetry.capture_profile(seconds)}
+    except device_telemetry.ProfilerUnavailable as e:
+        raise ApiError(501, f"NOT_IMPLEMENTED: {e}")
+    except device_telemetry.ProfilerBusy as e:
+        raise ApiError(409, f"CONFLICT: {e}")
+
+
+@route("GET", "/lighthouse/events/subscribers", P1)
+def lighthouse_events_subscribers(ctx):
+    """Per-subscriber SSE state: topics, queue depth, delivered and dropped
+    event counts (the per-topic aggregates live on /metrics as
+    ``sse_events_{sent,dropped}_total``)."""
+    return {"data": ctx.chain.events.summary()}
+
+
 # ------------------------------------------------------------------ server
 
 
@@ -2320,6 +2386,10 @@ class _Handler(BaseHTTPRequestHandler):
                 chunk = f"event: {topic}\ndata: {json.dumps(data)}\n\n".encode()
                 self.wfile.write(chunk)
                 self.wfile.flush()
+                # Delivery accounting: the write succeeded (a broken pipe
+                # raises before this line), so the event reached the client.
+                sub.sent += 1
+                metrics.SSE_EVENTS_SENT.inc(topic=topic)
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:

@@ -297,6 +297,47 @@ DEVICE_VERDICT_SECONDS = histogram(
     "host-side verdict (W-at-infinity check + final-exp-is-one)",
 )
 
+# Device-layer telemetry (device_telemetry.py): XLA compile-cache
+# observability, padding-waste accounting, host-fallback tracking, and
+# device memory gauges — the "why was device_batch_wait slow" layer.
+DEVICE_PROGRAM_COMPILES = counter(
+    "device_program_compiles_total",
+    "first-seen (op, bucket shape) jit compilations, by op and shape",
+)
+DEVICE_PROGRAM_COMPILE_SECONDS = histogram(
+    "device_program_compile_seconds",
+    "trace+compile time of a first-seen bucket shape (the compiling dispatch)",
+)
+# Occupancy ratios live in (0, 1]: linear buckets, not the time-spaced set.
+OCCUPANCY_BUCKETS = (0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+DEVICE_BATCH_OCCUPANCY_RATIO = histogram(
+    "device_batch_occupancy_ratio",
+    "live/padded occupancy per device batch, by op and axis (sets|keys)",
+    buckets=OCCUPANCY_BUCKETS,
+)
+DEVICE_BATCH_WASTED_LANES = counter(
+    "device_batch_wasted_lanes_total",
+    "padding lanes dispatched with no live work, by op and axis (sets|keys)",
+)
+DEVICE_HOST_FALLBACK = counter(
+    "device_batch_host_fallback_total",
+    "device batches re-verified entirely on the host, by reason",
+)
+DEVICE_MEMORY_BYTES = gauge(
+    "device_memory_bytes",
+    "device memory_stats() figures sampled on scrape, by device and stat",
+)
+
+# SSE event bus (chain/events.py): per-topic delivery vs slow-consumer drops.
+SSE_EVENTS_SENT = counter(
+    "sse_events_sent_total",
+    "server-sent events written to a subscriber stream, by topic",
+)
+SSE_EVENTS_DROPPED = counter(
+    "sse_events_dropped_total",
+    "server-sent events dropped on a full subscriber queue, by topic",
+)
+
 # Additional block import stages (reference metrics.rs:40-161 has ~15).
 BLOCK_DA_CHECK_SECONDS = histogram(
     "beacon_block_da_check_seconds", "blob availability check inside import"

@@ -124,9 +124,18 @@ def epoch_deltas_device(
 ):
     """numpy in, numpy out — the device analog of the per_epoch numpy block.
     Returns ``(new_inactivity, balance_delta)`` (int64 arrays)."""
+    import time as _time
+
     from jax.experimental import enable_x64
 
+    from .. import device_telemetry
+
+    # One executable per (validator-count, in_leak) pair — in_leak is a
+    # static argument, so it forks the compiled program like a shape does.
+    op = "epoch_deltas_leak" if in_leak else "epoch_deltas"
+    n = int(np.asarray(arrays.effective_balance).shape[0])
     with enable_x64():
+        t_dispatch = _time.perf_counter()
         out = _deltas_kernel(
             jnp.asarray(arrays.effective_balance, dtype=jnp.int64),
             jnp.asarray(arrays.activation_epoch, dtype=jnp.int64),
@@ -144,7 +153,19 @@ def epoch_deltas_device(
             jnp.int64(quotient),
             in_leak=bool(in_leak),
         )
+        dispatch_s = _time.perf_counter() - t_dispatch
+        compiled = device_telemetry.note_dispatch(op, (n,), dispatch_s)
+        t_wait = _time.perf_counter()
         new_inactivity, balance_delta = jax.device_get(out)
+    device_telemetry.record_batch(
+        op=op,
+        shape=(n,),
+        n_live=n,
+        stages={"dispatch": dispatch_s,
+                "wait": _time.perf_counter() - t_wait},
+        trace_id=device_telemetry.active_trace_id(),
+        compiled=compiled,
+    )
     return (
         np.asarray(new_inactivity, dtype=np.int64),
         np.asarray(balance_delta, dtype=np.int64),

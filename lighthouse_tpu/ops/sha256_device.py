@@ -143,7 +143,13 @@ def install_device_hash(threshold_blocks: int = 8192) -> None:
 def hash_pairs_device(data: bytes) -> bytes:
     """Drop-in for ``types.ssz.set_hash_pairs_impl``: hash consecutive
     64-byte blocks on the device (padded to a shape bucket so every layer
-    size reuses a cached executable)."""
+    size reuses a cached executable).  Telemetry: the dispatch registers in
+    the compile-cache mirror and the batch's block-lane occupancy is
+    accounted (device_telemetry.py) — all host-side, outside the jit."""
+    import time as _time
+
+    from .. import device_telemetry
+
     n = len(data) // 64
     if n == 0:
         return b""
@@ -151,6 +157,20 @@ def hash_pairs_device(data: bytes) -> bytes:
     buf = np.zeros((nb, 64), dtype=np.uint8)
     buf[:n] = np.frombuffer(data[: n * 64], dtype=np.uint8).reshape(n, 64)
     words = buf.view(">u4").astype(np.uint32)  # big-endian words
-    out = np.asarray(_sha256_64byte_batch(jnp.asarray(words)))
+    t_dispatch = _time.perf_counter()
+    dev_out = _sha256_64byte_batch(jnp.asarray(words))
+    dispatch_s = _time.perf_counter() - t_dispatch
+    compiled = device_telemetry.note_dispatch("sha256_pairs", (nb,), dispatch_s)
+    t_wait = _time.perf_counter()
+    out = np.asarray(dev_out)
+    device_telemetry.record_batch(
+        op="sha256_pairs",
+        shape=(nb,),
+        n_live=n,
+        stages={"dispatch": dispatch_s,
+                "wait": _time.perf_counter() - t_wait},
+        trace_id=device_telemetry.active_trace_id(),
+        compiled=compiled,
+    )
     out_bytes = out[:n].astype(">u4").tobytes()
     return out_bytes

@@ -169,8 +169,13 @@ def verify_signature_sets_device(sets, seed: Optional[bytes] = None) -> bool:
     async enqueue; the block-until-ready timer is the device execution
     window a TPU perf investigation cares about.  Each stage span feeds its
     histogram AND the active trace (tracing.py), with batch-size and bucket
-    fields, so a slow batch inside a block import is attributable."""
-    from .. import metrics, tracing
+    fields, so a slow batch inside a block import is attributable.
+
+    Device telemetry (device_telemetry.py) rides the same seams: the
+    dispatch duration of a first-seen (nb, kb) registers in the compile
+    cache, occupancy is accounted against the padded shape, and the whole
+    batch lands in the flight recorder linked to the active trace id."""
+    from .. import device_telemetry, metrics, tracing
 
     sets = list(sets)
     if not sets:
@@ -178,27 +183,67 @@ def verify_signature_sets_device(sets, seed: Optional[bytes] = None) -> bool:
     with tracing.span(
         "device_batch_setup", hist=metrics.DEVICE_BATCH_SETUP_SECONDS,
         n_sets=len(sets),
-    ):
+    ) as sp_setup:
         rands = _rand_scalars(len(sets), seed)
         batch = build_batch(sets, rands)
     if batch is None:
         return False
     # compiled-program shape: (n_sets_bucket, max_keys_bucket)
     nb, kb = int(batch[0][0].shape[0]), int(batch[0][0].shape[1])
+    live_keys = sum(len(s.signing_keys) for s in sets)
     with tracing.span(
         "device_batch_dispatch", hist=metrics.DEVICE_DISPATCH_SECONDS,
         n_bucket=nb, k_bucket=kb,
-    ):
+    ) as sp_dispatch:
         fe, w_z = _device_verify(*batch)
+    # First dispatch of a shape pays trace+compile inside the call itself:
+    # the dispatch duration IS the compile-time observation for that shape.
+    compiled = device_telemetry.note_dispatch(
+        "bls_verify", (nb, kb), sp_dispatch.duration
+    )
+    if compiled:
+        sp_dispatch.fields["compiled"] = True
     with tracing.span(
         "device_batch_wait", hist=metrics.DEVICE_BLOCK_UNTIL_READY_SECONDS,
         n_bucket=nb, k_bucket=kb,
-    ):
+    ) as sp_wait:
         jax.block_until_ready((fe, w_z))
-    with tracing.span("device_batch_verdict", hist=metrics.DEVICE_VERDICT_SECONDS):
+    host_fallback = False
+    with tracing.span(
+        "device_batch_verdict", hist=metrics.DEVICE_VERDICT_SECONDS
+    ) as sp_verdict:
         if tower.fq2_from_limbs(np.asarray(w_z)).is_zero():
             # W at infinity: Miller value was poisoned; decide on the host.
+            # The single most expensive untracked event in the hot path —
+            # count it and stamp the active span so traces show it.
+            host_fallback = True
+            metrics.DEVICE_HOST_FALLBACK.inc(reason="w_at_infinity")
+            tracing.annotate(host_fallback=True, fallback_reason="w_at_infinity")
             from ..crypto.bls.backends import host
 
-            return host.verify_signature_sets(sets, seed=seed)
-        return pairing.fe_is_one(fe)
+            ok = host.verify_signature_sets(sets, seed=seed)
+        else:
+            ok = pairing.fe_is_one(fe)
+    rec = device_telemetry.record_batch(
+        op="bls_verify",
+        shape=(nb, kb),
+        n_live=len(sets),
+        live_keys=live_keys,
+        stages={
+            "setup": sp_setup.duration,
+            "dispatch": sp_dispatch.duration,
+            "wait": sp_wait.duration,
+            "verdict": sp_verdict.duration,
+        },
+        verdict=ok,
+        host_fallback=host_fallback,
+        fallback_reason="w_at_infinity" if host_fallback else None,
+        trace_id=device_telemetry.active_trace_id(),
+        compiled=compiled,
+    )
+    # Reverse link: the enclosing span (device_verify when routed through
+    # the backend) carries the flight-recorder seq of this batch.
+    tracing.annotate(flight_seq=rec["seq"])
+    if host_fallback:
+        tracing.annotate(host_fallback=True)
+    return ok

@@ -39,6 +39,9 @@ REGISTERING_MODULES = (
     "lighthouse_tpu.system_health",
     "lighthouse_tpu.scheduler.processor",
     "lighthouse_tpu.monitoring",
+    # registers the device-memory scrape collector; its metric constants
+    # live in lighthouse_tpu.metrics like everything else
+    "lighthouse_tpu.device_telemetry",
 )
 
 
