@@ -181,6 +181,17 @@ def _device_telemetry_summary() -> dict:
         ],
         "occupancy": s["occupancy"],
         "host_fallbacks": s["host_fallbacks"],
+        # Breaker state per op (device_supervisor.py): a benched run on a
+        # degraded device — breaker OPEN, batches on the host path — must be
+        # attributable from the artifact alone, not look like a regression.
+        "breakers": {
+            br["op"]: {
+                "state": br["state"],
+                "trips_total": br["trips_total"],
+                "consecutive_failures": br["consecutive_failures"],
+            }
+            for br in s["supervisor"]["breakers"]
+        },
     }
 
 

@@ -399,6 +399,12 @@ class BeaconChain:
         self.observed_block_roots: set = set()
         self._migrated_slot = 0
         self.events = EventBus()
+        # Device circuit-breaker transitions (device_supervisor.py) publish
+        # to this bus as `device_breaker` SSE events (weakly registered —
+        # harness-built chains drop out on GC).
+        from .. import device_supervisor
+
+        device_supervisor.register_event_bus(self.events)
         self._last_finalized_epoch = 0
         from .observed import ObservedCaches
 
@@ -716,6 +722,9 @@ class BeaconChain:
             blobs=blob_sidecars,
         )
         with tracing.span("store_write", hist=metrics.BLOCK_STORE_WRITE_SECONDS):
+            from .. import fault_injection
+
+            fault_injection.check("store.write")
             self._store_block(block_root, signed_block, state)
         self.observed_block_roots.add(block_root)
         self.pre_finalization_cache.block_processed(block_root)

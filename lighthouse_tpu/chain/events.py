@@ -23,6 +23,10 @@ TOPIC_BLOB_SIDECAR = "blob_sidecar"
 TOPIC_CHAIN_REORG = "chain_reorg"
 TOPIC_PAYLOAD_ATTRIBUTES = "payload_attributes"
 TOPIC_CONTRIBUTION_AND_PROOF = "contribution_and_proof"
+# Non-spec operator topic: device circuit-breaker transitions
+# (device_supervisor.py) — a subscriber watching this sees the device
+# degrade to the host path and recover, live.
+TOPIC_DEVICE_BREAKER = "device_breaker"
 
 ALL_TOPICS = (
     TOPIC_HEAD,
@@ -34,6 +38,7 @@ ALL_TOPICS = (
     TOPIC_EXIT,
     TOPIC_BLOB_SIDECAR,
     TOPIC_CHAIN_REORG,
+    TOPIC_DEVICE_BREAKER,
 )
 
 
@@ -132,6 +137,11 @@ class EventBus:
             "state": "0x" + state_root.hex(),
             "execution_optimistic": False,
         })
+
+    def device_breaker(self, *, op: str, **fields) -> None:
+        """Device circuit-breaker transition (called by the supervisor on
+        every state change: op, from, to, reason, timestamp_ms)."""
+        self.publish(TOPIC_DEVICE_BREAKER, {"op": op, **fields})
 
 
 def exit_event_payload(exit_) -> dict:

@@ -295,9 +295,21 @@ def epoch_deltas(arrays, prev_part, inactivity, **kwargs):
         max_inact = int(inactivity.max()) if n else 0
         spec = kwargs["spec"]
         if max_eb * (max_inact + spec.inactivity_score_bias) <= _I64_MAX:
+            from .. import device_supervisor
             from ..ops.epoch_device import epoch_deltas_device
 
-            return epoch_deltas_device(arrays, prev_part, inactivity, **kwargs)
+            # Supervised: a hung or failing device epoch pass resolves
+            # through the exact numpy path (no split retry — the kernel
+            # computes registry-wide participation sums, so halves are not
+            # independent).
+            op = "epoch_deltas_leak" if kwargs.get("in_leak") else "epoch_deltas"
+            return device_supervisor.run(
+                op,
+                lambda: epoch_deltas_device(arrays, prev_part, inactivity, **kwargs),
+                host_fn=lambda: _epoch_deltas_numpy(
+                    arrays, prev_part, inactivity, **kwargs
+                ),
+            )
     return _epoch_deltas_numpy(arrays, prev_part, inactivity, **kwargs)
 
 

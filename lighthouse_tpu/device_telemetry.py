@@ -108,6 +108,13 @@ class CompileCache:
         metrics.DEVICE_PROGRAM_COMPILE_SECONDS.observe(seconds, op=op)
         return True
 
+    def seen(self, op: str, shape: Tuple[int, ...]) -> bool:
+        """True iff (op, shape) already has a cached executable — i.e. the
+        next dispatch will NOT compile.  Lets fault-injection sites target
+        ``device.compile`` deterministically."""
+        with self._lock:
+            return (op, tuple(int(s) for s in shape)) in self._programs
+
     def inventory(self) -> List[dict]:
         with self._lock:
             return sorted(
@@ -194,6 +201,8 @@ def record_batch(
     fallback_reason: Optional[str] = None,
     trace_id: Optional[str] = None,
     compiled: bool = False,
+    breaker_state: Optional[str] = None,
+    dispatched: bool = True,
 ) -> dict:
     """Account one dispatched device batch: occupancy histograms +
     wasted-lane counters + a flight-recorder entry.  Returns the entry
@@ -215,13 +224,19 @@ def record_batch(
         entry["verdict"] = bool(verdict)
     if fallback_reason is not None:
         entry["fallback_reason"] = fallback_reason
+    if breaker_state is not None:
+        entry["breaker_state"] = breaker_state
 
-    if nb > 0:
+    if dispatched and nb > 0:
+        # A batch the breaker routed to the host never reached the device:
+        # it is still flight-recorded, but stays out of the occupancy /
+        # wasted-lane data that tunes K_BUCKETS/N_BUCKETS (no lanes were
+        # actually dispatched).
         set_ratio = min(1.0, n_live / nb)
         entry["occupancy_sets"] = round(set_ratio, 4)
         metrics.DEVICE_BATCH_OCCUPANCY_RATIO.observe(set_ratio, op=op, axis="sets")
         metrics.DEVICE_BATCH_WASTED_LANES.inc(max(0, nb - n_live), op=op, axis="sets")
-    if live_keys is not None and len(shape) >= 2 and nb * shape[1] > 0:
+    if dispatched and live_keys is not None and len(shape) >= 2 and nb * shape[1] > 0:
         lanes = nb * shape[1]
         key_ratio = min(1.0, live_keys / lanes)
         entry["live_keys"] = int(live_keys)
@@ -330,6 +345,8 @@ def summary() -> dict:
         op: {axis: _percentiles(vals) for axis, vals in axes.items() if vals}
         for op, axes in occ.items()
     }
+    from . import device_supervisor
+
     return {
         "programs": COMPILE_CACHE.inventory(),
         "occupancy": occ,
@@ -340,6 +357,10 @@ def summary() -> dict:
             "recorded_total": FLIGHT_RECORDER.recorded_total,
         },
         "memory": device_memory_stats(),
+        # Supervisor surface (device_supervisor.py): per-op breaker state,
+        # trip/probe counters, and the watchdog deadlines in force — the
+        # first thing to check when host_fallbacks is climbing.
+        "supervisor": device_supervisor.summary(),
     }
 
 

@@ -59,6 +59,17 @@ class Engine:
         and connection failures flip the state back to offline."""
         if self.state != STATE_ONLINE and not self.upcheck():
             raise EngineOffline(f"engine {self.api.url} is {self.state}")
+        from .. import fault_injection
+
+        if fault_injection.ACTIVE:
+            try:
+                fault_injection.check("engine.request")
+            except fault_injection.InjectedFault as e:
+                # An injected fault plays a dropped connection: the engine
+                # flips offline and recovers through the normal
+                # upcheck/cooldown machinery.
+                self.state = STATE_OFFLINE
+                raise EngineOffline(f"engine {self.api.url}: {e}") from e
         try:
             return fn(self.api)
         except EngineOffline:

@@ -2222,6 +2222,69 @@ def lighthouse_device_profile(ctx):
         raise ApiError(409, f"CONFLICT: {e}")
 
 
+# ------------------------------------------------------------ faults routes
+# The fault-injection admin surface (fault_injection.py): install, list,
+# and clear deterministic fault plans against the named injection points —
+# the chaos-testing companion of the device supervisor.
+
+
+@route("GET", "/lighthouse/faults", P1)
+def lighthouse_faults(ctx):
+    """Active fault plans with hit/fired counts, plus the known points."""
+    from .. import fault_injection
+
+    return {"data": fault_injection.summary()}
+
+
+@route("POST", "/lighthouse/faults", P1)
+def lighthouse_faults_install(ctx):
+    """Install fault plans.  Body: ``{"spec": "<plan;plan;...>"}`` (the
+    env-var syntax, e.g. ``device.dispatch[op=bls_verify]=error``) or a
+    single structured plan ``{"point": ..., "mode": ..., "op": ...,
+    "first_n": ..., "probability": ..., "seed": ..., "sleep_s": ...}``."""
+    from .. import fault_injection
+
+    body = ctx.body or {}
+    if not isinstance(body, dict):
+        raise _bad("body must be a JSON object")
+    try:
+        if "spec" in body:
+            plans = [
+                fault_injection.REGISTRY.install(p)
+                for p in fault_injection.parse_spec(body["spec"])
+            ]
+        elif "point" in body:
+            kwargs = {
+                k: body[k]
+                for k in ("op", "first_n", "probability", "seed",
+                          "sleep_s", "message")
+                if body.get(k) is not None
+            }
+            plans = [fault_injection.install(
+                body["point"], body.get("mode", "error"), **kwargs)]
+        else:
+            raise _bad("body needs a 'spec' string or a 'point' plan")
+    except (TypeError, ValueError) as e:
+        # TypeError: non-numeric probability/first_n/seed in a structured
+        # plan — a client input error, not a server bug.
+        raise _bad(str(e))
+    return {"data": [p.to_dict() for p in plans]}
+
+
+@route("DELETE", "/lighthouse/faults", P1)
+def lighthouse_faults_clear(ctx):
+    """Clear fault plans: all of them, ``?point=<point>``, or ``?id=<id>``."""
+    from .. import fault_injection
+
+    plan_id = ctx.q1("id")
+    try:
+        plan_id = None if plan_id is None else int(plan_id)
+    except ValueError:
+        raise _bad(f"id must be an integer, got {plan_id!r}")
+    cleared = fault_injection.clear(point=ctx.q1("point"), plan_id=plan_id)
+    return {"data": {"cleared": cleared}}
+
+
 @route("GET", "/lighthouse/events/subscribers", P1)
 def lighthouse_events_subscribers(ctx):
     """Per-subscriber SSE state: topics, queue depth, delivered and dropped
@@ -2429,6 +2492,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         self._handle("POST")
+
+    def do_DELETE(self):
+        self._handle("DELETE")
 
 
 class HttpApiServer:

@@ -328,6 +328,32 @@ DEVICE_MEMORY_BYTES = gauge(
     "device memory_stats() figures sampled on scrape, by device and stat",
 )
 
+# Device-execution supervisor (device_supervisor.py): the watchdog /
+# split-retry / circuit-breaker layer that keeps a failing device from
+# taking block import down with it.
+DEVICE_BREAKER_STATE = gauge(
+    "device_breaker_state",
+    "per-op circuit breaker state (0=closed, 1=open, 2=half_open), by op",
+)
+DEVICE_BREAKER_TRANSITIONS = counter(
+    "device_breaker_transitions_total",
+    "circuit breaker state transitions, by op and destination state",
+)
+DEVICE_DISPATCH_TIMEOUTS = counter(
+    "device_dispatch_timeouts_total",
+    "device dispatches abandoned by the watchdog deadline, by op",
+)
+DEVICE_SPLIT_RETRIES = counter(
+    "device_batch_split_retries_total",
+    "split-batch retries after a transient device error, by op and outcome",
+)
+
+# Validator-client remote signing (validator_client/web3signer.py).
+WEB3SIGNER_RETRIES = counter(
+    "web3signer_retries_total",
+    "web3signer requests retried after a connection error, by request kind",
+)
+
 # SSE event bus (chain/events.py): per-topic delivery vs slow-consumer drops.
 SSE_EVENTS_SENT = counter(
     "sse_events_sent_total",

@@ -128,12 +128,16 @@ def epoch_deltas_device(
 
     from jax.experimental import enable_x64
 
-    from .. import device_telemetry
+    from .. import device_telemetry, fault_injection
 
     # One executable per (validator-count, in_leak) pair — in_leak is a
     # static argument, so it forks the compiled program like a shape does.
     op = "epoch_deltas_leak" if in_leak else "epoch_deltas"
     n = int(np.asarray(arrays.effective_balance).shape[0])
+    if fault_injection.ACTIVE:
+        if not device_telemetry.COMPILE_CACHE.seen(op, (n,)):
+            fault_injection.check("device.compile", op=op)
+        fault_injection.check("device.dispatch", op=op)
     with enable_x64():
         t_dispatch = _time.perf_counter()
         out = _deltas_kernel(

@@ -114,6 +114,11 @@ def _bucket(n: int, buckets: Sequence[int] = N_BUCKETS) -> int:
 
 _installed = False
 
+# The true host kernel, captured before install_device_hash swaps the ssz
+# seam — the supervisor's fallback must reach the native/hashlib impl, not
+# recurse into the installed hybrid wrapper.
+_HOST_IMPL = None
+
 
 def install_device_hash(threshold_blocks: int = 8192) -> None:
     """Install a hybrid pair-hash kernel: device for layers of
@@ -121,12 +126,13 @@ def install_device_hash(threshold_blocks: int = 8192) -> None:
     existing host kernel (SHA-NI native / hashlib) below it.  Opt-in via
     ``LIGHTHOUSE_TPU_DEVICE_SHA=1`` at node assembly.  Idempotent — building
     several clients in one process (the simulator) must not stack wrappers."""
-    global _installed
+    global _installed, _HOST_IMPL
     if _installed:
         return
     from ..types import ssz as ssz_mod
 
     host_impl = ssz_mod._hash_pairs
+    _HOST_IMPL = host_impl
 
     def hybrid(data: bytes) -> bytes:
         n = len(data) // 64
@@ -140,15 +146,52 @@ def install_device_hash(threshold_blocks: int = 8192) -> None:
     _installed = True
 
 
+def _host_hash_pairs(data: bytes) -> bytes:
+    """The host kernel (SHA-NI native / hashlib) as the supervisor's
+    fallback.  Uses the impl captured before :func:`install_device_hash`
+    swapped the ssz seam — never the installed hybrid (which would recurse
+    right back into the device path)."""
+    if _HOST_IMPL is not None:
+        return _HOST_IMPL(data)
+    from ..types import ssz as ssz_mod
+
+    return ssz_mod._hash_pairs(data)
+
+
+def _dispatch_batch(words: np.ndarray, nb: int, stages: dict,
+                    state: dict) -> np.ndarray:
+    """Dispatch + wait on the supervisor's watchdog worker."""
+    import time as _time
+
+    from .. import device_telemetry, fault_injection
+
+    if fault_injection.ACTIVE:
+        if not device_telemetry.COMPILE_CACHE.seen("sha256_pairs", (nb,)):
+            fault_injection.check("device.compile", op="sha256_pairs")
+        fault_injection.check("device.dispatch", op="sha256_pairs")
+    t_dispatch = _time.perf_counter()
+    dev_out = _sha256_64byte_batch(jnp.asarray(words))
+    dispatch_s = _time.perf_counter() - t_dispatch
+    stages["dispatch"] = dispatch_s
+    if device_telemetry.note_dispatch("sha256_pairs", (nb,), dispatch_s):
+        state["compiled"] = True
+    t_wait = _time.perf_counter()
+    out = np.asarray(dev_out)
+    stages["wait"] = _time.perf_counter() - t_wait
+    return out
+
+
 def hash_pairs_device(data: bytes) -> bytes:
     """Drop-in for ``types.ssz.set_hash_pairs_impl``: hash consecutive
     64-byte blocks on the device (padded to a shape bucket so every layer
     size reuses a cached executable).  Telemetry: the dispatch registers in
     the compile-cache mirror and the batch's block-lane occupancy is
-    accounted (device_telemetry.py) — all host-side, outside the jit."""
-    import time as _time
+    accounted (device_telemetry.py) — all host-side, outside the jit.
 
-    from .. import device_telemetry
+    Supervised (device_supervisor.py): a hung or failing device batch
+    resolves through the host SHA kernel, split-retried once first — each
+    64-byte block is independent, so halves concatenate exactly."""
+    from .. import device_supervisor, device_telemetry
 
     n = len(data) // 64
     if n == 0:
@@ -157,20 +200,68 @@ def hash_pairs_device(data: bytes) -> bytes:
     buf = np.zeros((nb, 64), dtype=np.uint8)
     buf[:n] = np.frombuffer(data[: n * 64], dtype=np.uint8).reshape(n, 64)
     words = buf.view(">u4").astype(np.uint32)  # big-endian words
-    t_dispatch = _time.perf_counter()
-    dev_out = _sha256_64byte_batch(jnp.asarray(words))
-    dispatch_s = _time.perf_counter() - t_dispatch
-    compiled = device_telemetry.note_dispatch("sha256_pairs", (nb,), dispatch_s)
-    t_wait = _time.perf_counter()
-    out = np.asarray(dev_out)
+    # Worker-owned stage dicts, published when the device fn finishes (see
+    # verify.py): sharing them with an abandoned watchdog worker would race
+    # record_batch's iteration after a dispatch timeout.
+    holder: dict = {}
+
+    def device_fn() -> bytes:
+        stages_local: dict = {}
+        state_local: dict = {}
+        try:
+            out = _dispatch_batch(words, nb, stages_local, state_local)
+            return out[:n].astype(">u4").tobytes()
+        finally:
+            holder["stages"] = stages_local
+            holder["state"] = state_local
+
+    def _device_half(chunk: bytes) -> bytes:
+        # Raw device path for one half — must NOT recurse into the
+        # supervised entry point (the halves already run on the watchdog
+        # worker; re-entering run() would submit to the busy worker).
+        m = len(chunk) // 64
+        nbh = _bucket(m)
+        half = np.zeros((nbh, 64), dtype=np.uint8)
+        half[:m] = np.frombuffer(chunk, dtype=np.uint8).reshape(m, 64)
+        out = _dispatch_batch(
+            half.view(">u4").astype(np.uint32), nbh, {}, {}
+        )
+        return out[:m].astype(">u4").tobytes()
+
+    def split_fn():
+        mid = n // 2
+        if mid == 0:
+            raise ValueError("single-block batch cannot split")
+        return [
+            lambda: _device_half(data[: mid * 64]),
+            lambda: _device_half(data[mid * 64: n * 64]),
+        ]
+
+    info: dict = {}
+    out_bytes = device_supervisor.run(
+        "sha256_pairs",
+        device_fn,
+        host_fn=lambda: _host_hash_pairs(data),
+        split_fn=split_fn,
+        combine_fn=b"".join,
+        info=info,
+    )
+    reason = info.get("fallback_reason")
+    stages: dict = {}
+    compiled = False
+    if reason != "dispatch_timeout":
+        stages = holder.get("stages") or {}
+        compiled = (holder.get("state") or {}).get("compiled", False)
     device_telemetry.record_batch(
         op="sha256_pairs",
         shape=(nb,),
         n_live=n,
-        stages={"dispatch": dispatch_s,
-                "wait": _time.perf_counter() - t_wait},
+        stages=stages or None,
+        host_fallback=info.get("route") == "host",
+        fallback_reason=reason,
         trace_id=device_telemetry.active_trace_id(),
         compiled=compiled,
+        breaker_state=info.get("breaker_state"),
+        dispatched=reason != "breaker_open",
     )
-    out_bytes = out[:n].astype(">u4").tobytes()
     return out_bytes

@@ -161,6 +161,93 @@ def build_batch(sets, rands) -> Optional[tuple]:
     )
 
 
+def _device_batch_verdict(batch, nb: int, kb: int, stages: dict,
+                          state: dict) -> bool:
+    """Dispatch + block-until-ready + verdict for one marshalled batch.
+
+    Runs on the supervisor's watchdog worker thread (the caller's trace
+    context is re-attached there), so a hung ``block_until_ready`` strands
+    the worker, never block import.  Raises
+    ``device_supervisor.HostFallback("w_at_infinity")`` when the device
+    disclaims its own Miller value — the supervisor then re-verifies on the
+    host through the one shared fallback path.
+    """
+    from .. import device_supervisor, device_telemetry, fault_injection, metrics, tracing
+
+    if fault_injection.ACTIVE:
+        if not device_telemetry.COMPILE_CACHE.seen("bls_verify", (nb, kb)):
+            fault_injection.check("device.compile", op="bls_verify")
+        fault_injection.check("device.dispatch", op="bls_verify")
+    with tracing.span(
+        "device_batch_dispatch", hist=metrics.DEVICE_DISPATCH_SECONDS,
+        n_bucket=nb, k_bucket=kb,
+    ) as sp_dispatch:
+        fe, w_z = _device_verify(*batch)
+    # First dispatch of a shape pays trace+compile inside the call itself:
+    # the dispatch duration IS the compile-time observation for that shape.
+    compiled = device_telemetry.note_dispatch(
+        "bls_verify", (nb, kb), sp_dispatch.duration
+    )
+    if compiled:
+        sp_dispatch.fields["compiled"] = True
+        state["compiled"] = True
+    stages["dispatch"] = sp_dispatch.duration
+    with tracing.span(
+        "device_batch_wait", hist=metrics.DEVICE_BLOCK_UNTIL_READY_SECONDS,
+        n_bucket=nb, k_bucket=kb,
+    ) as sp_wait:
+        jax.block_until_ready((fe, w_z))
+    stages["wait"] = sp_wait.duration
+    with tracing.span(
+        "device_batch_verdict", hist=metrics.DEVICE_VERDICT_SECONDS
+    ) as sp_verdict:
+        try:
+            if tower.fq2_from_limbs(np.asarray(w_z)).is_zero():
+                # W at infinity: Miller value was poisoned; decide on the
+                # host — via the supervisor, so every fallback reason shares
+                # one mechanism and one counter.
+                sp_verdict.fields["host_fallback"] = True
+                raise device_supervisor.HostFallback("w_at_infinity")
+            ok = pairing.fe_is_one(fe)
+            if (
+                fault_injection.ACTIVE
+                and fault_injection.fire("device.result", op="bls_verify")
+                == "corrupt"
+            ):
+                tracing.annotate(corrupted_verdict=True)
+                ok = False
+        finally:
+            stages["verdict"] = sp_verdict.duration
+    return ok
+
+
+def _device_verify_subset(subset, seed: Optional[bytes]) -> bool:
+    """One half of a split-batch retry: the raw device path at the half's
+    own bucket shape.  No stage spans (the parent batch's flight record
+    carries the split outcome); the dispatch still registers in the compile
+    mirror — a half bucket can be a first-seen shape."""
+    from .. import device_supervisor, device_telemetry, fault_injection
+
+    rands = _rand_scalars(len(subset), seed)
+    batch = build_batch(subset, rands)
+    if batch is None:
+        return False
+    nb, kb = int(batch[0][0].shape[0]), int(batch[0][0].shape[1])
+    if fault_injection.ACTIVE:
+        fault_injection.check("device.dispatch", op="bls_verify")
+    import time as _time
+
+    t0 = _time.perf_counter()
+    fe, w_z = _device_verify(*batch)
+    device_telemetry.note_dispatch(
+        "bls_verify", (nb, kb), _time.perf_counter() - t0
+    )
+    jax.block_until_ready((fe, w_z))
+    if tower.fq2_from_limbs(np.asarray(w_z)).is_zero():
+        raise device_supervisor.HostFallback("w_at_infinity")
+    return pairing.fe_is_one(fe)
+
+
 def verify_signature_sets_device(sets, seed: Optional[bytes] = None) -> bool:
     """Drop-in batch verifier running the hot path on the JAX backend.
 
@@ -174,8 +261,15 @@ def verify_signature_sets_device(sets, seed: Optional[bytes] = None) -> bool:
     Device telemetry (device_telemetry.py) rides the same seams: the
     dispatch duration of a first-seen (nb, kb) registers in the compile
     cache, occupancy is accounted against the padded shape, and the whole
-    batch lands in the flight recorder linked to the active trace id."""
-    from .. import device_telemetry, metrics, tracing
+    batch lands in the flight recorder linked to the active trace id.
+
+    Execution is supervised (device_supervisor.py): the device leg runs
+    under a dispatch-deadline watchdog, transient device errors get one
+    split-batch retry, and a per-op circuit breaker routes batches to the
+    host golden model while the device is failing — so a device fault
+    degrades the chain to slow-but-correct instead of crashing it."""
+    from .. import device_supervisor, device_telemetry, metrics, tracing
+    from ..crypto.bls.backends import host
 
     sets = list(sets)
     if not sets:
@@ -191,55 +285,62 @@ def verify_signature_sets_device(sets, seed: Optional[bytes] = None) -> bool:
     # compiled-program shape: (n_sets_bucket, max_keys_bucket)
     nb, kb = int(batch[0][0].shape[0]), int(batch[0][0].shape[1])
     live_keys = sum(len(s.signing_keys) for s in sets)
-    with tracing.span(
-        "device_batch_dispatch", hist=metrics.DEVICE_DISPATCH_SECONDS,
-        n_bucket=nb, k_bucket=kb,
-    ) as sp_dispatch:
-        fe, w_z = _device_verify(*batch)
-    # First dispatch of a shape pays trace+compile inside the call itself:
-    # the dispatch duration IS the compile-time observation for that shape.
-    compiled = device_telemetry.note_dispatch(
-        "bls_verify", (nb, kb), sp_dispatch.duration
-    )
-    if compiled:
-        sp_dispatch.fields["compiled"] = True
-    with tracing.span(
-        "device_batch_wait", hist=metrics.DEVICE_BLOCK_UNTIL_READY_SECONDS,
-        n_bucket=nb, k_bucket=kb,
-    ) as sp_wait:
-        jax.block_until_ready((fe, w_z))
-    host_fallback = False
-    with tracing.span(
-        "device_batch_verdict", hist=metrics.DEVICE_VERDICT_SECONDS
-    ) as sp_verdict:
-        if tower.fq2_from_limbs(np.asarray(w_z)).is_zero():
-            # W at infinity: Miller value was poisoned; decide on the host.
-            # The single most expensive untracked event in the hot path —
-            # count it and stamp the active span so traces show it.
-            host_fallback = True
-            metrics.DEVICE_HOST_FALLBACK.inc(reason="w_at_infinity")
-            tracing.annotate(host_fallback=True, fallback_reason="w_at_infinity")
-            from ..crypto.bls.backends import host
+    stages = {"setup": sp_setup.duration}
+    # The watchdog worker writes stage durations into dicts IT owns and
+    # publishes them via this one-slot holder when the device fn finishes.
+    # The caller merges only when the worker completed (never on a
+    # dispatch timeout, where the abandoned worker may still be writing) —
+    # sharing the dicts directly would race record_batch's iteration.
+    holder: dict = {}
 
-            ok = host.verify_signature_sets(sets, seed=seed)
-        else:
-            ok = pairing.fe_is_one(fe)
+    def device_fn():
+        stages_local: dict = {}
+        state_local = {"compiled": False}
+        try:
+            return _device_batch_verdict(batch, nb, kb, stages_local, state_local)
+        finally:
+            holder["stages"] = stages_local
+            holder["state"] = state_local
+
+    def split_fn():
+        mid = len(sets) // 2
+        if mid == 0:
+            raise ValueError("single-set batch cannot split")
+        return [
+            lambda: _device_verify_subset(sets[:mid], seed),
+            lambda: _device_verify_subset(sets[mid:], seed),
+        ]
+
+    info: dict = {}
+    ok = device_supervisor.run(
+        "bls_verify",
+        device_fn,
+        host_fn=lambda: host.verify_signature_sets(sets, seed=seed),
+        split_fn=split_fn,
+        combine_fn=all,
+        info=info,
+    )
+    host_fallback = info.get("route") == "host"
+    reason = info.get("fallback_reason")
+    compiled = False
+    if reason != "dispatch_timeout":
+        stages.update(holder.get("stages") or {})
+        compiled = (holder.get("state") or {}).get("compiled", False)
     rec = device_telemetry.record_batch(
         op="bls_verify",
         shape=(nb, kb),
         n_live=len(sets),
         live_keys=live_keys,
-        stages={
-            "setup": sp_setup.duration,
-            "dispatch": sp_dispatch.duration,
-            "wait": sp_wait.duration,
-            "verdict": sp_verdict.duration,
-        },
+        stages=stages,
         verdict=ok,
         host_fallback=host_fallback,
-        fallback_reason="w_at_infinity" if host_fallback else None,
+        fallback_reason=reason,
         trace_id=device_telemetry.active_trace_id(),
         compiled=compiled,
+        breaker_state=info.get("breaker_state"),
+        # breaker-OPEN batches never reached the device: keep them out of
+        # the occupancy/wasted-lane tuning data.
+        dispatched=reason != "breaker_open",
     )
     # Reverse link: the enclosing span (device_verify when routed through
     # the backend) carries the flight-recorder seq of this batch.

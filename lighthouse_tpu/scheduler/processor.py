@@ -32,6 +32,8 @@ from .work import (
     DEFAULT_QUEUE_LENGTH,
     DEFAULT_QUEUE_LENGTHS,
     DRAIN_ORDER,
+    MAX_WORK_RETRIES,
+    RequeueWork,
     W,
     WorkEvent,
 )
@@ -57,6 +59,11 @@ WORK_EVENTS_DROPPED = _gm.counter(
 DROPPED_DURING_SYNC = _gm.counter(
     "beacon_processor_dropped_during_sync_total",
     "gossip work discarded because the node is syncing, by work class",
+)
+WORK_EVENTS_REQUEUED = _gm.counter(
+    "beacon_processor_work_requeued_total",
+    "work events re-enqueued after a RequeueWork (device dispatch "
+    "deadline exceeded and retryable), by work class",
 )
 
 
@@ -186,6 +193,22 @@ class BeaconProcessor:
     def _all_empty(self) -> bool:
         return all(not q for q in self._queues.values())
 
+    def _requeue(self, events: List[WorkEvent], wt: str) -> None:
+        """Deadline-exceeded (or otherwise retryable) work: re-enqueue each
+        event once instead of dropping it — by the retry, the device has
+        recovered or its breaker has opened and routed the work to the host
+        backend (device_supervisor.DispatchTimeout subclasses RequeueWork
+        exactly for this seam)."""
+        for ev in events:
+            if ev.retries < MAX_WORK_RETRIES:
+                ev.retries += 1
+                WORK_EVENTS_REQUEUED.inc(work=wt)
+                # A failed send already accounts for its own drop
+                # (queue-full / during-sync) — don't double-count here.
+                self.send(ev)
+            else:
+                self.metrics.bump(self.metrics.dropped, wt)
+
     def _run_worker(self, batch: List[WorkEvent]) -> None:
         wt = batch[0].work_type
         token = tracing.attach(batch[0].trace_parent)
@@ -204,12 +227,22 @@ class BeaconProcessor:
                     batch_wt = BATCH_RULES[wt][0]
                     self.metrics.bump(self.metrics.batches, batch_wt)
                     self.metrics.bump(self.metrics.batch_items, batch_wt, len(batch))
-                    batch[0].process_batch([ev.item for ev in batch])
-                    self.metrics.bump(self.metrics.processed, wt, len(batch))
+                    try:
+                        batch[0].process_batch([ev.item for ev in batch])
+                    except RequeueWork:
+                        self._requeue(batch, wt)
+                    else:
+                        self.metrics.bump(self.metrics.processed, wt, len(batch))
                 else:
-                    for ev in batch:
-                        ev.process(ev.item)
-                        self.metrics.bump(self.metrics.processed, wt)
+                    idx = 0
+                    try:
+                        for idx, ev in enumerate(batch):
+                            ev.process(ev.item)
+                            self.metrics.bump(self.metrics.processed, wt)
+                    except RequeueWork:
+                        # Only the raiser and the unprocessed tail retry;
+                        # events before it already ran to completion.
+                        self._requeue(batch[idx:], wt)
         except Exception:
             # A worker panic must not kill the node (reference logs + metric).
             self.metrics.bump(self.metrics.dropped, wt, len(batch))

@@ -103,6 +103,23 @@ DEFAULT_QUEUE_LENGTHS = {
 }
 DEFAULT_QUEUE_LENGTH = 4096
 
+#: How many times a worker-raised ``RequeueWork`` re-enqueues an event
+#: before it is dropped for good.
+MAX_WORK_RETRIES = 1
+
+
+class RequeueWork(RuntimeError):
+    """Raised by a work handler to ask the processor to re-enqueue the
+    event(s) instead of counting them dropped.
+
+    The canonical raiser is the device supervisor's ``DispatchTimeout``
+    (``device_supervisor.py``): a dispatch that exceeded its watchdog
+    deadline with no host fallback available is worth exactly one retry —
+    by then the device has recovered, or the circuit breaker has opened and
+    the retry routes to the host backend.  Each event retries at most
+    :data:`MAX_WORK_RETRIES` times (``WorkEvent.retries``).
+    """
+
 # Batchable work: (batch_work_type, max batch size).  Matches the reference's
 # 64-attestation coalescing (``lib.rs:200-201``) — and the device batch
 # buckets, so one drained batch feeds one TPU program invocation.
@@ -130,3 +147,6 @@ class WorkEvent:
     # enqueue instant, from which the worker records the queue-wait span.
     trace_parent: Any = None
     enqueued_at: float = 0.0
+    # Times this event has been re-enqueued after a RequeueWork (bounded by
+    # MAX_WORK_RETRIES).
+    retries: int = 0

@@ -15,7 +15,9 @@ Wire format (Web3Signer ETH2 API subset): POST
 from __future__ import annotations
 
 import json
+import random
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
@@ -27,11 +29,46 @@ class Web3SignerError(Exception):
 
 class Web3SignerClient:
     """The VC-side remote signer (pluggable into
-    ``ValidatorStore.add_remote_key``)."""
+    ``ValidatorStore.add_remote_key``).
 
-    def __init__(self, base_url: str, timeout: float = 5.0):
+    Requests carry a timeout and, on *connection* errors only, one
+    jittered-backoff retry (``web3signer_retries_total{kind}``) — the same
+    degrade-and-recover discipline as ``Engine.upcheck``'s cooldown in
+    ``execution_layer/engines.py``.  HTTP-level errors (4xx/5xx) are signer
+    verdicts and never retried; a duty window is ~4 s, so the backoff is
+    capped well below it.
+    """
+
+    def __init__(self, base_url: str, timeout: float = 5.0,
+                 retries: int = 1, backoff_s: float = 0.2):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.retries = max(0, int(retries))
+        self.backoff_s = backoff_s
+
+    def _request(self, req: "urllib.request.Request", kind: str):
+        """urlopen + parse with bounded connection-error retries."""
+        from .. import fault_injection, metrics
+
+        last: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            if attempt:
+                # Jittered backoff: a fleet of VCs hammered by the same
+                # signer blip must not retry in lockstep.
+                time.sleep(self.backoff_s * (1.0 + random.random()))
+                metrics.WEB3SIGNER_RETRIES.inc(kind=kind)
+            try:
+                fault_injection.check("signer.request")
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                # The signer answered: its verdict stands, no retry.
+                raise Web3SignerError(
+                    f"signer {e.code}: {e.read().decode(errors='replace')}"
+                ) from None
+            except (OSError, fault_injection.InjectedFault) as e:
+                last = e
+        raise Web3SignerError(f"signer unreachable: {last}") from None
 
     def sign(self, pubkey: bytes, signing_root: bytes) -> bytes:
         body = json.dumps({"signing_root": "0x" + bytes(signing_root).hex()}).encode()
@@ -40,13 +77,7 @@ class Web3SignerClient:
             data=body, method="POST",
             headers={"Content-Type": "application/json"},
         )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                obj = json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            raise Web3SignerError(f"signer {e.code}: {e.read().decode(errors='replace')}") from None
-        except OSError as e:
-            raise Web3SignerError(f"signer unreachable: {e}") from None
+        obj = self._request(req, kind="sign")
         try:
             return bytes.fromhex(obj["signature"][2:])
         except (KeyError, TypeError, ValueError) as e:
@@ -56,11 +87,10 @@ class Web3SignerClient:
         req = urllib.request.Request(
             f"{self.base_url}/api/v1/eth2/publicKeys", method="GET"
         )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return [bytes.fromhex(s[2:]) for s in json.loads(resp.read())]
-        except OSError as e:
-            raise Web3SignerError(f"signer unreachable: {e}") from None
+        return [
+            bytes.fromhex(s[2:])
+            for s in self._request(req, kind="public_keys")
+        ]
 
 
 class MockWeb3Signer:

@@ -63,9 +63,15 @@ class PragmaIndex:
 
 def iter_py_files(root: str, rel_dirs: Tuple[str, ...]) -> Iterator[Tuple[str, str]]:
     """Yield ``(abs_path, rel_path)`` for every .py file under the given
-    repo-relative directories, sorted for deterministic output."""
+    repo-relative directories — entries may also name single .py files
+    (top-level modules like ``lighthouse_tpu/device_supervisor.py``) —
+    sorted for deterministic output."""
     for rel_dir in rel_dirs:
         base = os.path.join(root, rel_dir)
+        if os.path.isfile(base):
+            if base.endswith(".py"):
+                yield base, os.path.relpath(base, root).replace(os.sep, "/")
+            continue
         for dirpath, dirnames, filenames in os.walk(base):
             dirnames.sort()
             dirnames[:] = [d for d in dirnames if d != "__pycache__"]

@@ -47,6 +47,11 @@ SCAN_DIRS = (
     "lighthouse_tpu/scheduler",
     "lighthouse_tpu/network",
     "lighthouse_tpu/store",
+    # Device-execution supervision (ISSUE 5): breaker/supervisor state and
+    # the fault-plan registry are lock-guarded and called from hot paths —
+    # they get the same lock-order/blocking-call discipline as the chain.
+    "lighthouse_tpu/device_supervisor.py",
+    "lighthouse_tpu/fault_injection.py",
 )
 
 LOCK_CTORS = frozenset({"TimeoutLock", "Lock", "RLock", "Condition"})

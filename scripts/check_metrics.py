@@ -42,6 +42,11 @@ REGISTERING_MODULES = (
     # registers the device-memory scrape collector; its metric constants
     # live in lighthouse_tpu.metrics like everything else
     "lighthouse_tpu.device_telemetry",
+    # fault_injections_fired_total lives with the registry it counts for
+    "lighthouse_tpu.fault_injection",
+    # breaker/watchdog metric constants live in lighthouse_tpu.metrics;
+    # importing validates the module wires against the registry cleanly
+    "lighthouse_tpu.device_supervisor",
 )
 
 

@@ -1,0 +1,297 @@
+"""Fault-injection layer tests (ISSUE 5): plan-spec parsing, scoping
+(op filter / fail-first-N / seeded probability), the disabled-is-a-noop
+contract, the admin endpoint (POST/GET/DELETE /lighthouse/faults), and the
+non-device injection points — store.write into block import, engine.request
+through the EL state machine, signer.request through the web3signer
+retry satellite."""
+
+import http.client
+import json
+
+import pytest
+
+from lighthouse_tpu import fault_injection as fi
+from lighthouse_tpu import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.reset_for_tests()
+    yield
+    fi.reset_for_tests()
+
+
+# ----------------------------------------------------------------- parsing
+
+
+class TestPlanParsing:
+    def test_bare_point(self):
+        p = fi.parse_plan("device.dispatch=error")
+        assert (p.point, p.mode, p.op) == ("device.dispatch", "error", None)
+
+    def test_op_selector(self):
+        p = fi.parse_plan("device.dispatch[op=bls_verify]=error")
+        assert p.op == "bls_verify"
+
+    def test_args(self):
+        p = fi.parse_plan("store.write=error:first_n=2")
+        assert p.first_n == 2
+        p = fi.parse_plan("device.dispatch=hang:sleep_s=1.5")
+        assert p.mode == "hang" and p.sleep_s == 1.5
+        p = fi.parse_plan("device.result=corrupt:probability=0.25,seed=7")
+        assert p.probability == 0.25 and p.seed == 7
+
+    def test_multi_plan_spec(self):
+        plans = fi.parse_spec(
+            "device.dispatch[op=bls_verify]=error; store.write=error:first_n=1"
+        )
+        assert [p.point for p in plans] == ["device.dispatch", "store.write"]
+
+    @pytest.mark.parametrize("bad", [
+        "nonsense",
+        "unknown.point=error",
+        "device.dispatch=explode",
+        "device.dispatch=error:first_n=2,probability=0.5",
+        "device.dispatch=error:probability=1.5",
+        "device.dispatch[shape=4]=error",
+        "device.dispatch=error:wat=1",
+    ])
+    def test_rejects_malformed(self, bad):
+        with pytest.raises(ValueError):
+            fi.parse_plan(bad)
+
+    def test_env_configuration(self, monkeypatch):
+        monkeypatch.setenv(
+            "LIGHTHOUSE_TPU_FAULTS",
+            "device.dispatch[op=bls_verify]=error;device.result=corrupt",
+        )
+        assert fi.configure_from_env() == 2
+        points = {p["point"] for p in fi.plans()}
+        assert points == {"device.dispatch", "device.result"}
+
+
+# ----------------------------------------------------------------- firing
+
+
+class TestFiring:
+    def test_disabled_is_noop(self):
+        assert fi.ACTIVE is False
+        assert fi.fire("device.dispatch", op="bls_verify") is None
+        fi.check("store.write")  # must not raise
+
+    def test_error_mode_raises_and_counts(self):
+        before = fi.FAULT_INJECTIONS_FIRED.get(
+            point="device.dispatch", mode="error")
+        fi.install("device.dispatch", "error")
+        assert fi.ACTIVE is True
+        with pytest.raises(fi.InjectedFault):
+            fi.check("device.dispatch", op="anything")
+        assert fi.FAULT_INJECTIONS_FIRED.get(
+            point="device.dispatch", mode="error") == before + 1
+
+    def test_op_filter(self):
+        fi.install("device.dispatch", "error", op="bls_verify")
+        fi.check("device.dispatch", op="sha256_pairs")  # no fire
+        with pytest.raises(fi.InjectedFault):
+            fi.check("device.dispatch", op="bls_verify")
+        plan = fi.plans()[0]
+        assert plan["hits"] == 1 and plan["fired"] == 1
+
+    def test_fail_first_n_then_passes(self):
+        fi.install("store.write", "error", first_n=2)
+        for _ in range(2):
+            with pytest.raises(fi.InjectedFault):
+                fi.check("store.write")
+        fi.check("store.write")  # 3rd call passes
+        fi.check("store.write")
+        plan = fi.plans()[0]
+        assert plan["hits"] == 4 and plan["fired"] == 2
+
+    def test_seeded_probability_is_deterministic(self):
+        def firing_pattern():
+            plan = fi.install("device.dispatch", "corrupt",
+                              probability=0.5, seed=1234)
+            pattern = [
+                fi.fire("device.dispatch") == "corrupt" for _ in range(32)
+            ]
+            fi.clear(plan_id=plan.plan_id)
+            return pattern
+
+        a, b = firing_pattern(), firing_pattern()
+        assert a == b
+        assert 0 < sum(a) < 32  # actually probabilistic, not constant
+
+    def test_corrupt_action_returned(self):
+        fi.install("device.result", "corrupt")
+        assert fi.fire("device.result") == "corrupt"
+        # check() swallows the action (for sites with nothing to corrupt)
+        fi.check("device.result")
+
+    def test_clear_by_point_and_id(self):
+        a = fi.install("device.dispatch", "error")
+        fi.install("store.write", "error")
+        assert fi.clear(plan_id=a.plan_id) == 1
+        assert fi.clear(point="store.write") == 1
+        assert fi.ACTIVE is False
+
+
+# ---------------------------------------------------------- admin endpoint
+
+
+@pytest.fixture(scope="module")
+def faults_api():
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+    from lighthouse_tpu.http_api import HttpApiServer
+
+    set_backend("fake")
+    harness = BeaconChainHarness(validator_count=8, fake_crypto=True)
+    server = HttpApiServer(harness.chain).start()
+    yield harness, server
+    server.stop()
+    set_backend("host")
+
+
+def _request(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        payload = None if body is None else json.dumps(body)
+        headers = {} if body is None else {"Content-Type": "application/json"}
+        conn.request(method, path, body=payload, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+class TestAdminEndpoint:
+    def test_install_list_clear_roundtrip(self, faults_api):
+        _, server = faults_api
+        status, out = _request(
+            server.port, "POST", "/lighthouse/faults",
+            body={"spec": "device.dispatch[op=bls_verify]=error:first_n=3"},
+        )
+        assert status == 200
+        (plan,) = out["data"]
+        assert plan["point"] == "device.dispatch"
+        assert plan["op"] == "bls_verify" and plan["first_n"] == 3
+
+        status, out = _request(server.port, "GET", "/lighthouse/faults")
+        assert status == 200
+        assert out["data"]["active"] is True
+        assert len(out["data"]["plans"]) == 1
+        assert "device.dispatch" in out["data"]["points"]
+
+        status, out = _request(
+            server.port, "DELETE", f"/lighthouse/faults?id={plan['id']}")
+        assert status == 200
+        assert out["data"]["cleared"] == 1
+        assert fi.ACTIVE is False
+
+    def test_install_structured_plan(self, faults_api):
+        _, server = faults_api
+        status, out = _request(
+            server.port, "POST", "/lighthouse/faults",
+            body={"point": "device.result", "mode": "corrupt",
+                  "probability": 0.5, "seed": 9},
+        )
+        assert status == 200
+        assert out["data"][0]["mode"] == "corrupt"
+        assert out["data"][0]["seed"] == 9
+        status, out = _request(server.port, "DELETE", "/lighthouse/faults")
+        assert status == 200 and out["data"]["cleared"] == 1
+
+    def test_bad_plans_are_400(self, faults_api):
+        _, server = faults_api
+        for body in (
+            {"spec": "unknown.point=error"},
+            {"point": "device.dispatch", "mode": "explode"},
+            {},
+        ):
+            status, _ = _request(
+                server.port, "POST", "/lighthouse/faults", body=body)
+            assert status == 400, body
+
+    def test_delete_with_non_numeric_id_is_400(self, faults_api):
+        _, server = faults_api
+        status, _ = _request(server.port, "DELETE", "/lighthouse/faults?id=abc")
+        assert status == 400
+
+
+# -------------------------------------------------- non-device fault points
+
+
+class TestStoreWriteFault:
+    def test_block_import_fails_then_recovers(self):
+        from lighthouse_tpu.chain import BeaconChainHarness
+
+        harness = BeaconChainHarness(validator_count=8, fake_crypto=True)
+        harness.extend_chain(1, attest=False)
+        fi.install("store.write", "error", first_n=1)
+        harness.advance_slot()
+        signed = harness.produce_signed_block()
+        with pytest.raises(fi.InjectedFault):
+            harness.chain.process_block(signed)
+        # The fault plan is exhausted; the block was never marked observed
+        # (that happens after the store write), so re-importing it lands it
+        # in the store and the chain keeps extending.
+        harness.chain.process_block(signed)
+        roots = harness.extend_chain(1, attest=False)
+        assert harness.chain.head_root == roots[-1]
+
+
+class TestEngineRequestFault:
+    def test_engine_flips_offline_and_recovers(self):
+        from lighthouse_tpu.execution_layer.engines import (
+            STATE_OFFLINE, STATE_ONLINE, Engine, EngineOffline,
+        )
+
+        class FakeApi:
+            url = "http://fake:8551"
+
+            def exchange_capabilities(self):
+                return ["engine_newPayloadV3"]
+
+        eng = Engine(FakeApi(), upcheck_cooldown=0.0)
+        assert eng.request(lambda api: "ok") == "ok"
+        assert eng.state == STATE_ONLINE
+
+        fi.install("engine.request", "error", first_n=1)
+        with pytest.raises(EngineOffline):
+            eng.request(lambda api: "ok")
+        assert eng.state == STATE_OFFLINE
+        # recovery through the normal upcheck machinery (cooldown=0)
+        assert eng.request(lambda api: "ok") == "ok"
+        assert eng.state == STATE_ONLINE
+
+
+class TestSignerRequestFault:
+    def test_sign_retries_once_on_connection_error(self):
+        from lighthouse_tpu.crypto.bls import api as bls
+        from lighthouse_tpu.validator_client.web3signer import (
+            MockWeb3Signer, Web3SignerClient,
+        )
+
+        sk = bls.SecretKey.random()
+        signer = MockWeb3Signer([sk]).start()
+        try:
+            client = Web3SignerClient(signer.url, backoff_s=0.01)
+            before = metrics.WEB3SIGNER_RETRIES.get(kind="sign")
+            fi.install("signer.request", "error", first_n=1)
+            root = b"\x22" * 32
+            sig = client.sign(sk.public_key().to_bytes(), root)
+            assert sig == sk.sign(root).to_bytes()
+            assert metrics.WEB3SIGNER_RETRIES.get(kind="sign") == before + 1
+        finally:
+            signer.stop()
+
+    def test_sign_fails_after_retries_exhausted(self):
+        from lighthouse_tpu.validator_client.web3signer import (
+            Web3SignerClient, Web3SignerError,
+        )
+
+        client = Web3SignerClient("http://127.0.0.1:9", timeout=0.2,
+                                  backoff_s=0.01)
+        fi.install("signer.request", "error")  # every attempt
+        with pytest.raises(Web3SignerError, match="unreachable"):
+            client.sign(b"\x01" * 48, b"\x02" * 32)
