@@ -209,14 +209,15 @@ def _child_main(force_cpu: bool) -> None:
             # environment; forcing the live config is the only reliable
             # off-switch (same pattern as __graft_entry__._dryrun_multichip_impl).
             jax.config.update("jax_platforms", "cpu")
-        try:
-            jax.config.update(
-                "jax_compilation_cache_dir",
-                os.environ.get("JAX_COMPILATION_CACHE_DIR", os.path.join(HERE, ".jax_cache")),
-            )
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        except Exception:
-            pass
+        # Shared persistent-cache setup (ops/compile_cache.py) — the same
+        # config the client/CLI startup path applies, so bench and node
+        # share one on-disk cache and compiles are paid once per binary.
+        # No explicit dir: default_cache_dir() applies the documented
+        # LIGHTHOUSE_TPU_COMPILE_CACHE_DIR > JAX_COMPILATION_CACHE_DIR >
+        # <repo>/.jax_cache precedence, identically everywhere.
+        from lighthouse_tpu.ops.compile_cache import configure_persistent_cache
+
+        configure_persistent_cache()
 
         devs = jax.devices()  # <-- known ~25-min tunnel hang point
         out["platform"] = devs[0].platform
@@ -225,8 +226,13 @@ def _child_main(force_cpu: bool) -> None:
         _checkpoint(out)
 
         from __graft_entry__ import _build_example
+        from lighthouse_tpu.ops.fq import active_fq_backend
         from lighthouse_tpu.ops.pairing import fe_is_one
         from lighthouse_tpu.ops.verify import _device_verify
+
+        # The fq_mul lowering the measured program traces with (int8 MXU vs
+        # int32 einsum) — a BENCH number is meaningless without it.
+        out["fq_backend"] = active_fq_backend()
 
         on_cpu = devs[0].platform == "cpu"
 
@@ -419,7 +425,8 @@ def _final_emit() -> None:
         if probe:
             result = probe
     if result is not None:
-        for k in ("platform", "init_secs", "smoke_sets_per_sec_1x1", "smoke_warm_secs",
+        for k in ("platform", "init_secs", "fq_backend",
+                  "smoke_sets_per_sec_1x1", "smoke_warm_secs",
                   "headline_warm_secs", "sets_per_sec_4096x32", "vs_baseline_4096x32",
                   "scale_warm_secs", "scale_bench_error", "cpu_extrapolated",
                   "cpu_measured_shape", "cpu_warm_secs", "from_probe_loop",

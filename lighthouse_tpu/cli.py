@@ -61,6 +61,10 @@ def run_beacon_node(args) -> int:
         spec = Eth2NetworkConfig.from_testnet_dir(args.testnet_dir).spec
     else:
         spec = _spec_for(args.network)
+    if getattr(args, "aot_warmup", False):
+        # The builder's compile-cache hook reads the env flag; the CLI flag
+        # is just its spelled-out form.
+        os.environ["LIGHTHOUSE_TPU_AOT_WARMUP"] = "1"
     builder = ClientBuilder().with_spec(spec).with_bls_backend(args.bls_backend)
     if getattr(args, "checkpoint_sync_url", None):
         builder.with_checkpoint_sync(args.checkpoint_sync_url)
@@ -581,6 +585,10 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--genesis-state", default=None)
     bn.add_argument("--slasher", action="store_true")
     bn.add_argument("--bls-backend", default="jax", choices=["jax", "host", "fake"])
+    bn.add_argument("--aot-warmup", action="store_true",
+                    help="ahead-of-time compile the standard device buckets "
+                         "at startup (background thread; persistent compile "
+                         "cache makes repeat starts near-instant)")
     bn.add_argument("--debug", action="store_true")
     bn.add_argument("--log-json", action="store_true", dest="log_json",
                     help="emit structured JSON log lines (one object per line)")

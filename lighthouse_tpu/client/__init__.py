@@ -173,6 +173,20 @@ class ClientBuilder:
         from ..crypto.bls.backends import set_backend
 
         set_backend(self._bls_backend)  # node assembly selects the device path
+        if self._bls_backend == "jax":
+            # Persistent compile cache + optional AOT bucket warmup
+            # (ops/compile_cache.py): cold XLA compiles are paid once per
+            # binary, not per node restart — and with
+            # LIGHTHOUSE_TPU_AOT_WARMUP=1 the standard buckets compile on a
+            # background thread before the first batch arrives.
+            try:
+                from ..ops import compile_cache
+
+                compile_cache.configure_persistent_cache()
+                compile_cache.maybe_warmup_from_env()
+            except Exception:
+                log.warning("persistent compile-cache setup failed",
+                            exc_info=True)
         if os.environ.get("LIGHTHOUSE_TPU_DEVICE_SHA") == "1":
             from ..ops.sha256_device import install_device_hash
 

@@ -75,6 +75,14 @@ DEFAULT_DEADLINES = {
 }
 DEFAULT_DEADLINE_S = 300.0
 
+#: Ops whose device kernels compute batch-GLOBAL reductions (the epoch pass
+#: sums participation over the whole registry): the halves of a split are
+#: not independent sub-problems, so split-batch retry is forbidden for them
+#: no matter what a caller passes — with 4096-scale standard buckets a
+#: mis-wired split would silently change the op's semantics, not just its
+#: shape.  Failures for these ops go straight to the host fallback.
+NO_SPLIT_OPS = frozenset({"epoch_deltas", "epoch_deltas_leak"})
+
 
 class DispatchTimeout(RequeueWork):
     """A device dispatch exceeded its watchdog deadline.
@@ -428,6 +436,9 @@ class DeviceSupervisor:
         """
         if info is None:
             info = {}
+        if split_fn is not None and op in NO_SPLIT_OPS:
+            log.warning("split_fn ignored for batch-global op", op=op)
+            split_fn = None
         br = self.breaker(op)
         route, transitions = br.route()
         self._emit(op, transitions)

@@ -108,6 +108,41 @@ class CompileCache:
         metrics.DEVICE_PROGRAM_COMPILE_SECONDS.observe(seconds, op=op)
         return True
 
+    def note_warmup(self, op: str, shape: Tuple[int, ...], seconds: float,
+                    hit: bool) -> None:
+        """Record an ahead-of-time warmup of ``(op, shape)`` (compile_cache
+        ``warmup_standard_buckets``).  Pre-seeds the mirror so the shape's
+        first production dispatch is NOT misattributed as a compile, and
+        keeps the compiles counter honest: only a warmup MISS (a real XLA
+        compile, vs a persistent-cache deserialize) increments
+        ``device_program_compiles_total``."""
+        shape = tuple(int(s) for s in shape)
+        now = time.time()
+        with self._lock:
+            entry = self._programs.get((op, shape))
+            # A production dispatch can race the background warmup compile
+            # for the same shape; if it won, note_dispatch already counted
+            # the compile — the warmup must not count it a second time.
+            already_counted = entry is not None
+            if entry is None:
+                entry = self._programs[(op, shape)] = {
+                    "op": op,
+                    "shape": _shape_label(shape),
+                    "compile_seconds": round(seconds, 4),
+                    "invocations": 0,
+                    "first_seen_ms": int(now * 1000),
+                    "last_used_ms": int(now * 1000),
+                }
+            entry["source"] = "warmup"
+            entry["warmup_outcome"] = "hit" if hit else "miss"
+        outcome = "hit" if hit else "miss"
+        metrics.DEVICE_AOT_WARMUP.inc(op=op, shape=_shape_label(shape),
+                                      outcome=outcome)
+        metrics.DEVICE_AOT_WARMUP_SECONDS.observe(seconds, op=op)
+        if not hit and not already_counted:
+            metrics.DEVICE_PROGRAM_COMPILES.inc(op=op, shape=_shape_label(shape))
+            metrics.DEVICE_PROGRAM_COMPILE_SECONDS.observe(seconds, op=op)
+
     def seen(self, op: str, shape: Tuple[int, ...]) -> bool:
         """True iff (op, shape) already has a cached executable — i.e. the
         next dispatch will NOT compile.  Lets fault-injection sites target
@@ -134,6 +169,10 @@ COMPILE_CACHE = CompileCache()
 
 def note_dispatch(op: str, shape: Tuple[int, ...], seconds: float) -> bool:
     return COMPILE_CACHE.note_dispatch(op, shape, seconds)
+
+
+def note_warmup(op: str, shape: Tuple[int, ...], seconds: float, hit: bool) -> None:
+    COMPILE_CACHE.note_warmup(op, shape, seconds, hit)
 
 
 # ------------------------------------------------------------ flight recorder

@@ -323,6 +323,17 @@ DEVICE_HOST_FALLBACK = counter(
     "device_batch_host_fallback_total",
     "device batches re-verified entirely on the host, by reason",
 )
+# AOT warmup (ops/compile_cache.py): standard buckets compiled at startup so
+# production traffic never pays a cold XLA compile.  ``outcome`` separates a
+# persistent-cache deserialize (hit) from a real compile (miss).
+DEVICE_AOT_WARMUP = counter(
+    "device_aot_warmup_total",
+    "ahead-of-time bucket compilations at startup, by op, shape and outcome (hit|miss)",
+)
+DEVICE_AOT_WARMUP_SECONDS = histogram(
+    "device_aot_warmup_seconds",
+    "wall time of one ahead-of-time bucket warmup (lower+compile), by op",
+)
 DEVICE_MEMORY_BYTES = gauge(
     "device_memory_bytes",
     "device memory_stats() figures sampled on scrape, by device and stat",

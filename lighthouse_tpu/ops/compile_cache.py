@@ -1,0 +1,201 @@
+"""Persistent XLA compilation cache + ahead-of-time bucket warmup.
+
+The fused BLS verifier pays 20-165 s of trace+compile per (n_bucket,
+k_bucket) shape (PERF.md round 5, device_telemetry measures it per shape)
+— and before this module every PROCESS paid it again: bench.py, the
+scripts and the test conftest each carried their own copy of the
+``jax_compilation_cache_dir`` config block, while the actual node startup
+path (``client.ClientBuilder.build`` / the CLI) had none, so a restarted
+node recompiled everything.  This module is the one shared implementation:
+
+- :func:`configure_persistent_cache` points jax's persistent compile cache
+  at a stable on-disk directory (``LIGHTHOUSE_TPU_COMPILE_CACHE_DIR`` >
+  ``JAX_COMPILATION_CACHE_DIR`` > ``<repo>/.jax_cache``), so cold compiles
+  are paid once per *binary*, not once per process restart.
+- :func:`warmup_standard_buckets` ahead-of-time compiles the standard
+  dispatch buckets (``jit(...).lower(...).compile()`` on abstract shapes —
+  no example batch needed) before traffic arrives, classifying each bucket
+  as a persistent-cache ``hit`` (fast deserialize) or ``miss`` (real
+  compile) and feeding the existing compile-cache telemetry
+  (``device_program_compiles_total`` / ``device_aot_warmup_total``; the
+  mirror is pre-seeded so the bucket's first production dispatch is not
+  misattributed as a compile).
+- :func:`maybe_warmup_from_env` is the startup hook: opt-in via
+  ``LIGHTHOUSE_TPU_AOT_WARMUP=1`` (bucket list override
+  ``LIGHTHOUSE_TPU_AOT_BUCKETS="128x32,4096x32"``), run on a daemon thread
+  so node startup never blocks on the compiler.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+CACHE_DIR_ENV = "LIGHTHOUSE_TPU_COMPILE_CACHE_DIR"
+AOT_WARMUP_ENV = "LIGHTHOUSE_TPU_AOT_WARMUP"
+AOT_BUCKETS_ENV = "LIGHTHOUSE_TPU_AOT_BUCKETS"
+
+#: Production standard buckets warmed by default: the headline config and
+#: the 4096-set top bucket (ops/verify.py N_BUCKETS[-1]).
+DEFAULT_WARMUP_BUCKETS: Tuple[Tuple[int, int], ...] = ((128, 32), (4096, 32))
+
+#: A warmup faster than this is a persistent-cache deserialize, not a
+#: compile — the real compiles of these programs take tens of seconds on
+#: every platform measured (PERF.md).
+WARMUP_HIT_THRESHOLD_S = 5.0
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def default_cache_dir() -> str:
+    return (
+        os.environ.get(CACHE_DIR_ENV)
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or os.path.join(_REPO_ROOT, ".jax_cache")
+    )
+
+
+def configure_persistent_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Point jax's persistent compile cache at ``cache_dir`` (default: the
+    env/repo resolution above).  Returns the directory in force, or None if
+    this jax build rejects the config (startup must never fail on a cache).
+    """
+    import jax
+
+    path = cache_dir or default_cache_dir()
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        return None
+    return path
+
+
+def _env_buckets() -> Optional[List[Tuple[int, int]]]:
+    """Parse ``LIGHTHOUSE_TPU_AOT_BUCKETS`` ("128x32,4096x32"; case-insensitive
+    separator, empty parts skipped).  Raises ValueError naming the variable on
+    garbage — callers decide whether to fall back."""
+    raw = os.environ.get(AOT_BUCKETS_ENV, "").strip()
+    if not raw:
+        return None
+    buckets = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        nb, sep, kb = part.lower().partition("x")
+        try:
+            bucket = (int(nb), int(kb))
+        except ValueError:
+            raise ValueError(
+                f"{AOT_BUCKETS_ENV}={raw!r}: expected e.g. \"128x32,4096x32\""
+            ) from None
+        buckets.append(bucket)
+    return buckets or None
+
+
+def _cache_file_count() -> Optional[int]:
+    """Number of entries in the live persistent-cache dir, or None when the
+    cache is unset/unreadable (then hit/miss falls back to wall clock)."""
+    import jax
+
+    try:
+        path = jax.config.jax_compilation_cache_dir
+        if not path:
+            return None
+        return len(os.listdir(path))
+    except Exception:
+        return None
+
+
+def _bucket_shape_structs(nb: int, kb: int):
+    """Abstract argument shapes of ``_device_verify`` for one bucket — the
+    exact dtypes/shapes ``build_batch`` marshals, with no host crypto."""
+    import jax
+    import numpy as np
+
+    i32 = np.int32
+    pk = tuple(jax.ShapeDtypeStruct((nb, kb, 25), i32) for _ in range(3))
+    sig = tuple(jax.ShapeDtypeStruct((nb, 2, 25), i32) for _ in range(3))
+    msg = tuple(jax.ShapeDtypeStruct((nb, 2, 25), i32) for _ in range(2))
+    wbits = jax.ShapeDtypeStruct((nb, 64), i32)
+    live = jax.ShapeDtypeStruct((nb,), np.bool_)
+    return pk, sig, msg, wbits, live
+
+
+def warmup_standard_buckets(
+    buckets: Optional[Sequence[Tuple[int, int]]] = None,
+    *,
+    hit_threshold_s: float = WARMUP_HIT_THRESHOLD_S,
+) -> List[dict]:
+    """AOT-compile the standard verifier buckets; returns per-bucket records
+    ``{"op", "shape", "seconds", "outcome"}`` (outcome hit|miss|error).
+
+    Telemetry rides the existing compile-cache machinery
+    (:func:`device_telemetry.note_warmup`), so ``GET /lighthouse/device``
+    shows warmed buckets before the first batch arrives.
+    """
+    from .. import device_telemetry
+    from ..logs import get_logger
+    from .verify import _device_verify
+
+    log = get_logger("compile_cache")
+    if buckets is None:
+        try:
+            buckets = _env_buckets()
+        except ValueError as e:
+            # A bad env list must not kill the daemon thread OR silently
+            # disable the warmup the operator explicitly enabled: log loud,
+            # warm the defaults.
+            log.warning("AOT bucket list invalid, warming defaults", error=str(e))
+            buckets = None
+        buckets = buckets or list(DEFAULT_WARMUP_BUCKETS)
+    results: List[dict] = []
+    for nb, kb in buckets:
+        record = {"op": "bls_verify", "shape": f"{int(nb)}x{int(kb)}"}
+        t0 = time.perf_counter()
+        cache_files_before = _cache_file_count()
+        try:
+            _device_verify.lower(*_bucket_shape_structs(int(nb), int(kb))).compile()
+        except Exception as e:  # noqa: BLE001 — warmup must never kill startup
+            record["seconds"] = round(time.perf_counter() - t0, 3)
+            record["outcome"] = "error"
+            record["error"] = f"{type(e).__name__}: {e}"
+            log.warning("AOT warmup failed", **record)
+            results.append(record)
+            continue
+        dt = time.perf_counter() - t0
+        # A real compile writes new entries into the persistent cache dir
+        # (min_compile_time 1.0s); a deserialize does not.  The wall-clock
+        # threshold is the fallback when the dir is not observable.
+        cache_files_after = _cache_file_count()
+        if cache_files_before is not None and cache_files_after is not None:
+            hit = cache_files_after == cache_files_before
+        else:
+            hit = dt < hit_threshold_s
+        record["seconds"] = round(dt, 3)
+        record["outcome"] = "hit" if hit else "miss"
+        device_telemetry.note_warmup("bls_verify", (int(nb), int(kb)), dt, hit)
+        log.info("AOT warmup", **record)
+        results.append(record)
+    return results
+
+
+def maybe_warmup_from_env(*, background: bool = True) -> Optional[threading.Thread]:
+    """Startup hook: run the AOT warmup iff ``LIGHTHOUSE_TPU_AOT_WARMUP`` is
+    truthy.  Background by default so node startup never blocks on XLA;
+    returns the thread (or None when disabled / when run inline)."""
+    if os.environ.get(AOT_WARMUP_ENV, "").strip().lower() not in ("1", "true", "yes"):
+        return None
+    if not background:
+        warmup_standard_buckets()
+        return None
+    thread = threading.Thread(
+        target=warmup_standard_buckets, name="aot-warmup", daemon=True
+    )
+    thread.start()
+    return thread

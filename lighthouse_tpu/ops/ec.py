@@ -34,6 +34,10 @@ class FieldOps(NamedTuple):
     mul_by_b3: callable      # multiply by 3*b of the curve
     zero: jax.Array
     one: jax.Array
+    # many(muls, squares) -> (mul_results, square_results): all the round's
+    # independent products fused into ONE conv+reduce pipeline, so a group
+    # law's 6-mul round is one wide contraction instead of 6 narrow ones.
+    many: callable
 
 
 def _g1_mul_by_b3(x):
@@ -45,10 +49,16 @@ def _g2_mul_by_b3(x):
     return _tw.fq2_mul_by_xi(_tw.fq2_mul_small(x, 12))
 
 
+def _g1_many(muls=(), squares=()):
+    # Fq squaring IS fq_mul(a, a) — fold the squares into the same pipeline.
+    outs = _fq.fq_mul_many(list(muls) + [(s, s) for s in squares])
+    return outs[: len(muls)], outs[len(muls):]
+
+
 G1_OPS = FieldOps(_fq.fq_mul, _fq.fq_square, _fq.fq_mul_small, _g1_mul_by_b3,
-                  _fq.FQ_ZERO, _fq.FQ_ONE)
+                  _fq.FQ_ZERO, _fq.FQ_ONE, _g1_many)
 G2_OPS = FieldOps(_tw.fq2_mul, _tw.fq2_square, _tw.fq2_mul_small, _g2_mul_by_b3,
-                  _tw.FQ2_ZERO, _tw.FQ2_ONE)
+                  _tw.FQ2_ZERO, _tw.FQ2_ONE, _tw.fq2_many)
 
 
 def identity(ops: FieldOps, batch_shape=()):
@@ -61,59 +71,46 @@ def identity(ops: FieldOps, batch_shape=()):
 
 
 def point_add(ops: FieldOps, p, q):
-    """Complete addition (RCB15 algorithm 7, a = 0)."""
+    """Complete addition (RCB15 algorithm 7, a = 0).
+
+    The 12 field muls run as TWO fused pipelines (a round of 6 independent
+    products each) instead of 12 sequential ones — same operand rows, so
+    the result limbs are bit-identical to the per-mul schedule.
+    """
     x1, y1, z1 = p
     x2, y2, z2 = q
-    m, b3 = ops.mul, ops.mul_by_b3
-    t0 = m(x1, x2)
-    t1 = m(y1, y2)
-    t2 = m(z1, z2)
-    t3 = m(x1 + y1, x2 + y2)
+    b3 = ops.mul_by_b3
+    (t0, t1, t2, t3, t4, x3), _ = ops.many(
+        [(x1, x2), (y1, y2), (z1, z2),
+         (x1 + y1, x2 + y2), (y1 + z1, y2 + z2), (x1 + z1, x2 + z2)])
     t3 = t3 - t0 - t1
-    t4 = m(y1 + z1, y2 + z2)
     t4 = t4 - t1 - t2
-    x3 = m(x1 + z1, x2 + z2)
     y3 = x3 - t0 - t2
     x3 = t0 + t0 + t0
     t2 = b3(t2)
     z3 = t1 + t2
     t1 = t1 - t2
     y3 = b3(y3)
-    x3o = m(t4, y3)
-    t2 = m(t3, t1)
-    x3o = t2 - x3o
-    y3o = m(y3, x3)
-    t1 = m(t1, z3)
-    y3o = t1 + y3o
-    t0 = m(x3, t3)
-    z3o = m(z3, t4)
-    z3o = z3o + t0
-    return (x3o, y3o, z3o)
+    (m_t4y3, m_t3t1, m_y3x3, m_t1z3, m_x3t3, m_z3t4), _ = ops.many(
+        [(t4, y3), (t3, t1), (y3, x3), (t1, z3), (x3, t3), (z3, t4)])
+    return (m_t3t1 - m_t4y3, m_t1z3 + m_y3x3, m_z3t4 + m_x3t3)
 
 
 def point_double(ops: FieldOps, p):
-    """Complete doubling (RCB15 algorithm 9, a = 0)."""
+    """Complete doubling (RCB15 algorithm 9, a = 0) — 8 field products in
+    THREE fused pipelines (bit-identical to the per-mul schedule)."""
     x, y, z = p
-    m, sq, b3 = ops.mul, ops.square, ops.mul_by_b3
-    t0 = sq(y)
+    b3 = ops.mul_by_b3
+    (t1,), (t0, t2) = ops.many([(y, z)], [y, z])
     z3 = t0 + t0
     z3 = z3 + z3
     z3 = z3 + z3
-    t1 = m(y, z)
-    t2 = sq(z)
     t2 = b3(t2)
-    x3 = m(t2, z3)
     y3 = t0 + t2
-    z3 = m(t1, z3)
-    t1 = t2 + t2
-    t2 = t1 + t2
-    t0 = t0 - t2
-    y3 = m(t0, y3)
-    y3 = x3 + y3
-    t1 = m(x, y)
-    x3 = m(t0, t1)
-    x3 = x3 + x3
-    return (x3, y3, z3)
+    (x3, z3o, xy), _ = ops.many([(t2, z3), (t1, z3), (x, y)])
+    t0 = t0 - (t2 + t2 + t2)
+    (y3o, x3o), _ = ops.many([(t0, y3), (t0, xy)])
+    return (x3o + x3o, x3 + y3o, z3o)
 
 
 def point_neg(p):

@@ -23,16 +23,52 @@ maps any redundant vector to a congruent one confined to 48 radix-2^8 positions.
 Every step is exact on values; truncation/ripple hazards simply do not arise.
 
 **Bound discipline** (checked empirically in tests, derived in comments):
- - fold8_2 output limbs lie in [-52, 307]; fold16_2 in [-? , 2^16+1] (2 rounds).
+ - fold8_2 output limbs lie in [-52, 307]; fold16_2 in [-1, 2^16] (2 rounds,
+   proved below for any input with |limb| <= 2^25).
  - conv accumulators stay below 2^24; reduction accumulators below 2^23.
  - ``fq_mul`` output: 25 limbs, |limb| < 2^16.3, for ANY inputs with
    |limb| <= 2^25 — so ~hundreds of additions may be chained between muls.
+
+**int8 MXU backend** (``LIGHTHOUSE_TPU_FQ_BACKEND=int8``, auto-selected on
+TPU).  The MXU's native integer path is s8 x s8 -> s32; the int32
+convolution above reaches it only after expensive emulation.  The int8
+backend re-digitises the folded operands so the convolution's dot operands
+are *provably* int8:
+
+ - fold16_2 bounds: for |limb| <= 2^25, round 1 gives lo in [0, 2^16-1]
+   plus a carry in [-512, 512]; round 2's carry is then in [-1, 1], so
+   folded limbs lie in **[-1, 2^16]**.  That range (width 2^16 + 2) cannot
+   be split into two radix-2^8 half-limbs both inside ANY 256-value window
+   — the +-1 carry slack of a redundant representation survives any finite
+   number of carry-free folds — which is why the int8 path uses *balanced
+   nibbles* instead of half-limbs.
+ - ``_balanced_nibbles`` rewrites each folded limb as four radix-2^4 digits
+   in [-8, 7] plus a 0/1 carry into the next limb's low digit (top carry
+   becomes digit 108): digits lie in **[-8, 8]**, so every digit product
+   |a_i * b_j| <= 64 — the elementwise outer product is exact in int8, and
+   the convolution lowers to one (batch, 109*109) @ (109*109, 217) dot with
+   s8 operands and s32 accumulation.
+ - The radix-2^4 convolution output (|coeff| <= 109 * 64 < 2^13) is
+   recombined pairwise into radix-2^8 coefficients (< 2^17) and re-enters
+   the SAME ``fold8_2`` + ``_reduce8`` pipeline as the int32 path, so both
+   backends share one reduction and one output contract (|limb| < 2^16.3).
+
+The two backends are *value-identical* (exact integers, congruent mod p,
+equal under ``from_limbs16``) but not limb-identical: the radix-2^4 and
+radix-2^8 convolutions distribute the same integer over different
+coefficient vectors before the linear reduction.  Verdicts, host
+conversions and field-level comparisons are therefore bit-identical;
+raw limb streams are not, and tests compare values, never limbs, across
+backends.
 
 Negative BLS parameter handling, tower arithmetic and curve ops build on these
 primitives in ``tower.py`` / ``ec.py`` / ``pairing.py``.
 """
 
 from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -74,6 +110,60 @@ def _onehot_conv(a_len: int, b_len: int) -> np.ndarray:
 
 
 _ONEHOT = jnp.asarray(_onehot_conv(_SPLIT8, _SPLIT8))
+
+# int8 backend: balanced radix-2^4 digits (4 per folded limb + 1 top carry).
+_DIG4 = 4 * _FOLDED16 + 1    # 109
+_CONV4 = 2 * _DIG4 - 1       # 217
+_ONEHOT4 = jnp.asarray(_onehot_conv(_DIG4, _DIG4))
+
+# --------------------------------------------------------- backend selection
+
+#: Env switch for the modular-multiply lowering, mirroring the reference's
+#: compile-time BLS backend selection (crypto/bls/src/lib.rs:84-139):
+#: ``int8`` (MXU s8 dot), ``int32`` (the proven einsum path), or ``auto``
+#: (int8 on TPU, int32 elsewhere) — so a bad int8 lowering on some platform
+#: degrades to the proven path with one env var.
+FQ_BACKEND_ENV = "LIGHTHOUSE_TPU_FQ_BACKEND"
+_FQ_BACKENDS = ("int8", "int32")
+
+_backend: Optional[str] = None
+
+
+def active_fq_backend() -> str:
+    """The lowering ``fq_mul`` traces with, resolved lazily (``auto`` needs
+    the jax platform, which must not be touched at import time — backend
+    init can hang on a dead TPU tunnel)."""
+    global _backend
+    if _backend is None:
+        choice = os.environ.get(FQ_BACKEND_ENV, "auto").strip().lower() or "auto"
+        if choice not in _FQ_BACKENDS + ("auto",):
+            raise ValueError(
+                f"{FQ_BACKEND_ENV}={choice!r}: expected int8, int32 or auto"
+            )
+        if choice == "auto":
+            try:
+                choice = "int8" if jax.default_backend() == "tpu" else "int32"
+            except Exception:
+                choice = "int32"
+        _backend = choice
+    return _backend
+
+
+def set_fq_backend(name: Optional[str]) -> Optional[str]:
+    """Force the backend (``int8``/``int32``) or reset to env/auto (None).
+
+    Returns the previously forced value.  Takes effect at TRACE time: jitted
+    programs already traced keep their lowering — and jax's trace cache keys
+    on the wrapped callable's identity, so even a fresh ``jax.jit(f)`` of a
+    module-level ``f`` can replay the old backend's trace.  Tests switch
+    backends through fresh closures (``jax.jit(lambda ...: f(...))``) or
+    ``jax.clear_caches()``.
+    """
+    global _backend
+    if name is not None and name not in _FQ_BACKENDS:
+        raise ValueError(f"unknown fq backend {name!r}")
+    prev, _backend = _backend, name
+    return prev
 
 # ------------------------------------------------------------------ core ops
 
@@ -126,16 +216,104 @@ def _reduce8(c8: jax.Array) -> jax.Array:
     return combine8_to_16(r8)
 
 
-def fq_mul(a: jax.Array, b: jax.Array) -> jax.Array:
-    """Modular multiply: (.., 25) x (.., 25) -> (.., 25), congruent mod p.
-
-    Accepts any inputs with |limb| <= 2^25 (i.e. sums of up to ~500 fresh
-    elements); output limbs are < 2^16.3 in magnitude.
-    """
+def _fq_mul_int32(a: jax.Array, b: jax.Array) -> jax.Array:
+    """The proven radix-2^8 lowering: one int32 convolution dot + reduction."""
     a8 = split16_to_8(fold16_2(a))
     b8 = split16_to_8(fold16_2(b))
     c = jnp.einsum("...i,...j,ijk->...k", a8, b8, _ONEHOT, preferred_element_type=jnp.int32)
     return _reduce8(fold8_2(c))
+
+
+def _balanced_nibbles(y16: jax.Array) -> jax.Array:
+    """fold16_2 output (limbs in [-1, 2^16], length K) -> balanced radix-2^4
+    digits (.., 4K+1), every digit in [-8, 8] (int8).
+
+    Per limb: four nibbles balanced into [-8, 7] by a 4-step carry chain
+    (subtract 16 whenever a nibble lands in [8, 15]); the limb's carry-out
+    (0/1) is added to the NEXT limb's low digit (making it [-8, 8]) and the
+    last limb's carry-out becomes the final digit.  Exact base-16 rewrite:
+    the digit vector represents the same integer as the input.
+    """
+    n0 = y16 & 15
+    c = (n0 + 8) >> 4
+    d0 = n0 - (c << 4)
+    n1 = ((y16 >> 4) & 15) + c
+    c = (n1 + 8) >> 4
+    d1 = n1 - (c << 4)
+    n2 = ((y16 >> 8) & 15) + c
+    c = (n2 + 8) >> 4
+    d2 = n2 - (c << 4)
+    n3 = (y16 >> 12) + c  # arithmetic shift: the y = -1 limb stays exact
+    c = (n3 + 8) >> 4
+    d3 = n3 - (c << 4)
+    pad = [(0, 0)] * (y16.ndim - 1)
+    d0 = d0 + jnp.pad(c[..., :-1], pad + [(1, 0)])  # cross-limb carry-in
+    digits = jnp.stack([d0, d1, d2, d3], axis=-1).reshape(*y16.shape[:-1], -1)
+    return jnp.concatenate([digits, c[..., -1:]], axis=-1).astype(jnp.int8)
+
+
+def _combine4_to_8(c4: jax.Array) -> jax.Array:
+    """Radix 2^4 -> radix 2^8 coefficients, exact: (.., 2K-1) -> (.., K)."""
+    if c4.shape[-1] % 2:
+        c4 = jnp.pad(c4, [(0, 0)] * (c4.ndim - 1) + [(0, 1)])
+    return c4[..., 0::2] + (c4[..., 1::2] << 4)
+
+
+def _fq_mul_int8(a: jax.Array, b: jax.Array) -> jax.Array:
+    """The MXU lowering: balanced-nibble digits make the convolution's dot
+    operands s8 (|digit| <= 8, |product| <= 64 — exact in int8); the
+    radix-2^4 output recombines into radix-2^8 and re-enters the shared
+    fold + reduction pipeline.  Value-identical to ``_fq_mul_int32``."""
+    a4 = _balanced_nibbles(fold16_2(a))
+    b4 = _balanced_nibbles(fold16_2(b))
+    # Elementwise outer product stays int8 by construction; the einsum then
+    # lowers to ONE dot with s8 operands and s32 accumulation.
+    outer = a4[..., :, None] * b4[..., None, :]
+    c4 = jnp.einsum(
+        "...ij,ijk->...k", outer, _ONEHOT4, preferred_element_type=jnp.int32
+    )
+    return _reduce8(fold8_2(_combine4_to_8(c4)))
+
+
+def fq_mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Modular multiply: (.., 25) x (.., 25) -> (.., 25), congruent mod p.
+
+    Accepts any inputs with |limb| <= 2^25 (i.e. sums of up to ~500 fresh
+    elements); output limbs are < 2^16.3 in magnitude.  Lowering is chosen
+    at trace time by :func:`active_fq_backend` (int32 einsum vs int8 MXU).
+    """
+    if active_fq_backend() == "int8":
+        return _fq_mul_int8(a, b)
+    return _fq_mul_int32(a, b)
+
+
+def fq_mul_many(pairs: Sequence[Tuple[jax.Array, jax.Array]]) -> List[jax.Array]:
+    """Fuse independent modular products into ONE conv+reduce pipeline.
+
+    ``pairs`` holds (a, b) limb arrays — broadcastable within each pair,
+    arbitrary batch shapes across pairs.  All operand rows are flattened and
+    concatenated onto one leading axis, so a round of k independent muls
+    costs one convolution dot k times as wide instead of k narrow ones
+    (the 2916x107-shaped contractions that starve the MXU).  Per-pair
+    results are bit-identical to calling :func:`fq_mul` on each pair.
+    """
+    if not pairs:
+        return []
+    if len(pairs) == 1:
+        a, b = pairs[0]
+        return [fq_mul(a, b)]
+    bcast = [jnp.broadcast_arrays(a, b) for a, b in pairs]
+    shapes = [a.shape for a, _ in bcast]
+    lhs = jnp.concatenate([a.reshape(-1, a.shape[-1]) for a, _ in bcast])
+    rhs = jnp.concatenate([b.reshape(-1, b.shape[-1]) for _, b in bcast])
+    out = fq_mul(lhs, rhs)
+    outs: List[jax.Array] = []
+    off = 0
+    for shape in shapes:
+        n = int(np.prod(shape[:-1], dtype=np.int64)) if len(shape) > 1 else 1
+        outs.append(out[off:off + n].reshape(shape))
+        off += n
+    return outs
 
 
 def fq_square(a: jax.Array) -> jax.Array:

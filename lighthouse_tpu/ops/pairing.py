@@ -29,9 +29,7 @@ from .tower import (
     FQ2_ZERO,
     fq2_mul,
     fq2_mul_by_xi,
-    fq2_mul_fq,
     fq2_mul_small,
-    fq2_square,
     fq2_sub,
     fq12_conj,
     fq12_frobenius,
@@ -48,39 +46,54 @@ _X_BITS_LSB = jnp.asarray([(X_ABS >> i) & 1 for i in range(X_ABS.bit_length())],
 
 
 def _proj_dbl(t):
-    """Twist-point doubling + eliminated-denominator line (host_projective.proj_dbl)."""
-    x, y, z = t
-    xx = fq2_square(x)
-    w3 = fq2_mul_small(xx, 3)
-    s = fq2_mul(y, z)
-    b = fq2_mul(fq2_mul(x, y), s)
-    h = fq2_sub(fq2_square(w3), fq2_mul_small(b, 8))
-    x3 = fq2_mul_small(fq2_mul(h, s), 2)
-    y2s2 = fq2_square(fq2_mul(y, s))
-    y3 = fq2_sub(fq2_mul(w3, fq2_mul_small(b, 4) - h), fq2_mul_small(y2s2, 8))
-    z3 = fq2_mul_small(fq2_mul(fq2_square(s), s), 8)
+    """Twist-point doubling + eliminated-denominator line (host_projective.proj_dbl).
 
-    l00 = fq2_mul_by_xi(fq2_mul_small(fq2_mul(y, fq2_square(z)), 2))
-    l1v = -(fq2_mul(fq2_square(y), fq2_mul_small(z, 2)) - fq2_mul(xx, fq2_mul_small(x, 3)))
-    l1vv = -fq2_mul_small(fq2_mul(xx, z), 3)
+    The 16 Fq2 products run as THREE fused pipelines (tw.fq2_many) instead
+    of 16 sequential conv+reduce round-trips — same sub-product operand
+    rows, so the outputs are bit-identical to the per-call schedule.
+    """
+    x, y, z = t
+    (xy, s), (xx, y2, zz) = tw.fq2_many([(x, y), (y, z)], [x, y, z])
+    w3 = fq2_mul_small(xx, 3)
+    (b, ys, yzz, xx3x, xxz, y2_2z), (w3sq, s2) = tw.fq2_many(
+        [(xy, s), (y, s), (y, zz), (xx, fq2_mul_small(x, 3)), (xx, z),
+         (y2, fq2_mul_small(z, 2))],
+        [w3, s],
+    )
+    h = fq2_sub(w3sq, fq2_mul_small(b, 8))
+    (hs, tt, s3), (y2s2,) = tw.fq2_many(
+        [(h, s), (w3, fq2_mul_small(b, 4) - h), (s2, s)], [ys]
+    )
+    x3 = fq2_mul_small(hs, 2)
+    y3 = fq2_sub(tt, fq2_mul_small(y2s2, 8))
+    z3 = fq2_mul_small(s3, 8)
+
+    l00 = fq2_mul_by_xi(fq2_mul_small(yzz, 2))
+    l1v = -(y2_2z - xx3x)
+    l1vv = -fq2_mul_small(xxz, 3)
     return (x3, y3, z3), (l00, l1v, l1vv)
 
 
 def _proj_add_mixed(t, q):
-    """Mixed addition + line (host_projective.proj_add_mixed)."""
+    """Mixed addition + line (host_projective.proj_add_mixed) — 14 Fq2
+    products in FOUR fused pipelines, bit-identical to the per-call form."""
     x, y, z = t
     xq, yq = q
-    e = fq2_sub(fq2_mul(yq, z), y)
-    f = fq2_sub(fq2_mul(xq, z), x)
-    ff = fq2_square(f)
-    fff = fq2_mul(f, ff)
-    t1 = fq2_sub(fq2_mul(fq2_square(e), z), fq2_mul(ff, x + fq2_mul(xq, z)))
-    x3 = fq2_mul(f, t1)
-    y3 = fq2_sub(fq2_mul(e, fq2_sub(fq2_mul(ff, x), t1)), fq2_mul(fff, y))
-    z3 = fq2_mul(z, fff)
+    (yqz, xqz), _ = tw.fq2_many([(yq, z), (xq, z)])
+    e = fq2_sub(yqz, y)
+    f = fq2_sub(xqz, x)
+    (yqf, exq), (ff, ee) = tw.fq2_many([(yq, f), (e, xq)], [f, e])
+    (fff, eez, ffx, ffs), _ = tw.fq2_many(
+        [(f, ff), (ee, z), (ff, x), (ff, x + xqz)]
+    )
+    t1 = fq2_sub(eez, ffs)
+    (x3, et, fffy, z3), _ = tw.fq2_many(
+        [(f, t1), (e, fq2_sub(ffx, t1)), (fff, y), (z, fff)]
+    )
+    y3 = fq2_sub(et, fffy)
 
     l00 = fq2_mul_by_xi(f)
-    l1v = -fq2_sub(fq2_mul(yq, f), fq2_mul(e, xq))
+    l1v = -fq2_sub(yqf, exq)
     l1vv = -e
     return (x3, y3, z3), (l00, l1v, l1vv)
 
@@ -92,9 +105,10 @@ def _line_fq12(line, p1):
     """
     l00, l1v, l1vv = line
     xp, yp, zp = p1
+    a, b1, b2 = tw.fq2_mul_fq_many([(l00, yp), (l1v, zp), (l1vv, xp)])
     zero = jnp.broadcast_to(FQ2_ZERO, l00.shape)
-    c0 = jnp.stack([fq2_mul_fq(l00, yp), zero, zero], axis=-3)
-    c1 = jnp.stack([zero, fq2_mul_fq(l1v, zp), fq2_mul_fq(l1vv, xp)], axis=-3)
+    c0 = jnp.stack([a, zero, zero], axis=-3)
+    c1 = jnp.stack([zero, b1, b2], axis=-3)
     return jnp.stack([c0, c1], axis=-4)
 
 
@@ -207,9 +221,7 @@ def _sparse_line_coeffs(line, p1, mask):
     """Scale a raw line by the projective G1 coords and mask dead pairs to 1."""
     l00, l1v, l1vv = line
     xp, yp, zp = p1
-    a = fq2_mul_fq(l00, yp)
-    b1 = fq2_mul_fq(l1v, zp)
-    b2 = fq2_mul_fq(l1vv, xp)
+    a, b1, b2 = tw.fq2_mul_fq_many([(l00, yp), (l1v, zp), (l1vv, xp)])
     m = mask.reshape(mask.shape + (1, 1))
     one = jnp.broadcast_to(tw.FQ2_ONE, a.shape)
     return jnp.where(m, a, one), jnp.where(m, b1, 0), jnp.where(m, b2, 0)

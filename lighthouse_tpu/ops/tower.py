@@ -17,6 +17,8 @@ the batched shapes keep the underlying matmuls large (MXU-friendly).
 
 from __future__ import annotations
 
+from typing import List, Sequence, Tuple
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -27,6 +29,7 @@ from .fq import (
     FQ_ZERO,
     fq_inv,
     fq_mul,
+    fq_mul_many,
     fq_mul_small,
     fq_reduce,
     from_limbs16,
@@ -86,6 +89,69 @@ def fq2_mul_small(a, k: int):
 def fq2_mul_fq(a, s):
     """Fq2 * Fq (s shape (..., 25), broadcast over the pair axis)."""
     return fq_mul(a, s[..., None, :])
+
+
+def fq2_many(muls: Sequence[Tuple] = (), squares: Sequence = ()):
+    """All the round's independent Fq2 products in ONE fq_mul pipeline.
+
+    Each mul contributes its 3 Karatsuba sub-products, each square its 2
+    (the cheaper ``(a0+a1)(a0-a1) / 2·a0·a1`` form); the flattened operand
+    rows ride one convolution+reduction, so a round of k independent tower
+    products lowers to one wide dot instead of k narrow ones.  Returns
+    ``(mul_results, square_results)`` — bit-identical to per-call
+    :func:`fq2_mul` / :func:`fq2_square` (same operand rows, same
+    recombination).
+    """
+    if not muls and not squares:
+        return [], []
+    plan = []  # (kind, batch_shape, rows)
+    lhs_parts, rhs_parts = [], []
+
+    def emit(kind, l, r):
+        # l, r: batch + (k, 25) stacked sub-products for one item
+        rows = int(np.prod(l.shape[:-1], dtype=np.int64))
+        plan.append((kind, l.shape))
+        lhs_parts.append(l.reshape(-1, l.shape[-1]))
+        rhs_parts.append(r.reshape(-1, r.shape[-1]))
+        return rows
+
+    for a, b in muls:
+        a, b = jnp.broadcast_arrays(a, b)
+        a0, a1 = a[..., 0, :], a[..., 1, :]
+        b0, b1 = b[..., 0, :], b[..., 1, :]
+        emit("mul",
+             jnp.stack([a0, a1, a0 + a1], axis=-2),
+             jnp.stack([b0, b1, b0 + b1], axis=-2))
+    for x in squares:
+        x0, x1 = x[..., 0, :], x[..., 1, :]
+        emit("square",
+             jnp.stack([x0 + x1, x0], axis=-2),
+             jnp.stack([x0 - x1, x1 + x1], axis=-2))
+
+    out = fq_mul(jnp.concatenate(lhs_parts), jnp.concatenate(rhs_parts))
+    mul_out, sq_out = [], []
+    off = 0
+    for kind, shape in plan:
+        n = int(np.prod(shape[:-1], dtype=np.int64))
+        t = out[off:off + n].reshape(shape)
+        off += n
+        if kind == "mul":
+            t0, t1, t2 = t[..., 0, :], t[..., 1, :], t[..., 2, :]
+            mul_out.append(jnp.stack([t0 - t1, t2 - t0 - t1], axis=-2))
+        else:
+            sq_out.append(jnp.stack([t[..., 0, :], t[..., 1, :]], axis=-2))
+    return mul_out, sq_out
+
+
+def fq2_mul_many(pairs: Sequence[Tuple]) -> List:
+    """Independent Fq2 products fused into one pipeline (see fq2_many)."""
+    return fq2_many(muls=pairs)[0]
+
+
+def fq2_mul_fq_many(pairs: Sequence[Tuple]) -> List:
+    """Independent Fq2 x Fq products (one conv pipeline, no Karatsuba —
+    the scalar broadcasts over the pair axis, 2 base muls each)."""
+    return fq_mul_many([(a, s[..., None, :]) for a, s in pairs])
 
 
 def fq2_inv(a):

@@ -10,6 +10,7 @@ low-priority API traffic.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
@@ -120,12 +121,33 @@ class RequeueWork(RuntimeError):
     :data:`MAX_WORK_RETRIES` times (``WorkEvent.retries``).
     """
 
-# Batchable work: (batch_work_type, max batch size).  Matches the reference's
-# 64-attestation coalescing (``lib.rs:200-201``) — and the device batch
-# buckets, so one drained batch feeds one TPU program invocation.
+# Batchable work: (batch_work_type, max batch size).  The reference caps
+# coalescing at 64 attestations (``lib.rs:200-201``) because blst verifies
+# on CPU threads; here one drained batch feeds one TPU program invocation,
+# and the device is latency-dominated (PERF.md round 5: 1x1 and 128x32
+# execute in nearly the same wall time) — so the cap is the production
+# standard device bucket (ops/verify.py ``N_BUCKETS[-1]``; kept as a
+# literal so importing the work taxonomy never pulls jax).  Overridable for
+# hosts where giant buckets are wrong (e.g. CPU-only deployments).
+def _standard_batch_from_env() -> int:
+    raw = os.environ.get("LIGHTHOUSE_TPU_STANDARD_BATCH", "4096")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"LIGHTHOUSE_TPU_STANDARD_BATCH={raw!r}: expected a positive integer"
+        ) from None
+    if n < 1:
+        raise ValueError(
+            f"LIGHTHOUSE_TPU_STANDARD_BATCH={n}: must be >= 1"
+        )
+    return n
+
+
+STANDARD_DEVICE_BATCH = _standard_batch_from_env()
 BATCH_RULES = {
-    W.GOSSIP_ATTESTATION: (W.GOSSIP_ATTESTATION_BATCH, 64),
-    W.GOSSIP_AGGREGATE: (W.GOSSIP_AGGREGATE_BATCH, 64),
+    W.GOSSIP_ATTESTATION: (W.GOSSIP_ATTESTATION_BATCH, STANDARD_DEVICE_BATCH),
+    W.GOSSIP_AGGREGATE: (W.GOSSIP_AGGREGATE_BATCH, STANDARD_DEVICE_BATCH),
 }
 
 

@@ -36,17 +36,19 @@ import jax  # noqa: E402
 # pins CPU because the axon sitecustomize otherwise hangs jax.devices().
 if "--device" not in sys.argv:
     jax.config.update("jax_platforms", "cpu")
-try:
-    jax.config.update("jax_compilation_cache_dir", os.path.join(HERE, ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass
+
+from lighthouse_tpu.ops.compile_cache import configure_persistent_cache  # noqa: E402
+
+# No explicit dir: the shared LIGHTHOUSE_TPU_COMPILE_CACHE_DIR >
+# JAX_COMPILATION_CACHE_DIR > <repo>/.jax_cache resolution applies, so the
+# perf harness shares the node's cache.
+configure_persistent_cache()
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 from __graft_entry__ import _build_example  # noqa: E402
-from lighthouse_tpu.ops import ec, pairing, tower  # noqa: E402
+from lighthouse_tpu.ops import ec, fq as fq_mod, pairing, tower  # noqa: E402
 from lighthouse_tpu.ops.verify import _NEG_G1, _device_verify  # noqa: E402
 from lighthouse_tpu.ops.pairing import fe_is_one  # noqa: E402
 
@@ -125,28 +127,59 @@ def _flops(fn, args) -> dict:
         return {"cost_analysis_error": f"{type(e).__name__}: {e}"}
 
 
-def _dot_audit() -> dict:
-    """Count dot ops in the optimized HLO of one fq2_mul / fq12_mul.
+def _count_dots(txt: str) -> int:
+    return len(re.findall(r"\bdot\(", txt)) + len(re.findall(r"\bdot-general\b", txt))
 
-    The whole design claim (SURVEY §7): every tower multiply stacks its
+
+def _count_s8_dots(stablehlo: str) -> int:
+    """dot_generals in the LOWERED (pre-XLA) module whose operands are both
+    i8 — the emission the int8 backend promises; what the platform compiler
+    does afterwards is its own business."""
+    n = 0
+    for line in stablehlo.splitlines():
+        if "dot_general" in line and line.count("xi8>") >= 2:
+            n += 1
+    return n
+
+
+def _dot_audit() -> dict:
+    """Count dot ops in the optimized HLO of the hot-path kernels.
+
+    The design claims being locked in: (1) every tower multiply stacks its
     Karatsuba sub-products onto one axis and issues ONE fq_mul pipeline —
     one convolution einsum + one reduction einsum = exactly 2 dots,
-    regardless of tower level.  More dots would mean XLA rematerialized
-    the contraction.
+    regardless of tower level (more would mean XLA rematerialized the
+    contraction); (2) the widened group-law / Miller-step schedules fuse
+    each round of independent products into one pipeline (point_add: 2
+    pipelines = 4 dots, vs 24 for the per-mul schedule); (3) under the int8
+    backend the convolution dots carry s8 operands (counted on the lowered
+    StableHLO).
     """
-    out = {}
+    out = {"fq_backend": fq_mod.active_fq_backend()}
     a2 = jnp.asarray(np.ones((4, 2, 25), np.int32))
     a12 = jnp.asarray(np.ones((4, 2, 3, 2, 25), np.int32))
+    g1 = tuple(jnp.asarray(np.stack([c] * 4)) for c in ec.G1_GEN_LIMBS)
+    g2 = tuple(jnp.asarray(np.stack([c] * 4)) for c in ec.G2_GEN_LIMBS)
+    g2_aff = (g2[0], g2[1])
+    # Every target is wrapped in a FRESH lambda: jax's trace cache keys on
+    # the wrapped callable's identity, so jitting a module-level function
+    # directly could replay a trace made under the other fq backend.
     for name, fn, args in (
-        ("fq2_mul", jax.jit(tower.fq2_mul), (a2, a2)),
-        ("fq12_mul", jax.jit(tower.fq12_mul), (a12, a12)),
-        ("fq12_square", jax.jit(tower.fq12_square), (a12,)),
+        ("fq2_mul", jax.jit(lambda a, b: tower.fq2_mul(a, b)), (a2, a2)),
+        ("fq12_mul", jax.jit(lambda a, b: tower.fq12_mul(a, b)), (a12, a12)),
+        ("fq12_square", jax.jit(lambda a: tower.fq12_square(a)), (a12,)),
+        ("g1_point_add", jax.jit(lambda p, q: ec.point_add(ec.G1_OPS, p, q)),
+         (g1, g1)),
+        ("g1_point_double", jax.jit(lambda p: ec.point_double(ec.G1_OPS, p)),
+         (g1,)),
+        ("g2_proj_dbl", jax.jit(lambda t: pairing._proj_dbl(t)), (g2,)),
+        ("g2_proj_add_mixed", jax.jit(lambda t, q: pairing._proj_add_mixed(t, q)),
+         (g2, g2_aff)),
     ):
         try:
-            txt = fn.lower(*args).compile().as_text()
-            out[name + "_dots"] = len(re.findall(r"\bdot\(", txt)) + len(
-                re.findall(r"\bdot-general\b", txt)
-            )
+            lowered = fn.lower(*args)
+            out[name + "_s8_dots"] = _count_s8_dots(lowered.as_text())
+            out[name + "_dots"] = _count_dots(lowered.compile().as_text())
         except Exception as e:
             out[name + "_dots_error"] = f"{type(e).__name__}: {e}"
     return out
@@ -161,11 +194,16 @@ def main() -> None:
     ap.add_argument("--skip-dot-audit", action="store_true")
     ap.add_argument("--device", action="store_true",
                     help="run on the live platform (TPU) instead of pinning CPU")
+    ap.add_argument("--fq-backend", choices=("int8", "int32"), default=None,
+                    help="force the fq_mul lowering (default: env/auto)")
     args = ap.parse_args()
 
+    if args.fq_backend:
+        fq_mod.set_fq_backend(args.fq_backend)
     n, k = args.sets, args.keys
     res: dict = {"n_sets": n, "n_keys": k, "reps": args.reps,
-                 "platform": jax.devices()[0].platform}
+                 "platform": jax.devices()[0].platform,
+                 "fq_backend": fq_mod.active_fq_backend()}
 
     t0 = time.perf_counter()
     pk, sig, msg, wbits, live = _build_example(n_sets=n, n_keys=k, seed=3)

@@ -29,6 +29,14 @@ import jax  # noqa: E402  (env above must be set first)
 
 jax.config.update("jax_platforms", "cpu")
 # sitecustomize imports jax before this file runs, so the env vars above never
-# reach jax's config snapshot — set the compile cache through the live config.
-jax.config.update("jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"])
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# reach jax's config snapshot — set the compile cache through the live config,
+# via the one shared implementation (same call the node startup path makes).
+from lighthouse_tpu.ops.compile_cache import configure_persistent_cache  # noqa: E402
+
+configure_persistent_cache()
+
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; register the marker so pytest does not
+    # warn on the opt-in big-bucket executions.
+    config.addinivalue_line("markers", "slow: excluded from the tier-1 gate")

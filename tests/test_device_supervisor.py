@@ -161,6 +161,44 @@ class TestSupervisedBlsVerify:
             reason="device_error") == fb_before
         assert ds.SUPERVISOR.breaker("bls_verify").state == "closed"
 
+    def test_batch_global_ops_never_split(self):
+        """epoch_deltas[_leak] compute registry-wide sums: halves are not
+        independent, so the supervisor must refuse split-retry for them even
+        if a caller wires a split_fn — with 4096-scale standard buckets a
+        mis-split would silently change the op's semantics.  A device error
+        goes straight to the host fallback instead."""
+        split_calls = []
+        for op in sorted(ds.NO_SPLIT_OPS):
+            fb_before = metrics.DEVICE_HOST_FALLBACK.get(reason="device_error")
+
+            def bad_device():
+                raise RuntimeError("injected")
+
+            def spy_split():
+                split_calls.append(op)
+                return [lambda: 1, lambda: 2]
+
+            out = ds.run(op, bad_device, host_fn=lambda: "host-exact",
+                         split_fn=spy_split, combine_fn=sum)
+            assert out == "host-exact"
+            assert split_calls == []
+            assert metrics.DEVICE_HOST_FALLBACK.get(
+                reason="device_error") == fb_before + 1
+        # bls_verify is NOT in the registry: its split path stays available
+        assert "bls_verify" not in ds.NO_SPLIT_OPS
+
+    def test_top_bucket_split_halves_at_smaller_bucket(self):
+        """A transient error on a top-bucket-shaped bls batch retries as two
+        halves at the half bucket (the split path stays shape-bucketed) —
+        asserted structurally on the verify split_fn contract: each half is
+        its own supervised dispatch at its own bucket."""
+        from lighthouse_tpu.ops import verify as v
+
+        assert v.MAX_SETS_PER_DISPATCH == v.N_BUCKETS[-1] == 4096
+        # _bucket pads a split half of 2048 into the 2048 bucket, not 4096
+        assert v._bucket(2048, v.N_BUCKETS) == 2048
+        assert v._bucket(2049, v.N_BUCKETS) == 4096
+
     def test_split_retry_detects_bad_half(self):
         """A batch with one invalid set still verifies False through the
         split path (halves AND together)."""

@@ -1,0 +1,101 @@
+"""HLO lowering audit for the hot-path kernels (CI guard, CPU-jax).
+
+Locks in the contraction structure the MXU work depends on, so a refactor
+cannot silently rematerialize a convolution or de-widen the fused group-law
+rounds:
+
+- every tower multiply is ONE fq_mul pipeline = 2 dot_generals (conv +
+  reduction), regardless of tower level;
+- the widened schedules fuse each round of independent products:
+  point_add 2 pipelines (4 dots), point_double / _proj_dbl 3 (6 dots),
+  _proj_add_mixed 4 (8 dots);
+- under the int8 backend every pipeline's convolution dot carries s8
+  operands (the MXU's native integer path).
+
+Counts are taken on the LOWERED StableHLO (trace only — no XLA compile, so
+the whole audit costs seconds); one compiled-HLO canary keeps the
+"XLA does not rematerialize" claim honest.  All targets are jitted through
+fresh closures: jax's trace cache keys on callable identity, and a direct
+``jax.jit(module_fn)`` could replay a trace made under the other backend.
+"""
+
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lighthouse_tpu.ops import ec, fq, pairing, tower
+
+A2 = jnp.asarray(np.ones((4, 2, 25), np.int32))
+A12 = jnp.asarray(np.ones((4, 2, 3, 2, 25), np.int32))
+G1 = tuple(jnp.asarray(np.stack([c] * 4)) for c in ec.G1_GEN_LIMBS)
+G2 = tuple(jnp.asarray(np.stack([c] * 4)) for c in ec.G2_GEN_LIMBS)
+
+#: (name, fresh-closure factory, args, expected dot_general count)
+TARGETS = (
+    ("fq2_mul", lambda: (lambda a, b: tower.fq2_mul(a, b)), (A2, A2), 2),
+    ("fq12_mul", lambda: (lambda a, b: tower.fq12_mul(a, b)), (A12, A12), 2),
+    ("fq12_square", lambda: (lambda a: tower.fq12_square(a)), (A12,), 2),
+    ("g1_point_add", lambda: (lambda p, q: ec.point_add(ec.G1_OPS, p, q)),
+     (G1, G1), 4),
+    ("g1_point_double", lambda: (lambda p: ec.point_double(ec.G1_OPS, p)),
+     (G1,), 6),
+    ("g2_proj_dbl", lambda: (lambda t: pairing._proj_dbl(t)), (G2,), 6),
+    ("g2_proj_add_mixed", lambda: (lambda t, q: pairing._proj_add_mixed(t, q)),
+     (G2, (G2[0], G2[1])), 8),
+)
+
+
+def _lowered_text(factory, args, backend):
+    prev = fq.set_fq_backend(backend)
+    try:
+        return jax.jit(factory()).lower(*args).as_text()
+    finally:
+        fq.set_fq_backend(prev)
+
+
+def _dot_lines(txt):
+    """Contraction dot_generals in lowered StableHLO.  The int32 einsum
+    lowers its elementwise outer product as a degenerate dot_general with
+    ``contracting_dims = [] x []`` that XLA fuses into a multiply — only
+    dots that actually contract count."""
+    return [
+        l for l in txt.splitlines()
+        if "dot_general" in l and "contracting_dims = [] x []" not in l
+    ]
+
+
+@pytest.mark.parametrize("name,factory,args,want", TARGETS,
+                         ids=[t[0] for t in TARGETS])
+def test_dot_count_int32(name, factory, args, want):
+    assert len(_dot_lines(_lowered_text(factory, args, "int32"))) == want
+
+
+@pytest.mark.parametrize("name,factory,args,want", TARGETS,
+                         ids=[t[0] for t in TARGETS])
+def test_dot_count_and_s8_operands_int8(name, factory, args, want):
+    lines = _dot_lines(_lowered_text(factory, args, "int8"))
+    assert len(lines) == want
+    # Every pipeline = one s8-operand conv dot + one s32 reduction dot.
+    s8 = [l for l in lines if l.count("xi8>") >= 2]
+    assert len(s8) == want // 2, f"{name}: conv dots lost their s8 operands"
+
+
+def test_int32_dots_carry_no_s8_operands():
+    lines = _dot_lines(_lowered_text(*TARGETS[0][1:3], backend="int32"))
+    assert all(l.count("xi8>") < 2 for l in lines)
+
+
+def test_compiled_hlo_does_not_rematerialize_fq2_mul():
+    """Compiled-HLO canary: XLA keeps the fq2_mul pipeline at exactly 2
+    dots (optimization could in principle duplicate the contraction; the
+    lowered-text counts above would not see that)."""
+    prev = fq.set_fq_backend("int32")
+    try:
+        txt = jax.jit(lambda a, b: tower.fq2_mul(a, b)).lower(A2, A2).compile().as_text()
+    finally:
+        fq.set_fq_backend(prev)
+    dots = len(re.findall(r"\bdot\(", txt)) + len(re.findall(r"\bdot-general\b", txt))
+    assert dots == 2

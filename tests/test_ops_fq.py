@@ -97,3 +97,123 @@ def test_reduce_tightens():
     r = np.asarray(jax.jit(fq.fq_reduce)(x))
     assert fq.from_limbs16(r) == fq.from_limbs16(np.asarray(x))
     assert int(np.abs(r).max()) < 1 << 17
+
+
+# ------------------------------------------------------------ int8 backend
+
+
+def _limbs(v: int) -> jnp.ndarray:
+    return jnp.asarray(fq.to_limbs16(v))
+
+
+def test_int8_backend_selection_and_env(monkeypatch):
+    monkeypatch.setenv(fq.FQ_BACKEND_ENV, "int8")
+    prev = fq.set_fq_backend(None)  # force re-resolution from env
+    try:
+        assert fq.active_fq_backend() == "int8"
+        monkeypatch.setenv(fq.FQ_BACKEND_ENV, "bogus")
+        fq.set_fq_backend(None)
+        with pytest.raises(ValueError):
+            fq.active_fq_backend()
+    finally:
+        monkeypatch.delenv(fq.FQ_BACKEND_ENV, raising=False)
+        fq.set_fq_backend(prev)
+
+
+def test_int8_mul_exact_canonical():
+    """int8 lowering is exact (and value-identical to int32) on canonical
+    inputs; both meet the shared output-bound contract."""
+    m8 = jax.jit(fq._fq_mul_int8)
+    m32 = jax.jit(fq._fq_mul_int32)
+    for _ in range(20):
+        a, b = rand_elt(), rand_elt()
+        r8 = np.asarray(m8(_limbs(a), _limbs(b)))
+        r32 = np.asarray(m32(_limbs(a), _limbs(b)))
+        assert fq.from_limbs16(r8) == a * b % P
+        assert fq.from_limbs16(r8) == fq.from_limbs16(r32)
+        assert int(np.abs(r8).max()) < 1 << 17
+
+
+def test_int8_mul_exact_at_documented_magnitude_limit():
+    """The bound discipline's edge: EVERY limb at +-2^25 (the documented
+    input ceiling) still multiplies exactly — the balanced-nibble digits
+    stay in [-8, 8] and nothing overflows int8/int32 anywhere."""
+    m8 = jax.jit(fq._fq_mul_int8)
+    hi = np.full((fq.L16,), 1 << 25, np.int32)
+    lo = -hi
+    mixed = np.asarray([(1 << 25) * (-1) ** i for i in range(fq.L16)], np.int32)
+    for x, y in [(hi, hi), (hi, lo), (lo, lo), (mixed, hi), (mixed, mixed)]:
+        r = np.asarray(m8(jnp.asarray(x), jnp.asarray(y)))
+        want = fq.from_limbs16(x) * fq.from_limbs16(y) % P
+        assert fq.from_limbs16(r) == want
+        assert int(np.abs(r).max()) < 1 << 17
+
+
+def test_int8_mul_chained_add_worst_case():
+    """Chained-add worst case: ~500 summed fresh elements (limbs ~2^25)
+    multiplied under the int8 lowering match exact integers."""
+
+    @jax.jit
+    def chain(a, b):
+        acc = a
+        for _ in range(499):
+            acc = fq.fq_add(acc, a)  # 500 * a, limbs up to ~500 * 2^16
+        return fq._fq_mul_int8(acc, b)
+
+    a, b = rand_elt(), rand_elt()
+    r = np.asarray(chain(_limbs(a), _limbs(b)))
+    assert fq.from_limbs16(r) == (500 * a % P) * b % P
+
+
+def test_int8_mul_redundant_and_negative_inputs():
+    """Redundant signed limbs (subtraction results, scaled elements) are
+    value-identical between the two lowerings."""
+    m8 = jax.jit(fq._fq_mul_int8)
+    m32 = jax.jit(fq._fq_mul_int32)
+    rs = np.random.RandomState(0xBEEF)
+    for _ in range(10):
+        x = rs.randint(-(1 << 25), 1 << 25, size=(4, fq.L16)).astype(np.int32)
+        y = rs.randint(-(1 << 25), 1 << 25, size=(4, fq.L16)).astype(np.int32)
+        r8 = np.asarray(m8(jnp.asarray(x), jnp.asarray(y)))
+        r32 = np.asarray(m32(jnp.asarray(x), jnp.asarray(y)))
+        for i in range(4):
+            want = fq.from_limbs16(x[i]) * fq.from_limbs16(y[i]) % P
+            assert fq.from_limbs16(r8[i]) == want
+            assert fq.from_limbs16(r8[i]) == fq.from_limbs16(r32[i])
+
+
+def test_balanced_nibbles_bounds_and_value():
+    """The digitisation invariants the s8 dot depends on: |digit| <= 8 and
+    exact value preservation, across the whole documented input range."""
+    rs = np.random.RandomState(7)
+    x = rs.randint(-(1 << 25), 1 << 25, size=(32, fq.L16)).astype(np.int32)
+    folded = jax.jit(fq.fold16_2)(jnp.asarray(x))
+    digits = np.asarray(jax.jit(fq._balanced_nibbles)(folded))
+    assert digits.dtype == np.int8
+    assert int(np.abs(digits).max()) <= 8
+    for row in range(x.shape[0]):
+        val = sum(int(d) << (4 * k) for k, d in enumerate(digits[row]))
+        assert val == sum(int(l) << (16 * i) for i, l in enumerate(x[row]))
+
+
+def test_fq_mul_many_matches_per_call_fuzz():
+    """Seeded fuzz: heterogeneous batch shapes through fq_mul_many are
+    bit-identical to per-call fq_mul."""
+    rs = np.random.RandomState(0x51EED)
+    for _ in range(3):
+        pairs = []
+        for shape in [(), (3,), (2, 2), (5,)]:
+            a = rs.randint(-(1 << 24), 1 << 24, size=shape + (fq.L16,))
+            b = rs.randint(-(1 << 24), 1 << 24, size=shape + (fq.L16,))
+            pairs.append((jnp.asarray(a, jnp.int32), jnp.asarray(b, jnp.int32)))
+        outs = jax.jit(fq.fq_mul_many)(pairs)
+        assert len(outs) == len(pairs)
+        for (a, b), o in zip(pairs, outs):
+            assert np.array_equal(np.asarray(o), np.asarray(fq.fq_mul(a, b)))
+
+
+def test_fq_mul_many_broadcasts_like_fq_mul():
+    a = jnp.asarray(np.stack([fq.to_limbs16(rand_elt()) for _ in range(3)]))
+    s = _limbs(rand_elt())
+    (o,) = fq.fq_mul_many([(a, s)])  # (3, 25) x (25,) broadcast
+    assert np.array_equal(np.asarray(o), np.asarray(fq.fq_mul(a, s)))

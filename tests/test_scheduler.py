@@ -64,7 +64,16 @@ class TestPriority:
 
 
 class TestBatching:
-    def test_attestations_coalesce(self, processor):
+    def test_attestations_coalesce(self, processor, monkeypatch):
+        # Pin the coalescing cap to 64 for this test: the production cap is
+        # the 4096-set standard device bucket (asserted in
+        # test_verify_buckets), far above what a unit test should enqueue —
+        # the drain logic is what's under test, not the cap value.
+        from lighthouse_tpu.scheduler import work
+
+        monkeypatch.setitem(
+            work.BATCH_RULES, W.GOSSIP_ATTESTATION,
+            (W.GOSSIP_ATTESTATION_BATCH, 64))
         gate = threading.Event()
         started = threading.Event()
         processor.send(gate_event(W.STATUS, gate, started))
