@@ -405,6 +405,11 @@ class BeaconChain:
         from .. import device_supervisor
 
         device_supervisor.register_event_bus(self.events)
+        # Synchronous import-completion hooks (root) — the router's
+        # reprocess queue releases parked unknown-head attestations here
+        # the moment the block they vote for lands (any import path:
+        # gossip, range sync, parent chase).
+        self.block_imported_hooks: list = []
         self._last_finalized_epoch = 0
         from .observed import ObservedCaches
 
@@ -830,6 +835,11 @@ class BeaconChain:
             import_s=round(time.perf_counter() - t_import, 3),
             attestations=len(block.body.attestations),
         )
+        for hook in list(self.block_imported_hooks):
+            try:
+                hook(block_root)
+            except Exception:
+                pass  # a subscriber must never fail an import
         return block_root
 
     def verify_block_header_signature(self, signed_header) -> bool:
@@ -1982,10 +1992,13 @@ class BeaconChain:
         f_slot = f_epoch * self.spec.slots_per_epoch
         if f_slot <= self._migrated_slot or f_root not in self._states:
             return
-        proto = self.fork_choice.proto
+        fork_choice = self.fork_choice
 
         def canonical_root_at_slot(slot: int):
-            return proto.ancestor_at_slot(f_root, slot)
+            # locked per-walk: prune() may rebuild the node array between
+            # migration steps (holding the lock across the WHOLE migration
+            # would park imports behind state I/O)
+            return fork_choice.ancestor_at_slot(f_root, slot)
 
         def state_for_root(block_root: bytes):
             return self._states.get(block_root)
@@ -1996,7 +2009,7 @@ class BeaconChain:
             for root in self._states
             if root != f_root
             and self._blocks_slot(root) <= f_slot
-            and proto.ancestor_at_slot(f_root, self._blocks_slot(root)) != root
+            and fork_choice.ancestor_at_slot(f_root, self._blocks_slot(root)) != root
         ]
         self.db.migrate(
             finalized_slot=f_slot,
@@ -2106,4 +2119,4 @@ class BeaconChain:
 
     def block_root_at_slot(self, slot: int) -> Optional[bytes]:
         """Canonical chain block root at ``slot`` (walks from head)."""
-        return self.fork_choice.proto.ancestor_at_slot(self.head_root, slot)
+        return self.fork_choice.ancestor_at_slot(self.head_root, slot)

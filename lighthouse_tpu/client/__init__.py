@@ -341,7 +341,10 @@ class Client:
             progressed = 0
             for peer in peers:
                 try:
-                    progressed += backfill.backfill_from(peer)
+                    # a batch that fails on `peer` retries once against the
+                    # next connected peer instead of ending the round
+                    progressed += backfill.backfill_from(
+                        peer, fallback_peers=[p for p in peers if p != peer])
                 except Exception as e:
                     log.warning("backfill from %s failed: %s", peer, e)
                 if backfill.complete:

@@ -51,6 +51,9 @@ POINTS = (
     "store.write",       # chain/beacon_chain.py block+state persistence
     "engine.request",    # execution_layer/engines.py Engine.request
     "signer.request",    # validator_client/web3signer.py remote signing
+    "net.deliver",       # network/transport.py Hub.deliver: error=drop,
+                         # hang=stall the sender, corrupt=flip a payload byte
+                         # (op selector matches the envelope kind)
 )
 
 MODES = ("error", "hang", "corrupt")

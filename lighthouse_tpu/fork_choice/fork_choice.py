@@ -16,6 +16,8 @@ is the same cost class.
 
 from __future__ import annotations
 
+import functools
+import threading
 from dataclasses import dataclass, field
 from typing import Iterable, List, Optional, Tuple
 
@@ -182,6 +184,27 @@ def justified_balances(state, spec: ChainSpec) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _locked(fn):
+    """Serialize a public ForkChoice entry point on the instance lock.
+
+    The chain calls fork choice from several threads at once (processor
+    workers importing blocks and applying attestations, sync lookup threads
+    chasing parents, duty loops producing) and the proto-array walk is
+    multi-step mutable arithmetic: two interleaved ``get_head`` calls
+    double-consume vote deltas and drive node weights negative (observed as
+    intermittent ``ProtoArrayError: negative weight`` under the scenario
+    soak).  The reference wraps fork choice in an ``RwLock`` for exactly
+    this reason; an RLock because the entry points nest (``on_block`` ->
+    ``update_time``)."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
+
+
 class ForkChoice:
     """Stateful fork choice: proto-array + votes + time + checkpoints."""
 
@@ -194,6 +217,7 @@ class ForkChoice:
         anchor_slot: Optional[int] = None,
     ):
         self.spec = spec
+        self._lock = threading.RLock()  # see _locked
         anchor_slot = int(genesis_state.slot) if anchor_slot is None else anchor_slot
         anchor_epoch = anchor_slot // spec.slots_per_epoch
         # Spec ``get_forkchoice_store`` / reference ``ForkChoice::from_anchor``:
@@ -242,6 +266,7 @@ class ForkChoice:
 
     # ------------------------------------------------------------------ time
 
+    @_locked
     def update_time(self, current_slot: int) -> None:
         """Reference: ``fork_choice.rs:1104`` ``update_time`` (spec
         ``on_tick_per_slot``), computed as ONE jump: per-slot iteration is
@@ -292,6 +317,7 @@ class ForkChoice:
 
     # ----------------------------------------------------------------- block
 
+    @_locked
     def on_block(
         self,
         *,
@@ -383,6 +409,7 @@ class ForkChoice:
 
     # ----------------------------------------------------------- attestation
 
+    @_locked
     def on_attestation(
         self,
         *,
@@ -451,6 +478,7 @@ class ForkChoice:
         self.votes.next_root_id[upd] = rid
         self.votes.next_epoch[upd] = target_epoch
 
+    @_locked
     def on_attester_slashing(self, attesting_indices: Iterable[int]) -> None:
         """Mark equivocating validators; their weight is removed at the next
         ``get_head`` (reference: ``fork_choice.rs`` ``on_attester_slashing``)."""
@@ -462,6 +490,7 @@ class ForkChoice:
 
     # ------------------------------------------------------------------ head
 
+    @_locked
     def get_head(self, current_slot: Optional[int] = None) -> bytes:
         """Reference: ``fork_choice.rs:468`` ``get_head`` →
         ``proto_array_fork_choice`` delta computation + weight walk."""
@@ -487,6 +516,7 @@ class ForkChoice:
         self._old_balances = new_balances
         return self.proto.find_head(self.justified_checkpoint[1], self.current_slot)
 
+    @_locked
     def get_proposer_head(
         self,
         current_slot: int,
@@ -551,9 +581,11 @@ class ForkChoice:
 
     # -------------------------------------------------------- optimistic sync
 
+    @_locked
     def on_valid_execution_payload(self, block_root: bytes) -> None:
         self.proto.on_valid_execution_payload(block_root)
 
+    @_locked
     def on_invalid_execution_payload(
         self, block_root: bytes, latest_valid_hash: Optional[bytes] = None
     ) -> None:
@@ -561,11 +593,27 @@ class ForkChoice:
 
     # ----------------------------------------------------------------- misc
 
+    def locked(self):
+        """The instance lock, for callers doing multi-step reads straight
+        off ``self.proto`` (HTTP debug dumps, migration walks): ``prune``
+        rebuilds the node array in place, so an unlocked walker can read
+        parent indices mid-remap."""
+        return self._lock
+
+    @_locked
+    def ancestor_at_slot(self, root: bytes, slot: int) -> Optional[bytes]:
+        """Locked canonical-ancestor walk (the ``block_root_at_slot`` and
+        migration seam — see :meth:`locked`)."""
+        return self.proto.ancestor_at_slot(root, slot)
+
+    @_locked
     def contains_block(self, root: bytes) -> bool:
         return self.proto.contains_block(root)
 
+    @_locked
     def is_descendant(self, ancestor: bytes, descendant: bytes) -> bool:
         return self.proto.is_descendant(ancestor, descendant)
 
+    @_locked
     def prune(self) -> None:
         self.proto.prune(self.finalized_checkpoint[1])

@@ -1538,11 +1538,12 @@ def _head_entries(ctx, with_optimistic: bool):
     chain = ctx.chain
     proto = chain.fork_choice.proto
     heads = []
-    for root in proto.head_roots() if hasattr(proto, "head_roots") else [chain.head_root]:
-        entry = {"root": "0x" + root.hex(), "slot": str(chain._blocks_slot(root))}
-        if with_optimistic:
-            entry["execution_optimistic"] = False
-        heads.append(entry)
+    with chain.fork_choice.locked():  # prune() rebuilds the node array
+        for root in proto.head_roots() if hasattr(proto, "head_roots") else [chain.head_root]:
+            entry = {"root": "0x" + root.hex(), "slot": str(chain._blocks_slot(root))}
+            if with_optimistic:
+                entry["execution_optimistic"] = False
+            heads.append(entry)
     return heads
 
 
@@ -1575,8 +1576,9 @@ def debug_fork_choice(ctx):
     chain = ctx.chain
     proto = chain.fork_choice.proto
     nodes = []
-    for node in proto.nodes_snapshot() if hasattr(proto, "nodes_snapshot") else []:
-        nodes.append(node)
+    with chain.fork_choice.locked():  # prune() rebuilds the node array
+        for node in proto.nodes_snapshot() if hasattr(proto, "nodes_snapshot") else []:
+            nodes.append(node)
     j_epoch, j_root = chain.justified_checkpoint()
     f_epoch, f_root = chain.finalized_checkpoint()
     return {
@@ -1767,16 +1769,17 @@ def lighthouse_peers_connected(ctx):
 def lighthouse_proto_array(ctx):
     proto = ctx.chain.fork_choice.proto
     nodes = []
-    for i, n in enumerate(proto.nodes):
-        nodes.append({
-            "slot": str(n.slot),
-            "root": "0x" + n.root.hex(),
-            "parent": n.parent,
-            "weight": str(n.weight),
-            "best_child": n.best_child,
-            "best_descendant": n.best_descendant,
-            "execution_status": n.execution_status,
-        })
+    with ctx.chain.fork_choice.locked():  # prune() rebuilds the node array
+        for i, n in enumerate(proto.nodes):
+            nodes.append({
+                "slot": str(n.slot),
+                "root": "0x" + n.root.hex(),
+                "parent": n.parent,
+                "weight": str(n.weight),
+                "best_child": n.best_child,
+                "best_descendant": n.best_descendant,
+                "execution_status": n.execution_status,
+            })
     return {"data": {
         "justified_checkpoint": {
             "epoch": str(proto.justified_checkpoint[0]),

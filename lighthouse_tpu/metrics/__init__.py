@@ -375,6 +375,37 @@ SSE_EVENTS_DROPPED = counter(
     "server-sent events dropped on a full subscriber queue, by topic",
 )
 
+# In-process fault fabric (network/transport.py Hub): what the seeded
+# per-link fault plans and the net.deliver injection point did to traffic.
+NET_ENVELOPES_DROPPED = counter(
+    "net_envelopes_dropped_total",
+    "fabric envelopes not delivered, by reason (unlinked|partition|plan|fault|dead)",
+)
+NET_ENVELOPES_DELAYED = counter(
+    "net_envelopes_delayed_total",
+    "fabric envelopes queued for delayed delivery by a link plan",
+)
+NET_ENVELOPES_DUPLICATED = counter(
+    "net_envelopes_duplicated_total",
+    "fabric envelopes delivered twice by a link plan",
+)
+NET_ENVELOPES_REORDERED = counter(
+    "net_envelopes_reordered_total",
+    "fabric envelopes delivered ahead of earlier-due traffic by a link plan",
+)
+
+# Sync hardening (network/sync.py, network/backfill.py): aborted lookups and
+# backfill batches retried against a different peer — the churn scenarios'
+# evidence that a dead or lying peer cannot stall sync.
+SYNC_LOOKUP_ABORTED = counter(
+    "sync_lookup_aborted_total",
+    "single-block/parent lookups aborted before import, by reason",
+)
+BACKFILL_BATCH_RETRIES = counter(
+    "backfill_batch_retries_total",
+    "backfill batches retried against a different peer, by outcome",
+)
+
 # Additional block import stages (reference metrics.rs:40-161 has ~15).
 BLOCK_DA_CHECK_SECONDS = histogram(
     "beacon_block_da_check_seconds", "blob availability check inside import"

@@ -10,13 +10,15 @@ node can serve history.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional, Sequence
 
+from ..metrics import BACKFILL_BATCH_RETRIES
 from . import rpc as rpc_mod
 from .peer_manager import PeerAction
 from .sync import decode_signed_block
 
 BATCH_SLOTS = 32
+REQUEST_TIMEOUT_S = 10.0
 
 
 class BackfillSync:
@@ -37,27 +39,60 @@ class BackfillSync:
     def complete(self) -> bool:
         return self.oldest_slot <= 1 or self.expected_parent == b"\x00" * 32
 
-    def backfill_from(self, peer: str, target_slot: int = 0) -> int:
+    def backfill_from(self, peer: str, target_slot: int = 0, *,
+                      request_timeout: float = REQUEST_TIMEOUT_S,
+                      fallback_peers: Sequence[str] = ()) -> int:
         """Pull batches from ``peer`` until history reaches ``target_slot``
-        (or the peer runs dry).  Returns #blocks persisted."""
+        (or the peer runs dry).  Returns #blocks persisted.
+
+        Every batch request carries ``request_timeout``; a batch that fails
+        (dead peer, RPC timeout) is retried ONCE against the next peer in
+        ``fallback_peers`` (``backfill_batch_retries_total{outcome}``) —
+        a single dead peer bounds the stall to one timeout instead of
+        parking backfill forever (the churn scenarios kill the serving
+        peer mid-backfill to prove exactly this)."""
         chain = self.chain
         filled = 0
+        candidates = [peer] + [p for p in fallback_peers if p != peer]
         while not self.complete and self.oldest_slot > target_slot:
             start = max(target_slot, self.oldest_slot - BATCH_SLOTS)
             count = self.oldest_slot - start
-            try:
-                chunks = self.service.request(
-                    peer,
-                    rpc_mod.BLOCKS_BY_RANGE,
-                    rpc_mod.BlocksByRangeRequest(start_slot=start, count=count),
-                    timeout=10.0,
-                )
-            except rpc_mod.RpcSelfLimited:
-                break  # OUR outbound throttle: resume next round, no blame
-            except rpc_mod.RpcError:
-                self.service.peer_manager.report(
-                    peer, PeerAction.MID_TOLERANCE, "backfill rpc failed"
-                )
+            request = rpc_mod.BlocksByRangeRequest(start_slot=start, count=count)
+            chunks = None
+            self_limited = False
+            failed: List[str] = []
+            for attempt, serving in enumerate(candidates[:2]):
+                try:
+                    chunks = self.service.request(
+                        serving, rpc_mod.BLOCKS_BY_RANGE, request,
+                        timeout=request_timeout,
+                    )
+                except rpc_mod.RpcSelfLimited:
+                    self_limited = True  # OUR throttle: resume later, no blame
+                    break
+                except rpc_mod.RpcError:
+                    self.service.peer_manager.report(
+                        serving, PeerAction.MID_TOLERANCE, "backfill rpc failed"
+                    )
+                    failed.append(serving)
+                    if attempt == 0 and len(candidates) > 1:
+                        BACKFILL_BATCH_RETRIES.inc(outcome="retried")
+                        continue  # one retry, against a DIFFERENT peer
+                    break
+                if attempt > 0:
+                    BACKFILL_BATCH_RETRIES.inc(outcome="recovered")
+                peer = serving
+                # future batches: the answering peer first, proven-dead
+                # peers demoted LAST (a later failure must fall back to a
+                # still-untried peer, not straight back to the dead one)
+                candidates = ([serving]
+                              + [p for p in candidates
+                                 if p != serving and p not in failed]
+                              + failed)
+                break
+            if chunks is None:
+                if not self_limited and len(candidates) > 1:
+                    BACKFILL_BATCH_RETRIES.inc(outcome="exhausted")
                 break
             blocks = []
             for result, payload, _ctx in chunks:

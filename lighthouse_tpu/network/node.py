@@ -328,6 +328,14 @@ class LocalNode:
                 continue  # best-effort PER PEER; one failure must not
                 # silence the goodbye to everyone else
         self.service.shutdown()
+        self.router.reprocess.shutdown()
+        # unhook from the chain: a restarted node (SimNode.resurrect, same
+        # chain object) must not leave imports feeding a dead queue
+        try:
+            self.chain.block_imported_hooks.remove(
+                self.router.reprocess.block_imported)
+        except ValueError:
+            pass
         self.processor.shutdown()
         if getattr(self, "discv5", None) is not None:
             # persist the routing table for the next start (persisted_dht.rs)

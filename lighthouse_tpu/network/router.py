@@ -14,7 +14,7 @@ from typing import List, Optional
 
 from ..chain.beacon_chain import AttestationError, BlockError, ChainError
 from ..consensus import helpers as h
-from ..scheduler import BeaconProcessor, W, WorkEvent
+from ..scheduler import BeaconProcessor, ReprocessQueue, W, WorkEvent
 from . import rpc as rpc_mod
 from . import topics as topics_mod
 from .peer_manager import PeerAction
@@ -36,6 +36,13 @@ class Router:
         self.processor = processor if processor is not None else BeaconProcessor(max_workers=2)
         self.sync = sync_manager
         self.slasher = slasher
+        # Attestations referencing a not-yet-imported block are parked here
+        # and re-queued the moment the chain imports that root (reference
+        # work_reprocessing_queue.rs) — dropping them instead loses real
+        # fork-choice weight after every partition heal, and makes block
+        # content race the lookup that imports the missing fork.
+        self.reprocess = ReprocessQueue(self.processor)
+        chain.block_imported_hooks.append(self.reprocess.block_imported)
         # drop_during_sync enforcement: while range sync is running, stale
         # gossip (attestations/aggregates/contributions/LC updates) is
         # discarded at enqueue (reference beacon_processor lib.rs).  The
@@ -416,8 +423,24 @@ class Router:
                             "attestation to pre-finalization block",
                         )
                     elif self.sync is not None:
-                        # genuinely unknown: single-block lookup off-thread
-                        self.sync.lookup_block_async(root, sender)
+                        # genuinely unknown: park the raw item until the
+                        # root imports (park BEFORE the lookup spawns, or
+                        # the import could land between the two and strand
+                        # the attestation), then chase the block off-thread
+                        item = (topic, uncompressed, compressed, sender)
+                        self.reprocess.await_block(root, WorkEvent(
+                            work_type=W.GOSSIP_ATTESTATION,
+                            process=lambda _=None, it=item:
+                                self._process_gossip_attestations([it]),
+                        ))
+                        if chain.fork_choice.contains_block(root):
+                            # ANOTHER import path (range sync, a parent
+                            # chase) landed the root between preverify and
+                            # the park — its hook has already fired, so
+                            # release the fresh park ourselves
+                            self.reprocess.block_imported(root)
+                        else:
+                            self.sync.lookup_block_async(root, sender)
                     continue
                 self.service.peer_manager.report(
                     sender, PeerAction.MID_TOLERANCE, f"bad attestation: {e}"

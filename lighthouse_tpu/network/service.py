@@ -107,6 +107,7 @@ class NetworkService:
 
         endpoint.on_connect = self._handle_connect
         endpoint.on_disconnect = self._handle_disconnect
+        self._processing = False  # see _run: Simulator.settle quiescence
         self._shutdown = False
         self._thread = threading.Thread(
             target=self._run, name=f"net-{self.peer_id}", daemon=True
@@ -354,6 +355,11 @@ class NetworkService:
         while not self._shutdown:
             try:
                 env = self.endpoint.inbound.get(timeout=0.5)
+                # quiescence beacon for Simulator.settle(): raised the
+                # instant an envelope is in hand (BEFORE the heartbeat
+                # block below, or settle could observe empty-queue +
+                # not-processing while this envelope awaits dispatch)
+                self._processing = True
             except queue_mod.Empty:
                 env = None
             # Drain score-triggered disconnects (reference: the peer
@@ -367,6 +373,10 @@ class NetworkService:
                 self._expire_gossip_promises(now)
             if env is None:
                 continue
+            # _processing stays True until the envelope's work is handed
+            # off (router validation enqueues to the processor BEFORE the
+            # finally clears it, so a settle check that sees False + empty
+            # inbound + idle processor has seen every consequence)
             try:
                 if env.kind == "gossip":
                     self._on_gossip(env)
@@ -392,6 +402,8 @@ class NetworkService:
                 from .peer_manager import PeerAction
 
                 self.peer_manager.report(env.sender, PeerAction.LOW_TOLERANCE, "codec error")
+            finally:
+                self._processing = False
 
     # -------------------------------------------------- mesh maintenance
 

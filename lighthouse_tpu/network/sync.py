@@ -14,6 +14,7 @@ from typing import Dict, List, Optional
 
 from ..chain.beacon_chain import BlockError
 from ..logs import get_logger
+from ..metrics import SYNC_LOOKUP_ABORTED
 from . import rpc as rpc_mod
 from .peer_manager import PeerAction
 
@@ -21,6 +22,13 @@ log = get_logger("network.sync")
 
 BATCH_SLOTS = 16  # 2 epochs on the minimal preset (reference: 2-epoch batches)
 PARENT_DEPTH_LIMIT = 32  # reference ``block_lookups`` parent chain bound
+
+
+def _lookup_aborted(reason: str) -> None:
+    """One counter for every path that gives up on a lookup before import —
+    the churn scenarios' evidence that a dead/lying peer bounded the chase
+    instead of stalling it (``sync_lookup_aborted_total{reason}``)."""
+    SYNC_LOOKUP_ABORTED.inc(reason=reason)
 
 
 class SyncState:
@@ -50,6 +58,17 @@ class SyncManager:
         self._lock = threading.Lock()
         self._sync_thread: Optional[threading.Thread] = None
         self._lookups_in_flight: set = set()
+
+    def busy(self) -> bool:
+        """True while range sync or any single-block lookup is in flight —
+        the simulator's quiescence check (``Simulator.settle``) must not
+        call a fabric settled while a background chase is still importing
+        blocks."""
+        with self._lock:
+            if self._lookups_in_flight:
+                return True
+            return (self._sync_thread is not None
+                    and self._sync_thread.is_alive())
 
     # ------------------------------------------------------------- status
 
@@ -196,15 +215,18 @@ class SyncManager:
                     timeout=5.0,
                 )
             except rpc_mod.RpcError:
+                _lookup_aborted("rpc_error")
                 return
             got = [c for c in chunks if c[0] == rpc_mod.SUCCESS]
             if not got:
+                _lookup_aborted("not_found")
                 return  # peer doesn't have it either: learn nothing
             try:
                 signed = self._decode_block_chunk(got[0][1])
             except Exception:
                 self.service.peer_manager.report(
                     peer, PeerAction.LOW_TOLERANCE, "undecodable lookup block")
+                _lookup_aborted("undecodable")
                 return
             if signed.message.hash_tree_root() != block_root:
                 # The response is NOT the requested block: penalize the
@@ -212,6 +234,7 @@ class SyncManager:
                 self.service.peer_manager.report(
                     peer, PeerAction.LOW_TOLERANCE,
                     "lookup block root mismatch")
+                _lookup_aborted("root_mismatch")
                 return
             try:
                 self._import_with_blobs(peer, signed)
@@ -256,13 +279,18 @@ class SyncManager:
 
     # ------------------------------------------------------ parent lookup
 
-    def on_unknown_parent(self, orphan_block, peer: str) -> None:
+    def on_unknown_parent(self, orphan_block, peer: str,
+                          depth_limit: int = PARENT_DEPTH_LIMIT) -> None:
         """Fetch the missing ancestry by root and import in order
-        (reference ``block_lookups/`` parent lookups)."""
+        (reference ``block_lookups/`` parent lookups).  The chase is bounded
+        by ``depth_limit``: a peer feeding an endless orphan chain (or a
+        reorg deeper than the cap) aborts with a penalty and a
+        ``sync_lookup_aborted_total{reason="depth_limit"}`` tick instead of
+        chasing forever."""
         chain = self.chain
         ancestry: List[object] = [orphan_block]
         parent_root = bytes(orphan_block.message.parent_root)
-        for _ in range(PARENT_DEPTH_LIMIT):
+        for _ in range(depth_limit):
             if chain.fork_choice.contains_block(parent_root):
                 break
             try:
@@ -273,18 +301,30 @@ class SyncManager:
                     timeout=5.0,
                 )
             except rpc_mod.RpcError:
+                _lookup_aborted("rpc_error")
                 return
             got = [c for c in chunks if c[0] == rpc_mod.SUCCESS]
             if not got:
                 self.service.peer_manager.report(
                     peer, PeerAction.MID_TOLERANCE, "parent lookup failed"
                 )
+                _lookup_aborted("not_found")
                 return
-            parent = self._decode_block_chunk(got[0][1])
+            try:
+                parent = self._decode_block_chunk(got[0][1])
+            except Exception:
+                self.service.peer_manager.report(
+                    peer, PeerAction.LOW_TOLERANCE, "undecodable parent block")
+                _lookup_aborted("undecodable")
+                return
             ancestry.append(parent)
             parent_root = bytes(parent.message.parent_root)
         else:
             self.service.peer_manager.report(peer, PeerAction.LOW_TOLERANCE, "parent chain too deep")
+            _lookup_aborted("depth_limit")
+            log.warning("parent chase aborted at depth limit",
+                        peer=peer, depth=depth_limit,
+                        orphan=bytes(orphan_block.message.hash_tree_root()).hex()[:16])
             return
         for block in reversed(ancestry):
             try:

@@ -94,11 +94,20 @@ class OperationPool:
 
         candidates: List[Tuple[object, Set[int]]] = []
         state_slot = int(state.slot)
-        for (slot, _), group in self._attestations.items():
+        # Canonical candidate order (sorted keys, then bit patterns), NOT
+        # gossip-arrival order: max_cover breaks ties by position, so two
+        # nodes with the same pool contents — or one node across two runs —
+        # must pack identical bodies whatever order the wire delivered the
+        # attestations in (the scenario soak's determinism gate).
+        for (slot, _), group in sorted(self._attestations.items()):
             if not spec.attestation_includable(slot, state_slot):
                 continue
             is_electra_state = type(state).fork_name == "electra"
-            for att in group.aggregates:
+            for att in sorted(
+                group.aggregates,
+                key=lambda a: (tuple(a.aggregation_bits),
+                               tuple(getattr(a, "committee_bits", ()) or ())),
+            ):
                 committee_bits = getattr(att, "committee_bits", None)
                 # container families don't cross the electra boundary:
                 # pre-fork attestations can't ride in electra bodies (and

@@ -1,0 +1,797 @@
+"""Deterministic multi-node scenario soak engine.
+
+The adversarial half of the in-process simulator (``simulator.py`` mirrors
+the reference's ``basic-sim``/``fallback-sim`` happy path; this module is
+the reference's fault matrix grown past it): a declarative
+:class:`Scenario` spec — seed, node/validator counts, a timeline of
+:class:`Event`\\ s (partition/heal, kill/restart, checkpoint-sync join
+under lossy links, spam/slow peers, device fault plans) — executed by
+:class:`ScenarioRunner` on top of the :class:`~.network.transport.Hub`
+fault fabric and the ``fault_injection`` registry, with **convergence
+gates** at the end: every live node must agree on one head and finality
+must advance strictly past its value at the end of the fault window.
+
+Everything is seeded and deterministic: link-level fault decisions are a
+pure function of ``(seed, directed link, message index)``
+(``transport.LinkPlan``), timeline events fire at fixed window-relative
+slots, and a node that restarts or joins is pumped to the fleet head
+*before* slots resume so thread scheduling cannot change which blocks get
+built.  Two runs with the same seed produce identical final head roots —
+the slow test matrix asserts exactly that.
+
+Every run writes a **SOAK JSON** artifact (analogous to BENCH JSON):
+per-node convergence/finality evidence, slot-relative delay metric deltas
+from the tracing layer's histograms, fabric fault counters plus the
+per-link schedule digest, fault-injection plan hit counts, and device
+circuit-breaker states.
+
+Run the full matrix::
+
+    python -m lighthouse_tpu.scenarios --seed 1
+
+or one scenario with two determinism runs::
+
+    python -m lighthouse_tpu.scenarios --scenario nonfinality_spell --runs 2
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import fault_injection, metrics
+from .logs import get_logger
+from .network.transport import LinkPlan
+from .simulator import SimNode, Simulator
+
+log = get_logger("scenarios")
+
+SCENARIO_RUNS = metrics.counter(
+    "scenario_runs_total",
+    "scenario soak runs, by scenario and outcome (passed|failed|error)",
+)
+SCENARIO_EVENTS = metrics.counter(
+    "scenario_events_applied_total",
+    "timeline events applied by the scenario runner, by action",
+)
+
+#: Envelope kinds that carry gossipsub traffic (vs the rpc_* stream) — the
+#: usual target of lossy-link plans, so sync RPC stays merely slow.
+GOSSIP_KINDS = frozenset(
+    {"gossip", "ihave", "iwant", "graft", "prune", "subscribe", "unsubscribe"}
+)
+
+#: The slot-relative delay histograms (tracing layer, PR 2) sampled into
+#: every SOAK artifact as before/after deltas.
+DELAY_HISTOGRAMS = {
+    "block_arrival": metrics.BLOCK_ARRIVAL_DELAY_SECONDS,
+    "block_imported": metrics.BLOCK_IMPORTED_DELAY_SECONDS,
+    "attestation_arrival": metrics.ATTESTATION_ARRIVAL_DELAY_SECONDS,
+}
+
+
+class ScenarioFailure(AssertionError):
+    """A convergence gate (or a scenario's extra check) did not hold."""
+
+
+@dataclass
+class Event:
+    """One timeline entry: ``action`` applied at ``at_slot`` (0-based,
+    relative to the start of the fault window)."""
+
+    at_slot: int
+    action: str
+    args: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"at_slot": self.at_slot, "action": self.action,
+                "args": self.args}
+
+
+@dataclass
+class Scenario:
+    """Declarative scenario spec.  ``warmup_slots`` run with the happy-path
+    convergence assert (the fabric is clean), ``fault_slots`` run the event
+    timeline without it, ``recovery_slots`` run after every fault is
+    cleared; then the gates fire."""
+
+    name: str
+    description: str = ""
+    seed: int = 0
+    node_count: int = 3
+    validator_count: int = 16
+    warmup_slots: int = 8
+    fault_slots: int = 8
+    recovery_slots: int = 24
+    events: Tuple[Event, ...] = ()
+    #: optional callable(runner) -> dict of extra evidence; raises
+    #: AssertionError to fail the scenario (kept out of the artifact spec)
+    extra_checks: Optional[Callable[["ScenarioRunner"], dict]] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "description": self.description,
+            "seed": self.seed, "node_count": self.node_count,
+            "validator_count": self.validator_count,
+            "warmup_slots": self.warmup_slots,
+            "fault_slots": self.fault_slots,
+            "recovery_slots": self.recovery_slots,
+            "events": [e.to_dict() for e in self.events],
+        }
+
+
+def _plan_from(spec: Dict[str, Any]) -> LinkPlan:
+    kwargs = dict(spec)
+    if "kinds" in kwargs and kwargs["kinds"] is not None:
+        kinds = kwargs["kinds"]
+        kwargs["kinds"] = GOSSIP_KINDS if kinds == "gossip" else frozenset(kinds)
+    return LinkPlan(**kwargs)
+
+
+class ScenarioRunner:
+    """Executes one :class:`Scenario` and writes its SOAK JSON artifact."""
+
+    #: pump cadence while waiting on sync/backfill — each iteration drains
+    #: one fabric tick, so plan latency resolves in milliseconds of wall
+    #: time instead of one simulated slot
+    PUMP_SLEEP_S = 0.02
+    SYNC_DEADLINE_S = 60.0
+    CONVERGE_DEADLINE_S = 30.0
+
+    def __init__(self, scenario: Scenario, out_dir: Optional[str] = None):
+        self.scenario = scenario
+        self.out_dir = out_dir or os.environ.get("LIGHTHOUSE_TPU_SOAK_DIR", ".")
+        self.sim: Optional[Simulator] = None
+        self.ctx: Dict[str, Any] = {}  # cross-event state for extra checks
+        self.timeline: List[dict] = []
+        self._saved_hash_impl = None
+        self._saved_host_impl = None
+        self._breakers_touched = False
+        self._spam_endpoints: List[str] = []
+
+    # ------------------------------------------------------------ helpers
+
+    def _node(self, index: int) -> SimNode:
+        return self.sim.nodes[index]
+
+    def _pump_until(self, cond: Callable[[], bool], timeout: float,
+                    rekick: Optional[Callable[[], None]] = None) -> bool:
+        """Advance fabric ticks (so delayed envelopes drain) until ``cond``
+        holds; ``rekick`` fires about once a second (re-triggering sync for
+        a node whose first attempt lost a race)."""
+        deadline = time.monotonic() + timeout
+        last_kick = 0.0
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            if self.sim.hub.pending_delayed():
+                self.sim.hub.advance_tick()
+            if rekick is not None and time.monotonic() - last_kick > 1.0:
+                last_kick = time.monotonic()
+                rekick()
+            time.sleep(self.PUMP_SLEEP_S)
+        return cond()
+
+    def _pump_node_to_head(self, node: SimNode, donor: SimNode,
+                           deadline: Optional[float] = None) -> None:
+        """Block until ``node`` reaches ``donor``'s head, re-kicking range
+        sync via a fresh status push — a restarted/joined node resumes
+        duties only once synced, so thread scheduling cannot change which
+        blocks the fleet builds."""
+
+        def rekick() -> None:
+            try:
+                node.node.sync.on_peer_status(
+                    donor.peer_id, donor.node.router.local_status())
+            except Exception:
+                pass  # donor churning mid-kick: the next kick retries
+
+        ok = self._pump_until(
+            lambda: node.chain.head_root == donor.chain.head_root,
+            deadline or self.SYNC_DEADLINE_S, rekick=rekick)
+        if not ok:
+            raise ScenarioFailure(
+                f"node {node.peer_id} failed to sync to {donor.peer_id} "
+                f"within {deadline or self.SYNC_DEADLINE_S}s")
+
+    def _donor(self) -> SimNode:
+        """A live full node to sync against (lowest index, the convention
+        every built-in scenario follows for its anchor)."""
+        for n in self.sim.live_nodes:
+            if n.harness is not None:
+                return n
+        raise ScenarioFailure("no live full node left to sync against")
+
+    def _step_slot(self) -> int:
+        """One fault-window/recovery slot: advance clocks, run duties on
+        every live node, drain one fabric tick, then ``Simulator.settle``
+        until the fabric is quiescent — each slot's gossip lands before
+        the next slot proposes, keeping block content deterministic (but
+        no convergence assert: fault windows diverge by design)."""
+        sim = self.sim
+        slot = None
+        for n in sim.live_nodes:
+            slot = n.advance_slot()
+        for n in sim.live_nodes:
+            n.run_duties(slot)
+            sim.settle()  # per-node: see Simulator.run_slot
+        sim.hub.advance_tick()
+        sim.settle()
+        heads = {n.chain.head_root for n in sim.live_nodes}
+        max_final = max(
+            n.chain.finalized_checkpoint()[0] for n in sim.live_nodes)
+        self.timeline.append(
+            {"slot": slot, "distinct_heads": len(heads),
+             "head_root": sim.live_nodes[0].chain.head_root.hex(),
+             "max_finalized_epoch": max_final})
+        return slot
+
+    def _finalized(self, agg) -> int:
+        return agg(n.chain.finalized_checkpoint()[0]
+                   for n in self.sim.live_nodes)
+
+    # ------------------------------------------------------- event actions
+
+    def _apply(self, event: Event) -> None:
+        handler = getattr(self, f"_ev_{event.action}", None)
+        if handler is None:
+            raise ValueError(f"unknown scenario action {event.action!r}")
+        log.info("scenario event", scenario=self.scenario.name,
+                 action=event.action, at_slot=event.at_slot)
+        SCENARIO_EVENTS.inc(action=event.action)
+        handler(**event.args)
+
+    def _ev_partition(self, groups: Sequence[Sequence[int]]) -> None:
+        for gid, group in enumerate(groups):
+            for index in group:
+                self.sim.hub.set_partition(self._node(index).peer_id, gid)
+
+    def _ev_heal(self) -> None:
+        self.sim.hub.clear_partitions()
+
+    def _ev_kill(self, node: int) -> None:
+        self.sim.kill_node(node)
+
+    def _ev_restart(self, node: int) -> None:
+        restarted = self.sim.restart_node(node)
+        self._pump_node_to_head(restarted, self._donor())
+
+    def _ev_link_plan(self, a: int, b: int, plans: Sequence[dict]) -> None:
+        pa, pb = self._node(a).peer_id, self._node(b).peer_id
+        for i, spec in enumerate(plans):
+            self.sim.hub.set_link_plan(pa, pb, _plan_from(spec), append=i > 0)
+
+    def _ev_clear_link_plans(self) -> None:
+        self.sim.hub.clear_link_plans()
+
+    def _ev_install_faults(self, spec: str) -> None:
+        for plan in fault_injection.parse_spec(spec):
+            fault_injection.REGISTRY.install(plan)
+
+    def _ev_clear_faults(self) -> None:
+        fault_injection.clear()
+
+    def _ev_breaker_config(self, **kwargs) -> None:
+        from . import device_supervisor
+
+        self._breakers_touched = True
+        device_supervisor.SUPERVISOR.configure(
+            config=device_supervisor.BreakerConfig(**kwargs))
+
+    def _ev_device_hashing(self, enable: bool, threshold_blocks: int = 4) -> None:
+        """Route Merkle pair-hash layers of ``threshold_blocks``+ through
+        the supervised device op (so a ``device.dispatch[op=sha256_pairs]``
+        fault plan has a seam to bite mid-sync); host and device produce
+        identical bytes, so enabling it never changes chain content.  The
+        swap mirrors ``sha256_device.install_device_hash`` but is
+        reversible, and ``_HOST_IMPL`` is pointed at the saved kernel so
+        the supervisor's fallback cannot recurse into the hybrid."""
+        from .ops import sha256_device
+        from .types import ssz as ssz_mod
+
+        if enable:
+            if self._saved_hash_impl is not None:
+                return
+            host = self._saved_hash_impl = ssz_mod._hash_pairs
+            self._saved_host_impl = sha256_device._HOST_IMPL
+            sha256_device._HOST_IMPL = host
+
+            def hybrid(data: bytes) -> bytes:
+                n = len(data) // 64
+                if threshold_blocks <= n <= sha256_device.N_BUCKETS[-1]:
+                    return sha256_device.hash_pairs_device(data)
+                return host(data)
+
+            ssz_mod.set_hash_pairs_impl(hybrid)
+        elif self._saved_hash_impl is not None:
+            ssz_mod.set_hash_pairs_impl(self._saved_hash_impl)
+            sha256_device._HOST_IMPL = self._saved_host_impl
+            self._saved_hash_impl = None
+
+    def _ev_join_checkpoint(self, anchor_from: int = 0, lossy: bool = False,
+                            backfill: bool = False,
+                            churn_kill: Optional[int] = None) -> None:
+        """A new node joins from ``anchor_from``'s finalized checkpoint.
+        ``lossy``: its links get a seeded lossy-gossip + slow-RPC plan
+        BEFORE sync starts.  ``backfill``: it then backfills history; with
+        ``churn_kill`` the named peer is killed first and listed as the
+        preferred backfill server, so the dead-peer timeout/retry path is
+        what actually fills history."""
+        donor = self._node(anchor_from)
+        joined = self.sim.add_checkpoint_node(anchor_from=anchor_from)
+        self.ctx["joined"] = joined
+        if lossy:
+            for other in self.sim.live_nodes:
+                if other is joined:
+                    continue
+                self.sim.hub.set_link_plan(
+                    joined.peer_id, other.peer_id,
+                    LinkPlan(drop=0.2, delay=1, jitter=1, duplicate=0.1,
+                             reorder=0.3, kinds=GOSSIP_KINDS))
+                self.sim.hub.set_link_plan(
+                    joined.peer_id, other.peer_id,
+                    LinkPlan(delay=1, kinds=frozenset(
+                        {"rpc_request", "rpc_response"})),
+                    append=True)
+        self._pump_node_to_head(joined, donor)
+        if not backfill:
+            return
+        from .network.backfill import BackfillSync
+
+        sync = BackfillSync(chain=joined.chain, service=joined.node.service)
+        self.ctx["backfill"] = sync
+        dead_peer = None
+        if churn_kill is not None:
+            dead_peer = self._node(churn_kill).peer_id
+            self.sim.kill_node(churn_kill)
+        serving = dead_peer or donor.peer_id
+        fallbacks = [n.peer_id for n in self.sim.live_nodes
+                     if n is not joined and n.peer_id != serving]
+        done: Dict[str, Any] = {}
+
+        def run_backfill() -> None:
+            try:
+                done["filled"] = sync.backfill_from(
+                    serving, request_timeout=2.0, fallback_peers=fallbacks)
+            except Exception as e:  # surfaced by the gate below
+                done["error"] = repr(e)
+
+        worker = threading.Thread(target=run_backfill, daemon=True,
+                                  name="scenario-backfill")
+        worker.start()
+        self._pump_until(lambda: not worker.is_alive(), self.SYNC_DEADLINE_S)
+        if worker.is_alive() or "error" in done:
+            raise ScenarioFailure(
+                f"backfill did not finish cleanly: {done.get('error', 'stuck')}")
+        self.ctx["backfill_filled"] = done.get("filled", 0)
+        if churn_kill is not None:
+            restarted = self.sim.restart_node(churn_kill)
+            self._pump_node_to_head(restarted, donor)
+
+    def _ev_spam(self, target: int = 0, count: int = 64) -> None:
+        """An ephemeral hub peer floods the target with undecodable gossip
+        on a real subscribed topic — the peer-scoring path must absorb and
+        penalize it without disturbing the honest mesh."""
+        import hashlib
+
+        from .network import topics as topics_mod
+        from .network.transport import Envelope
+
+        victim = self._node(target)
+        spammer_id = f"spammer{len(self._spam_endpoints)}"
+        endpoint = self.sim.hub.register(spammer_id)
+        self._spam_endpoints.append(spammer_id)
+        self.sim.hub.connect(spammer_id, victim.peer_id)
+        topic = str(topics_mod.GossipTopic(
+            victim.node.router.fork_digest, topics_mod.BEACON_BLOCK))
+        for i in range(count):
+            junk = hashlib.sha256(
+                f"{self.scenario.seed}:spam:{i}".encode()).digest()
+            endpoint.send(victim.peer_id, Envelope(
+                kind="gossip", sender=spammer_id, topic=topic, data=junk))
+        self.ctx["spammer"] = (spammer_id, victim)
+
+    # ------------------------------------------------------------ the run
+
+    def run(self) -> dict:
+        scenario = self.scenario
+        started = time.monotonic()
+        delay_before = {k: h.stats() for k, h in DELAY_HISTOGRAMS.items()}
+        # fault-window evidence, captured before recovery clears the plans
+        breakers: Optional[dict] = None
+        fault_plans: Optional[list] = None
+        self.sim = Simulator(
+            node_count=scenario.node_count,
+            validator_count=scenario.validator_count,
+            seed=scenario.seed,
+        )
+        self.sim.hub.record_schedule()
+        artifact: dict = {"scenario": scenario.to_dict(), "passed": False}
+        try:
+            for _ in range(scenario.warmup_slots):
+                self.sim.run_slot()
+            finalized_at_window_start = self._finalized(max)
+
+            events = sorted(scenario.events, key=lambda e: e.at_slot)
+            queue = list(events)
+            for offset in range(scenario.fault_slots):
+                while queue and queue[0].at_slot <= offset:
+                    self._apply(queue.pop(0))
+                self._step_slot()
+            for event in queue:  # events past the window still apply once
+                self._apply(event)
+            finalized_at_window_end = self._finalized(max)
+            breakers = self._breaker_summary()
+            fault_plans = fault_injection.plans()
+
+            # implicit recovery: every fabric fault heals, injected faults
+            # clear; churned nodes must have been restarted by the timeline
+            self.sim.hub.clear_partitions()
+            self.sim.hub.clear_link_plans()
+            fault_injection.clear()
+            for _ in range(scenario.recovery_slots):
+                self._step_slot()
+
+            converged = self.sim.wait_converged(self.CONVERGE_DEADLINE_S)
+            final_finalized_min = self._finalized(min)
+            per_node = [self._node_summary(n) for n in self.sim.nodes]
+            extra = {}
+            if scenario.extra_checks is not None:
+                extra = scenario.extra_checks(self) or {}
+
+            if not converged:
+                raise ScenarioFailure(
+                    f"live nodes did not converge: "
+                    f"{[p['head_root'][:16] for p in per_node if p['alive']]}")
+            if final_finalized_min <= finalized_at_window_end:
+                raise ScenarioFailure(
+                    f"finality did not advance past the fault window "
+                    f"({final_finalized_min} <= {finalized_at_window_end})")
+
+            head = self.sim.live_nodes[0].chain.head_root
+            artifact.update({
+                "passed": True,
+                "result": {
+                    "converged": True,
+                    "head_root": head.hex(),
+                    "head_slot": self.sim.live_nodes[0].chain.head_slot(),
+                    "finalized_at_window_start": finalized_at_window_start,
+                    "finalized_at_window_end": finalized_at_window_end,
+                    "final_finalized_epoch": final_finalized_min,
+                    "per_node": per_node,
+                },
+                "extra": extra,
+            })
+            SCENARIO_RUNS.inc(scenario=scenario.name, outcome="passed")
+            return artifact
+        except ScenarioFailure as e:
+            artifact["failure"] = str(e)
+            SCENARIO_RUNS.inc(scenario=scenario.name, outcome="failed")
+            raise
+        except Exception as e:
+            artifact["failure"] = f"{type(e).__name__}: {e}"
+            SCENARIO_RUNS.inc(scenario=scenario.name, outcome="error")
+            raise
+        finally:
+            try:
+                if breakers is None:  # failed before the window-end snapshot
+                    breakers = self._breaker_summary()
+                    fault_plans = fault_injection.plans()
+                artifact.update({
+                    "net": {
+                        "counters": self.sim.hub.fault_counters(),
+                        "schedule_digest": self.sim.hub.schedule_digest(),
+                        "pending_delayed": self.sim.hub.pending_delayed(),
+                    },
+                    "faults": fault_plans,
+                    "breakers": breakers,
+                    "delay_metrics": self._delay_deltas(delay_before),
+                    "timeline": self.timeline,
+                    "duration_s": round(time.monotonic() - started, 3),
+                })
+                self._write_artifact(artifact)
+            finally:
+                self._cleanup()
+
+    # ---------------------------------------------------------- reporting
+
+    def _node_summary(self, n: SimNode) -> dict:
+        f_epoch, _ = n.chain.finalized_checkpoint()
+        return {
+            "peer_id": n.peer_id,
+            "alive": n.alive,
+            "validators": len(n.keys),
+            "head_slot": n.chain.head_slot(),
+            "head_root": n.chain.head_root.hex(),
+            "finalized_epoch": f_epoch,
+        }
+
+    def _breaker_summary(self) -> dict:
+        from . import device_supervisor
+
+        summary = device_supervisor.summary()
+        return {
+            b["op"]: {"state": b["state"], "trips_total": b.get("trips_total", 0)}
+            for b in summary.get("breakers", [])
+        }
+
+    def _delay_deltas(self, before: Dict[str, Tuple[int, float]]) -> dict:
+        """Slot-relative delay deltas over this run (the tracing layer's
+        histograms are process-cumulative; a per-scenario artifact wants
+        just this scenario's traffic)."""
+        out = {}
+        for key, hist in DELAY_HISTOGRAMS.items():
+            n0, s0 = before[key]
+            n1, s1 = hist.stats()
+            count = n1 - n0
+            out[key] = {
+                "count": count,
+                "mean_s": round((s1 - s0) / count, 6) if count else None,
+            }
+        return out
+
+    def _write_artifact(self, artifact: dict) -> Optional[str]:
+        try:
+            os.makedirs(self.out_dir, exist_ok=True)
+            path = os.path.join(
+                self.out_dir,
+                f"SOAK_{self.scenario.name}_seed{self.scenario.seed}.json")
+            with open(path, "w") as f:
+                json.dump(artifact, f, indent=2, sort_keys=True)
+            artifact["artifact_path"] = path
+            log.info("soak artifact written", path=path,
+                     passed=artifact.get("passed", False))
+            return path
+        except OSError:
+            log.warning("soak artifact not written", out_dir=self.out_dir)
+            return None
+
+    def _cleanup(self) -> None:
+        fault_injection.clear()
+        if self._saved_hash_impl is not None:
+            self._ev_device_hashing(enable=False)
+        if self._breakers_touched:
+            from . import device_supervisor
+
+            device_supervisor.reset_for_tests()
+        if self.sim is not None:
+            for spammer in self._spam_endpoints:
+                self.sim.hub.unregister(spammer)
+            self.sim.shutdown()
+
+
+# --------------------------------------------------------------- built-ins
+
+
+def smoke_partition(seed: int = 0) -> Scenario:
+    """Tier-1 smoke: a 3-node fleet partitions {0} | {1, 2} for four slots,
+    both sides fork, the heal converges them and finality resumes."""
+    return Scenario(
+        name="smoke_partition",
+        description="partition/heal smoke with a small fork and reorg",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=8, fault_slots=6, recovery_slots=24,
+        events=(
+            Event(0, "partition", {"groups": [[0], [1, 2]]}),
+            Event(4, "heal"),
+        ),
+        extra_checks=_check_reorg,
+    )
+
+
+def partition_deep_reorg(seed: int = 0) -> Scenario:
+    """A minority node builds alone for a full epoch, then reorgs back to
+    the majority fork — the deepest reorg the parent-lookup path must walk."""
+    return Scenario(
+        name="partition_deep_reorg",
+        description="epoch-long minority partition, deep reorg on heal",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=8, fault_slots=10, recovery_slots=24,
+        events=(
+            Event(0, "partition", {"groups": [[0], [1, 2]]}),
+            Event(8, "heal"),
+        ),
+        extra_checks=_check_reorg,
+    )
+
+
+def nonfinality_spell(seed: int = 0) -> Scenario:
+    """>1/3 of validators go offline: finality stalls for two epochs, the
+    nodes come back, sync, and finality resumes (the reference's
+    fallback-sim liveness property plus recovery)."""
+    return Scenario(
+        name="nonfinality_spell",
+        description=">1/3 offline non-finality spell with recovery",
+        seed=seed, node_count=5, validator_count=20,
+        warmup_slots=32, fault_slots=24, recovery_slots=24,
+        events=(
+            Event(0, "kill", {"node": 3}),
+            Event(0, "kill", {"node": 4}),
+            Event(16, "restart", {"node": 3}),
+            Event(16, "restart", {"node": 4}),
+        ),
+        extra_checks=_check_stall,
+    )
+
+
+def checkpoint_join_lossy(seed: int = 0) -> Scenario:
+    """A node checkpoint-syncs into a lossy fabric (seeded gossip drop /
+    delay / duplication / reordering, slow RPC), then backfills history
+    through a dead preferred peer — the timeout+retry path fills it."""
+    return Scenario(
+        name="checkpoint_join_lossy",
+        description="checkpoint-sync join under lossy links + backfill churn",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=40, fault_slots=8, recovery_slots=24,
+        events=(
+            Event(0, "join_checkpoint",
+                  {"anchor_from": 0, "lossy": True, "backfill": True,
+                   "churn_kill": 1}),
+        ),
+        extra_checks=_check_backfill,
+    )
+
+
+def device_breaker_mid_sync(seed: int = 0) -> Scenario:
+    """A joining node range-syncs while every ``sha256_pairs`` device
+    dispatch faults: the supervisor's breaker trips OPEN, imports resolve
+    through the host golden model, and sync still converges."""
+    return Scenario(
+        name="device_breaker_mid_sync",
+        description="device.dispatch fault plan trips the breaker mid-sync",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=32, fault_slots=8, recovery_slots=24,
+        events=(
+            Event(0, "breaker_config",
+                  {"failure_threshold": 2, "open_cooldown_s": 300.0,
+                   "probe_successes": 1}),
+            Event(0, "device_hashing", {"enable": True}),
+            Event(0, "install_faults",
+                  {"spec": "device.dispatch[op=sha256_pairs]=error"}),
+            Event(1, "join_checkpoint", {"anchor_from": 0}),
+            Event(4, "clear_faults"),
+            Event(4, "device_hashing", {"enable": False}),
+        ),
+        extra_checks=_check_breaker_tripped,
+    )
+
+
+def spam_slow_peer(seed: int = 0) -> Scenario:
+    """A spammer floods undecodable blocks at one node while another pair's
+    RPC link turns slow: scoring graylists the spammer, the mesh converges
+    anyway."""
+    return Scenario(
+        name="spam_slow_peer",
+        description="gossip spam + slow RPC link, mesh unharmed",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=8, fault_slots=8, recovery_slots=16,
+        events=(
+            Event(0, "link_plan",
+                  {"a": 1, "b": 2,
+                   "plans": [{"delay": 1, "jitter": 1,
+                              "kinds": ["rpc_request", "rpc_response"]}]}),
+            Event(1, "spam", {"target": 0, "count": 64}),
+        ),
+        extra_checks=_check_spammer_penalized,
+    )
+
+
+# ------------------------------------------------------------ extra checks
+
+
+def _check_reorg(runner: ScenarioRunner) -> dict:
+    """The minority side really forked and really reorged back."""
+    forked = max(t["distinct_heads"] for t in runner.timeline)
+    assert forked >= 2, "partition never produced distinct heads"
+    return {"max_distinct_heads": forked}
+
+
+def _check_stall(runner: ScenarioRunner) -> dict:
+    """Finality stalled while >1/3 were offline (the timeline's
+    max_finalized must be flat across the first half of the window)."""
+    window = runner.timeline[: runner.scenario.fault_slots]
+    stalled = window[: 16]
+    assert stalled, "no fault-window timeline recorded"
+    values = {t["max_finalized_epoch"] for t in stalled}
+    assert len(values) == 1, f"finality advanced during the spell: {values}"
+    return {"stalled_at_epoch": values.pop()}
+
+
+def _check_backfill(runner: ScenarioRunner) -> dict:
+    sync = runner.ctx.get("backfill")
+    assert sync is not None and sync.complete, "backfill did not complete"
+    retries = metrics.BACKFILL_BATCH_RETRIES.get(outcome="recovered")
+    assert retries >= 1, "dead-peer backfill never exercised the retry path"
+    return {"backfill_filled": runner.ctx.get("backfill_filled", 0),
+            "backfill_retries_recovered": retries}
+
+
+def _check_breaker_tripped(runner: ScenarioRunner) -> dict:
+    joined = runner.ctx.get("joined")
+    assert joined is not None, "join event never ran"
+    from . import device_supervisor
+
+    br = device_supervisor.SUPERVISOR.breaker("sha256_pairs")
+    snapshot = br.snapshot()
+    assert snapshot["trips_total"] >= 1, "breaker never tripped mid-sync"
+    return {"breaker": snapshot}
+
+
+def _check_spammer_penalized(runner: ScenarioRunner) -> dict:
+    spammer_id, victim = runner.ctx["spammer"]
+    score = victim.node.service.peer_manager._peer(spammer_id).score
+    assert score < 0, f"spammer was never penalized (score {score})"
+    return {"spammer_score": score}
+
+
+#: name -> factory(seed); the full matrix in documentation order
+SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
+    "smoke_partition": smoke_partition,
+    "partition_deep_reorg": partition_deep_reorg,
+    "nonfinality_spell": nonfinality_spell,
+    "checkpoint_join_lossy": checkpoint_join_lossy,
+    "device_breaker_mid_sync": device_breaker_mid_sync,
+    "spam_slow_peer": spam_slow_peer,
+}
+
+
+def run_scenario(name_or_scenario, seed: int = 0,
+                 out_dir: Optional[str] = None) -> dict:
+    scenario = (SCENARIOS[name_or_scenario](seed)
+                if isinstance(name_or_scenario, str) else name_or_scenario)
+    return ScenarioRunner(scenario, out_dir=out_dir).run()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    from .crypto.bls.backends import set_backend
+
+    parser = argparse.ArgumentParser(
+        description="deterministic multi-node scenario soak")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--scenario", choices=sorted(SCENARIOS),
+                        help="run one scenario (default: the full matrix)")
+    parser.add_argument("--out", default=None, help="artifact directory")
+    parser.add_argument("--runs", type=int, default=1,
+                        help="repeat each scenario N times and require "
+                             "identical final head roots (determinism gate)")
+    args = parser.parse_args(argv)
+
+    set_backend("fake")
+    names = [args.scenario] if args.scenario else list(SCENARIOS)
+    failures = []
+    for name in names:
+        heads = []
+        for run_index in range(max(1, args.runs)):
+            print(f"=== {name} (seed {args.seed}, run {run_index + 1}) ===")
+            try:
+                artifact = run_scenario(name, seed=args.seed, out_dir=args.out)
+            except Exception as e:  # noqa: BLE001 — report, keep the matrix going
+                print(f"FAIL {name}: {e}")
+                failures.append(name)
+                break
+            result = artifact["result"]
+            heads.append(result["head_root"])
+            print(f"ok {name}: head {result['head_root'][:16]} "
+                  f"finalized {result['final_finalized_epoch']} "
+                  f"({artifact['duration_s']}s) -> "
+                  f"{artifact.get('artifact_path', '-')}")
+        if len(set(heads)) > 1:
+            print(f"FAIL {name}: nondeterministic heads {heads}")
+            failures.append(name)
+    if failures:
+        print(f"scenario soak: FAILED {sorted(set(failures))}")
+        return 1
+    print(f"scenario soak: OK ({len(names)} scenarios)")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -273,12 +273,18 @@ class ReprocessQueue:
     (reference: ``work_reprocessing_queue.rs``, doc ``:1-12``)."""
 
     MAX_DELAYED = 16384
+    #: how long work may await a block that never imports (a lookup that
+    #: aborts — dead peer, depth cap, bad response — must not strand its
+    #: parked attestations forever, or the cap above eventually disables
+    #: parking for the rest of the process)
+    AWAIT_TTL_S = 12.0
 
     def __init__(self, processor: BeaconProcessor):
         self.processor = processor
         self._lock = threading.Condition()
         self._by_time: List = []  # heap of (due, seq, event)
-        self._awaiting_root: Dict[bytes, List[WorkEvent]] = {}
+        # root -> [(expires_at, event)]
+        self._awaiting_root: Dict[bytes, List[tuple]] = {}
         self._seq = 0
         self._n_awaiting = 0
         self._shutdown = False
@@ -296,22 +302,37 @@ class ReprocessQueue:
             self._lock.notify_all()
 
     def await_block(self, block_root: bytes, event: WorkEvent) -> bool:
-        """Queue ``event`` until ``block_imported(block_root)``."""
+        """Queue ``event`` until ``block_imported(block_root)`` — or until
+        ``AWAIT_TTL_S`` passes without it (then it is dropped)."""
         with self._lock:
             if self._n_awaiting >= self.MAX_DELAYED:
                 return False
-            self._awaiting_root.setdefault(block_root, []).append(event)
+            self._awaiting_root.setdefault(block_root, []).append(
+                (time.monotonic() + self.AWAIT_TTL_S, event))
             self._n_awaiting += 1
             return True
 
     def block_imported(self, block_root: bytes) -> int:
         """Release work waiting on a now-imported block; returns #released."""
         with self._lock:
-            events = self._awaiting_root.pop(block_root, [])
-            self._n_awaiting -= len(events)
-        for ev in events:
+            entries = self._awaiting_root.pop(block_root, [])
+            self._n_awaiting -= len(entries)
+        for _expires, ev in entries:
             self.processor.send(ev)
-        return len(events)
+        return len(entries)
+
+    def _expire_awaiting(self, now: float) -> None:
+        """Drop parked work whose block never imported (caller holds the
+        lock) — the sibling of the reference's queued-attestation expiry."""
+        for root in list(self._awaiting_root):
+            kept = [e for e in self._awaiting_root[root] if e[0] > now]
+            dropped = len(self._awaiting_root[root]) - len(kept)
+            if dropped:
+                self._n_awaiting -= dropped
+                if kept:
+                    self._awaiting_root[root] = kept
+                else:
+                    del self._awaiting_root[root]
 
     def _run(self) -> None:
         import heapq
@@ -321,6 +342,7 @@ class ReprocessQueue:
                 if self._shutdown:
                     return
                 now = time.monotonic()
+                self._expire_awaiting(now)
                 due_events = []
                 while self._by_time and self._by_time[0][0] <= now:
                     _, _, ev = heapq.heappop(self._by_time)

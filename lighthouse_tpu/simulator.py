@@ -21,28 +21,65 @@ from .network.transport import Hub
 class SimNode:
     def __init__(self, *, index: int, hub: Optional[Hub], validator_count: int,
                  keys: List[int], genesis_time: int, spec=None,
-                 endpoint=None):
+                 endpoint=None, chain=None, peer_id: Optional[str] = None):
         self.index = index
-        self.harness = BeaconChainHarness(
-            validator_count=validator_count, fake_crypto=True,
-            genesis_time=genesis_time, spec=spec,
-        )
+        if chain is not None:
+            # Chain-only node (checkpoint-sync join): no duty keys, no
+            # harness — it follows the chain over gossip/sync.
+            self.harness = None
+            self._chain = chain
+        else:
+            self.harness = BeaconChainHarness(
+                validator_count=validator_count, fake_crypto=True,
+                genesis_time=genesis_time, spec=spec,
+            )
+            self._chain = self.harness.chain
         self.keys = set(keys)  # validator indices this node runs
+        self.alive = True
         self.node = LocalNode(
-            hub=hub, peer_id=f"sim{index}", harness=self.harness,
-            endpoint=endpoint,
+            hub=hub, peer_id=peer_id or f"sim{index}",
+            chain=self._chain, harness=self.harness, endpoint=endpoint,
         )
+
+    @classmethod
+    def resurrect(cls, old: "SimNode", *, hub: Hub) -> "SimNode":
+        """A restarted node: same chain, same keys, same peer id, fresh
+        network stack (the store survived the crash; the socket did not)."""
+        fresh = cls.__new__(cls)
+        fresh.index = old.index
+        fresh.harness = old.harness
+        fresh._chain = old.chain
+        fresh.keys = old.keys
+        fresh.alive = True
+        fresh.node = LocalNode(hub=hub, peer_id=old.peer_id,
+                               chain=old.chain, harness=old.harness)
+        return fresh
 
     @property
     def chain(self):
-        return self.harness.chain
+        return self._chain
+
+    @property
+    def peer_id(self) -> str:
+        return self.node.peer_id
+
+    def advance_slot(self) -> int:
+        """Advance this node's clock one slot (harness nodes run the
+        per-slot chain task too; chain-only nodes just move the clock)."""
+        if self.harness is not None:
+            return self.harness.advance_slot()
+        clock = self.chain.slot_clock
+        clock.set_slot((clock.now() or 0) + 1)
+        return self.chain.current_slot()
 
     def run_duties(self, slot: int) -> Dict[str, int]:
         """One slot of duties for OUR validators: propose if ours, attest
         with our committee members (published over gossip)."""
+        out = {"proposed": 0, "attested": 0}
+        if self.harness is None or not self.keys:
+            return out
         harness, chain = self.harness, self.chain
         spec = harness.spec
-        out = {"proposed": 0, "attested": 0}
         state, parent_root = chain.state_at_slot(slot)
         proposer = h.get_beacon_proposer_index(state, spec)
         if proposer in self.keys:
@@ -76,6 +113,7 @@ class SimNode:
     def shutdown(self) -> None:
         # sever the fabric links too: live peers must stop delivering into a
         # dead node's inbound queue (unbounded growth otherwise)
+        self.alive = False
         endpoint = self.node.endpoint
         if hasattr(endpoint, "hub"):
             for peer in list(endpoint.connected_peers()):
@@ -95,13 +133,16 @@ class Simulator:
 
     def __init__(self, *, node_count: int = 3, validator_count: int = 16,
                  genesis_time: int = 1_600_000_000, spec=None,
-                 transport: str = "hub", discovery: Optional[str] = None):
+                 transport: str = "hub", discovery: Optional[str] = None,
+                 seed: int = 0):
         if transport not in ("hub", "tcp_secured"):
             raise ValueError(f"unknown transport {transport!r}")
         tcp = transport == "tcp_secured"
+        self.genesis_time = genesis_time
+        self.validator_count = validator_count
         self.nodes: List[SimNode] = []
         self.boot_discv5 = None
-        self.hub = None if tcp else Hub()
+        self.hub = None if tcp else Hub(seed=seed)
         shares: List[List[int]] = [[] for _ in range(node_count)]
         for v in range(validator_count):
             shares[v % node_count].append(v)
@@ -144,55 +185,190 @@ class Simulator:
             self.shutdown()
             raise
 
-    def run_slot(self) -> int:
-        """Advance every clock one slot and run all duties; returns the slot.
-        Raises if gossip fails to converge the heads (a divergence would
-        otherwise burn the whole run before the final check reports it)."""
+    @property
+    def live_nodes(self) -> List[SimNode]:
+        return [n for n in self.nodes if n.alive]
+
+    def run_slot(self, require_converged: bool = True) -> int:
+        """Advance every live clock one slot and run all duties; returns the
+        slot.  With ``require_converged`` (the happy-path default) raises if
+        gossip fails to converge the heads — a divergence would otherwise
+        burn the whole run before the final check reports it.  Scenario
+        runs pass ``False`` while a fault window is open (partitioned or
+        lossy fabrics diverge by design; the convergence GATE runs after
+        recovery)."""
         slot = None
-        for n in self.nodes:
-            slot = n.harness.advance_slot()
-        for n in self.nodes:
+        for n in self.live_nodes:
+            slot = n.advance_slot()
+        for n in self.live_nodes:
             n.run_duties(slot)
-        if not self.wait_converged():
+            # settle between nodes: whether the NEXT node's attesters see
+            # this node's freshly-published block must be a property of
+            # the topology, never of thread scheduling (the scenario
+            # soak's determinism gate hangs on this)
+            self.settle()
+        if self.hub is not None:
+            # one fabric tick per slot: link-plan latency is slot-granular
+            self.hub.advance_tick()
+            self.settle()
+        if require_converged and not self.wait_converged():
             raise AssertionError(f"heads failed to converge at slot {slot}")
         return slot
 
-    def run_epochs(self, epochs: int) -> None:
-        spe = self.nodes[0].harness.spec.slots_per_epoch
+    def run_epochs(self, epochs: int, require_converged: bool = True) -> None:
+        spe = self.spec.slots_per_epoch
         for _ in range(epochs * spe):
-            self.run_slot()
+            self.run_slot(require_converged=require_converged)
 
-    def wait_converged(self, timeout: float = 10.0) -> bool:
-        """Wait until every node agrees on the head (gossip settled)."""
+    @property
+    def spec(self):
+        for n in self.nodes:
+            if n.harness is not None:
+                return n.harness.spec
+        return self.nodes[0].chain.spec
+
+    def settle(self, timeout: float = 10.0, rounds: int = 2) -> bool:
+        """Block until the fabric is quiescent: every live node's inbound
+        queue empty, its network loop between envelopes, and its processor
+        idle — for ``rounds`` consecutive checks (work can cascade: a
+        drained envelope may forward gossip into another node's inbound).
+
+        This, not head equality, is what makes a slot deterministic: the
+        next proposer's op pool must hold every attestation the wire
+        delivered, or block content races thread scheduling."""
         import time
 
         deadline = time.monotonic() + timeout
+        consecutive = 0
+        while consecutive < rounds:
+            quiet = True
+            for n in self.live_nodes:
+                node = n.node
+                if not node.endpoint.inbound.empty() or \
+                        getattr(node.service, "_processing", False):
+                    quiet = False
+                if node.sync.busy():  # background lookups still importing
+                    quiet = False
+                if not node.processor.wait_idle(
+                        max(0.0, deadline - time.monotonic())):
+                    quiet = False
+            if quiet:
+                consecutive += 1
+            else:
+                consecutive = 0
+                if time.monotonic() > deadline:
+                    return False
+            time.sleep(0.002)
+        return True
+
+    def wait_converged(self, timeout: float = 10.0,
+                       nodes: Optional[List[SimNode]] = None) -> bool:
+        """Wait until every (live) node agrees on the head (gossip settled).
+        Pumps the fabric's delayed queue while waiting so plan latency
+        cannot deadlock convergence."""
+        import time
+
+        group = [n for n in (nodes if nodes is not None else self.nodes)
+                 if n.alive]
+        if not group:
+            return True
+        deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            heads = {n.chain.head_root for n in self.nodes}
+            heads = {n.chain.head_root for n in group}
             if len(heads) == 1:
                 return True
-            for n in self.nodes:
+            for n in group:
                 n.node.wait_idle()
+            if self.hub is not None and self.hub.pending_delayed():
+                self.hub.advance_tick()
             # all idle yet diverged: don't busy-spin until the deadline
             time.sleep(0.05)
-        return len({n.chain.head_root for n in self.nodes}) == 1
+        return len({n.chain.head_root for n in group}) == 1
+
+    # ----------------------------------------------------------- churn
+
+    def kill_node(self, index: int) -> SimNode:
+        """Take a node offline (fallback-sim's killed BN): links severed,
+        processor down, peer id freed for a later restart."""
+        node = self.nodes[index]
+        node.shutdown()
+        if self.hub is not None:
+            self.hub.unregister(node.peer_id)
+        return node
+
+    def restart_node(self, index: int) -> SimNode:
+        """Bring a killed node back on its own persisted chain: clock
+        fast-forwarded to the fleet's slot, fresh network stack, links
+        re-dialed — the status handshake then range-syncs it to the head."""
+        assert self.hub is not None, "restart is a hub-fabric operation"
+        old = self.nodes[index]
+        assert not old.alive, f"node {index} is not dead"
+        current = max(n.chain.current_slot() for n in self.live_nodes)
+        while old.chain.current_slot() < current:
+            if old.harness is not None:
+                old.harness.advance_slot()
+            else:
+                old.chain.slot_clock.set_slot(old.chain.current_slot() + 1)
+        fresh = SimNode.resurrect(old, hub=self.hub)
+        self.nodes[index] = fresh
+        for other in self.live_nodes:
+            if other is not fresh:
+                self.hub.connect(fresh.peer_id, other.peer_id)
+        return fresh
+
+    def add_checkpoint_node(self, *, anchor_from: int = 0,
+                            peer_id: Optional[str] = None) -> SimNode:
+        """A new node joins from a checkpoint anchor (weak subjectivity):
+        it boots from ``anchor_from``'s finalized (state, block) pair — no
+        genesis replay — and is wired to every live peer; forward sync
+        starts on the status handshake, backfill is the caller's second
+        step (``BackfillSync``)."""
+        assert self.hub is not None, "checkpoint join is a hub-fabric operation"
+        from .chain.beacon_chain import BeaconChain
+        from .chain.slot_clock import ManualSlotClock
+
+        donor = self.nodes[anchor_from]
+        assert donor.harness is not None, "anchor donor must be a full node"
+        f_epoch, f_root = donor.chain.finalized_checkpoint()
+        assert f_epoch >= 1, "checkpoint join needs a finalized anchor"
+        anchor_block = donor.chain.get_block(f_root)
+        anchor_state = donor.chain.get_state(f_root).copy()
+        clock = ManualSlotClock(self.genesis_time, donor.chain.spec.seconds_per_slot)
+        clock.set_slot(donor.chain.current_slot())
+        chain = BeaconChain(
+            genesis_state=anchor_state, types=donor.harness.types,
+            spec=donor.harness.spec, slot_clock=clock,
+            anchor_block=anchor_block,
+        )
+        index = len(self.nodes)
+        joined = SimNode(
+            index=index, hub=self.hub, validator_count=self.validator_count,
+            keys=[], genesis_time=self.genesis_time, chain=chain,
+            peer_id=peer_id or f"sim{index}",
+        )
+        self.nodes.append(joined)
+        for other in self.live_nodes:
+            if other is not joined:
+                self.hub.connect(joined.peer_id, other.peer_id)
+        return joined
 
     # ------------------------------------------------------------- checks
 
     def check_finalization(self, min_epoch: int) -> None:
         """The reference's per-epoch liveness check (checks.rs)."""
-        for n in self.nodes:
+        for n in self.live_nodes:
             f_epoch, _ = n.chain.finalized_checkpoint()
             assert f_epoch >= min_epoch, (
                 f"node {n.index} finalized epoch {f_epoch} < {min_epoch}"
             )
 
     def check_heads_agree(self) -> None:
-        heads = {n.chain.head_root for n in self.nodes}
+        heads = {n.chain.head_root for n in self.live_nodes}
         assert len(heads) == 1, f"heads diverged: {len(heads)} distinct"
 
     def shutdown(self) -> None:
         for n in self.nodes:
-            n.shutdown()
+            if n.alive:
+                n.shutdown()
         if self.boot_discv5 is not None:
             self.boot_discv5.stop()

@@ -52,6 +52,14 @@ SCAN_DIRS = (
     # they get the same lock-order/blocking-call discipline as the chain.
     "lighthouse_tpu/device_supervisor.py",
     "lighthouse_tpu/fault_injection.py",
+    # Scenario soak (ISSUE 7): the runner drives the Hub's fault fabric
+    # (whose delayed-delivery heap is lock-guarded) from pump loops — same
+    # discipline, so a scenario can never deadlock the fabric it tests.
+    "lighthouse_tpu/scenarios.py",
+    "lighthouse_tpu/simulator.py",
+    # Fork choice grew an instance RLock (PR 7): every public entry point
+    # serializes proto-array mutation — audit it like the chain locks.
+    "lighthouse_tpu/fork_choice",
 )
 
 LOCK_CTORS = frozenset({"TimeoutLock", "Lock", "RLock", "Condition"})

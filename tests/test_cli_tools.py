@@ -187,6 +187,10 @@ def test_lcli_mock_el_serves_engine_api(tmp_path):
 def test_lcli_generate_bootnode_enr(tmp_path, capsys):
     """`lcli generate-bootnode-enr` mints a decodable signed ENR + key
     (reference lcli generate_bootnode_enr.rs)."""
+    # ENR signing needs secp256k1 via the `cryptography` package, which some
+    # CI containers don't ship — skip rather than fail on the environment.
+    pytest.importorskip("cryptography",
+                        reason="discv5 ENR signing needs the cryptography package")
     from lighthouse_tpu.network.discv5.enr import ENR
 
     out_dir = tmp_path / "bootnode"

@@ -1,0 +1,202 @@
+"""Hub fault-fabric determinism and composition (ISSUE 7 satellite): same
+seed + same traffic => byte-identical per-link delivery schedule; partitions
+compose with link plans; the net.deliver injection point drops/corrupts."""
+
+import pytest
+
+from lighthouse_tpu import fault_injection
+from lighthouse_tpu.network.transport import Envelope, Hub, LinkPlan
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fault_injection.reset_for_tests()
+    yield
+    fault_injection.reset_for_tests()
+
+
+def _drain(endpoint):
+    out = []
+    while not endpoint.inbound.empty():
+        out.append(endpoint.inbound.get_nowait())
+    return out
+
+
+def _scripted_run(seed, n_messages=64, plan=None, ticks=8):
+    """One deterministic traffic run: a->b gossip stream under ``plan``.
+    Returns (delivered payloads in order, schedule dict, digest)."""
+    hub = Hub(seed=seed)
+    a = hub.register("a")
+    b = hub.register("b")
+    hub.connect("a", "b")
+    hub.record_schedule()
+    hub.set_link_plan(
+        "a", "b",
+        plan or LinkPlan(drop=0.25, delay=1, jitter=2, duplicate=0.15,
+                         reorder=0.4))
+    for i in range(n_messages):
+        a.send("b", Envelope(kind="gossip", sender="a", topic="t",
+                             data=bytes([i])))
+    for _ in range(ticks):
+        hub.advance_tick()
+    payloads = [env.data for env in _drain(b)]
+    return payloads, hub.schedule(), hub.schedule_digest()
+
+
+class TestDeterminism:
+    def test_same_seed_byte_identical_schedule(self):
+        p1, s1, d1 = _scripted_run(seed=42)
+        p2, s2, d2 = _scripted_run(seed=42)
+        assert s1 == s2
+        assert d1 == d2
+        assert p1 == p2  # same drops, same delays, same drain order
+
+    def test_different_seed_differs(self):
+        _, _, d1 = _scripted_run(seed=1)
+        _, _, d2 = _scripted_run(seed=2)
+        assert d1 != d2
+
+    def test_schedule_digest_is_link_sorted(self):
+        """The digest must not depend on cross-link interleaving: two hubs
+        receiving the same per-link streams in different global orders
+        fingerprint identically."""
+        plan = LinkPlan(drop=0.3, delay=1, jitter=1)
+        digests = []
+        for order in ((0, 1), (1, 0)):
+            hub = Hub(seed=9)
+            ep = {p: hub.register(p) for p in ("a", "b", "c")}
+            hub.connect("a", "c")
+            hub.connect("b", "c")
+            hub.record_schedule()
+            hub.set_link_plan("a", "c", plan)
+            hub.set_link_plan("b", "c", plan)
+            senders = ["a", "b"]
+            for i in range(32):
+                for k in order:
+                    s = senders[k]
+                    ep[s].send("c", Envelope(kind="gossip", sender=s,
+                                             data=bytes([i])))
+            digests.append(hub.schedule_digest())
+        assert digests[0] == digests[1]
+
+
+class TestComposition:
+    def test_partition_drops_before_plan_dice(self):
+        """A partitioned link drops outright and must NOT consume the
+        plan's per-message decision stream — heal resumes the schedule
+        exactly where it left off."""
+        hub = Hub(seed=7)
+        a = hub.register("a")
+        b = hub.register("b")
+        hub.connect("a", "b")
+        hub.record_schedule()
+        hub.set_link_plan("a", "b", LinkPlan(drop=0.5))
+        for i in range(4):
+            a.send("b", Envelope(kind="gossip", sender="a", data=bytes([i])))
+        before = dict(hub.schedule())
+        hub.set_partition("a", 1)
+        for i in range(4, 8):
+            a.send("b", Envelope(kind="gossip", sender="a", data=bytes([i])))
+        assert hub.schedule() == before  # no decisions spent while severed
+        assert hub.fault_counters().get("dropped_partition") == 4
+        hub.clear_partitions()
+        for i in range(8, 12):
+            a.send("b", Envelope(kind="gossip", sender="a", data=bytes([i])))
+        entries = hub.schedule()["a>b"]
+        assert len(entries) == 8
+        assert [e.split(":")[0] for e in entries] == [str(n) for n in range(8)]
+
+    def test_delayed_envelope_respects_partition_at_drain(self):
+        """An envelope sent pre-partition must not tunnel through one that
+        forms before its due tick."""
+        hub = Hub(seed=0)
+        a = hub.register("a")
+        b = hub.register("b")
+        hub.connect("a", "b")
+        hub.set_link_plan("a", "b", LinkPlan(delay=2))
+        assert a.send("b", Envelope(kind="gossip", sender="a", data=b"x"))
+        hub.set_partition("a", 1)
+        hub.advance_tick()
+        hub.advance_tick()
+        assert _drain(b) == []
+        assert hub.fault_counters().get("dropped_partition") == 1
+
+    def test_duplicate_and_reorder(self):
+        hub = Hub(seed=0)
+        a = hub.register("a")
+        b = hub.register("b")
+        hub.connect("a", "b")
+        hub.set_link_plan("a", "b", LinkPlan(delay=1, duplicate=1.0))
+        a.send("b", Envelope(kind="gossip", sender="a", data=b"dup"))
+        hub.advance_tick()
+        assert [e.data for e in _drain(b)] == [b"dup", b"dup"]
+        assert hub.fault_counters().get("duplicated") == 1
+        # reorder: a later-sent always-reordered message jumps ahead of an
+        # earlier normal one due at the same tick
+        hub.set_link_plan("a", "b", LinkPlan(delay=1))
+        a.send("b", Envelope(kind="gossip", sender="a", data=b"first"))
+        hub.set_link_plan("a", "b", LinkPlan(delay=1, reorder=1.0))
+        a.send("b", Envelope(kind="gossip", sender="a", data=b"second"))
+        hub.advance_tick()
+        assert [e.data for e in _drain(b)] == [b"second", b"first"]
+
+    def test_kinds_filter_first_match_wins(self):
+        """Stacked plans: gossip is dropped outright, RPC only delayed —
+        the first plan whose kinds match decides."""
+        hub = Hub(seed=0)
+        a = hub.register("a")
+        b = hub.register("b")
+        hub.connect("a", "b")
+        hub.set_link_plan("a", "b", LinkPlan(drop=1.0,
+                                             kinds=frozenset({"gossip"})))
+        hub.set_link_plan("a", "b",
+                          LinkPlan(delay=1,
+                                   kinds=frozenset({"rpc_request"})),
+                          append=True)
+        assert not a.send("b", Envelope(kind="gossip", sender="a", data=b"g"))
+        assert a.send("b", Envelope(kind="rpc_request", sender="a", data=b"r"))
+        assert _drain(b) == []  # rpc delayed, not dropped
+        hub.advance_tick()
+        assert [e.kind for e in _drain(b)] == ["rpc_request"]
+        # unmatched kinds pass untouched
+        assert a.send("b", Envelope(kind="rpc_response", sender="a", data=b"ok"))
+        assert [e.kind for e in _drain(b)] == ["rpc_response"]
+
+    def test_unregister_frees_peer_id_and_drops_delayed(self):
+        hub = Hub(seed=0)
+        a = hub.register("a")
+        hub.register("b")
+        hub.connect("a", "b")
+        hub.set_link_plan("a", "b", LinkPlan(delay=1))
+        a.send("b", Envelope(kind="gossip", sender="a", data=b"late"))
+        hub.unregister("b")
+        hub.advance_tick()
+        assert hub.fault_counters().get("dropped_unlinked") == 1
+        hub.register("b")  # a restarted node reuses its id
+
+
+class TestNetDeliverPoint:
+    def test_error_plan_drops(self):
+        hub = Hub(seed=0)
+        a = hub.register("a")
+        b = hub.register("b")
+        hub.connect("a", "b")
+        fault_injection.install("net.deliver", "error", op="gossip")
+        assert not a.send("b", Envelope(kind="gossip", sender="a", data=b"x"))
+        # rpc kind unaffected by the op selector
+        assert a.send("b", Envelope(kind="rpc_request", sender="a", data=b"y"))
+        assert hub.fault_counters().get("dropped_fault") == 1
+        assert [e.kind for e in _drain(b)] == ["rpc_request"]
+
+    def test_corrupt_plan_flips_one_byte(self):
+        hub = Hub(seed=0)
+        a = hub.register("a")
+        b = hub.register("b")
+        hub.connect("a", "b")
+        fault_injection.install("net.deliver", "corrupt")
+        payload = bytes(range(32))
+        assert a.send("b", Envelope(kind="gossip", sender="a", data=payload))
+        (env,) = _drain(b)
+        assert env.data != payload
+        assert len(env.data) == len(payload)
+        assert sum(x != y for x, y in zip(env.data, payload)) == 1

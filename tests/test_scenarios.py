@@ -1,0 +1,90 @@
+"""Scenario soak engine (ISSUE 7): the tier-1 smoke scenario plus the full
+slow-marked matrix with the determinism gate (two runs, same seed =>
+identical final head roots and SOAK artifacts that agree)."""
+
+import json
+import os
+
+import pytest
+
+from lighthouse_tpu import fault_injection
+from lighthouse_tpu.crypto.bls.backends import set_backend
+from lighthouse_tpu.scenarios import (
+    SCENARIOS,
+    ScenarioRunner,
+    run_scenario,
+    smoke_partition,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fake():
+    set_backend("fake")
+    fault_injection.reset_for_tests()
+    yield
+    fault_injection.reset_for_tests()
+    set_backend("host")
+
+
+def test_smoke_partition_scenario(tmp_path):
+    """Tier-1 gate: the smoke scenario (partition -> fork -> heal -> reorg
+    -> finality resumes) passes and writes a complete SOAK artifact."""
+    artifact = run_scenario(smoke_partition(seed=0), out_dir=str(tmp_path))
+    assert artifact["passed"]
+    result = artifact["result"]
+    assert result["converged"]
+    # every live node converged to ONE head and finality advanced past the
+    # fault window
+    heads = {n["head_root"] for n in result["per_node"] if n["alive"]}
+    assert len(heads) == 1
+    assert result["final_finalized_epoch"] > result["finalized_at_window_end"]
+    # the partition really forked the fleet mid-run
+    assert artifact["extra"]["max_distinct_heads"] >= 2
+    assert artifact["net"]["counters"]["dropped_partition"] > 0
+    # slot-relative delay metrics from the tracing layer made it in
+    assert artifact["delay_metrics"]["block_imported"]["count"] > 0
+    assert artifact["delay_metrics"]["block_imported"]["mean_s"] is not None
+    # the artifact landed on disk and round-trips as JSON
+    path = os.path.join(str(tmp_path), "SOAK_smoke_partition_seed0.json")
+    with open(path) as f:
+        on_disk = json.load(f)
+    assert on_disk["scenario"]["name"] == "smoke_partition"
+    assert on_disk["passed"]
+    assert "schedule_digest" in on_disk["net"]
+    assert "timeline" in on_disk
+
+
+def test_failed_gate_still_writes_artifact(tmp_path):
+    """A scenario whose gates fail must still leave its evidence on disk
+    (the whole point of a soak artifact is triaging the failure)."""
+    from lighthouse_tpu.scenarios import Scenario, ScenarioFailure
+
+    # recovery far too short for finality to advance => the gate trips
+    doomed = Scenario(name="doomed", seed=0, node_count=3,
+                      validator_count=16, warmup_slots=2, fault_slots=1,
+                      recovery_slots=1)
+    with pytest.raises(ScenarioFailure):
+        ScenarioRunner(doomed, out_dir=str(tmp_path)).run()
+    with open(os.path.join(str(tmp_path), "SOAK_doomed_seed0.json")) as f:
+        artifact = json.load(f)
+    assert not artifact["passed"]
+    assert "failure" in artifact
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_matrix_deterministic(name, tmp_path):
+    """The full matrix, each scenario twice with one seed: both runs pass
+    their gates and produce identical final head roots (the acceptance
+    criterion: seeded faults => bit-for-bit reproducible chains)."""
+    results = []
+    for run_index in range(2):
+        out = tmp_path / f"run{run_index}"
+        artifact = run_scenario(name, seed=7, out_dir=str(out))
+        assert artifact["passed"], f"{name} run {run_index} failed its gates"
+        results.append(artifact["result"])
+    assert results[0]["head_root"] == results[1]["head_root"], (
+        f"{name}: nondeterministic final head"
+    )
+    assert (results[0]["final_finalized_epoch"]
+            == results[1]["final_finalized_epoch"])

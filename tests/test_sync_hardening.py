@@ -1,0 +1,125 @@
+"""Sync hardening (ISSUE 7 satellites): the parent-chase depth cap reports
+``sync_lookup_aborted_total``, and backfill survives a dead preferred peer
+via the per-request timeout + one retry against a different peer."""
+
+import pytest
+
+from lighthouse_tpu import metrics
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.crypto.bls.backends import set_backend
+from lighthouse_tpu.network.backfill import BackfillSync
+from lighthouse_tpu.network.node import LocalNode
+from lighthouse_tpu.network.transport import Hub
+
+GENESIS_TIME = 1_600_000_000
+
+
+@pytest.fixture(autouse=True)
+def _fake():
+    set_backend("fake")
+    yield
+    set_backend("host")
+
+
+def _two_nodes(slots=16):
+    ha = BeaconChainHarness(validator_count=16, fake_crypto=True,
+                            genesis_time=GENESIS_TIME)
+    hb = BeaconChainHarness(validator_count=16, fake_crypto=True,
+                            genesis_time=GENESIS_TIME)
+    ha.extend_chain(slots)
+    for _ in range(slots):
+        hb.advance_slot()
+    hub = Hub()
+    na = LocalNode(hub=hub, peer_id="a", harness=ha)
+    nb = LocalNode(hub=hub, peer_id="b", harness=hb)
+    # link WITHOUT the on_connect status dance: range sync must not race
+    # the parent chase under test
+    with hub._lock:
+        hub._links.add(("a", "b"))
+    return hub, ha, hb, na, nb
+
+
+def test_parent_chase_depth_cap_reports_metric():
+    """A parent chain deeper than the cap aborts with a penalty and a
+    ``sync_lookup_aborted_total{reason="depth_limit"}`` tick — it must not
+    walk the whole chain."""
+    hub, ha, hb, na, nb = _two_nodes(slots=12)
+    try:
+        before = metrics.SYNC_LOOKUP_ABORTED.get(reason="depth_limit")
+        tip_root = ha.chain.head_root
+        tip = ha.chain.get_block(tip_root)
+        nb.sync.on_unknown_parent(tip, "a", depth_limit=3)
+        assert metrics.SYNC_LOOKUP_ABORTED.get(reason="depth_limit") == before + 1
+        assert not nb.chain.fork_choice.contains_block(tip_root)
+        assert nb.service.peer_manager._peer("a").score < 0
+        # with an adequate cap the same chase succeeds
+        nb.sync.on_unknown_parent(tip, "a", depth_limit=32)
+        assert nb.chain.fork_choice.contains_block(tip_root)
+    finally:
+        na.shutdown()
+        nb.shutdown()
+
+
+def test_backfill_dead_peer_retries_against_fallback():
+    """The preferred backfill peer is dead: the batch request fails fast,
+    is retried once against the fallback, and history still completes
+    (``backfill_batch_retries_total{outcome="recovered"}``)."""
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.chain.slot_clock import ManualSlotClock
+
+    ha = BeaconChainHarness(validator_count=16, fake_crypto=True,
+                            genesis_time=GENESIS_TIME)
+    ha.extend_chain(ha.spec.slots_per_epoch * 5)
+    f_epoch, f_root = ha.chain.finalized_checkpoint()
+    assert f_epoch >= 1
+    anchor_block = ha.chain.get_block(f_root)
+    anchor_state = ha.chain.get_state(f_root).copy()
+    clock = ManualSlotClock(GENESIS_TIME, ha.spec.seconds_per_slot)
+    clock.set_slot(ha.chain.current_slot())
+    chain_b = BeaconChain(
+        genesis_state=anchor_state, types=ha.types, spec=ha.spec,
+        slot_clock=clock, anchor_block=anchor_block,
+    )
+    hub = Hub()
+    na = LocalNode(hub=hub, peer_id="a", harness=ha)
+    nb = LocalNode(hub=hub, peer_id="b", chain=chain_b)
+    hub.register("dead")  # registered but never answers: timeouts, not NACKs
+    try:
+        hub.connect("a", "b")
+        with hub._lock:  # silent link so the request rides the timeout path
+            hub._links.add(("b", "dead"))
+        retried = metrics.BACKFILL_BATCH_RETRIES.get(outcome="retried")
+        recovered = metrics.BACKFILL_BATCH_RETRIES.get(outcome="recovered")
+        backfill = BackfillSync(chain=chain_b, service=nb.service)
+        filled = backfill.backfill_from(
+            "dead", request_timeout=1.0, fallback_peers=["a"])
+        assert backfill.complete, "fallback peer must complete backfill"
+        assert filled == int(anchor_state.slot) - 1
+        assert metrics.BACKFILL_BATCH_RETRIES.get(outcome="retried") > retried
+        assert (metrics.BACKFILL_BATCH_RETRIES.get(outcome="recovered")
+                > recovered)
+    finally:
+        na.shutdown()
+        nb.shutdown()
+
+
+def test_backfill_no_fallback_keeps_old_behavior():
+    """Without fallbacks a failing peer just ends the round (no retry
+    counters, no exception) — the pre-ISSUE-7 contract."""
+    ha = BeaconChainHarness(validator_count=16, fake_crypto=True,
+                            genesis_time=GENESIS_TIME)
+    hub = Hub()
+    nb = LocalNode(hub=hub, peer_id="b", harness=ha)
+    hub.register("dead")
+    try:
+        with hub._lock:
+            hub._links.add(("b", "dead"))
+        exhausted = metrics.BACKFILL_BATCH_RETRIES.get(outcome="exhausted")
+        backfill = BackfillSync(chain=ha.chain, service=nb.service)
+        backfill.oldest_slot = 8  # pretend there is history to fill
+        backfill.expected_parent = b"\x11" * 32
+        assert backfill.backfill_from("dead", request_timeout=1.0) == 0
+        assert (metrics.BACKFILL_BATCH_RETRIES.get(outcome="exhausted")
+                == exhausted)
+    finally:
+        nb.shutdown()
