@@ -195,6 +195,165 @@ def _device_telemetry_summary() -> dict:
     }
 
 
+def _build_sig_sets(n_distinct: int, n_keys: int, seed: int) -> list:
+    """A pool of distinct valid SignatureSet objects (host crypto; reused
+    across groups — signing thousands of distinct messages on this host is
+    what starved the r5 scale config, and the device work is identical)."""
+    import random
+
+    from lighthouse_tpu.crypto.bls import api
+    from lighthouse_tpu.crypto.bls.params import R
+
+    rng = random.Random(seed)
+    sks = [api.SecretKey(rng.randrange(1, R)) for _ in range(n_keys)]
+    pks = [sk.public_key() for sk in sks]
+    agg_sk = api.SecretKey(sum(sk.scalar for sk in sks) % R)
+    sets = []
+    for i in range(n_distinct):
+        msg = (i.to_bytes(2, "big") + bytes([seed & 0xFF])) * 10 + b"\x00\x00"
+        sets.append(api.SignatureSet.multiple_pubkeys(agg_sk.sign(msg), pks, msg))
+    return sets
+
+
+def _pipeline_bench() -> dict:
+    """Mixed-traffic pipeline benchmark (ISSUE 8): attestation, aggregate
+    and block-import groups arriving CONCURRENTLY, measured twice — direct
+    (each caller dispatches its own batch, the pre-pipeline shape) and
+    through the async device pipeline (cross-work-type coalescing).  The
+    headline figures are achieved median live-sets-per-dispatched-batch
+    (flight-recorder evidence) and sets/s, plus caller wait percentiles
+    (scheduler workers wait on futures, not block_until_ready)."""
+    import threading
+
+    from lighthouse_tpu import device_pipeline, device_telemetry
+    from lighthouse_tpu.crypto.bls import api
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+
+    set_backend("jax")
+    n_keys = int(os.environ.get("BENCH_PIPELINE_KEYS", "2"))
+    pool = _build_sig_sets(
+        int(os.environ.get("BENCH_PIPELINE_DISTINCT", "8")), n_keys, seed=9)
+    mix = (
+        ("gossip_attestation", 1, int(os.environ.get("BENCH_PIPELINE_ATT", "12"))),
+        ("gossip_aggregate", 3, int(os.environ.get("BENCH_PIPELINE_AGG", "8"))),
+        ("block_import", 8, int(os.environ.get("BENCH_PIPELINE_BLK", "4"))),
+    )
+
+    def run_phase(label: str) -> dict:
+        waits: list = []
+        errors: list = []
+        lock = threading.Lock()
+        rec0 = device_telemetry.FLIGHT_RECORDER.recorded_total
+        total_sets = sum(size * count for _, size, count in mix)
+        threads = []
+        t0 = time.perf_counter()
+        for kind, size, count in mix:
+            groups = [
+                [pool[(i + j) % len(pool)] for j in range(size)]
+                for i in range(count)
+            ]
+
+            def worker(groups=groups, kind=kind):
+                from lighthouse_tpu import device_pipeline as dp
+
+                for g in groups:
+                    s0 = time.perf_counter()
+                    try:
+                        with dp.work_context(kind):
+                            ok = api.verify_signature_sets(g)
+                        if not ok:
+                            raise AssertionError(f"{kind} group failed to verify")
+                    except Exception as e:  # noqa: BLE001 — reported in JSON
+                        with lock:
+                            errors.append(f"{type(e).__name__}: {e}")
+                        return
+                    with lock:
+                        waits.append(time.perf_counter() - s0)
+
+            threads.append(threading.Thread(target=worker, daemon=True))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        recs = [
+            r for r in device_telemetry.FLIGHT_RECORDER.recent(limit=256)
+            if r["seq"] > rec0 and r["op"] == "bls_verify"
+        ]
+        lives = sorted(r["n_live"] for r in recs) or [0]
+        occ = sorted(r.get("occupancy_sets", 0.0) for r in recs) or [0.0]
+        waits.sort()
+        out = {
+            "wall_s": round(wall, 2),
+            "sets_per_sec": round(total_sets / wall, 2) if wall else None,
+            "batches_dispatched": len(recs),
+            "batch_live_sets_p50": lives[len(lives) // 2],
+            "batch_live_sets_max": lives[-1],
+            "occupancy_sets_p50": occ[len(occ) // 2],
+            "group_wait_p50_s": round(waits[len(waits) // 2], 4) if waits else None,
+            "group_wait_p99_s": (
+                round(waits[min(len(waits) - 1, int(0.99 * len(waits)))], 4)
+                if waits else None
+            ),
+        }
+        if errors:
+            out["errors"] = errors[:4]
+        return out
+
+    # The baseline phase must be genuinely pipeline-free even when the
+    # environment enabled the pipeline (LIGHTHOUSE_TPU_DEVICE_PIPELINE=1) —
+    # otherwise the gain figure compares the pipeline against itself.
+    device_pipeline.disable()
+    direct = run_phase("direct")
+    device_pipeline.enable()
+    try:
+        pipe = device_pipeline.get_pipeline()
+        pipe.target_sets = int(os.environ.get("BENCH_PIPELINE_TARGET", "64"))
+        pipe.linger_s = float(os.environ.get("BENCH_PIPELINE_LINGER_S", "0.05"))
+        pipelined = run_phase("pipeline")
+        snap = pipe.snapshot()
+    finally:
+        device_pipeline.shutdown()
+    gain = None
+    if direct["batch_live_sets_p50"]:
+        gain = round(
+            pipelined["batch_live_sets_p50"] / direct["batch_live_sets_p50"], 2)
+    return {
+        "mix": [{"work": k, "sets_per_group": s, "groups": c} for k, s, c in mix],
+        "direct": direct,
+        "pipeline": pipelined,
+        "pipeline_config": {k: snap[k] for k in
+                            ("target_sets", "linger_s", "batches_total",
+                             "groups_total", "sets_total")},
+        "median_batch_occupancy_gain": gain,
+    }
+
+
+def _pipeline_mode_main(force_cpu: bool) -> None:
+    """``python bench.py --pipeline [--cpu]``: run ONLY the mixed-traffic
+    pipeline bench and print its JSON (the dev/acceptance harness; the
+    device child also runs it best-effort after the scale config)."""
+    os.environ.setdefault("JAX_ENABLE_X64", "0")
+    sys.path.insert(0, HERE)
+    import jax
+
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    from lighthouse_tpu.ops.compile_cache import configure_persistent_cache
+
+    configure_persistent_cache()
+    out = {"platform": jax.devices()[0].platform}
+    try:
+        out["pipeline_bench"] = _pipeline_bench()
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
 def _child_main(force_cpu: bool) -> None:
     """Run the bench; checkpoint after each milestone; always exit 0."""
     os.environ.setdefault("JAX_ENABLE_X64", "0")
@@ -295,6 +454,16 @@ def _child_main(force_cpu: bool) -> None:
             out["device_telemetry"] = _device_telemetry_summary()  # cumulative
         except Exception as e:
             out["scale_bench_error"] = f"{type(e).__name__}: {e}"
+        _checkpoint(out)
+
+        # Mixed-traffic pipeline bench (best-effort, device only — the CPU
+        # path would spend minutes re-verifying tiny batches): achieved
+        # batch fill + sets/s with and without the async device pipeline,
+        # next to stage_timers on the perf trajectory.
+        try:
+            out["pipeline_bench"] = _pipeline_bench()
+        except Exception as e:
+            out["pipeline_bench_error"] = f"{type(e).__name__}: {e}"
     except Exception as e:
         import traceback
 
@@ -564,7 +733,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--child" in sys.argv:
+    if "--pipeline" in sys.argv:
+        _pipeline_mode_main(force_cpu="--cpu" in sys.argv)
+    elif "--child" in sys.argv:
         _child_main(force_cpu="--cpu" in sys.argv)
     else:
         main()

@@ -1150,7 +1150,11 @@ class BeaconChain:
         from ..crypto.bls import api as bls
 
         positions, sig_set = self._preverify_sync_message(msg, self.head_state)
-        if not bls.verify_signature_sets([sig_set]):
+        from .. import device_pipeline
+
+        with device_pipeline.work_context("sync_committee"):
+            ok = bls.verify_signature_sets([sig_set])
+        if not ok:
             raise AttestationError("bad sync committee message signature")
         self._pool_sync_message(msg, positions)
 
@@ -1176,7 +1180,10 @@ class BeaconChain:
         live = [p for p in prepared if p is not None]
         if not live:
             return results
-        batch_ok = bls.verify_signature_sets([p[2] for p in live])
+        from .. import device_pipeline
+
+        with device_pipeline.work_context("sync_committee"):
+            batch_ok = bls.verify_signature_sets([p[2] for p in live])
         for i, p in enumerate(prepared):
             if p is None:
                 continue
@@ -1260,7 +1267,11 @@ class BeaconChain:
         from ..crypto.bls import api as bls
 
         contribution, sig_sets = self._preverify_signed_contribution(signed_contribution)
-        if not bls.verify_signature_sets(sig_sets):
+        from .. import device_pipeline
+
+        with device_pipeline.work_context("sync_committee"):
+            ok = bls.verify_signature_sets(sig_sets)
+        if not ok:
             raise AttestationError("bad sync contribution signature(s)")
         self.sync_contribution_pool.insert_contribution(contribution)
 
@@ -1381,7 +1392,11 @@ class BeaconChain:
         live = [p for p in prepared if p is not None]
         if not live:
             return results
-        batch_ok = bls.verify_signature_sets([s for p in live for s in p[1]])
+        from .. import device_pipeline
+
+        with device_pipeline.work_context("sync_committee"):
+            batch_ok = bls.verify_signature_sets(
+                [s for p in live for s in p[1]])
         for i, p in enumerate(prepared):
             if p is None:
                 continue
@@ -1424,7 +1439,11 @@ class BeaconChain:
         from ..crypto.bls import api as bls
 
         cand = self.preverify_aggregate(signed_aggregate)
-        if not bls.verify_signature_sets(cand.signature_sets):
+        from .. import device_pipeline
+
+        with device_pipeline.work_context("gossip_aggregate"):
+            ok = bls.verify_signature_sets(cand.signature_sets)
+        if not ok:
             raise AttestationError("bad aggregate signature(s)")
         self.apply_verified_aggregate(cand)
 
@@ -1471,7 +1490,11 @@ class BeaconChain:
         from ..crypto.bls import api as bls
 
         cand = self.preverify_attestation(attestation)
-        if not bls.verify_signature_sets([cand.signature_set]):
+        from .. import device_pipeline
+
+        with device_pipeline.work_context("gossip_attestation"):
+            ok = bls.verify_signature_sets([cand.signature_set])
+        if not ok:
             raise AttestationError("bad attestation signature")
         self.apply_attestation(cand, is_from_block)
 

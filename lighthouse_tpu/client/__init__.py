@@ -187,6 +187,14 @@ class ClientBuilder:
             except Exception:
                 log.warning("persistent compile-cache setup failed",
                             exc_info=True)
+            # Async device pipeline (device_pipeline.py): production nodes
+            # stream every signature-set group through the persistent device
+            # worker so block import / gossip / sync-committee work coalesce
+            # into maximal device batches.  LIGHTHOUSE_TPU_DEVICE_PIPELINE=0
+            # opts out (device_pipeline.enable honors it).
+            from .. import device_pipeline
+
+            device_pipeline.enable()
         if os.environ.get("LIGHTHOUSE_TPU_DEVICE_SHA") == "1":
             from ..ops.sha256_device import install_device_hash
 
@@ -430,6 +438,11 @@ class Client:
         if self.http_server is not None:
             self.http_server.stop()
         self.processor.shutdown()
+        # Drain the device pipeline AFTER the processor stops feeding it:
+        # pending futures resolve (no caller hangs), then its threads exit.
+        from .. import device_pipeline
+
+        device_pipeline.shutdown()
         for t in self._threads:
             t.join(timeout=2.0)
         if self.chain.db is not None:

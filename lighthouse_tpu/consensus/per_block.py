@@ -91,7 +91,13 @@ class BlockSignatureVerifier:
                 self.sets.append(s)
 
     def verify(self) -> bool:
-        return bls.verify_signature_sets(self.sets)
+        from .. import device_pipeline
+
+        # Block import submits its whole set list as ONE pipeline group:
+        # through the async device pipeline it coalesces with concurrent
+        # gossip/sync-committee groups into one maximal device batch.
+        with device_pipeline.work_context("block_import"):
+            return bls.verify_signature_sets(self.sets)
 
 
 # ------------------------------------------------------------- entry point

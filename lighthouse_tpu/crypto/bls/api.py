@@ -336,8 +336,16 @@ def verify_signature_sets(signature_sets: Iterable[SignatureSet], seed: Optional
 
     `seed` pins the random weights for reproducibility in tests; production use
     leaves it None (host CSPRNG — randomness must stay host-side, blst.rs:52-57).
+
+    When the async device pipeline is enabled (``device_pipeline.enable()``,
+    done by the client builder for jax-backend nodes), seedless calls submit
+    their sets as ONE group to the persistent device worker and block on a
+    future — the pipeline coalesces groups across work types into maximal
+    device batches instead of dispatching this caller's sets alone.  Seeded
+    calls (reproducibility contracts) and oversized batches keep the direct
+    backend path.
     """
-    from ... import metrics, tracing
+    from ... import device_pipeline, metrics, tracing
     from .backends import backend_name, get_backend
 
     sets = list(signature_sets)
@@ -349,4 +357,9 @@ def verify_signature_sets(signature_sets: Iterable[SignatureSet], seed: Optional
         "device_batch", hist=metrics.ATTESTATION_BATCH_SECONDS,
         n_sets=len(sets), backend=backend_name(),
     ):
+        if device_pipeline.routes(sets, seed):
+            try:
+                return device_pipeline.verify(sets)
+            except device_pipeline.PipelineShutdown:
+                pass  # racing Client.stop: the direct path still answers
         return backend.verify_signature_sets(sets, seed=seed)

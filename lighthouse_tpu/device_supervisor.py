@@ -555,6 +555,13 @@ def summary() -> dict:
     return SUPERVISOR.summary()
 
 
+def breaker_state(op: str) -> str:
+    """Current breaker state for ``op`` (creates the breaker CLOSED on first
+    ask) — the cheap probe the device pipeline's tests/scenarios use to
+    assert breaker-open batches still resolve futures."""
+    return SUPERVISOR.breaker(op).state
+
+
 def reset_for_tests() -> None:
     SUPERVISOR.reset_for_tests()
 

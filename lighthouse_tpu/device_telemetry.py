@@ -234,6 +234,8 @@ def record_batch(
     shape: Tuple[int, ...],
     n_live: int,
     live_keys: Optional[int] = None,
+    n_groups: Optional[int] = None,
+    work_mix: Optional[Dict[str, int]] = None,
     stages: Optional[Dict[str, float]] = None,
     verdict: Optional[bool] = None,
     host_fallback: bool = False,
@@ -257,6 +259,12 @@ def record_batch(
         "host_fallback": bool(host_fallback),
         "trace_id": trace_id,
     }
+    if n_groups is not None:
+        # Pipeline-coalesced batches: how many caller groups rode this one
+        # dispatch, and which work kinds contributed how many sets.
+        entry["n_groups"] = int(n_groups)
+    if work_mix:
+        entry["work_mix"] = {str(k): int(v) for k, v in work_mix.items()}
     if stages:
         entry["stages_s"] = {k: round(float(v), 6) for k, v in stages.items()}
     if verdict is not None:
@@ -384,12 +392,16 @@ def summary() -> dict:
         op: {axis: _percentiles(vals) for axis, vals in axes.items() if vals}
         for op, axes in occ.items()
     }
-    from . import device_supervisor
+    from . import device_pipeline, device_supervisor
 
     return {
         "programs": COMPILE_CACHE.inventory(),
         "occupancy": occ,
         "host_fallbacks": host_fallback_counts(),
+        # Async device pipeline (device_pipeline.py): pending depth, fill
+        # and linger of the coalescing layer feeding the batches above
+        # (None until a pipeline has started in this process).
+        "pipeline": device_pipeline.summary(),
         "flight_recorder": {
             "capacity": FLIGHT_RECORDER.capacity,
             "stored": len(FLIGHT_RECORDER),

@@ -359,6 +359,48 @@ DEVICE_SPLIT_RETRIES = counter(
     "split-batch retries after a transient device error, by op and outcome",
 )
 
+# Async device pipeline (device_pipeline.py): the persistent device-worker
+# queue that coalesces signature-set groups across work types into maximal
+# device batches.  ``pending_sets`` vs ``batch_fill_ratio`` answers "is the
+# queue starving the device or the device starving the queue" in one scrape.
+DEVICE_PIPELINE_PENDING_SETS = gauge(
+    "device_pipeline_pending_sets",
+    "signature sets queued in the device pipeline awaiting coalescing, by op",
+)
+DEVICE_PIPELINE_DEPTH = gauge(
+    "device_pipeline_depth",
+    "groups queued or in flight in the device pipeline, by op",
+)
+DEVICE_PIPELINE_BATCH_FILL_RATIO = histogram(
+    "device_pipeline_batch_fill_ratio",
+    "live sets dispatched / target batch size per coalesced pipeline batch, by op",
+    buckets=(0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+)
+DEVICE_PIPELINE_LINGER_SECONDS = histogram(
+    "device_pipeline_linger_seconds",
+    "oldest-submit to batch-take wait per coalesced pipeline batch, by op",
+)
+DEVICE_PIPELINE_WAIT_SECONDS = histogram(
+    "device_pipeline_wait_seconds",
+    "submit to future-resolution wait per pipeline group, by op",
+)
+DEVICE_PIPELINE_BATCHES = counter(
+    "device_pipeline_batches_total",
+    "coalesced batches executed by the device pipeline, by op",
+)
+DEVICE_PIPELINE_GROUPS = counter(
+    "device_pipeline_groups_total",
+    "signature-set groups submitted to the device pipeline, by op and work kind",
+)
+
+# Scheduler queue depth, sampled by the manager loop (reference
+# beacon_processor per-queue length gauges): read NEXT TO
+# device_pipeline_pending_sets to attribute queue pressure vs batch fill.
+BEACON_PROCESSOR_QUEUE_DEPTH = gauge(
+    "beacon_processor_queue_depth",
+    "events waiting in a priority queue, sampled by the manager, by work class",
+)
+
 # Validator-client remote signing (validator_client/web3signer.py).
 WEB3SIGNER_RETRIES = counter(
     "web3signer_retries_total",

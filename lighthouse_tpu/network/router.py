@@ -460,11 +460,19 @@ class Router:
         if not candidates:
             return
 
-        # ONE device program for the whole drained batch (aggregates
-        # contribute 3 sets each — batch.rs:31-135 semantics).
-        batch_ok = bls.verify_signature_sets(
-            [s for c in candidates for s in c[1]]
-        )
+        # ONE verification group for the whole drained batch (aggregates
+        # contribute 3 sets each — batch.rs:31-135 semantics).  Through the
+        # async device pipeline this group coalesces with whatever block
+        # import / sync-committee / other gossip workers submitted
+        # concurrently — the worker waits on a future, not on the device.
+        from .. import device_pipeline
+
+        kind = ("gossip_aggregate" if any(c[2] for c in candidates)
+                else "gossip_attestation")
+        with device_pipeline.work_context(kind):
+            batch_ok = bls.verify_signature_sets(
+                [s for c in candidates for s in c[1]]
+            )
         for cand, sig_sets, is_aggregate, topic, compressed, sender in candidates:
             ok = batch_ok or bls.verify_signature_sets(sig_sets)
             if not ok:

@@ -254,43 +254,41 @@ def _device_verify_subset(subset, seed: Optional[bytes]) -> bool:
     return pairing.fe_is_one(fe)
 
 
-def verify_signature_sets_device(sets, seed: Optional[bytes] = None) -> bool:
-    """Drop-in batch verifier running the hot path on the JAX backend.
+class BuiltBatch:
+    """A marshalled batch between the build and dispatch stages.
 
-    Instrumented per stage (setup / dispatch / block-until-ready / verdict —
-    reference metrics.rs:247-271): the dispatch timer measures only the
-    async enqueue; the block-until-ready timer is the device execution
-    window a TPU perf investigation cares about.  Each stage span feeds its
-    histogram AND the active trace (tracing.py), with batch-size and bucket
-    fields, so a slow batch inside a block import is attributable.
+    The two stages are separately callable so the async device pipeline
+    (``device_pipeline.py``) can overlap host-side building of batch N+1
+    (its builder thread calls :func:`build_device_batch`) with the in-flight
+    device execution of batch N (its executor thread calls
+    :func:`execute_built_batch`).  ``verify_signature_sets_device`` is the
+    two stages run back-to-back — the direct, non-pipelined path."""
 
-    Device telemetry (device_telemetry.py) rides the same seams: the
-    dispatch duration of a first-seen (nb, kb) registers in the compile
-    cache, occupancy is accounted against the padded shape, and the whole
-    batch lands in the flight recorder linked to the active trace id.
+    __slots__ = ("sets", "seed", "batch", "nb", "kb", "live_keys", "setup_s")
 
-    Execution is supervised (device_supervisor.py): the device leg runs
-    under a dispatch-deadline watchdog, transient device errors get one
-    split-batch retry, and a per-op circuit breaker routes batches to the
-    host golden model while the device is failing — so a device fault
-    degrades the chain to slow-but-correct instead of crashing it."""
-    from .. import device_supervisor, device_telemetry, metrics, tracing
-    from ..crypto.bls.backends import host
+    def __init__(self, sets, seed, batch, setup_s: float):
+        self.sets = sets
+        self.seed = seed
+        self.batch = batch
+        self.nb = int(batch[0][0].shape[0])
+        self.kb = int(batch[0][0].shape[1])
+        self.live_keys = sum(len(s.signing_keys) for s in sets)
+        self.setup_s = setup_s
+
+
+def build_device_batch(sets, seed: Optional[bytes] = None) -> Optional[BuiltBatch]:
+    """Stage 1 — host-side marshalling (validation, hash-to-curve, limb
+    packing) into padded device arrays.  Returns None when host-side
+    validation already decides False (bad/missing signature, empty key
+    list).  Safe to call from any thread; no device work happens here
+    beyond the host→device array uploads."""
+    from .. import metrics, tracing
 
     sets = list(sets)
-    if not sets:
-        return False
-    if len(sets) > MAX_SETS_PER_DISPATCH:
-        # Oversized batches chunk through the standard top bucket: each
-        # chunk is an independently supervised dispatch (split-retry and
-        # breaker semantics per chunk), verdicts AND together.  The seed is
-        # shared — each chunk is its own batch-verification equation, so
-        # repeated blinding weights across chunks are harmless.
-        return all(
-            verify_signature_sets_device(
-                sets[i:i + MAX_SETS_PER_DISPATCH], seed=seed
-            )
-            for i in range(0, len(sets), MAX_SETS_PER_DISPATCH)
+    if not sets or len(sets) > MAX_SETS_PER_DISPATCH:
+        raise ValueError(
+            f"build_device_batch takes 1..{MAX_SETS_PER_DISPATCH} sets, "
+            f"got {len(sets)}"
         )
     with tracing.span(
         "device_batch_setup", hist=metrics.DEVICE_BATCH_SETUP_SECONDS,
@@ -299,11 +297,24 @@ def verify_signature_sets_device(sets, seed: Optional[bytes] = None) -> bool:
         rands = _rand_scalars(len(sets), seed)
         batch = build_batch(sets, rands)
     if batch is None:
-        return False
-    # compiled-program shape: (n_sets_bucket, max_keys_bucket)
-    nb, kb = int(batch[0][0].shape[0]), int(batch[0][0].shape[1])
-    live_keys = sum(len(s.signing_keys) for s in sets)
-    stages = {"setup": sp_setup.duration}
+        return None
+    return BuiltBatch(sets, seed, batch, sp_setup.duration)
+
+
+def execute_built_batch(built: BuiltBatch, *, n_groups: int = 1,
+                        work_mix: Optional[dict] = None) -> bool:
+    """Stage 2 — supervised dispatch + wait + verdict for a built batch.
+
+    Runs under the device supervisor (watchdog, one split-batch retry, the
+    per-op circuit breaker routing to the host golden model) and records the
+    batch in the flight recorder.  ``n_groups``/``work_mix`` attribute a
+    pipeline-coalesced batch's composition in the flight record."""
+    from .. import device_supervisor, device_telemetry, tracing
+    from ..crypto.bls.backends import host
+
+    sets, seed = built.sets, built.seed
+    batch, nb, kb = built.batch, built.nb, built.kb
+    stages = {"setup": built.setup_s}
     # The watchdog worker writes stage durations into dicts IT owns and
     # publishes them via this one-slot holder when the device fn finishes.
     # The caller merges only when the worker completed (never on a
@@ -348,7 +359,9 @@ def verify_signature_sets_device(sets, seed: Optional[bytes] = None) -> bool:
         op="bls_verify",
         shape=(nb, kb),
         n_live=len(sets),
-        live_keys=live_keys,
+        live_keys=built.live_keys,
+        n_groups=n_groups,
+        work_mix=work_mix,
         stages=stages,
         verdict=ok,
         host_fallback=host_fallback,
@@ -366,3 +379,45 @@ def verify_signature_sets_device(sets, seed: Optional[bytes] = None) -> bool:
     if host_fallback:
         tracing.annotate(host_fallback=True)
     return ok
+
+
+def verify_signature_sets_device(sets, seed: Optional[bytes] = None) -> bool:
+    """Drop-in batch verifier running the hot path on the JAX backend — the
+    build and dispatch stages run back-to-back on the calling thread.
+
+    Instrumented per stage (setup / dispatch / block-until-ready / verdict —
+    reference metrics.rs:247-271): the dispatch timer measures only the
+    async enqueue; the block-until-ready timer is the device execution
+    window a TPU perf investigation cares about.  Each stage span feeds its
+    histogram AND the active trace (tracing.py), with batch-size and bucket
+    fields, so a slow batch inside a block import is attributable.
+
+    Device telemetry (device_telemetry.py) rides the same seams: the
+    dispatch duration of a first-seen (nb, kb) registers in the compile
+    cache, occupancy is accounted against the padded shape, and the whole
+    batch lands in the flight recorder linked to the active trace id.
+
+    Execution is supervised (device_supervisor.py): the device leg runs
+    under a dispatch-deadline watchdog, transient device errors get one
+    split-batch retry, and a per-op circuit breaker routes batches to the
+    host golden model while the device is failing — so a device fault
+    degrades the chain to slow-but-correct instead of crashing it."""
+    sets = list(sets)
+    if not sets:
+        return False
+    if len(sets) > MAX_SETS_PER_DISPATCH:
+        # Oversized batches chunk through the standard top bucket: each
+        # chunk is an independently supervised dispatch (split-retry and
+        # breaker semantics per chunk), verdicts AND together.  The seed is
+        # shared — each chunk is its own batch-verification equation, so
+        # repeated blinding weights across chunks are harmless.
+        return all(
+            verify_signature_sets_device(
+                sets[i:i + MAX_SETS_PER_DISPATCH], seed=seed
+            )
+            for i in range(0, len(sets), MAX_SETS_PER_DISPATCH)
+        )
+    built = build_device_batch(sets, seed=seed)
+    if built is None:
+        return False
+    return execute_built_batch(built)

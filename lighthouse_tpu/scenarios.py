@@ -151,6 +151,7 @@ class ScenarioRunner:
         self._saved_hash_impl = None
         self._saved_host_impl = None
         self._breakers_touched = False
+        self._pipeline_enabled = False
         self._spam_endpoints: List[str] = []
 
     # ------------------------------------------------------------ helpers
@@ -281,6 +282,23 @@ class ScenarioRunner:
         self._breakers_touched = True
         device_supervisor.SUPERVISOR.configure(
             config=device_supervisor.BreakerConfig(**kwargs))
+
+    def _ev_device_pipeline(self, enable: bool, linger_s: float = 0.002) -> None:
+        """Route every node's ``verify_signature_sets`` through the async
+        device pipeline (device_pipeline.py) — coalescing stays active over
+        whatever BLS backend the scenario runs, so the determinism gate
+        covers batching-composition variance: batch makeup may differ
+        between runs, but verdicts (and therefore heads) must not."""
+        from . import device_pipeline
+
+        if enable:
+            self._pipeline_enabled = True
+            # a tight linger keeps scenario wall time sane: the point is the
+            # coalescing seam in the path, not big batches
+            device_pipeline.get_pipeline().linger_s = float(linger_s)
+            device_pipeline.enable()
+        else:
+            device_pipeline.disable()
 
     def _ev_device_hashing(self, enable: bool, threshold_blocks: int = 4) -> None:
         """Route Merkle pair-hash layers of ``threshold_blocks``+ through
@@ -552,6 +570,10 @@ class ScenarioRunner:
 
     def _cleanup(self) -> None:
         fault_injection.clear()
+        if self._pipeline_enabled:
+            from . import device_pipeline
+
+            device_pipeline.reset_for_tests()
         if self._saved_hash_impl is not None:
             self._ev_device_hashing(enable=False)
         if self._breakers_touched:
@@ -660,6 +682,35 @@ def device_breaker_mid_sync(seed: int = 0) -> Scenario:
     )
 
 
+def pipeline_mid_sync(seed: int = 0) -> Scenario:
+    """``device_breaker_mid_sync`` with the async device pipeline enabled:
+    every gossip/import verification rides the coalescing pipeline while a
+    joining node range-syncs and the ``sha256_pairs`` breaker trips OPEN.
+    The determinism gate (2 identical runs) proves batch COMPOSITION
+    variance — which groups coalesce together is timing-dependent — cannot
+    leak into chain content, and the breaker interplay proves pipeline
+    futures still resolve while device work routes to the host."""
+    return Scenario(
+        name="pipeline_mid_sync",
+        description="async device pipeline on during breaker-tripping sync",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=32, fault_slots=8, recovery_slots=24,
+        events=(
+            Event(0, "device_pipeline", {"enable": True}),
+            Event(0, "breaker_config",
+                  {"failure_threshold": 2, "open_cooldown_s": 300.0,
+                   "probe_successes": 1}),
+            Event(0, "device_hashing", {"enable": True}),
+            Event(0, "install_faults",
+                  {"spec": "device.dispatch[op=sha256_pairs]=error"}),
+            Event(1, "join_checkpoint", {"anchor_from": 0}),
+            Event(4, "clear_faults"),
+            Event(4, "device_hashing", {"enable": False}),
+        ),
+        extra_checks=_check_pipeline_active,
+    )
+
+
 def spam_slow_peer(seed: int = 0) -> Scenario:
     """A spammer floods undecodable blocks at one node while another pair's
     RPC link turns slow: scoring graylists the spammer, the mesh converges
@@ -721,6 +772,23 @@ def _check_breaker_tripped(runner: ScenarioRunner) -> dict:
     return {"breaker": snapshot}
 
 
+def _check_pipeline_active(runner: ScenarioRunner) -> dict:
+    """The pipeline really carried traffic AND the breaker really tripped —
+    otherwise the scenario proved nothing about their interplay."""
+    from . import device_pipeline, device_supervisor
+
+    snap = device_pipeline.summary()
+    assert snap is not None and snap["batches_total"] >= 1, (
+        "no verification rode the pipeline")
+    br = device_supervisor.SUPERVISOR.breaker("sha256_pairs").snapshot()
+    assert br["trips_total"] >= 1, "breaker never tripped mid-sync"
+    assert snap["pending_groups"] == 0 and snap["in_flight_groups"] == 0, (
+        "pipeline did not drain")
+    return {"pipeline": {k: snap[k] for k in
+                         ("batches_total", "groups_total", "sets_total")},
+            "breaker": br}
+
+
 def _check_spammer_penalized(runner: ScenarioRunner) -> dict:
     spammer_id, victim = runner.ctx["spammer"]
     score = victim.node.service.peer_manager._peer(spammer_id).score
@@ -735,6 +803,7 @@ SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     "nonfinality_spell": nonfinality_spell,
     "checkpoint_join_lossy": checkpoint_join_lossy,
     "device_breaker_mid_sync": device_breaker_mid_sync,
+    "pipeline_mid_sync": pipeline_mid_sync,
     "spam_slow_peer": spam_slow_peer,
 }
 

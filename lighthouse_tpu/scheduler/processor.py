@@ -65,6 +65,7 @@ WORK_EVENTS_REQUEUED = _gm.counter(
     "work events re-enqueued after a RequeueWork (device dispatch "
     "deadline exceeded and retryable), by work class",
 )
+QUEUE_DEPTH = _gm.BEACON_PROCESSOR_QUEUE_DEPTH
 
 
 @dataclass
@@ -109,6 +110,7 @@ class BeaconProcessor:
             self._limits.update(queue_lengths)
         self._lock = threading.Condition()
         self._active_workers = 0
+        self._last_depth_sample = 0.0
         self._shutdown = False
         self._idle = threading.Event()
         self._idle.set()
@@ -152,13 +154,22 @@ class BeaconProcessor:
 
     def _next_work(self) -> Optional[List[WorkEvent]]:
         """First non-empty queue in drain order; batchable classes coalesce
-        up to their batch size (must hold the lock)."""
+        up to their batch size (must hold the lock).
+
+        A batchable class with exactly ONE queued event still takes the
+        batch path: the batch handlers are the seam that feeds the async
+        device pipeline (device_pipeline.py), and a single attestation must
+        enter it like any other group — the old ``len(q) > 1`` guard routed
+        lone events through the per-item handler, so they never coalesced
+        with anything.  With the pipeline doing the real cross-work-type
+        batching, the per-class caps here are throughput hints (how much one
+        worker drains per wakeup), not the batch-formation mechanism."""
         for wt in DRAIN_ORDER:
             q = self._queues.get(wt)
             if not q:
                 continue
             rule = BATCH_RULES.get(wt)
-            if rule is not None and len(q) > 1:
+            if rule is not None:
                 _, max_batch = rule
                 batch = []
                 while q and len(batch) < max_batch:
@@ -175,14 +186,29 @@ class BeaconProcessor:
                 ):
                     if self._active_workers == 0 and self._all_empty():
                         self._idle.set()
+                    self._sample_queue_depths()
                     self._lock.wait(timeout=0.05)
                 if self._shutdown:
                     return
+                self._sample_queue_depths()
                 batch = self._next_work()
                 if batch is None:
                     continue
                 self._active_workers += 1
             threading.Thread(target=self._run_worker, args=(batch,), daemon=True).start()
+
+    def _sample_queue_depths(self) -> None:
+        """Mirror per-class queue lengths onto
+        ``beacon_processor_queue_depth{work}`` (throttled; must hold the
+        lock).  Read next to ``device_pipeline_pending_sets``: queue
+        pressure here vs batch fill there attributes a small-batches
+        regression in one scrape."""
+        now = time.monotonic()
+        if now - self._last_depth_sample < 0.25:
+            return
+        self._last_depth_sample = now
+        for wt, q in self._queues.items():
+            QUEUE_DEPTH.set(len(q), work=wt)
 
     def _next_ready(self) -> Optional[str]:
         for wt in DRAIN_ORDER:
@@ -223,7 +249,10 @@ class BeaconProcessor:
                     hist_labels={"work": wt},
                     work=wt,
                 )
-                if len(batch) > 1 and batch[0].process_batch is not None:
+                # Batch handler whenever one exists — including a batch of
+                # ONE (the handler is the device-pipeline seam; see
+                # _next_work).  Events without a batch handler run per-item.
+                if batch[0].process_batch is not None and wt in BATCH_RULES:
                     batch_wt = BATCH_RULES[wt][0]
                     self.metrics.bump(self.metrics.batches, batch_wt)
                     self.metrics.bump(self.metrics.batch_items, batch_wt, len(batch))

@@ -123,12 +123,16 @@ class RequeueWork(RuntimeError):
 
 # Batchable work: (batch_work_type, max batch size).  The reference caps
 # coalescing at 64 attestations (``lib.rs:200-201``) because blst verifies
-# on CPU threads; here one drained batch feeds one TPU program invocation,
-# and the device is latency-dominated (PERF.md round 5: 1x1 and 128x32
-# execute in nearly the same wall time) — so the cap is the production
-# standard device bucket (ops/verify.py ``N_BUCKETS[-1]``; kept as a
-# literal so importing the work taxonomy never pulls jax).  Overridable for
-# hosts where giant buckets are wrong (e.g. CPU-only deployments).
+# on CPU threads; here the cap is the production standard device bucket
+# (ops/verify.py ``N_BUCKETS[-1]``; kept as a literal so importing the work
+# taxonomy never pulls jax).  Overridable for hosts where giant buckets are
+# wrong (e.g. CPU-only deployments).
+#
+# Since the async device pipeline (device_pipeline.py), these caps are
+# throughput HINTS — how much one worker drains per wakeup — not the batch
+# formation mechanism: the pipeline coalesces what every worker submits
+# ACROSS work types into the actual device batch, so a one-event drain
+# still ends up in a maximal bucket.
 def _standard_batch_from_env() -> int:
     raw = os.environ.get("LIGHTHOUSE_TPU_STANDARD_BATCH", "4096")
     try:

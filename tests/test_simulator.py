@@ -61,6 +61,10 @@ def test_sim_finalizes_over_secured_tcp_with_discv5():
     noise -> yamux on real sockets), and keep one chain finalizing —
     the reference simulator's liveness property on the reference's own
     wire formats."""
+    pytest.importorskip(
+        "cryptography",
+        reason="secured TCP + discv5 needs the `cryptography` package",
+    )
     sim = Simulator(node_count=3, validator_count=16,
                     transport="tcp_secured", discovery="discv5")
     try:

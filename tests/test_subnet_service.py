@@ -172,6 +172,10 @@ def test_http_endpoint_feeds_subnet_service(spec):
 
 
 def test_attnets_bitfield_and_predicate(spec):
+    pytest.importorskip(
+        "cryptography",
+        reason="ENR signing needs the `cryptography` package",
+    )
     from lighthouse_tpu.network.discv5 import KeyPair
     from lighthouse_tpu.network.discv5.enr import ENR
     from lighthouse_tpu.network.subnet_service import (
@@ -195,6 +199,10 @@ def test_attnets_bitfield_and_predicate(spec):
 
 
 def test_node_enr_advertises_backbone(spec):
+    pytest.importorskip(
+        "cryptography",
+        reason="ENR signing needs the `cryptography` package",
+    )
     from lighthouse_tpu.chain import BeaconChainHarness
     from lighthouse_tpu.crypto.bls.backends import set_backend
     from lighthouse_tpu.network.node import LocalNode
@@ -226,6 +234,10 @@ def test_enr_refresh_on_rotation(spec):
     """When the active subnet set changes, the node re-mints its ENR with
     a bumped seq and updates MetaData — a stale record would have peers
     dialing us for subnets we left."""
+    pytest.importorskip(
+        "cryptography",
+        reason="ENR signing needs the `cryptography` package",
+    )
     from lighthouse_tpu.chain import BeaconChainHarness
     from lighthouse_tpu.crypto.bls.backends import set_backend
     from lighthouse_tpu.network.node import LocalNode
