@@ -406,11 +406,22 @@ class Kzg:
         if self.device:
             from ...ops.kzg_device import verify_kzg_proof_batch_device
 
+            # Supervised: the host MSM path below is the golden-model
+            # fallback a hung/failing device (or an OPEN kzg_batch breaker)
+            # resolves through — blob DA degrades to slow-but-correct.
             return verify_kzg_proof_batch_device(
                 [_g1_to_curve_point(c) for c in c_pts],
                 [_g1_to_curve_point(p) for p in p_pts],
                 r_powers, zs, ys, self.setup.g2_monomial[1],
+                host_fn=lambda: self._verify_kzg_proof_batch_host(
+                    c_pts, zs, ys, p_pts, r_powers
+                ),
             )
+        return self._verify_kzg_proof_batch_host(c_pts, zs, ys, p_pts, r_powers)
+
+    def _verify_kzg_proof_batch_host(
+        self, c_pts, zs, ys, p_pts, r_powers
+    ) -> bool:
         proof_lincomb = g1.msm(p_pts, r_powers)
         proof_z_lincomb = g1.msm(
             p_pts, [r * z % BLS_MODULUS for r, z in zip(r_powers, zs)]

@@ -72,16 +72,20 @@ DEFAULT_DEADLINES = {
     "sha256_pairs": 120.0,
     "epoch_deltas": 300.0,
     "epoch_deltas_leak": 300.0,
+    "kzg_batch": 300.0,
 }
 DEFAULT_DEADLINE_S = 300.0
 
 #: Ops whose device kernels compute batch-GLOBAL reductions (the epoch pass
-#: sums participation over the whole registry): the halves of a split are
-#: not independent sub-problems, so split-batch retry is forbidden for them
-#: no matter what a caller passes — with 4096-scale standard buckets a
+#: sums participation over the whole registry; the kzg program tree-sums
+#: its random-linear-combination over the blob axis): the halves of a split
+#: are not independent sub-problems, so split-batch retry is forbidden for
+#: them no matter what a caller passes — with 4096-scale standard buckets a
 #: mis-wired split would silently change the op's semantics, not just its
-#: shape.  Failures for these ops go straight to the host fallback.
-NO_SPLIT_OPS = frozenset({"epoch_deltas", "epoch_deltas_leak"})
+#: shape.  Failures for these ops go straight to the host fallback.  Must
+#: stay in sync with the ``reduces_over_batch`` entries in
+#: ``ops/batch_axes.py`` (the sharding contract reads the same property).
+NO_SPLIT_OPS = frozenset({"epoch_deltas", "epoch_deltas_leak", "kzg_batch"})
 
 
 class DispatchTimeout(RequeueWork):

@@ -14,7 +14,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..consensus import helpers as h
-from ..types.spec import ChainSpec
+from ..types.spec import TIMELY_TARGET_FLAG_INDEX, ChainSpec
 
 
 def max_cover(candidates: Sequence[Tuple[object, Set[int]]], limit: int) -> List[object]:
@@ -94,15 +94,33 @@ class OperationPool:
 
         candidates: List[Tuple[object, Set[int]]] = []
         state_slot = int(state.slot)
+        fork = type(state).fork_name
+        # Freshness filter (reference ``AttMaxCover::fresh_validators``):
+        # a validator who already carries the timely-target flag for the
+        # attestation's epoch contributes nothing, so it must not count as
+        # coverage.  Without this, deneb's unbounded inclusion window
+        # (EIP-7045) lets stale aggregates outscore fresh current-epoch
+        # ones and crowd them out of the block — justification then lands
+        # one epoch late and finalization trails by an epoch.  phase0
+        # states keep raw coverage (participation is pending-attestation
+        # based there; the inclusion window is one epoch anyway).
+        participation_by_epoch = {}
+        if fork != "phase0":
+            participation_by_epoch = {
+                int(h.get_previous_epoch(state, spec)):
+                    state.previous_epoch_participation,
+                int(h.get_current_epoch(state, spec)):
+                    state.current_epoch_participation,
+            }
         # Canonical candidate order (sorted keys, then bit patterns), NOT
         # gossip-arrival order: max_cover breaks ties by position, so two
         # nodes with the same pool contents — or one node across two runs —
         # must pack identical bodies whatever order the wire delivered the
         # attestations in (the scenario soak's determinism gate).
+        is_electra_state = fork == "electra"
         for (slot, _), group in sorted(self._attestations.items()):
             if not spec.attestation_includable(slot, state_slot):
                 continue
-            is_electra_state = type(state).fork_name == "electra"
             for att in sorted(
                 group.aggregates,
                 key=lambda a: (tuple(a.aggregation_bits),
@@ -132,6 +150,15 @@ class OperationPool:
                         }
                 except Exception:
                     continue
+                if fork != "phase0":
+                    part = participation_by_epoch.get(int(att.data.target.epoch))
+                    if part is None:
+                        continue  # target epoch not includable on this state
+                    cover = {
+                        i for i in cover
+                        if i < len(part)
+                        and not h.has_flag(int(part[i]), TIMELY_TARGET_FLAG_INDEX)
+                    }
                 if cover:
                     candidates.append((att, cover))
         picked = max_cover(candidates, limit)

@@ -1,0 +1,94 @@
+"""Batch-axis registry: the sharding contract of every device entry point.
+
+ROADMAP item 2 (graduate multi-chip to the production dispatch path) shards
+the *batch axis* of the bucketed device programs over a
+``jax.sharding.Mesh``.  That only works if the batch axis is a real,
+declared property of each entry point — not folklore living in docstrings.
+This registry IS that declaration: one entry per jitted device entry point
+in ``ops/``, naming the op, the batch axis position of its batched
+arguments, and whether the program reduces over the batch axis (in which
+case a sharded lowering needs a collective sum and the supervisor must
+never split the batch — see ``device_supervisor.NO_SPLIT_OPS``).
+
+Consumed three ways:
+
+- the **sharding-readiness static pass** (``scripts/analysis/sharding_pass.py``)
+  reads this file via ``ast.literal_eval`` (check_static stays import-free
+  of ``lighthouse_tpu``) and fails when a jitted entry point in ``ops/`` is
+  missing here, or when code inside a registered entry folds the batch
+  axis into limb axes;
+- the future mesh-sharding layer builds its ``PartitionSpec``\\ s from
+  ``batch_axis``/``reduces_over_batch`` instead of hand-maintaining them;
+- the HLO budget auditor (``scripts/analysis/hlo_budget.py``) keys its
+  per-(op, bucket) StableHLO budgets on the ``op`` names declared here.
+
+Keys are ``"<repo-relative path>:<function name>"``.  ``batch_axis`` is the
+axis of every *batched* argument that a mesh shards (non-batched arguments
+are listed under ``replicated_args`` — broadcast to every device).  This
+module must stay a plain dict literal with no imports: the static pass
+parses it, never imports it.
+"""
+
+#: sharding-readiness contract per jitted device entry point (see module
+#: docstring; sharding_pass.py enforces completeness of this mapping).
+BATCH_AXES = {
+    "lighthouse_tpu/ops/verify.py:_device_verify": {
+        "op": "bls_verify",
+        "batch_axis": 0,
+        "batched_args": ["pk", "sig", "msg", "wbits", "live"],
+        "replicated_args": [],
+        "reduces_over_batch": False,
+        "notes": "per-set pairing rows; the N+1'th (-g1, W) pair is "
+                 "assembled inside the program from a batch-wide MSM — a "
+                 "sharded lowering psums the MSM then replicates the pair",
+    },
+    "lighthouse_tpu/ops/sha256_device.py:_sha256_64byte_batch": {
+        "op": "sha256_pairs",
+        "batch_axis": 0,
+        "batched_args": ["words"],
+        "replicated_args": [],
+        "reduces_over_batch": False,
+        "notes": "embarrassingly parallel over 64-byte blocks",
+    },
+    "lighthouse_tpu/ops/epoch_device.py:_deltas_kernel": {
+        "op": "epoch_deltas",
+        "batch_axis": 0,
+        "batched_args": [
+            "eff_bal", "activation_epoch", "exit_epoch",
+            "withdrawable_epoch", "slashed", "prev_part", "inactivity",
+        ],
+        "replicated_args": [
+            "previous_epoch", "base_reward_per_increment",
+            "total_active_balance", "increment", "inactivity_score_bias",
+            "inactivity_score_recovery_rate", "quotient",
+        ],
+        "reduces_over_batch": True,
+        "notes": "participating-increment sums span the whole registry "
+                 "(NO_SPLIT_OPS); sharding needs a psum per flag index",
+    },
+    "lighthouse_tpu/ops/kzg_device.py:_device_kzg_batch": {
+        "op": "kzg_batch",
+        "batch_axis": 0,
+        "batched_args": ["c", "p", "r_bits", "rz_bits"],
+        "replicated_args": ["ry_bits", "tau", "g2gen"],
+        "reduces_over_batch": True,
+        "notes": "tree-sum lincombs reduce the blob axis into one "
+                 "2-pairing; sharding needs a collective point-sum",
+    },
+    "lighthouse_tpu/ops/pallas_fq.py:_fq_mul_pallas_flat": {
+        "op": "pallas_fq_mul",
+        "batch_axis": 0,
+        "batched_args": ["a8p", "b8p"],
+        "replicated_args": [],
+        "reduces_over_batch": False,
+        "notes": "bench-only opt-in kernel; tiles of 128 rows",
+    },
+    "lighthouse_tpu/ops/pallas_fq.py:_fq2_mul_pallas_flat": {
+        "op": "pallas_fq2_mul",
+        "batch_axis": 0,
+        "batched_args": ["operands"],
+        "replicated_args": [],
+        "reduces_over_batch": False,
+        "notes": "bench-only opt-in kernel; tiles of 128 rows",
+    },
+}

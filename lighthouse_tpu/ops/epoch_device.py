@@ -34,7 +34,11 @@ from ..types.spec import (
 )
 
 
+# Unbucketed by design: one executable per validator-count/in_leak pair —
+# the registry size is stable across epochs, so the compiled-program
+# population is two per network, not per batch.
 @partial(jax.jit, static_argnames=("in_leak",))
+# recompile-hazard: ok(one executable per registry size; stable across epochs)
 def _deltas_kernel(
     eff_bal,            # (n,) int64 gwei
     activation_epoch,   # (n,) int64

@@ -67,20 +67,12 @@ def _bucket(n: int) -> int:
     raise ValueError(f"kzg batch of {n} exceeds max bucket {N_BUCKETS[-1]}")
 
 
-def verify_kzg_proof_batch_device(
-    c_pts: Sequence, p_pts: Sequence, r_powers: Sequence[int],
-    zs: Sequence[int], ys: Sequence[int], g2_tau,
-) -> bool:
-    """Run the device program on parsed host points + scalars.
-
-    ``c_pts``/``p_pts``: host affine G1 (Fq pairs or None for infinity);
-    ``g2_tau``: host Fq2 affine point ([tau]G2 from the trusted setup)."""
+def _build_kzg_batch(c_pts, p_pts, r_powers, zs, ys, g2_tau, nb: int):
+    """Host-side marshalling (limb packing, scalar-bit expansion) into
+    padded device arrays — no device work beyond the uploads."""
     from ..crypto.bls.params import R
 
     n = len(c_pts)
-    assert n == len(p_pts) == len(r_powers) == len(zs) == len(ys)
-    nb = _bucket(max(1, n))
-
     id1 = ec.g1_to_limbs(None)
     c = [np.tile(np.asarray(x), (nb, 1)) for x in id1]
     p = [np.tile(np.asarray(x), (nb, 1)) for x in id1]
@@ -103,7 +95,7 @@ def verify_kzg_proof_batch_device(
         np.asarray(ec.G2_GEN_LIMBS[0]),
         np.asarray(ec.G2_GEN_LIMBS[1]),
     )
-    fe = _device_kzg_batch(
+    return (
         tuple(jnp.asarray(a) for a in c),
         tuple(jnp.asarray(a) for a in p),
         jnp.asarray(r_bits),
@@ -112,4 +104,88 @@ def verify_kzg_proof_batch_device(
         tuple(jnp.asarray(a) for a in tau),
         tuple(jnp.asarray(a) for a in g2gen),
     )
-    return pairing.fe_is_one(fe)
+
+
+def verify_kzg_proof_batch_device(
+    c_pts: Sequence, p_pts: Sequence, r_powers: Sequence[int],
+    zs: Sequence[int], ys: Sequence[int], g2_tau,
+    host_fn=None,
+) -> bool:
+    """Run the device program on parsed host points + scalars.
+
+    ``c_pts``/``p_pts``: host affine G1 (Fq pairs or None for infinity);
+    ``g2_tau``: host Fq2 affine point ([tau]G2 from the trusted setup).
+
+    Supervised (device_supervisor.py) like the other bucketed entry points:
+    the dispatch + the ``fe == 1`` materialization run on the watchdog
+    worker — the blob-DA caller (block import) never blocks inside a device
+    sync — and a hung or failing device resolves through ``host_fn`` (the
+    host MSM golden model in ``crypto/kzg/kzg.py``) under the one shared
+    breaker/fallback mechanism.  With ``host_fn=None`` failures propagate.
+    """
+    from .. import device_supervisor, device_telemetry, fault_injection
+
+    n = len(c_pts)
+    assert n == len(p_pts) == len(r_powers) == len(zs) == len(ys)
+    nb = _bucket(max(1, n))
+    holder: dict = {}
+
+    def device_fn() -> bool:
+        import time as _time
+
+        stages_local: dict = {}
+        state_local: dict = {}
+        try:
+            # Marshalling (and its host→device uploads) happens INSIDE the
+            # supervised leg: an OPEN breaker must not touch the device at
+            # all, and a transfer raising on a dead device resolves through
+            # the host fallback like any other device failure.
+            t_setup = _time.perf_counter()
+            batch = _build_kzg_batch(c_pts, p_pts, r_powers, zs, ys,
+                                     g2_tau, nb)
+            stages_local["setup"] = _time.perf_counter() - t_setup
+            if fault_injection.ACTIVE:
+                if not device_telemetry.COMPILE_CACHE.seen("kzg_batch", (nb,)):
+                    fault_injection.check("device.compile", op="kzg_batch")
+                fault_injection.check("device.dispatch", op="kzg_batch")
+            t_dispatch = _time.perf_counter()
+            fe = _device_kzg_batch(*batch)
+            dispatch_s = _time.perf_counter() - t_dispatch
+            stages_local["dispatch"] = dispatch_s
+            if device_telemetry.note_dispatch("kzg_batch", (nb,), dispatch_s):
+                state_local["compiled"] = True
+            t_wait = _time.perf_counter()
+            jax.block_until_ready(fe)
+            stages_local["wait"] = _time.perf_counter() - t_wait
+            return pairing.fe_is_one(fe)
+        finally:
+            holder["stages"] = stages_local
+            holder["state"] = state_local
+
+    info: dict = {}
+    ok = device_supervisor.run(
+        "kzg_batch",
+        device_fn,
+        host_fn=host_fn,
+        info=info,
+    )
+    reason = info.get("fallback_reason")
+    stages: dict = {}
+    compiled = False
+    if reason != "dispatch_timeout":
+        stages = holder.get("stages") or {}
+        compiled = (holder.get("state") or {}).get("compiled", False)
+    device_telemetry.record_batch(
+        op="kzg_batch",
+        shape=(nb,),
+        n_live=n,
+        stages=stages or None,
+        verdict=bool(ok),
+        host_fallback=info.get("route") == "host",
+        fallback_reason=reason,
+        trace_id=device_telemetry.active_trace_id(),
+        compiled=compiled,
+        breaker_state=info.get("breaker_state"),
+        dispatched=reason != "breaker_open",
+    )
+    return bool(ok)

@@ -146,6 +146,7 @@ def _fq2_mul_kernel(a0_ref, a1_ref, b0_ref, b1_ref, sa_ref, sb_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
+# recompile-hazard: ok(bench-only opt-in kernel; pads to 128-row tiles, adoption gated on pallas_bench)
 def _fq_mul_pallas_flat(a8p: jax.Array, b8p: jax.Array, interpret: bool):
     from jax.experimental import pallas as pl
 
@@ -174,6 +175,7 @@ def _stage_operand(x: jax.Array, n: int, n_pad: int) -> jax.Array:
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
+# recompile-hazard: ok(bench-only opt-in kernel; pads to 128-row tiles, adoption gated on pallas_bench)
 def _fq2_mul_pallas_flat(operands, interpret: bool):
     from jax.experimental import pallas as pl
 
@@ -207,6 +209,7 @@ def fq2_mul_pallas(a: jax.Array, b: jax.Array, *, interpret=None) -> jax.Array:
     b0, b1 = b2[:, 0, :], b2[:, 1, :]
     operands = [_stage_operand(x, n, n_pad)
                 for x in (a0, a1, b0, b1, a0 + a1, b0 + b1)]
+    # recompile-hazard: ok(tile-multiple pad; one program per tile count, bench-only)
     out0, out1 = _fq2_mul_pallas_flat(operands, interpret)
     return jnp.stack(
         [out0[:n, :L16], out1[:n, :L16]], axis=-2
@@ -236,5 +239,6 @@ def fq_mul_pallas(a: jax.Array, b: jax.Array, *, interpret=None) -> jax.Array:
     n_pad = max(_BT, ((n + _BT - 1) // _BT) * _BT)
     a8p = _stage_operand(a2, n, n_pad)
     b8p = _stage_operand(b2, n, n_pad)
+    # recompile-hazard: ok(tile-multiple pad; one program per tile count, bench-only)
     out = _fq_mul_pallas_flat(a8p, b8p, interpret)
     return out[:n, :L16].reshape(*lead, L16)

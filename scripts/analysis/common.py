@@ -12,7 +12,8 @@ from typing import Dict, Iterator, List, Optional, Set, Tuple
 #: ``# <pass>: ok(<reason>)`` — trailing on the offending line (or any line
 #: the offending expression spans), or standalone on the line just above it.
 PRAGMA_RE = re.compile(
-    r"#\s*(safe-arith|lock-order|device-purity):\s*ok\(([^)]*)\)"
+    r"#\s*(safe-arith|lock-order|device-purity|recompile-hazard|host-sync"
+    r"|sharding-ready):\s*ok\(([^)]*)\)"
 )
 
 
@@ -110,6 +111,142 @@ def dotted_path(node: ast.AST) -> Optional[str]:
         parts.append(node.id)
         return ".".join(reversed(parts))
     return None
+
+
+def is_jit_decorator(dec: ast.AST) -> bool:
+    """``@jax.jit``, ``@jit``, ``@functools.partial(jax.jit, ...)``,
+    ``@partial(jit, ...)`` — shared by the device passes."""
+    if terminal_name(dec) == "jit":
+        return True
+    if isinstance(dec, ast.Call):
+        if terminal_name(dec.func) == "jit":
+            return True
+        if terminal_name(dec.func) == "partial":
+            return any(terminal_name(a) == "jit" for a in dec.args)
+    return False
+
+
+def jitted_function_defs(tree: ast.Module) -> List[ast.FunctionDef]:
+    """Module-scope function defs carrying a jit decorator."""
+    out: List[ast.FunctionDef] = []
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
+            is_jit_decorator(d) for d in node.decorator_list
+        ):
+            out.append(node)
+    return out
+
+
+def local_jit_names(tree: ast.Module) -> Set[str]:
+    """Names of jitted callables defined in this module: decorated defs
+    plus module-level ``x = jax.jit(f)`` assignments."""
+    names = {fn.name for fn in jitted_function_defs(tree)}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and terminal_name(node.value.func) == "jit"
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _collect_stmt_bound(stmt: ast.stmt, names: Set[str]) -> None:
+    if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+        for a in stmt.names:
+            names.add((a.asname or a.name).split(".")[0])
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        names.add(stmt.name)
+    elif isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.For,
+                           ast.AsyncFor, ast.With, ast.AsyncWith, ast.If,
+                           ast.While, ast.Try)):
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                names.add(node.id)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for a in node.names:
+                    names.add((a.asname or a.name).split(".")[0])
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                names.add(node.name)
+            elif isinstance(node, ast.ExceptHandler) and node.name:
+                names.add(node.name)
+
+
+def module_bound_names(tree: ast.Module) -> Set[str]:
+    """Every name bound at module level (imports, defs, assigns — including
+    inside module-level ``if``/``try`` blocks)."""
+    names: Set[str] = set()
+    for stmt in tree.body:
+        _collect_stmt_bound(stmt, names)
+    return names
+
+
+def function_bound_names(fn: ast.AST) -> Set[str]:
+    """Every name bound anywhere inside a function subtree: parameters
+    (its own and nested functions'), Store-context names, imports, nested
+    def/class names, except aliases.  Used to compute a jitted function's
+    FREE names — the closure captures that become trace-time constants."""
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+            a = node.args
+            for arg in (
+                list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])
+            ):
+                names.add(arg.arg)
+        elif isinstance(node, ast.Lambda):
+            a = node.args
+            for arg in (
+                list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])
+            ):
+                names.add(arg.arg)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                names.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, ast.ClassDef):
+            names.add(node.name)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            names.add(node.name)
+    return names
+
+
+#: Repo-relative path of the batch-axis registry (parsed, never imported —
+#: check_static stays import-free of lighthouse_tpu).
+BATCH_AXES_PATH = "lighthouse_tpu/ops/batch_axes.py"
+
+
+def extract_batch_axes(tree: ast.Module) -> Optional[dict]:
+    """The ``BATCH_AXES = {...}`` dict literal from a parsed module, or
+    None when the module declares none (or the literal fails to eval)."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "BATCH_AXES":
+                    try:
+                        return ast.literal_eval(node.value)
+                    except (ValueError, SyntaxError):
+                        return None
+    return None
+
+
+def load_batch_axes(root: str) -> Optional[dict]:
+    """Parse the committed registry.  None when missing/malformed — the
+    passes turn that into a finding rather than going silently blind."""
+    path = os.path.join(root, BATCH_AXES_PATH)
+    if not os.path.exists(path):
+        return None
+    tree, _, _ = parse_file(path)
+    return extract_batch_axes(tree)
 
 
 class ScopedVisitor(ast.NodeVisitor):

@@ -32,6 +32,7 @@ from .common import (
     PragmaIndex,
     Violation,
     dotted_path,
+    is_jit_decorator,
     iter_py_files,
     parse_file,
     terminal_name,
@@ -49,19 +50,6 @@ METRIC_ATTRS = frozenset({"inc", "observe"})
 TIME_ATTRS = frozenset({"time", "perf_counter", "monotonic", "sleep", "process_time"})
 X64_DTYPES = frozenset({"int64", "uint64", "float64"})
 HOST_RNG_ROOTS = frozenset({"random", "np", "numpy"})
-
-
-def _is_jit_decorator(dec: ast.AST) -> bool:
-    """``@jax.jit``, ``@jit``, ``@functools.partial(jax.jit, ...)``,
-    ``@partial(jit, ...)``."""
-    if terminal_name(dec) == "jit":
-        return True
-    if isinstance(dec, ast.Call):
-        if terminal_name(dec.func) == "jit":
-            return True
-        if terminal_name(dec.func) == "partial":
-            return any(terminal_name(a) == "jit" for a in dec.args)
-    return False
 
 
 def _pallas_kernel_names(tree: ast.Module) -> Set[str]:
@@ -191,7 +179,7 @@ def run(root: str, scan_dirs: Tuple[str, ...] = SCAN_DIRS) -> List[Violation]:
         for node in ast.walk(tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            jitted = any(_is_jit_decorator(d) for d in node.decorator_list)
+            jitted = any(is_jit_decorator(d) for d in node.decorator_list)
             if not (jitted or node.name in kernel_names):
                 continue
             kind = "jit" if jitted else "pallas"

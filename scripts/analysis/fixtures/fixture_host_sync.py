@@ -1,0 +1,66 @@
+"""Seeded violations for the host-sync pass (parsed, never imported).
+
+Expected findings (all outside any sanctioned context, so each is a
+``hot-path-sync`` violation): block_until_ready, .item(), jax.device_get,
+np.asarray on a device value, and a verdict helper (fe_is_one) on a device
+value.  The host-side np.asarray (no device taint), the jnp.asarray of a
+device value (a no-op, not a sync) and the pragma'd site must NOT flag.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N_BUCKETS = (1, 2)  # keep fixture_recompile_hazard's no-bucket-decl quiet
+
+
+def fe_is_one(fe):
+    return bool(np.asarray(fe).sum() == 1)
+
+
+@jax.jit
+def sync_fixture_kernel(x):
+    return x + 1
+
+
+def hot_path_block(batch):
+    out = sync_fixture_kernel(batch)
+    jax.block_until_ready(out)  # SEEDED: hot-path-sync (block_until_ready)
+    return out
+
+
+def hot_path_item(batch):
+    out = sync_fixture_kernel(batch)
+    return out[0].item()  # SEEDED: hot-path-sync (.item)
+
+
+def hot_path_device_get(batch):
+    out = sync_fixture_kernel(batch)
+    return jax.device_get(out)  # SEEDED: hot-path-sync (device_get)
+
+
+def hot_path_materialize(batch):
+    out = sync_fixture_kernel(batch)
+    host = np.asarray(out)  # SEEDED: hot-path-sync (np.asarray on device value)
+    return host
+
+
+def hot_path_verdict(batch):
+    fe = sync_fixture_kernel(batch)
+    return fe_is_one(fe)  # SEEDED: hot-path-sync (verdict helper syncs)
+
+
+def hot_path_annotated(batch):
+    out: object = sync_fixture_kernel(batch)  # AnnAssign must taint too
+    return np.asarray(out)  # SEEDED: hot-path-sync (via annotated assign)
+
+
+def host_marshalling_is_fine(rows):
+    packed = np.asarray(rows)  # host data: no device taint, must not flag
+    staged = jnp.asarray(sync_fixture_kernel(packed))  # jnp: no-op, not a sync
+    return staged
+
+
+def suppressed_sync(batch):
+    out = sync_fixture_kernel(batch)
+    return np.asarray(out)  # host-sync: ok(fixture: suppressed)

@@ -1,0 +1,63 @@
+"""Seeded violations for the recompile-hazard pass (parsed, never imported).
+
+Expected findings: dynamic-shape-arg (direct len() into a jit call, and a
+taint chain through locals), fresh-closure-jit, and closure-capture.  The
+bucketed dispatch and the pragma'd site must NOT be flagged.  This module
+declares N_BUCKETS so no-bucket-decl does not fire here (that code is
+seeded in ``fixture_recompile_hazard_nobucket.py``).
+"""
+
+import jax
+import jax.numpy as jnp
+
+N_BUCKETS = (1, 2, 4, 8)
+
+
+def _bucket(n):
+    for b in N_BUCKETS:
+        if n <= b:
+            return b
+    raise ValueError(n)
+
+
+@jax.jit
+def seeded_kernel(x):
+    return x + 1
+
+
+def make_capturing_kernel(scale):
+    @jax.jit
+    def capturing_kernel(x):
+        return x * scale  # SEEDED: closure-capture (scale frozen into trace)
+
+    return capturing_kernel
+
+
+def direct_len_dispatch(items, buf):
+    return seeded_kernel(jnp.zeros((len(items),)))  # SEEDED: dynamic-shape-arg
+
+
+def tainted_chain_dispatch(data):
+    n = len(data)  # raw size
+    padded = jnp.zeros((n, 8))
+    return seeded_kernel(padded)  # SEEDED: dynamic-shape-arg (via taint chain)
+
+
+def annotated_taint_dispatch(data):
+    n: int = len(data)  # AnnAssign must taint too
+    return seeded_kernel(jnp.zeros((n, 8)))  # SEEDED: dynamic-shape-arg
+
+
+def fresh_jit_per_call(fn, x):
+    compiled = jax.jit(lambda v: fn(v))  # SEEDED: fresh-closure-jit
+    return compiled(x)
+
+
+def bucketed_dispatch_is_fine(items):
+    nb = _bucket(len(items))  # sanitized: routed through the bucket helper
+    return seeded_kernel(jnp.zeros((nb, 8)))
+
+
+def suppressed_fresh_jit(fn, x):
+    compiled = jax.jit(fn)  # recompile-hazard: ok(fixture: suppressed)
+    return compiled(x)

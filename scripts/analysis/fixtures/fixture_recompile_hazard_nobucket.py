@@ -1,0 +1,16 @@
+"""Seeded no-bucket-decl violation for the recompile-hazard pass: a module
+defining a jitted entry point with no bucket vocabulary at all.  The
+pragma'd entry must NOT be flagged."""
+
+import jax
+
+
+@jax.jit
+def raw_shape_entry(x):  # SEEDED: no-bucket-decl (module declares no buckets)
+    return x * 2
+
+
+@jax.jit
+# recompile-hazard: ok(fixture: suppressed entry without buckets)
+def suppressed_raw_shape_entry(x):
+    return x * 3

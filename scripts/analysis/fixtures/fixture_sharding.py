@@ -1,0 +1,68 @@
+"""Seeded violations for the sharding-readiness pass (parsed, never
+imported).
+
+The fixture carries its own ``BATCH_AXES`` literal (the pass merges
+registry literals found in scanned files), registering ``registered_entry``
+and a stale key.  Expected findings: batch-axis-fold (reshape(-1) and
+ravel), batch-axis-transpose, unregistered-entry, registry-stale, and
+unsharded-device-put.  The pragma'd fold, the sharded device_put and the
+registered clean entry must NOT flag.
+"""
+
+import jax
+import jax.numpy as jnp
+
+N_BUCKETS = (1, 2)  # keep fixture_recompile_hazard's no-bucket-decl quiet
+
+BATCH_AXES = {
+    "scripts/analysis/fixtures/fixture_sharding.py:registered_entry": {
+        "op": "fixture_op",
+        "batch_axis": 0,
+        "batched_args": ["x"],
+        "replicated_args": [],
+        "reduces_over_batch": False,
+    },
+    "scripts/analysis/fixtures/fixture_sharding.py:registered_clean_entry": {
+        "op": "fixture_clean_op",
+        "batch_axis": 0,
+        "batched_args": ["x"],
+        "replicated_args": [],
+        "reduces_over_batch": False,
+    },
+    # SEEDED: registry-stale (no such jitted function in this file)
+    "scripts/analysis/fixtures/fixture_sharding.py:vanished_entry": {
+        "op": "fixture_gone_op",
+        "batch_axis": 0,
+        "batched_args": [],
+        "replicated_args": [],
+        "reduces_over_batch": False,
+    },
+}
+
+
+@jax.jit
+def registered_entry(x):
+    allowed = x.reshape(-1)  # sharding-ready: ok(fixture: suppressed)
+    limbs = allowed.sum()
+    folded = x.reshape(-1, 8)  # SEEDED: batch-axis-fold (reshape -1)
+    flat = x.ravel()  # SEEDED: batch-axis-fold (ravel)
+    moved = jnp.swapaxes(x, 0, 1)  # SEEDED: batch-axis-transpose
+    return folded.sum() + flat.sum() + moved.sum() + limbs
+
+
+@jax.jit
+def registered_clean_entry(x):
+    return x + 1  # batch axis untouched: must not flag
+
+
+@jax.jit
+def rogue_entry(x):  # SEEDED: unregistered-entry (no BATCH_AXES declaration)
+    return x * 2
+
+
+def pinning_transfer(x):
+    return jax.device_put(x)  # SEEDED: unsharded-device-put
+
+
+def placed_transfer(x, mesh_sharding):
+    return jax.device_put(x, mesh_sharding)  # placed: must not flag

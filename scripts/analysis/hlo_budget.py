@@ -1,0 +1,396 @@
+#!/usr/bin/env python
+"""StableHLO budget auditor: per-(op, bucket) lowering locks (ISSUE 10).
+
+Promotes ``tests/test_hlo_audit.py``'s ad-hoc dot-count assertions into a
+committed, regenerable budget file — the lowering-level complement of the
+AST passes in ``check_static.py``.  For every audited (op, backend, bucket)
+this script lowers the program to StableHLO (trace only, no XLA compile)
+and compares against ``scripts/analysis/hlo_budget_baseline.json``:
+
+- ``dot_general``  — contraction dots (the MXU work; a rematerialized
+  convolution or de-widened fused round shows up here first);
+- ``s8_dot``       — dots whose operands are s8 (the int8 backend's MXU
+  lock: every fq_mul pipeline must keep its s8 conv dot);
+- ``convert``      — element-type conversions (an accidental dtype bounce
+  inflates this long before it shows on a bench);
+- ``transpose``    — layout shuffles (a batch-axis permutation sneaking
+  into a lowering is a sharding hazard *and* a copy);
+- ``collective``   — all_reduce/all_gather/etc (zero today; the budget line
+  exists so ROADMAP item 2's sharded lowerings are auditable from day one).
+
+Unlike the AST passes this needs jax + lighthouse_tpu, so it runs from the
+test suite (``tests/test_hlo_audit.py`` gates the small tier in tier-1, the
+full set behind the ``slow`` marker), not from ``check_static.py`` — which
+must stay import-free.
+
+Workflow (same churn discipline as check_static):
+
+    python scripts/analysis/hlo_budget.py                # self-test + audit
+    python scripts/analysis/hlo_budget.py --tier all     # + slow buckets
+    python scripts/analysis/hlo_budget.py --update-baseline [--tier all]
+
+A deliberate lowering change (widening a contraction, a new bucket) is
+re-baselined with ``--update-baseline`` and the diff reviewed like any
+other; an unexplained budget drift fails CI.  All programs are lowered
+through FRESH closures (jax's trace cache keys on callable identity — a
+direct ``jax.jit(module_fn)`` could replay a trace made under the other
+fq backend) over abstract ``ShapeDtypeStruct`` args (no data, no device).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Callable, Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+BASELINE_PATH = os.path.join(
+    REPO_ROOT, "scripts", "analysis", "hlo_budget_baseline.json"
+)
+
+METRICS = ("dot_general", "s8_dot", "convert", "transpose", "collective")
+
+_COLLECTIVE_RE = re.compile(
+    r"\b(all_reduce|all_gather|all_to_all|reduce_scatter|collective_permute"
+    r"|collective_broadcast)\b"
+)
+
+
+# ----------------------------------------------------------------- counting
+
+
+def count_budget(stablehlo_text: str) -> Dict[str, int]:
+    """The budget metrics of one lowered module.  The int32 einsum lowers
+    its elementwise outer product as a degenerate dot_general with
+    ``contracting_dims = [] x []`` that XLA fuses into a multiply — only
+    dots that actually contract count (same rule as the old test)."""
+    dots = [
+        l for l in stablehlo_text.splitlines()
+        if "dot_general" in l and "contracting_dims = [] x []" not in l
+    ]
+    return {
+        "dot_general": len(dots),
+        "s8_dot": sum(1 for l in dots if l.count("xi8>") >= 2),
+        "convert": stablehlo_text.count("stablehlo.convert"),
+        "transpose": stablehlo_text.count("stablehlo.transpose"),
+        "collective": len(_COLLECTIVE_RE.findall(stablehlo_text)),
+    }
+
+
+# ------------------------------------------------------------------ targets
+
+
+class Target:
+    """One audited (op, backend, bucket): ``build()`` returns
+    ``(fresh_callable, abstract_args)`` ready for ``jax.jit(...).lower``."""
+
+    def __init__(self, op: str, backend: str, bucket: str, tier: str,
+                 build: Callable[[], Tuple[Callable, tuple]]):
+        self.op = op
+        self.backend = backend  # "int32" | "int8" | "-" (fq-independent)
+        self.bucket = bucket
+        self.tier = tier        # "small" (tier-1) | "slow"
+        self.build = build
+
+    @property
+    def key(self) -> str:
+        return f"{self.op}|{self.backend}|{self.bucket}"
+
+
+def _targets() -> List[Target]:
+    import jax
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.ops import (  # noqa: F401 — lazily used below
+        ec,
+        epoch_device,
+        kzg_device,
+        pairing,
+        sha256_device,
+        tower,
+        verify,
+    )
+
+    S = jax.ShapeDtypeStruct
+    i32 = jnp.int32
+
+    def unwrap(f):
+        # A module-level @jax.jit entry point caches ITS inner trace even
+        # when lowered through a fresh outer closure — an int8 audit could
+        # silently replay the int32 trace.  Lower the wrapped function.
+        return getattr(f, "__wrapped__", f)
+
+    a2 = S((4, 2, 25), i32)
+    a12 = S((4, 2, 3, 2, 25), i32)
+    g1 = tuple(S((4, 25), i32) for _ in range(3))
+    g2 = tuple(S((4, 2, 25), i32) for _ in range(3))
+
+    #: the tower/group-law primitives the old test locked (probe batch of 4)
+    primitives = (
+        ("fq2_mul", lambda: ((lambda a, b: tower.fq2_mul(a, b)), (a2, a2))),
+        ("fq12_mul", lambda: ((lambda a, b: tower.fq12_mul(a, b)), (a12, a12))),
+        ("fq12_square", lambda: ((lambda a: tower.fq12_square(a)), (a12,))),
+        ("g1_point_add",
+         lambda: ((lambda p, q: ec.point_add(ec.G1_OPS, p, q)), (g1, g1))),
+        ("g1_point_double",
+         lambda: ((lambda p: ec.point_double(ec.G1_OPS, p)), (g1,))),
+        ("g2_proj_dbl",
+         lambda: ((lambda t: pairing._proj_dbl(t)), (g2,))),
+        ("g2_proj_add_mixed",
+         lambda: ((lambda t, q: pairing._proj_add_mixed(t, q)),
+                  (g2, (g2[0], g2[1])))),
+    )
+
+    def bls_build(nb: int, kb: int):
+        def build():
+            pk = tuple(S((nb, kb, 25), i32) for _ in range(3))
+            sig = tuple(S((nb, 2, 25), i32) for _ in range(3))
+            msg = tuple(S((nb, 2, 25), i32) for _ in range(2))
+            return (
+                (lambda *a: unwrap(verify._device_verify)(*a)),
+                (pk, sig, msg, S((nb, 64), i32), S((nb,), jnp.bool_)),
+            )
+        return build
+
+    def kzg_build(nb: int):
+        def build():
+            c = tuple(S((nb, 25), i32) for _ in range(3))
+            p = tuple(S((nb, 25), i32) for _ in range(3))
+            tau = tuple(S((2, 25), i32) for _ in range(2))
+            g2g = tuple(S((2, 25), i32) for _ in range(2))
+            return (
+                (lambda *a: unwrap(kzg_device._device_kzg_batch)(*a)),
+                (c, p, S((nb, 256), i32), S((nb, 256), i32),
+                 S((256,), i32), tau, g2g),
+            )
+        return build
+
+    def sha_build(nb: int):
+        def build():
+            return (
+                (lambda w: unwrap(sha256_device._sha256_64byte_batch)(w)),
+                (S((nb, 16), jnp.uint32),),
+            )
+        return build
+
+    def epoch_build(n: int, in_leak: bool):
+        def build():
+            i64 = jnp.int64
+            args = (
+                [S((n,), i64)] * 4 + [S((n,), jnp.bool_)] + [S((n,), i64)] * 2
+                + [S((), i64)] * 7
+            )
+            return (
+                (lambda *a: unwrap(epoch_device._deltas_kernel)(
+                    *a, in_leak=in_leak)),
+                tuple(args),
+            )
+        return build
+
+    out: List[Target] = []
+    for backend in ("int32", "int8"):
+        for name, build in primitives:
+            out.append(Target(name, backend, "probe4", "small", build))
+        out.append(Target("bls_verify", backend, "1x1", "small",
+                          bls_build(1, 1)))
+        out.append(Target("bls_verify", backend, "128x32", "slow",
+                          bls_build(128, 32)))
+        out.append(Target("kzg_batch", backend, "1", "small", kzg_build(1)))
+        out.append(Target("kzg_batch", backend, "128", "slow", kzg_build(128)))
+    out.append(Target("sha256_pairs", "-", "256", "small", sha_build(256)))
+    out.append(Target("sha256_pairs", "-", "4096", "slow", sha_build(4096)))
+    for in_leak in (False, True):
+        op = "epoch_deltas_leak" if in_leak else "epoch_deltas"
+        out.append(Target(op, "-", "64", "small", epoch_build(64, in_leak)))
+        out.append(Target(op, "-", "1024", "slow", epoch_build(1024, in_leak)))
+    return out
+
+
+def _lower_text(target: Target) -> str:
+    import jax
+
+    from lighthouse_tpu.ops import fq
+
+    fn, args = target.build()
+    if target.backend in ("int32", "int8"):
+        prev = fq.set_fq_backend(target.backend)
+    else:
+        prev = fq.set_fq_backend("int32")  # fq-independent: pin for determinism
+    try:
+        if target.op.startswith("epoch_deltas"):
+            from jax.experimental import enable_x64
+
+            with enable_x64():
+                return jax.jit(fn).lower(*args).as_text()
+        return jax.jit(fn).lower(*args).as_text()
+    finally:
+        fq.set_fq_backend(prev)
+
+
+# ----------------------------------------------------------------- baseline
+
+
+def serialize_budgets(budgets: Dict[str, Dict[str, int]]) -> str:
+    """Canonical byte form: sorted keys, 2-space indent, trailing newline —
+    ``--update-baseline`` must round-trip byte-identically."""
+    return json.dumps(budgets, indent=2, sort_keys=True) + "\n"
+
+
+def load_baseline() -> Dict[str, Dict[str, int]]:
+    if not os.path.exists(BASELINE_PATH):
+        return {}
+    with open(BASELINE_PATH, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def write_baseline(budgets: Dict[str, Dict[str, int]]) -> None:
+    with open(BASELINE_PATH, "w", encoding="utf-8") as f:
+        f.write(serialize_budgets(budgets))
+
+
+# -------------------------------------------------------------------- audit
+
+
+def compare(key: str, want: Optional[Dict[str, int]],
+            got: Dict[str, int]) -> List[str]:
+    """Human-readable mismatches for one target (empty == within budget)."""
+    if want is None:
+        return [f"{key}: no committed budget — run --update-baseline and "
+                "review the diff"]
+    out = []
+    for metric in METRICS:
+        w, g = want.get(metric), got.get(metric, 0)
+        if w != g:
+            out.append(f"{key}: {metric} budget {w}, lowered {g}")
+    return out
+
+
+def audit(tier: str = "small", verbose: bool = False,
+          ) -> Tuple[List[str], Dict[str, Dict[str, int]]]:
+    """(mismatches, measured budgets) for every target in ``tier``
+    ("small" = tier-1 set, "all" = small + slow).  Baseline keys that no
+    target declares anymore are mismatches too (a renamed/removed target
+    must not leave an orphan budget reading as audited coverage — the
+    budget-file analog of the sharding pass's registry-stale)."""
+    baseline = load_baseline()
+    mismatches: List[str] = []
+    measured: Dict[str, Dict[str, int]] = {}
+    targets = _targets()
+    declared = {t.key for t in targets}
+    for key in sorted(set(baseline) - declared):
+        mismatches.append(
+            f"{key}: stale budget entry — no such audit target; "
+            "run --update-baseline (it prunes undeclared keys)"
+        )
+    for target in targets:
+        if tier != "all" and target.tier != "small":
+            continue
+        got = count_budget(_lower_text(target))
+        measured[target.key] = got
+        mismatches.extend(compare(target.key, baseline.get(target.key), got))
+        if verbose:
+            print(f"hlo_budget: {target.key}: {got}")
+    return mismatches, measured
+
+
+def self_test() -> List[str]:
+    """The auditor must still be able to SEE (a blind budget check passes
+    everything): count a known program, detect the s8 lock, and detect a
+    seeded budget perturbation."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    errors: List[str] = []
+    f32 = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    txt = jax.jit(lambda a, b: a @ b).lower(f32, f32).as_text()
+    counts = count_budget(txt)
+    if counts["dot_general"] != 1:
+        errors.append(
+            f"self-test: matmul counted {counts['dot_general']} contraction "
+            "dots, expected 1 — the dot counter has gone blind"
+        )
+    i8 = jax.ShapeDtypeStruct((8, 8), jnp.int8)
+    txt8 = jax.jit(
+        lambda a, b: jax.lax.dot(a, b, preferred_element_type=jnp.int32)
+    ).lower(i8, i8).as_text()
+    if count_budget(txt8)["s8_dot"] != 1:
+        errors.append(
+            "self-test: s8 matmul not counted as s8_dot — the s8-operand "
+            "lock has gone blind"
+        )
+    perturbed = dict(counts)
+    perturbed["dot_general"] += 1
+    if not compare("self|test|probe", perturbed, counts):
+        errors.append(
+            "self-test: a seeded budget perturbation was not detected — "
+            "the comparator has gone blind"
+        )
+    return errors
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--tier", choices=("small", "all"), default="small",
+                    help="small = tier-1 buckets; all = + slow buckets")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the audited keys' budgets from the tree")
+    ap.add_argument("--no-self-test", action="store_true")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    errors: List[str] = []
+    if not args.no_self_test:
+        errors.extend(self_test())
+
+    if args.update_baseline:
+        if errors:
+            # A blind counter must never be committed as the new budget.
+            for e in errors:
+                print(f"hlo_budget: FAIL: {e}", file=sys.stderr)
+            print("hlo_budget: refusing to rewrite the baseline with a "
+                  "failing self-test", file=sys.stderr)
+            return 1
+        _, measured = audit(args.tier, args.verbose)
+        budgets = load_baseline()
+        budgets.update(measured)
+        declared = {t.key for t in _targets()}
+        stale = sorted(set(budgets) - declared)
+        for key in stale:
+            del budgets[key]
+        write_baseline(budgets)
+        pruned = f", pruned {len(stale)} stale" if stale else ""
+        print(f"hlo_budget: baseline rewritten for {len(measured)} "
+              f"target(s) (tier={args.tier}{pruned})")
+        return 0
+
+    mismatches, measured = audit(args.tier, args.verbose)
+    for m in mismatches:
+        print(f"hlo_budget: FAIL: {m}", file=sys.stderr)
+    for e in errors:
+        print(f"hlo_budget: FAIL: {e}", file=sys.stderr)
+    if mismatches or errors:
+        print(
+            f"hlo_budget: {len(mismatches)} budget mismatch(es), "
+            f"{len(errors)} self-test failure(s). Deliberate lowering "
+            "changes: --update-baseline and review the diff (ANALYSIS.md).",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"hlo_budget: OK ({len(measured)} (op, bucket) budgets within "
+        f"baseline, tier={args.tier}, self-test "
+        f"{'skipped' if args.no_self_test else 'fired'})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO_ROOT)
+    sys.exit(main())

@@ -1,0 +1,304 @@
+"""Recompile-hazard lint for the device dispatch path.
+
+Every jitted program is compiled per (callable identity, abstract shapes,
+static-arg values).  The throughput work (PERF.md rounds 5–7) depends on
+every hot-path dispatch hitting a CACHED executable: batches route through
+the declared shape buckets (``ops/verify.N_BUCKETS``/``K_BUCKETS`` and
+siblings), so the handful of bucket shapes compile once — 20–165 s each on
+real hardware — and everything after is dispatch.  One call site that feeds
+a raw ``len()`` into a jitted function, or re-wraps ``jax.jit`` around a
+fresh closure per call, silently re-opens that cold-compile latency on
+every batch.  This pass makes those hazards build failures:
+
+- ``dynamic-shape-arg`` — a call to a known-jitted callable passes an
+  argument derived from ``len(...)`` / ``.shape`` without routing through a
+  bucket helper (``_bucket``-style call): each distinct value is a distinct
+  compiled program.  Taint is tracked through local assignments within the
+  enclosing function; a call to any ``*bucket*``-named helper sanitizes.
+- ``fresh-closure-jit`` — ``jax.jit(...)`` invoked inside a function body:
+  jax's trace cache keys on callable identity, so a per-call closure never
+  hits it (and churns the persistent compile-cache keys).  Module-level
+  ``jax.jit`` decorators/assignments execute once and are fine.
+- ``closure-capture`` — a jitted function reads a name that is neither a
+  parameter nor module-level: the captured Python value is burned into the
+  trace as a constant, and every rebuild of the closure (or change of the
+  value) forces a retrace.
+- ``no-bucket-decl`` — an ``ops/`` module defines a jitted entry point but
+  declares no bucket vocabulary (``N_BUCKETS``/``K_BUCKETS`` assignment or
+  a ``*bucket*`` helper): its compiled-program population is unbounded by
+  construction.  Intentionally unbucketed entry points (the epoch kernel
+  compiles once per registry size; the Pallas bench kernels pad to tile
+  multiples) carry a reviewed ``# recompile-hazard: ok(...)`` pragma.
+
+Known limitations (deliberate, documented in ANALYSIS.md): taint is
+per-function (a tainted value passed through a helper parameter is not
+followed — same single-level discipline as the lock-order pass), and
+attribute loads (``built.nb``) are trusted as pre-bucketed.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import Dict, List, Optional, Set, Tuple
+
+from .common import (
+    PragmaIndex,
+    Violation,
+    function_bound_names,
+    is_jit_decorator,
+    iter_py_files,
+    jitted_function_defs,
+    load_batch_axes,
+    local_jit_names,
+    module_bound_names,
+    parse_file,
+    terminal_name,
+)
+
+PASS = "recompile-hazard"
+
+SCAN_DIRS = (
+    "lighthouse_tpu/ops",
+    "lighthouse_tpu/device_pipeline.py",
+    "lighthouse_tpu/device_supervisor.py",
+    "bench.py",
+)
+
+#: Modules here may *call* registry entry points imported from ops/ —
+#: the registry's function names count as known-jitted everywhere.
+_BUILTINS = frozenset(dir(builtins))
+
+#: Module-level names that count as "this module declares its buckets".
+BUCKET_DECL_NAMES = frozenset({"N_BUCKETS", "K_BUCKETS"})
+
+#: Calls that sanitize a raw size: the bucket helpers themselves, and the
+#: batch marshals that bucket internally (ops/verify.build_batch pads to
+#: (nb, kb) before anything reaches the device).
+BUCKETING_CALLS = frozenset({"build_batch", "build_device_batch"})
+
+
+def _contains_bucket_call(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = terminal_name(sub.func)
+            if name and ("bucket" in name.lower() or name in BUCKETING_CALLS):
+                return True
+    return False
+
+
+def _shape_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+    """Does this expression carry a raw dynamic size?  ``len(...)`` calls,
+    ``.shape`` attribute reads, or any Name currently tainted."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and terminal_name(sub.func) == "len":
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+            return True
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) and (
+            sub.id in tainted
+        ):
+            return True
+    return False
+
+
+class _FunctionAuditor(ast.NodeVisitor):
+    """Single-function taint walk: tracks locals tainted by raw sizes and
+    flags jit call sites fed by them, plus fresh ``jax.jit`` wraps."""
+
+    def __init__(self, rel_path: str, ctx: str, pragmas: PragmaIndex,
+                 jit_names: Set[str], violations: List[Violation]):
+        self.rel_path = rel_path
+        self.ctx = ctx
+        self.pragmas = pragmas
+        self.jit_names = jit_names
+        self.violations = violations
+        self.tainted: Set[str] = set()
+
+    def _flag(self, node: ast.AST, code: str, message: str) -> None:
+        if self.pragmas.suppresses(PASS, node):
+            return
+        self.violations.append(
+            Violation(PASS, self.rel_path, node.lineno, code, self.ctx, message)
+        )
+
+    # ---------------------------------------------------------- taint flow
+
+    def _assign_taint(self, targets: List[ast.AST], value: ast.AST) -> None:
+        is_tainted = (
+            not _contains_bucket_call(value)
+            and _shape_tainted(value, self.tainted)
+        )
+        for t in targets:
+            if isinstance(t, ast.Name):
+                if is_tainted:
+                    self.tainted.add(t.id)
+                else:
+                    self.tainted.discard(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                self._assign_taint(list(t.elts), value)
+            # subscript/attribute stores don't taint the base buffer: the
+            # padded-buffer idiom writes live rows into a bucketed array
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        self._assign_taint(list(node.targets), node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self.visit(node.value)
+            self._assign_taint([node.target], node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        if isinstance(node.target, ast.Name) and not _contains_bucket_call(
+            node.value
+        ):
+            if _shape_tainted(node.value, self.tainted):
+                self.tainted.add(node.target.id)
+
+    # ----------------------------------------------------------- jit calls
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn_name = terminal_name(node.func)
+        if fn_name == "jit":
+            self._flag(
+                node, "fresh-closure-jit",
+                "jax.jit(...) inside a function body builds a fresh callable "
+                "per call — the trace cache keys on identity, so this "
+                "retraces (and recompiles) every time; jit at module level",
+            )
+        elif fn_name in self.jit_names and isinstance(
+            node.func, (ast.Name, ast.Attribute)
+        ):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Starred):
+                    arg = arg.value
+                if _contains_bucket_call(arg):
+                    continue
+                if _shape_tainted(arg, self.tainted):
+                    self._flag(
+                        node, "dynamic-shape-arg",
+                        f"jitted `{fn_name}` is fed a raw dynamic size "
+                        "(len()/.shape-derived): every distinct value is a "
+                        "distinct compiled program — route through the shape "
+                        "buckets (`_bucket`)",
+                    )
+                    break
+        self.generic_visit(node)
+
+    # Nested defs are audited in the same walk with the outer taint set
+    # (closures see outer locals) — EXCEPT jit-decorated ones: calls inside
+    # a trace inline, they don't dispatch.
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if any(is_jit_decorator(d) for d in node.decorator_list):
+            return
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _audit_closure_captures(
+    rel_path: str, fn: ast.FunctionDef, module_names: Set[str],
+    pragmas: PragmaIndex, violations: List[Violation],
+) -> None:
+    bound = function_bound_names(fn)
+    flagged: Set[str] = set()
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)):
+            continue
+        name = node.id
+        if (
+            name in bound
+            or name in module_names
+            or name in _BUILTINS
+            or name in flagged
+        ):
+            continue
+        flagged.add(name)
+        if pragmas.suppresses(PASS, node):
+            continue
+        violations.append(
+            Violation(
+                PASS, rel_path, node.lineno, "closure-capture",
+                f"{fn.name}[jit]",
+                f"jitted `{fn.name}` captures `{name}` from an enclosing "
+                "scope: the value is frozen into the trace as a constant, "
+                "and rebuilding the closure forces a full retrace",
+            )
+        )
+
+
+def _module_declares_buckets(tree: ast.Module) -> bool:
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in BUCKET_DECL_NAMES:
+                    return True
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if "bucket" in node.name.lower():
+                return True
+    return False
+
+
+def run(root: str, scan_dirs: Tuple[str, ...] = SCAN_DIRS) -> List[Violation]:
+    registry = load_batch_axes(root) or {}
+    registry_fn_names = {key.rsplit(":", 1)[-1] for key in registry}
+
+    violations: List[Violation] = []
+    for abs_path, rel_path in iter_py_files(root, scan_dirs):
+        tree, _, pragmas = parse_file(abs_path)
+        module_names = module_bound_names(tree)
+        jit_names = local_jit_names(tree) | registry_fn_names
+        jit_defs = jitted_function_defs(tree)
+
+        # no-bucket-decl: modules defining jitted entry points must declare
+        # their bucket vocabulary (or carry a reviewed pragma).
+        if jit_defs and not _module_declares_buckets(tree):
+            for fn in jit_defs:
+                if pragmas.suppresses(PASS, fn):
+                    continue
+                violations.append(
+                    Violation(
+                        PASS, rel_path, fn.lineno, "no-bucket-decl",
+                        f"{fn.name}[jit]",
+                        f"jitted entry `{fn.name}` lives in a module with no "
+                        "declared shape buckets (N_BUCKETS/K_BUCKETS or a "
+                        "bucket helper): its compiled-program population is "
+                        "unbounded — bucket it or pragma with the reason",
+                    )
+                )
+
+        # closure captures inside jitted functions — nested ones included
+        # (a nested jit def closing over the enclosing function's locals is
+        # exactly the per-value trace-constant hazard)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
+                is_jit_decorator(d) for d in node.decorator_list
+            ):
+                _audit_closure_captures(rel_path, node, module_names, pragmas,
+                                        violations)
+
+        # call-site audit, per OUTERMOST function (the auditor descends into
+        # nested defs with the outer taint set — closures see outer locals;
+        # auditing nested defs standalone too would double-report).
+        # Module-level statements execute once — a dynamic shape there
+        # compiles once, not per batch — so they are not audited.
+        outermost: List[ast.AST] = []
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                outermost.append(node)
+            elif isinstance(node, ast.ClassDef):
+                outermost.extend(
+                    n for n in node.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                )
+        for node in outermost:
+            if any(is_jit_decorator(d) for d in node.decorator_list):
+                continue  # inside a trace there is no dispatch to audit
+            auditor = _FunctionAuditor(
+                rel_path, node.name, pragmas, jit_names, violations
+            )
+            for stmt in node.body:
+                auditor.visit(stmt)
+    return violations

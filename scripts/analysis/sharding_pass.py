@@ -1,0 +1,235 @@
+"""Sharding-readiness lint: the batch-axis contract mesh sharding consumes.
+
+ROADMAP item 2 shards the batch axis of the device programs over a
+``jax.sharding.Mesh``.  That is only mechanical if (a) every jitted device
+entry point DECLARES its batch axis in ``ops/batch_axes.py`` — the registry
+the future ``PartitionSpec`` builder reads — and (b) nothing inside a
+declared entry point destroys the batch axis before XLA sees it.  This pass
+gates both, turning the prerequisite from folklore into a build failure:
+
+- ``unregistered-entry``  — a jitted module-level function in ``ops/`` has
+  no ``ops/batch_axes.py`` entry (``"<path>:<name>"`` key): the sharding
+  layer would not know how to partition it;
+- ``registry-stale``      — a registry key names a function that no longer
+  exists as a jitted def at that path (the registry must not rot);
+- ``batch-axis-fold``     — ``reshape(-1, ...)`` / ``ravel`` / ``flatten``
+  inside a REGISTERED entry body folds the leading (batch) axis into data
+  axes — a sharded lowering would gather the whole batch onto every device;
+- ``batch-axis-transpose``— ``transpose``/``swapaxes``/``moveaxis`` inside
+  a registered entry body: the entry seam must not permute the batch axis
+  (limb-axis permutations belong in the ec/tower/pairing helpers, outside
+  the seam);
+- ``unsharded-device-put``— ``jax.device_put(x)`` without a
+  ``device=``/``sharding=`` placement anywhere in the scan dirs: an
+  unplaced transfer pins the array to device 0 and silently serializes a
+  future mesh.
+- ``registry-missing``    — ``ops/batch_axes.py`` is absent or its
+  ``BATCH_AXES`` literal fails to parse (the pass must fail loudly, not go
+  blind).
+
+Fixture self-tests declare their own ``BATCH_AXES`` literal in the fixture
+file — the pass merges registry literals found in scanned files, so seeded
+violations exercise the registered-entry checks without touching the real
+registry.  Suppress intentional sites with ``# sharding-ready: ok(<...>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .common import (
+    BATCH_AXES_PATH,
+    PragmaIndex,
+    ScopedVisitor,
+    Violation,
+    extract_batch_axes,
+    iter_py_files,
+    jitted_function_defs,
+    load_batch_axes,
+    parse_file,
+    terminal_name,
+)
+
+PASS = "sharding-ready"
+
+SCAN_DIRS = (
+    "lighthouse_tpu/ops",
+    "lighthouse_tpu/device_pipeline.py",
+    "bench.py",
+)
+
+#: Calls that fold or permute axes inside an entry body.
+FOLD_CALLS = frozenset({"ravel", "flatten"})
+PERMUTE_CALLS = frozenset({"transpose", "swapaxes", "moveaxis"})
+
+
+def _is_minus_one(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.UnaryOp)
+        and isinstance(node.op, ast.USub)
+        and isinstance(node.operand, ast.Constant)
+        and node.operand.value == 1
+    )
+
+
+def _reshape_folds_leading(call: ast.Call) -> bool:
+    """``x.reshape(-1, ...)`` / ``jnp.reshape(x, (-1, ...))`` — the leading
+    axis is merged with whatever follows."""
+    args = list(call.args)
+    if not args:
+        return False
+    # method form: first arg is the first shape element; function form:
+    # (array, shape) — look inside a tuple/list second arg too.
+    first = args[0]
+    if _is_minus_one(first):
+        return True
+    for candidate in args[:2]:
+        if isinstance(candidate, (ast.Tuple, ast.List)) and candidate.elts:
+            if _is_minus_one(candidate.elts[0]):
+                return True
+    return False
+
+
+class _EntryChecker(ast.NodeVisitor):
+    def __init__(self, rel_path: str, fn_name: str, pragmas: PragmaIndex,
+                 violations: List[Violation]):
+        self.rel_path = rel_path
+        self.ctx = f"{fn_name}[jit]"
+        self.pragmas = pragmas
+        self.violations = violations
+
+    def _flag(self, node: ast.AST, code: str, message: str) -> None:
+        if self.pragmas.suppresses(PASS, node):
+            return
+        self.violations.append(
+            Violation(PASS, self.rel_path, node.lineno, code, self.ctx, message)
+        )
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = terminal_name(node.func)
+        if name == "reshape" and _reshape_folds_leading(node):
+            self._flag(
+                node, "batch-axis-fold",
+                "reshape(-1, ...) inside a registered device entry folds the "
+                "batch axis into data axes — a sharded lowering would "
+                "all-gather the batch; keep the batch axis leading",
+            )
+        elif name in FOLD_CALLS:
+            self._flag(
+                node, "batch-axis-fold",
+                f"`{name}()` inside a registered device entry collapses all "
+                "axes, batch included — keep the batch axis leading",
+            )
+        elif name in PERMUTE_CALLS:
+            self._flag(
+                node, "batch-axis-transpose",
+                f"`{name}` inside a registered device entry may move the "
+                "batch axis off position 0 (the declared contract); permute "
+                "limb axes in the field helpers, not at the entry seam",
+            )
+        self.generic_visit(node)
+
+
+class _DevicePutChecker(ScopedVisitor):
+    def __init__(self, rel_path: str, pragmas: PragmaIndex,
+                 violations: List[Violation]):
+        super().__init__()
+        self.rel_path = rel_path
+        self.pragmas = pragmas
+        self.violations = violations
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if terminal_name(node.func) == "device_put":
+            kw_names = {k.arg for k in node.keywords}
+            if (
+                len(node.args) < 2
+                and not kw_names & {"device", "sharding", "dst"}
+                and not self.pragmas.suppresses(PASS, node)
+            ):
+                self.violations.append(
+                    Violation(
+                        PASS, self.rel_path, node.lineno,
+                        "unsharded-device-put", self.context,
+                        "device_put without a device/sharding placement pins "
+                        "the array to device 0 — pass the mesh sharding (or "
+                        "pragma `# sharding-ready: ok(<reason>)`)",
+                    )
+                )
+        self.generic_visit(node)
+
+
+def _check_device_put(tree: ast.Module, rel_path: str, pragmas: PragmaIndex,
+                      violations: List[Violation]) -> None:
+    _DevicePutChecker(rel_path, pragmas, violations).visit(tree)
+
+
+def run(root: str, scan_dirs: Tuple[str, ...] = SCAN_DIRS) -> List[Violation]:
+    violations: List[Violation] = []
+    registry = load_batch_axes(root)
+    scanning_real_tree = any(d.startswith("lighthouse_tpu") for d in scan_dirs)
+    if registry is None and scanning_real_tree:
+        violations.append(
+            Violation(
+                PASS, BATCH_AXES_PATH, 1, "registry-missing", "<module>",
+                "ops/batch_axes.py is missing or its BATCH_AXES literal "
+                "does not parse — the sharding contract is gone",
+            )
+        )
+        registry = {}
+    registry = dict(registry or {})
+
+    # First sweep: parse everything, merge fixture-local registries, and
+    # remember the jitted defs per file.
+    parsed: List[Tuple[str, ast.Module, PragmaIndex]] = []
+    jit_defs_by_path: Dict[str, List[ast.FunctionDef]] = {}
+    for abs_path, rel_path in iter_py_files(root, scan_dirs):
+        if rel_path == BATCH_AXES_PATH:
+            continue
+        tree, _, pragmas = parse_file(abs_path)
+        local_registry = extract_batch_axes(tree)
+        if local_registry:
+            registry.update(local_registry)
+        parsed.append((rel_path, tree, pragmas))
+        jit_defs_by_path[rel_path] = jitted_function_defs(tree)
+
+    registered_keys: Set[str] = set(registry)
+    seen_keys: Set[str] = set()
+
+    for rel_path, tree, pragmas in parsed:
+        for fn in jit_defs_by_path[rel_path]:
+            key = f"{rel_path}:{fn.name}"
+            seen_keys.add(key)
+            if key not in registered_keys:
+                if not pragmas.suppresses(PASS, fn):
+                    violations.append(
+                        Violation(
+                            PASS, rel_path, fn.lineno, "unregistered-entry",
+                            f"{fn.name}[jit]",
+                            f"jitted device entry `{fn.name}` has no "
+                            "ops/batch_axes.py declaration — the mesh "
+                            "sharding layer cannot partition it; declare "
+                            "its batch axis (or pragma with the reason)",
+                        )
+                    )
+                continue
+            checker = _EntryChecker(rel_path, fn.name, pragmas, violations)
+            for stmt in fn.body:
+                checker.visit(stmt)
+        _check_device_put(tree, rel_path, pragmas, violations)
+
+    # Stale registry keys: only meaningful for paths the scan covered (a
+    # fixtures-only self-test must not see the real registry as "stale").
+    scanned_paths = {p for p, _, _ in parsed}
+    for key in sorted(set(registry) - seen_keys):
+        path = key.rsplit(":", 1)[0]
+        if path in scanned_paths:
+            violations.append(
+                Violation(
+                    PASS, BATCH_AXES_PATH, 1, "registry-stale", "<module>",
+                    f"registry entry `{key}` names no jitted function at "
+                    "that path — update ops/batch_axes.py",
+                )
+            )
+    return violations

@@ -1,16 +1,28 @@
 #!/usr/bin/env python
-"""Consensus-safety static analysis runner (ISSUE 3 tentpole).
+"""Static analysis runner: consensus safety + device performance.
 
-Aggregates the three AST passes in ``scripts/analysis/``:
+Aggregates the six AST passes in ``scripts/analysis/``:
 
-- safe-arith     — raw arithmetic on spec-typed quantities in consensus/
-- lock-order     — lock-acquisition-order cycles + blocking calls under locks
-- device-purity  — host side effects / unguarded x64 inside jit/Pallas code
+- safe-arith        — raw arithmetic on spec-typed quantities in consensus/
+- lock-order        — lock-acquisition-order cycles + blocking calls under locks
+- device-purity     — host side effects / unguarded x64 inside jit/Pallas code
+- recompile-hazard  — jit dispatches fed raw sizes, fresh-closure jits,
+  trace-constant closure captures, unbucketed entry modules
+- host-sync         — device-value materialization off the sanctioned sync
+  points (supervisor worker / pipeline executor / bench harness)
+- sharding-ready    — the ops/batch_axes.py batch-axis contract mesh
+  sharding consumes (registry completeness, batch-axis-preserving entries,
+  placed device_puts)
+
+(The StableHLO budget auditor ``scripts/analysis/hlo_budget.py`` is the
+sibling runner for lowering-level locks — it needs jax, so it runs from the
+test suite, not here.)
 
 Exit 0 when the tree is clean (modulo the committed baseline) AND every
 pass still fires on its seeded-violation fixture; exit 1 otherwise.  Pure
 AST analysis: nothing under ``lighthouse_tpu/`` is imported, so this runs
-in milliseconds and needs no JAX/device environment.
+in milliseconds and needs no JAX/device environment —
+``tests/test_repo_lints.py`` asserts both properties.
 
 Usage:
     python scripts/check_static.py                 # self-test + tree scan
@@ -36,13 +48,27 @@ from typing import List
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
 
-from analysis import device_purity_pass, lock_order_pass, safe_arith_pass  # noqa: E402
+from analysis import (  # noqa: E402
+    device_purity_pass,
+    host_sync_pass,
+    lock_order_pass,
+    recompile_hazard_pass,
+    safe_arith_pass,
+    sharding_pass,
+)
 from analysis.common import Violation, iter_py_files  # noqa: E402
 
 BASELINE_PATH = os.path.join(REPO_ROOT, "scripts", "analysis", "baseline.txt")
 FIXTURES = ("scripts/analysis/fixtures",)
 
-PASSES = (safe_arith_pass, lock_order_pass, device_purity_pass)
+PASSES = (
+    safe_arith_pass,
+    lock_order_pass,
+    device_purity_pass,
+    recompile_hazard_pass,
+    host_sync_pass,
+    sharding_pass,
+)
 
 #: codes each pass MUST produce on its fixture (proves the lint fires) and
 #: strings that must NOT appear (proves pragma suppression works).
@@ -65,6 +91,39 @@ SELF_TEST = {
             "unguarded-x64": 1,
         },
         "must_not_flag_context": set(),
+    },
+    "recompile-hazard": {
+        "must_fire": {
+            "dynamic-shape-arg": 3,
+            "fresh-closure-jit": 1,
+            "closure-capture": 1,
+            "no-bucket-decl": 1,
+        },
+        "must_not_flag_context": {
+            "bucketed_dispatch_is_fine",
+            "suppressed_fresh_jit",
+            "suppressed_raw_shape_entry",
+        },
+    },
+    "host-sync": {
+        "must_fire": {"hot-path-sync": 6},
+        "must_not_flag_context": {
+            "host_marshalling_is_fine",
+            "suppressed_sync",
+        },
+    },
+    "sharding-ready": {
+        "must_fire": {
+            "unregistered-entry": 1,
+            "registry-stale": 1,
+            "batch-axis-fold": 2,
+            "batch-axis-transpose": 1,
+            "unsharded-device-put": 1,
+        },
+        "must_not_flag_context": {
+            "registered_clean_entry",
+            "placed_transfer",
+        },
     },
 }
 
@@ -186,7 +245,7 @@ def main() -> int:
         )
         return 1
     print(
-        f"check_static: OK (3 passes, {len(violations)} finding(s) "
+        f"check_static: OK ({len(PASSES)} passes, {len(violations)} finding(s) "
         f"all baselined/pragma'd, self-test "
         f"{'skipped' if args.no_self_test else 'fired'})"
     )

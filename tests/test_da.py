@@ -216,6 +216,42 @@ def test_device_kzg_batch_matches_host(setup):
     assert not dev.verify_blob_kzg_proof_batch(blobs, comms, bad)
 
 
+def test_device_kzg_batch_is_supervised(setup):
+    """ISSUE 10 host-sync fix: the kzg device leg runs under the device
+    supervisor — a faulted dispatch resolves through the host golden model
+    (correct verdicts, one fallback counter), and a tripped breaker routes
+    subsequent batches straight to the host."""
+    from lighthouse_tpu import device_supervisor as ds
+    from lighthouse_tpu import fault_injection as fi
+
+    fi.reset_for_tests()
+    ds.reset_for_tests()
+    try:
+        dev = Kzg(setup, device=True)
+        blobs = [_blob(i) for i in range(2)]
+        comms = [dev.blob_to_kzg_commitment(b) for b in blobs]
+        proofs = [dev.compute_blob_kzg_proof(b, c) for b, c in zip(blobs, comms)]
+
+        fi.install("device.dispatch", "error", op="kzg_batch")
+        # valid and tampered batches both decide CORRECTLY on the host path
+        assert dev.verify_blob_kzg_proof_batch(blobs, comms, proofs)
+        bad = [proofs[1], proofs[0]]
+        assert not dev.verify_blob_kzg_proof_batch(blobs, comms, bad)
+        # third failure trips the breaker (default threshold 3) — batches
+        # now route to host without touching the device
+        assert dev.verify_blob_kzg_proof_batch(blobs, comms, proofs)
+        assert ds.breaker_state("kzg_batch") == "open"
+        assert dev.verify_blob_kzg_proof_batch(blobs, comms, proofs)
+        # device recovers once faults clear and the cooldown elapses
+        fi.reset_for_tests()
+        ds.SUPERVISOR.breaker("kzg_batch")._opened_at = 0.0
+        assert dev.verify_blob_kzg_proof_batch(blobs, comms, proofs)
+        assert ds.breaker_state("kzg_batch") in ("half_open", "closed")
+    finally:
+        fi.reset_for_tests()
+        ds.reset_for_tests()
+
+
 def test_range_sync_fetches_blobs(setup):
     """A fresh node range-syncing a chain that CONTAINS blob blocks pulls
     sidecars over BlobsByRoot and imports with availability intact

@@ -1,100 +1,101 @@
-"""HLO lowering audit for the hot-path kernels (CI guard, CPU-jax).
+"""StableHLO budget gate (CI guard, CPU-jax).
 
-Locks in the contraction structure the MXU work depends on, so a refactor
-cannot silently rematerialize a convolution or de-widen the fused group-law
-rounds:
+The ad-hoc dot-count lock this file used to carry is promoted to
+``scripts/analysis/hlo_budget.py`` (ISSUE 10): committed per-(op, backend,
+bucket) budgets — contraction dots, the s8-operand lock, convert/transpose
+counts, and collective-op counts so sharded lowerings are auditable from
+day one — with an ``--update-baseline`` churn workflow.  Tier-1 gates the
+small buckets (tower/group-law primitives at the probe shape, the full
+bls_verify/kzg_batch entry points at their smallest buckets, sha256/epoch
+kernels); the full bucket set runs behind the ``slow`` marker.
 
-- every tower multiply is ONE fq_mul pipeline = 2 dot_generals (conv +
-  reduction), regardless of tower level;
-- the widened schedules fuse each round of independent products:
-  point_add 2 pipelines (4 dots), point_double / _proj_dbl 3 (6 dots),
-  _proj_add_mixed 4 (8 dots);
-- under the int8 backend every pipeline's convolution dot carries s8
-  operands (the MXU's native integer path).
-
-Counts are taken on the LOWERED StableHLO (trace only — no XLA compile, so
-the whole audit costs seconds); one compiled-HLO canary keeps the
-"XLA does not rematerialize" claim honest.  All targets are jitted through
-fresh closures: jax's trace cache keys on callable identity, and a direct
-``jax.jit(module_fn)`` could replay a trace made under the other backend.
+One compiled-HLO canary stays here: budgets count the LOWERED StableHLO
+(trace only), and the canary keeps the "XLA does not rematerialize the
+pipeline" claim honest at the optimized-HLO level.
 """
 
+import os
 import re
+import sys
 
-import numpy as np
 import jax
-import jax.numpy as jnp
 import pytest
 
-from lighthouse_tpu.ops import ec, fq, pairing, tower
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
 
-A2 = jnp.asarray(np.ones((4, 2, 25), np.int32))
-A12 = jnp.asarray(np.ones((4, 2, 3, 2, 25), np.int32))
-G1 = tuple(jnp.asarray(np.stack([c] * 4)) for c in ec.G1_GEN_LIMBS)
-G2 = tuple(jnp.asarray(np.stack([c] * 4)) for c in ec.G2_GEN_LIMBS)
+from analysis import hlo_budget  # noqa: E402
 
-#: (name, fresh-closure factory, args, expected dot_general count)
-TARGETS = (
-    ("fq2_mul", lambda: (lambda a, b: tower.fq2_mul(a, b)), (A2, A2), 2),
-    ("fq12_mul", lambda: (lambda a, b: tower.fq12_mul(a, b)), (A12, A12), 2),
-    ("fq12_square", lambda: (lambda a: tower.fq12_square(a)), (A12,), 2),
-    ("g1_point_add", lambda: (lambda p, q: ec.point_add(ec.G1_OPS, p, q)),
-     (G1, G1), 4),
-    ("g1_point_double", lambda: (lambda p: ec.point_double(ec.G1_OPS, p)),
-     (G1,), 6),
-    ("g2_proj_dbl", lambda: (lambda t: pairing._proj_dbl(t)), (G2,), 6),
-    ("g2_proj_add_mixed", lambda: (lambda t, q: pairing._proj_add_mixed(t, q)),
-     (G2, (G2[0], G2[1])), 8),
-)
+from lighthouse_tpu.ops import fq, tower  # noqa: E402
 
 
-def _lowered_text(factory, args, backend):
-    prev = fq.set_fq_backend(backend)
-    try:
-        return jax.jit(factory()).lower(*args).as_text()
-    finally:
-        fq.set_fq_backend(prev)
+def test_auditor_self_test_fires():
+    """The budget auditor must prove it can still see: count a known
+    program, detect the s8 lock, detect a seeded budget perturbation."""
+    assert hlo_budget.self_test() == []
 
 
-def _dot_lines(txt):
-    """Contraction dot_generals in lowered StableHLO.  The int32 einsum
-    lowers its elementwise outer product as a degenerate dot_general with
-    ``contracting_dims = [] x []`` that XLA fuses into a multiply — only
-    dots that actually contract count."""
-    return [
-        l for l in txt.splitlines()
-        if "dot_general" in l and "contracting_dims = [] x []" not in l
-    ]
+def test_small_tier_budgets_within_baseline():
+    mismatches, measured = hlo_budget.audit("small")
+    assert measured, "hlo_budget audited no targets — the gate has gone blind"
+    assert not mismatches, "\n".join(mismatches)
+    # The committed baseline must cover every small-tier target (no silent
+    # audit shrinkage) and lock s8 operands on every int8-backend program.
+    baseline = hlo_budget.load_baseline()
+    for key, counts in measured.items():
+        assert key in baseline, f"missing committed budget for {key}"
+        if "|int8|" in key:
+            assert counts["s8_dot"] > 0, (
+                f"{key}: int8 backend lowered with no s8-operand dots — "
+                "the MXU path lost its s8 lock"
+            )
+        elif "|int32|" in key:
+            # baseline-independent: the int32 backend must never pick up
+            # s8 operands (an --update-baseline cannot silence this)
+            assert counts["s8_dot"] == 0, (
+                f"{key}: int32 backend lowered with s8-operand dots"
+            )
+        assert counts["collective"] == 0, (
+            f"{key}: unsharded lowering contains collective ops"
+        )
 
 
-@pytest.mark.parametrize("name,factory,args,want", TARGETS,
-                         ids=[t[0] for t in TARGETS])
-def test_dot_count_int32(name, factory, args, want):
-    assert len(_dot_lines(_lowered_text(factory, args, "int32"))) == want
+@pytest.mark.slow
+def test_full_tier_budgets_within_baseline():
+    mismatches, measured = hlo_budget.audit("all")
+    assert measured
+    assert not mismatches, "\n".join(mismatches)
 
 
-@pytest.mark.parametrize("name,factory,args,want", TARGETS,
-                         ids=[t[0] for t in TARGETS])
-def test_dot_count_and_s8_operands_int8(name, factory, args, want):
-    lines = _dot_lines(_lowered_text(factory, args, "int8"))
-    assert len(lines) == want
-    # Every pipeline = one s8-operand conv dot + one s32 reduction dot.
-    s8 = [l for l in lines if l.count("xi8>") >= 2]
-    assert len(s8) == want // 2, f"{name}: conv dots lost their s8 operands"
+def test_baseline_roundtrips_byte_identically():
+    """--update-baseline must be churn-free: serializing the loaded
+    baseline reproduces the committed bytes exactly."""
+    with open(hlo_budget.BASELINE_PATH, "rb") as f:
+        raw = f.read()
+    assert hlo_budget.serialize_budgets(hlo_budget.load_baseline()).encode() == raw
 
 
-def test_int32_dots_carry_no_s8_operands():
-    lines = _dot_lines(_lowered_text(*TARGETS[0][1:3], backend="int32"))
-    assert all(l.count("xi8>") < 2 for l in lines)
+def test_seeded_budget_mismatch_is_detected():
+    got = {"dot_general": 2, "s8_dot": 0, "convert": 3, "transpose": 0,
+           "collective": 0}
+    want = dict(got, dot_general=4)
+    assert hlo_budget.compare("op|int32|probe", want, got)
+    assert hlo_budget.compare("op|int32|probe", None, got)  # missing budget
+    assert not hlo_budget.compare("op|int32|probe", dict(got), got)
 
 
 def test_compiled_hlo_does_not_rematerialize_fq2_mul():
     """Compiled-HLO canary: XLA keeps the fq2_mul pipeline at exactly 2
     dots (optimization could in principle duplicate the contraction; the
-    lowered-text counts above would not see that)."""
+    lowered-text budgets would not see that)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    a2 = jnp.asarray(np.ones((4, 2, 25), np.int32))
     prev = fq.set_fq_backend("int32")
     try:
-        txt = jax.jit(lambda a, b: tower.fq2_mul(a, b)).lower(A2, A2).compile().as_text()
+        txt = jax.jit(lambda a, b: tower.fq2_mul(a, b)).lower(
+            a2, a2).compile().as_text()
     finally:
         fq.set_fq_backend(prev)
     dots = len(re.findall(r"\bdot\(", txt)) + len(re.findall(r"\bdot-general\b", txt))
