@@ -31,6 +31,7 @@ from ..consensus.per_block import BlockProcessingError, BlockSignatureStrategy
 from ..consensus.per_slot import process_slots
 from ..consensus.state_transition import state_transition
 from ..fork_choice import ExecutionStatus, ForkChoice, InvalidAttestation
+from ..op_pool import attester_slashing_indices
 from ..store import HotColdDB, MemoryStore
 from ..types.spec import ChainSpec
 from .events import EventBus
@@ -785,6 +786,14 @@ class BeaconChain:
                 )
             except InvalidAttestation:
                 continue  # attestations for unknown forks don't block import
+        # Block-included slashings convict equivocators: mask their
+        # fork-choice weight even when the slashing never crossed our gossip
+        # path (reference import_block -> on_attester_slashing per included
+        # slashing).  state.validators[i].slashed already flipped in the
+        # state transition above.
+        for slashing in getattr(block.body, "attester_slashings", ()):
+            self.fork_choice.on_attester_slashing(
+                attester_slashing_indices(slashing))
         self.validator_monitor.on_block_imported(
             int(block.slot), int(block.proposer_index)
         )
@@ -1345,13 +1354,22 @@ class BeaconChain:
         from ..consensus import signature_sets as sets
         from ..consensus.per_block import process_attester_slashing
 
+        def insert():
+            self.op_pool.insert_attester_slashing(slashing)
+            # A verified slashing is proof of equivocation: strip the
+            # offenders' fork-choice weight NOW, without waiting for block
+            # inclusion (reference beacon_chain.rs
+            # verify_attester_slashing_for_gossip -> fc.on_attester_slashing).
+            self.fork_choice.on_attester_slashing(
+                attester_slashing_indices(slashing))
+
         return self._on_gossip_op(
             "attester_slashing", slashing, slashing.hash_tree_root(),
             lambda st: sets.attester_slashing_signature_sets(
                 st, slashing, self.spec),
             lambda st: process_attester_slashing(
                 st, slashing, self.types, self.spec, False),
-            lambda: self.op_pool.insert_attester_slashing(slashing),
+            insert,
             "attester slashing",
         )
 

@@ -948,7 +948,7 @@ def pool_attester_slashings_post(ctx):
 
 @route("GET", "/eth/v1/beacon/pool/attester_slashings")
 def pool_attester_slashings_get(ctx):
-    return {"data": [to_json(s) for s in ctx.chain.op_pool._attester_slashings]}
+    return {"data": [to_json(s) for s in ctx.chain.op_pool.attester_slashings()]}
 
 
 @route("POST", "/eth/v2/beacon/pool/attester_slashings", P0)
@@ -976,7 +976,7 @@ def pool_attester_slashings_get_v2(ctx):
     chain = ctx.chain
     version = chain.spec.fork_name_at_slot(chain.current_slot())
     return {"version": version,
-            "data": [to_json(s) for s in chain.op_pool._attester_slashings]}
+            "data": [to_json(s) for s in chain.op_pool.attester_slashings()]}
 
 
 @route("POST", "/eth/v1/beacon/pool/bls_to_execution_changes", P0)

@@ -88,6 +88,17 @@ class Counter(_Metric):
         with self._lock:
             self._series[_labels_key(labels)] = float(value)
 
+    def snapshot(self) -> Dict[Tuple, float]:
+        """All series values right now — pair with :meth:`delta` so a check
+        inside one run of a long-lived process asserts on THAT run's
+        increments, not on the process-cumulative totals."""
+        with self._lock:
+            return dict(self._series)
+
+    def delta(self, baseline: Dict[Tuple, float], **labels) -> float:
+        """This label set's increment since ``baseline`` (a snapshot())."""
+        return self.get(**labels) - baseline.get(_labels_key(labels), 0.0)
+
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
@@ -446,6 +457,15 @@ SYNC_LOOKUP_ABORTED = counter(
 BACKFILL_BATCH_RETRIES = counter(
     "backfill_batch_retries_total",
     "backfill batches retried against a different peer, by outcome",
+)
+
+# Slasher pipeline (slasher/__init__.py drained by network/router.py): every
+# slashing the local slasher produced, by kind and what happened to it —
+# pooled+gossiped, or stale (its validator was already slashed / the op
+# failed chain validation).  The byzantine scenarios' detection evidence.
+SLASHER_SLASHINGS = counter(
+    "slasher_slashings_total",
+    "slashings drained from the local slasher, by kind and outcome",
 )
 
 # Additional block import stages (reference metrics.rs:40-161 has ~15).

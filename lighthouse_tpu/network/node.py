@@ -30,6 +30,7 @@ class LocalNode:
         max_workers: int = 2,
         bls_backend: Optional[str] = None,
         enable_slasher: bool = False,
+        slasher_config=None,
         endpoint=None,
         subscribe_all_subnets: bool = True,
     ):
@@ -61,7 +62,8 @@ class LocalNode:
 
             self.slasher = Slasher(
                 chain.types,
-                SlasherConfig(slots_per_epoch=chain.spec.slots_per_epoch),
+                slasher_config
+                or SlasherConfig(slots_per_epoch=chain.spec.slots_per_epoch),
             )
         self.router = Router(
             chain=chain, service=self.service, processor=self.processor,

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+from .. import metrics
 from ..chain.beacon_chain import AttestationError, BlockError, ChainError
 from ..consensus import helpers as h
 from ..scheduler import BeaconProcessor, ReprocessQueue, W, WorkEvent
@@ -108,7 +109,7 @@ class Router:
         try:
             kind = topics_mod.GossipTopic.parse(topic).kind
         except ValueError:
-            self.service.peer_manager.report(sender, PeerAction.LOW_TOLERANCE, "bad topic")
+            self.service.reject_gossip(sender, topic, "bad_topic")
             return
         if kind == topics_mod.BEACON_BLOCK:
             self.processor.send(
@@ -221,18 +222,16 @@ class Router:
                     # the slot window is normal propagation lag, not peer
                     # misbehavior — penalizing it would bleed honest peers
                     if "outside the current-slot window" not in err:
-                        self.service.peer_manager.report(
-                            sender, PeerAction.LOW_TOLERANCE,
-                            f"bad sync contribution: {err}")
+                        self.service.reject_gossip(
+                            sender, topic, "invalid_op", detail=err)
                     return
                 fresh = True
         except ChainError as e:
-            self.service.peer_manager.report(
-                sender, PeerAction.LOW_TOLERANCE, f"bad {kind}: {e}")
+            self.service.reject_gossip(
+                sender, topic, "invalid_op", detail=str(e))
             return
         except Exception:
-            self.service.peer_manager.report(
-                sender, PeerAction.LOW_TOLERANCE, f"undecodable {kind}")
+            self.service.reject_gossip(sender, topic, "undecodable")
             return
         if fresh:
             self.service.forward(topic, compressed, exclude=sender,
@@ -247,7 +246,7 @@ class Router:
         try:
             signed = decode_signed_block(chain, uncompressed)
         except Exception:
-            self.service.peer_manager.report(sender, PeerAction.LOW_TOLERANCE, "undecodable block")
+            self.service.reject_gossip(sender, topic, "undecodable")
             return
         # Proposer dedup/equivocation gate before any state work; the cache
         # is only POPULATED after successful import (observe-after-verify),
@@ -264,9 +263,7 @@ class Router:
             if self.slasher is not None:
                 self.slasher.on_block(signed)
                 self._drain_slasher()
-            self.service.peer_manager.report(
-                sender, PeerAction.LOW_TOLERANCE, "proposer equivocation"
-            )
+            self.service.reject_gossip(sender, topic, "proposer_equivocation")
             return
         try:
             chain.process_block(signed)
@@ -282,7 +279,8 @@ class Router:
                 # for reprocessing and only propagates validated blocks).
                 self.sync.on_unknown_parent(signed, sender)
                 return
-            self.service.peer_manager.report(sender, PeerAction.LOW_TOLERANCE, f"bad block: {e}")
+            self.service.reject_gossip(
+                sender, topic, "invalid_block", detail=str(e))
             return
         chain.observed.block_producers.observe(
             int(signed.message.slot), int(signed.message.proposer_index), block_root
@@ -339,16 +337,14 @@ class Router:
         try:
             sidecar = chain.types.BlobSidecar.from_ssz_bytes(uncompressed)
         except Exception:
-            self.service.peer_manager.report(
-                sender, PeerAction.LOW_TOLERANCE, "undecodable blob sidecar"
-            )
+            self.service.reject_gossip(sender, topic, "undecodable")
             return
         try:
             block_root = chain.da_checker.put_blob(sidecar)
         except BlobError as e:
-            self.service.peer_manager.report(
-                sender, PeerAction.MID_TOLERANCE, f"bad blob sidecar: {e}"
-            )
+            self.service.reject_gossip(
+                sender, topic, "invalid_blob",
+                action=PeerAction.MID_TOLERANCE, detail=str(e))
             return
         self.service.forward(topic, compressed, exclude=sender,
                              uncompressed=uncompressed)
@@ -382,9 +378,7 @@ class Router:
                 else:
                     attestation = chain.types.Attestation.from_ssz_bytes(uncompressed)
             except Exception:
-                self.service.peer_manager.report(
-                    sender, PeerAction.LOW_TOLERANCE, "undecodable attestation"
-                )
+                self.service.reject_gossip(sender, topic, "undecodable")
                 continue
             # Observed-cache dedup BEFORE any signature work (the gossip
             # replay/DoS defense; observed_attesters.rs semantics).
@@ -418,10 +412,8 @@ class Router:
                     # left to sync's single-block lookup, unpenalized.
                     root = bytes(attestation.data.beacon_block_root)
                     if chain.is_pre_finalization_block(root):
-                        self.service.peer_manager.report(
-                            sender, PeerAction.LOW_TOLERANCE,
-                            "attestation to pre-finalization block",
-                        )
+                        self.service.reject_gossip(
+                            sender, topic, "pre_finalization_attestation")
                     elif self.sync is not None:
                         # genuinely unknown: park the raw item until the
                         # root imports (park BEFORE the lookup spawns, or
@@ -442,10 +434,11 @@ class Router:
                         else:
                             self.sync.lookup_block_async(root, sender)
                     continue
-                self.service.peer_manager.report(
-                    sender, PeerAction.MID_TOLERANCE, f"bad attestation: {e}"
-                )
+                self.service.reject_gossip(
+                    sender, topic, "invalid_attestation",
+                    action=PeerAction.MID_TOLERANCE, detail=str(e))
                 continue
+            slasher_only = False
             if not is_aggregate:
                 vidx = (
                     int(inner.indexed.attesting_indices[0])
@@ -455,8 +448,17 @@ class Router:
                 if vidx is not None and chain.observed.attesters.is_known(
                     target_epoch, vidx
                 ):
-                    continue  # validator already attested this epoch
-            candidates.append((cand, sig_sets, is_aggregate, topic, compressed, sender))
+                    # Validator already attested this epoch: IGNORE for fork
+                    # choice/forwarding — but a second message for the same
+                    # epoch is exactly what a double/surround voter emits, so
+                    # the slasher still gets it once the signature verifies
+                    # (reference handle_attestation_verification_failure:
+                    # PriorAttestationKnown still feeds the slasher).
+                    if self.slasher is None:
+                        continue
+                    slasher_only = True
+            candidates.append((cand, sig_sets, is_aggregate, topic, compressed,
+                               sender, slasher_only))
         if not candidates:
             return
 
@@ -473,32 +475,75 @@ class Router:
             batch_ok = bls.verify_signature_sets(
                 [s for c in candidates for s in c[1]]
             )
-        for cand, sig_sets, is_aggregate, topic, compressed, sender in candidates:
+        for (cand, sig_sets, is_aggregate, topic, compressed, sender,
+             slasher_only) in candidates:
             ok = batch_ok or bls.verify_signature_sets(sig_sets)
             if not ok:
-                self.service.peer_manager.report(
-                    sender, PeerAction.MID_TOLERANCE, "bad attestation signature"
-                )
+                self.service.reject_gossip(
+                    sender, topic, "bad_signature",
+                    action=PeerAction.MID_TOLERANCE)
                 continue
-            if is_aggregate:
-                chain.apply_verified_aggregate(cand)
-                indexed = cand.inner.indexed
-            else:
-                chain.apply_attestation(cand)
-                indexed = cand.indexed
+            indexed = cand.inner.indexed if is_aggregate else cand.indexed
+            # The slasher eats on SIGNATURE verification, before the
+            # fork-choice apply (reference: slashing evidence needs a valid
+            # signature, not a successful import) — an equivocating vote
+            # whose apply fails (e.g. its target was pruned from our view)
+            # is still evidence.
             if self.slasher is not None:
                 self.slasher.on_attestation(indexed)
                 self._drain_slasher()
+            if slasher_only:
+                # verified duplicate: slashing evidence only — no fork-choice
+                # weight, no forward (the epoch's first message already won)
+                continue
+            try:
+                if is_aggregate:
+                    chain.apply_verified_aggregate(cand)
+                else:
+                    chain.apply_attestation(cand)
+            except Exception as e:
+                # One bad item (e.g. fork choice's validate_on_attestation
+                # rejecting a crafted target) must never kill the rest of
+                # the drained batch — the byzantine soak caught exactly
+                # this: a half-bad batch silently dropped every later
+                # candidate, slasher evidence included.  IGNORE, don't
+                # penalize: a candidate that preverified and then fails
+                # apply is usually a view-lag race (our fork choice pruned
+                # the target between the two), and scoring honest relayers
+                # for it bleeds the mesh.
+                self.service.reject_gossip(
+                    sender, topic, "apply_failed", detail=str(e),
+                    penalize=False)
+                continue
             self.service.forward(topic, compressed, exclude=sender)
 
     def _drain_slasher(self) -> None:
-        """Slashings found by the slasher go straight to the op pool for
-        inclusion in our next proposal (reference slasher_service)."""
+        """Slashings found by the slasher enter the op pool for our next
+        proposal AND gossip out on the slashing topics (reference
+        slasher_service: slashings are broadcast so ANY proposer can include
+        them, not just us).  Both ride the chain's gossip-op path — dedup,
+        signature verification, trial application, fork-choice equivocation
+        mask — so a stale finding (validator already slashed) dies here
+        instead of poisoning blocks."""
         attester, proposer = self.slasher.drain_slashings()
-        for s in attester:
-            self.chain.op_pool.insert_attester_slashing(s)
-        for s in proposer:
-            self.chain.op_pool.insert_proposer_slashing(s)
+        for kind, ops, verify in (
+            (topics_mod.ATTESTER_SLASHING, attester,
+             self.chain.on_gossip_attester_slashing),
+            (topics_mod.PROPOSER_SLASHING, proposer,
+             self.chain.on_gossip_proposer_slashing),
+        ):
+            for s in ops:
+                try:
+                    fresh = verify(s)
+                except ChainError:
+                    metrics.SLASHER_SLASHINGS.inc(kind=kind, outcome="stale")
+                    continue
+                if not fresh:
+                    metrics.SLASHER_SLASHINGS.inc(kind=kind, outcome="known")
+                    continue
+                metrics.SLASHER_SLASHINGS.inc(kind=kind, outcome="pooled")
+                topic = topics_mod.GossipTopic(self.fork_digest, kind)
+                self.service.publish(str(topic), s.as_ssz_bytes())
 
     # --------------------------------------------------------------- rpc
 

@@ -24,9 +24,19 @@ import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
+from .. import metrics
 from . import rpc as rpc_mod
 from .peer_manager import PeerManager
 from .transport import Endpoint, Envelope
+
+#: Every gossip validation REJECT, by topic kind and reason — the router's
+#: rejection paths all funnel through ``NetworkService.reject_gossip`` so a
+#: lying peer's junk is simultaneously counted here and scored into the
+#: graylist/ban ladder (reference: gossipsub REJECT -> peer penalty).
+GOSSIP_REJECTED = metrics.counter(
+    "gossip_rejected_total",
+    "gossip messages rejected at validation, by topic kind and reason",
+)
 
 MESSAGE_DOMAIN_VALID_SNAPPY = b"\x01\x00\x00\x00"
 SEEN_CACHE_SIZE = 16384
@@ -573,6 +583,38 @@ class NetworkService:
                 cur -= removals
                 cur |= additions
 
+    @staticmethod
+    def _topic_kind_label(topic: str) -> str:
+        """Bounded-cardinality topic label: the topic KIND with subnet
+        indices collapsed (64 attestation subnets are one label)."""
+        try:
+            kind = topic.split("/")[3]
+        except IndexError:
+            return "unknown"
+        for prefix in ("beacon_attestation_", "sync_committee_",
+                       "blob_sidecar_"):
+            if kind.startswith(prefix) and kind[len(prefix):].isdigit():
+                return prefix.rstrip("_")
+        return kind or "unknown"
+
+    def reject_gossip(self, sender: str, topic: str, reason: str,
+                      action: Optional[str] = None, detail: str = "",
+                      penalize: bool = True) -> None:
+        """One funnel for every gossip validation REJECT: count it
+        (``gossip_rejected_total{topic,reason}``) and report the sender into
+        the scoring/graylist ladder.  ``reason`` is a bounded slug (the
+        metric label); ``detail`` is the free-form part of the peer-manager
+        report only.  ``penalize=False`` counts without scoring — for
+        IGNORE-grade drops (view-lag races) that must stay visible but must
+        never bleed honest peers."""
+        from .peer_manager import PeerAction
+
+        GOSSIP_REJECTED.inc(topic=self._topic_kind_label(topic), reason=reason)
+        if penalize:
+            self.peer_manager.report(
+                sender, action or PeerAction.LOW_TOLERANCE,
+                f"{reason}: {detail}" if detail else reason)
+
     def _graylisted(self, peer: str) -> bool:
         return self.peer_manager.score(peer) < GRAYLIST_THRESHOLD
 
@@ -581,14 +623,13 @@ class NetworkService:
 
     def _on_gossip(self, env: Envelope) -> None:
         from . import snappy_codec
-        from .peer_manager import PeerAction
 
         if env.topic not in self.subscriptions or self._graylisted(env.sender):
             return
         try:
             uncompressed = snappy_codec.decompress(env.data)
         except snappy_codec.SnappyError:
-            self.peer_manager.report(env.sender, PeerAction.LOW_TOLERANCE, "bad snappy")
+            self.reject_gossip(env.sender, env.topic, "bad_snappy")
             return
         mid = message_id(uncompressed)
         with self._seen_lock:

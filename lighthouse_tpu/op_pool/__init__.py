@@ -17,6 +17,16 @@ from ..consensus import helpers as h
 from ..types.spec import TIMELY_TARGET_FLAG_INDEX, ChainSpec
 
 
+def attester_slashing_indices(slashing) -> List[int]:
+    """Validators an attester slashing convicts: the intersection of the two
+    attestations' index sets (spec ``process_attester_slashing``) — the ONE
+    implementation every consumer (packing, pruning, fork-choice masking,
+    adversary evidence) shares."""
+    a1 = {int(i) for i in slashing.attestation_1.attesting_indices}
+    a2 = {int(i) for i in slashing.attestation_2.attesting_indices}
+    return sorted(a1 & a2)
+
+
 def max_cover(candidates: Sequence[Tuple[object, Set[int]]], limit: int) -> List[object]:
     """Greedy maximum-coverage: repeatedly take the candidate covering the
     most yet-uncovered items (reference ``max_cover.rs`` — same greedy
@@ -69,7 +79,9 @@ class OperationPool:
     def __init__(self) -> None:
         self._attestations: Dict[Tuple[int, bytes], _AttestationGroup] = {}
         self._proposer_slashings: Dict[int, object] = {}  # by proposer index
-        self._attester_slashings: List[object] = []
+        # keyed by hash_tree_root: the local slasher and the gossip topic can
+        # both deliver the same container — the pool must not grow on replays
+        self._attester_slashings: Dict[bytes, object] = {}
         self._voluntary_exits: Dict[int, object] = {}  # by validator index
         self._bls_changes: Dict[int, object] = {}  # by validator index
 
@@ -179,14 +191,40 @@ class OperationPool:
         self._proposer_slashings[int(slashing.signed_header_1.message.proposer_index)] = slashing
 
     def insert_attester_slashing(self, slashing) -> None:
-        self._attester_slashings.append(slashing)
+        self._attester_slashings.setdefault(slashing.hash_tree_root(), slashing)
+
+    def attester_slashings(self) -> List[object]:
+        """Pool contents in canonical (container-root) order.  Iterations
+        snapshot via ``.copy()`` (GIL-atomic): the pool is lock-free and a
+        worker may insert concurrently — the old list tolerated appends
+        mid-iteration, the dict must too."""
+        return [s for _root, s in sorted(self._attester_slashings.copy().items())]
+
+    def num_proposer_slashings(self) -> int:
+        return len(self._proposer_slashings)
+
+    def has_proposer_slashing(self, proposer_index: int) -> bool:
+        return int(proposer_index) in self._proposer_slashings
+
+    def num_attester_slashings(self) -> int:
+        return len(self._attester_slashings)
 
     def get_slashings(self, state, spec: ChainSpec, types) -> Tuple[List, List]:
         """(proposer_slashings, attester_slashings) valid against ``state``,
-        bounded by the preset maxima."""
+        bounded by the preset maxima.
+
+        Packing order is canonical (proposer slashings by proposer index,
+        attester slashings by container root), never arrival order: two
+        nodes holding the same pool — or one node across two runs — must
+        pack identical bodies (the scenario soak's determinism gate), and a
+        slashing flood past the per-block cap must overflow into later
+        blocks deterministically.  Slashings whose validators are all
+        already slashed in ``state`` are dead block space and are skipped
+        (``is_slashable_validator`` excludes slashed validators)."""
         epoch = h.get_current_epoch(state, spec)
         proposer = []
-        for idx, s in self._proposer_slashings.items():
+        for idx in sorted(self._proposer_slashings):
+            s = self._proposer_slashings[idx]
             if idx < len(state.validators) and h.is_slashable_validator(
                 state.validators[idx], epoch
             ):
@@ -201,7 +239,7 @@ class OperationPool:
             if is_electra_state
             else spec.preset.max_attester_slashings
         )
-        for s in self._attester_slashings:
+        for _root, s in sorted(self._attester_slashings.copy().items()):
             # container families don't cross the electra boundary (EIP-7549
             # changed IndexedAttestation's limits)
             if ("Electra" in type(s).__name__) != is_electra_state:
@@ -212,11 +250,9 @@ class OperationPool:
                 s.attestation_1.data, s.attestation_2.data
             ):
                 continue
-            att1 = set(int(i) for i in s.attestation_1.attesting_indices)
-            att2 = set(int(i) for i in s.attestation_2.attesting_indices)
             slashable = {
                 i
-                for i in att1 & att2
+                for i in attester_slashing_indices(s)
                 if i < len(state.validators)
                 and h.is_slashable_validator(state.validators[i], epoch)
             }
@@ -292,4 +328,17 @@ class OperationPool:
             i: s
             for i, s in self._proposer_slashings.items()
             if i < n and h.is_slashable_validator(state.validators[i], epoch)
+        }
+        # Attester slashings with no still-slashable intersection validator
+        # are dead weight forever — every offender is already slashed (or
+        # withdrawn); drop them so a slashing flood cannot pin pool memory.
+        def _still_slashable(s) -> bool:
+            return any(
+                i < n and h.is_slashable_validator(state.validators[i], epoch)
+                for i in attester_slashing_indices(s)
+            )
+
+        self._attester_slashings = {
+            root: s for root, s in self._attester_slashings.copy().items()
+            if _still_slashable(s)
         }

@@ -5,7 +5,8 @@ the reference's ``basic-sim``/``fallback-sim`` happy path; this module is
 the reference's fault matrix grown past it): a declarative
 :class:`Scenario` spec — seed, node/validator counts, a timeline of
 :class:`Event`\\ s (partition/heal, kill/restart, checkpoint-sync join
-under lossy links, spam/slow peers, device fault plans) — executed by
+under lossy links, spam/slow peers, device fault plans, byzantine actor
+strategies via ``adversary.py``) — executed by
 :class:`ScenarioRunner` on top of the :class:`~.network.transport.Hub`
 fault fabric and the ``fault_injection`` registry, with **convergence
 gates** at the end: every live node must agree on one head and finality
@@ -107,6 +108,11 @@ class Scenario:
     warmup_slots: int = 8
     fault_slots: int = 8
     recovery_slots: int = 24
+    #: run a per-node slasher (required by byzantine scenarios — the
+    #: detect→slash pipeline must be live on every node).  Off by default:
+    #: the per-attestation detection work adds real per-slot CPU that the
+    #: purely-lossy scenarios don't need.
+    slasher: bool = False
     events: Tuple[Event, ...] = ()
     #: optional callable(runner) -> dict of extra evidence; raises
     #: AssertionError to fail the scenario (kept out of the artifact spec)
@@ -120,6 +126,7 @@ class Scenario:
             "warmup_slots": self.warmup_slots,
             "fault_slots": self.fault_slots,
             "recovery_slots": self.recovery_slots,
+            "slasher": self.slasher,
             "events": [e.to_dict() for e in self.events],
         }
 
@@ -141,11 +148,18 @@ class ScenarioRunner:
     PUMP_SLEEP_S = 0.02
     SYNC_DEADLINE_S = 60.0
     CONVERGE_DEADLINE_S = 30.0
+    #: per-step quiescence budget.  Settle returns False on timeout and the
+    #: slot proceeds un-quiesced — silent nondeterminism.  The busiest slots
+    #: (a byzantine burst: votes + slashing gossip + packing) can exceed
+    #: Simulator.settle's 10 s default on a cold first run, so the runner's
+    #: own steps get triple the room; a quiet fabric still exits instantly.
+    SETTLE_TIMEOUT_S = 30.0
 
     def __init__(self, scenario: Scenario, out_dir: Optional[str] = None):
         self.scenario = scenario
         self.out_dir = out_dir or os.environ.get("LIGHTHOUSE_TPU_SOAK_DIR", ".")
         self.sim: Optional[Simulator] = None
+        self.byz = None  # ByzantineController, created by the first byz event
         self.ctx: Dict[str, Any] = {}  # cross-event state for extra checks
         self.timeline: List[dict] = []
         self._saved_hash_impl = None
@@ -212,16 +226,35 @@ class ScenarioRunner:
         every live node, drain one fabric tick, then ``Simulator.settle``
         until the fabric is quiescent — each slot's gossip lands before
         the next slot proposes, keeping block content deterministic (but
-        no convergence assert: fault windows diverge by design)."""
+        no convergence assert: fault windows diverge by design).
+
+        The byzantine controller (adversary.py) hooks in three places:
+        BEFORE duties (forged-content strategies need the slot's honest
+        block to not exist yet), INTO duties (suppressing validators whose
+        honest messages a strategy replaces), and AFTER duties settle
+        (equivocations ride on top of the honest message); its per-slot
+        evidence probe runs at the end of every step, recovery included."""
         sim = self.sim
+        settle = lambda: sim.settle(timeout=self.SETTLE_TIMEOUT_S)  # noqa: E731
         slot = None
         for n in sim.live_nodes:
             slot = n.advance_slot()
+        if self.byz is not None:
+            self.byz.pre_duties(slot)
+            settle()
         for n in sim.live_nodes:
-            n.run_duties(slot)
-            sim.settle()  # per-node: see Simulator.run_slot
+            n.run_duties(
+                slot,
+                skip_validators=(self.byz.suppressed_for(n)
+                                 if self.byz is not None else None))
+            settle()  # per-node: see Simulator.run_slot
+        if self.byz is not None:
+            self.byz.act(slot)
+            settle()
         sim.hub.advance_tick()
-        sim.settle()
+        settle()
+        if self.byz is not None:
+            self.byz.observe_slot(slot)
         heads = {n.chain.head_root for n in sim.live_nodes}
         max_final = max(
             n.chain.finalized_checkpoint()[0] for n in sim.live_nodes)
@@ -390,6 +423,20 @@ class ScenarioRunner:
             restarted = self.sim.restart_node(churn_kill)
             self._pump_node_to_head(restarted, donor)
 
+    def _ev_byzantine(self, strategy: str, node: int, validators=None,
+                      max_offenses: int = 1, **kwargs) -> None:
+        """Arm a byzantine misbehavior strategy (adversary.py) on a subset
+        of ``node``'s validators.  Every decision the controller takes is
+        keyed on sha256(seed | strategy | slot | validator), so the 2-run
+        determinism gate covers the adversary."""
+        from .adversary import ByzantineController
+
+        if self.byz is None:
+            self.byz = ByzantineController(self.sim, seed=self.scenario.seed)
+            self.ctx["byz"] = self.byz
+        self.byz.arm(strategy, node, validators=validators,
+                     max_offenses=max_offenses, **kwargs)
+
     def _ev_spam(self, target: int = 0, count: int = 64) -> None:
         """An ephemeral hub peer floods the target with undecodable gossip
         on a real subscribed topic — the peer-scoring path must absorb and
@@ -422,10 +469,15 @@ class ScenarioRunner:
         # fault-window evidence, captured before recovery clears the plans
         breakers: Optional[dict] = None
         fault_plans: Optional[list] = None
+        # byzantine scenarios run a slasher on every node (the detect→slash
+        # pipeline under test) — which is also an implicit honest-traffic
+        # gate: a false-positive slashing would flip validators[i].slashed
+        # and fail the finality gate
         self.sim = Simulator(
             node_count=scenario.node_count,
             validator_count=scenario.validator_count,
             seed=scenario.seed,
+            enable_slasher=scenario.slasher,
         )
         self.sim.hub.record_schedule()
         artifact: dict = {"scenario": scenario.to_dict(), "passed": False}
@@ -447,10 +499,14 @@ class ScenarioRunner:
             fault_plans = fault_injection.plans()
 
             # implicit recovery: every fabric fault heals, injected faults
-            # clear; churned nodes must have been restarted by the timeline
+            # clear; churned nodes must have been restarted by the timeline;
+            # byzantine actors stop offending (their evidence probe keeps
+            # running so detection latency spans into recovery)
             self.sim.hub.clear_partitions()
             self.sim.hub.clear_link_plans()
             fault_injection.clear()
+            if self.byz is not None:
+                self.byz.deactivate()
             for _ in range(scenario.recovery_slots):
                 self._step_slot()
 
@@ -499,6 +555,11 @@ class ScenarioRunner:
                 if breakers is None:  # failed before the window-end snapshot
                     breakers = self._breaker_summary()
                     fault_plans = fault_injection.plans()
+                if self.byz is not None:
+                    # adversarial coverage is a tracked artifact: offenses
+                    # emitted/detected/included + detection latency ride in
+                    # every byzantine SOAK JSON alongside the fabric evidence
+                    artifact["adversary"] = self.byz.summary()
                 artifact.update({
                     "net": {
                         "counters": self.sim.hub.fault_counters(),
@@ -580,6 +641,8 @@ class ScenarioRunner:
             from . import device_supervisor
 
             device_supervisor.reset_for_tests()
+        if self.byz is not None:
+            self.byz.cleanup()
         if self.sim is not None:
             for spammer in self._spam_endpoints:
                 self.sim.hub.unregister(spammer)
@@ -731,6 +794,132 @@ def spam_slow_peer(seed: int = 0) -> Scenario:
     )
 
 
+# ------------------------------------------------------- byzantine actors
+
+
+def byz_double_vote_smoke(seed: int = 0) -> Scenario:
+    """Tier-1 byzantine smoke: ONE double-voting validator, the complete
+    slashing pipeline asserted — offense → slasher detection → gossiped
+    slashing → op-pool pack → block inclusion → ``slashed`` flag → zeroed
+    fork-choice weight — while the honest majority still finalizes.
+    Warmup of 7 aligns the fault window on an epoch boundary, so the armed
+    validator's one duty slot per epoch is guaranteed inside the window."""
+    return Scenario(
+        name="byz_double_vote_smoke",
+        description="single double-voting validator, slashing pipeline gate",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=7, fault_slots=8, recovery_slots=24,
+        slasher=True,
+        events=(
+            Event(0, "byzantine",
+                  {"strategy": "double_vote", "node": 1, "validators": [1]}),
+        ),
+        extra_checks=_check_slashing_pipeline,
+    )
+
+
+def byz_minority_equivocation(seed: int = 0) -> Scenario:
+    """Minority equivocation under partition: while one node is partitioned
+    off, a byzantine proposer on node 1 double-proposes — the honest block
+    to everyone, a conflicting block to half the mesh.  The observed-
+    producer cache flags the equivocation, the slasher builds the
+    ProposerSlashing, and the pipeline gate asserts conviction while the
+    partitioned node still reorgs back and the fleet finalizes."""
+    return Scenario(
+        name="byz_minority_equivocation",
+        description="double-proposing validator during a partition",
+        seed=seed, node_count=4, validator_count=16,
+        warmup_slots=8, fault_slots=16, recovery_slots=24,
+        slasher=True,
+        events=(
+            Event(0, "partition", {"groups": [[0, 1, 2], [3]]}),
+            Event(0, "byzantine",
+                  {"strategy": "double_propose", "node": 1,
+                   "max_offenses": 2}),
+            Event(12, "heal"),
+        ),
+        extra_checks=_check_slashing_pipeline,
+    )
+
+
+def byz_surround_nonfinality(seed: int = 0) -> Scenario:
+    """Surround voter during a non-finality spell: >1/3 of validators go
+    offline (finality stalls), and a byzantine validator on node 0 seeds an
+    honest vote in one epoch then signs a surrounding (source-1, target+1)
+    vote the next.  Detection, gossip, and inclusion all happen while
+    finality is stalled; the gate then proves conviction and that finality
+    resumed past the window after the nodes return."""
+    return Scenario(
+        name="byz_surround_nonfinality",
+        description="surround vote emitted during a non-finality spell",
+        seed=seed, node_count=5, validator_count=20,
+        warmup_slots=32, fault_slots=24, recovery_slots=24,
+        slasher=True,
+        events=(
+            Event(0, "kill", {"node": 3}),
+            Event(0, "kill", {"node": 4}),
+            Event(0, "byzantine",
+                  {"strategy": "surround_vote", "node": 0,
+                   "validators": [0]}),
+            Event(16, "restart", {"node": 3}),
+            Event(16, "restart", {"node": 4}),
+        ),
+        extra_checks=_check_surround_pipeline,
+    )
+
+
+def byz_invalid_block_spam(seed: int = 0) -> Scenario:
+    """Invalid-block spammer vs peer scoring: forged blocks that are
+    perfectly decodable but consensus-invalid (bad state root, wrong
+    proposer, future slot, unknown parent) plus malformed gossip
+    (truncated SSZ, broken snappy) flood one node.  Every REJECT path must
+    count (``gossip_rejected_total``), score, and graylist the forger —
+    with zero effect on honest convergence or finality."""
+    return Scenario(
+        name="byz_invalid_block_spam",
+        description="forged invalid blocks + malformed gossip vs scoring",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=8, fault_slots=8, recovery_slots=16,
+        slasher=True,
+        events=(
+            # the three deterministic REJECT modes; unknown_parent (also
+            # implemented) triggers the sync parent-chase, whose wall-clock
+            # retry cadence is not determinism-gate material
+            Event(0, "byzantine",
+                  {"strategy": "invalid_block", "node": 1, "target": 0,
+                   "modes": ["bad_state_root", "wrong_proposer",
+                             "future_slot"],
+                   "count": 3, "max_offenses": 4}),
+            Event(1, "byzantine",
+                  {"strategy": "malformed_gossip", "node": 1, "target": 0,
+                   "count": 8, "max_offenses": 4}),
+        ),
+        extra_checks=_check_forgers_penalized,
+    )
+
+
+def byz_slashing_flood(seed: int = 0) -> Scenario:
+    """Slashing flood at the op-pool cap: three validators double-vote in
+    one window, producing more attester slashings than one block may carry
+    (``max_attester_slashings``).  The pool must pack deterministically
+    under the cap, spread conviction over several blocks, slash all three,
+    and then prune itself empty (dead slashings are dropped)."""
+    return Scenario(
+        name="byz_slashing_flood",
+        description="more slashings than one block can carry",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=7, fault_slots=16, recovery_slots=24,
+        slasher=True,
+        events=(
+            Event(0, "byzantine",
+                  {"strategy": "double_vote", "node": 1,
+                   "validators": [1, 4, 7], "max_offenses": 3,
+                   "burst": True}),
+        ),
+        extra_checks=_check_slashing_flood,
+    )
+
+
 # ------------------------------------------------------------ extra checks
 
 
@@ -796,6 +985,93 @@ def _check_spammer_penalized(runner: ScenarioRunner) -> dict:
     return {"spammer_score": score}
 
 
+def _check_slashing_pipeline(runner: ScenarioRunner) -> dict:
+    """The end-to-end byzantine gate — see adversary.slashing_pipeline_gate."""
+    from .adversary import slashing_pipeline_gate
+
+    return slashing_pipeline_gate(runner)
+
+
+def _check_surround_pipeline(runner: ScenarioRunner) -> dict:
+    """Pipeline gate + the spell really stalled finality (the shared
+    ``_check_stall`` assertion) and the conviction really was a surround."""
+    gate = _check_slashing_pipeline(runner)
+    kinds = {e["strategy"] for e in gate["slashing_pipeline"]}
+    assert "surround_vote" in kinds, f"no surround conviction (got {kinds})"
+    gate.update(_check_stall(runner))
+    return gate
+
+
+def _check_forgers_penalized(runner: ScenarioRunner) -> dict:
+    """Every forger identity scored below the graylist; the REJECT reasons
+    all counted; the honest mesh converged regardless (standard gates)."""
+    from .network import service as service_mod
+
+    byz = runner.ctx.get("byz")
+    assert byz is not None and byz.forger_ids, "no forger ever attacked"
+    assert any(o.strategy == "invalid_block" for o in byz.offenses), (
+        "no invalid blocks were emitted")
+    assert any(o.strategy == "malformed_gossip" for o in byz.offenses), (
+        "no malformed gossip was emitted")
+    victim = runner._node(0)
+    pm = victim.node.service.peer_manager
+    forgers = {}
+    for forger in byz.forger_ids:
+        info = pm.peers.get(forger)
+        assert info is not None, f"forger {forger} was never scored"
+        forgers[forger] = round(info.score, 1)
+        assert info.score < service_mod.GRAYLIST_THRESHOLD, (
+            f"forger {forger} not graylisted (score {info.score})")
+    # deltas against the controller's creation-time snapshot: the counter is
+    # process-cumulative and must not satisfy a later run vacuously
+    rejected = {
+        "invalid_block": service_mod.GOSSIP_REJECTED.delta(
+            byz.rejected_baseline, topic="beacon_block",
+            reason="invalid_block"),
+        "undecodable": service_mod.GOSSIP_REJECTED.delta(
+            byz.rejected_baseline, topic="beacon_block",
+            reason="undecodable"),
+        "bad_snappy": service_mod.GOSSIP_REJECTED.delta(
+            byz.rejected_baseline, topic="attester_slashing",
+            reason="bad_snappy"),
+    }
+    for reason, count in rejected.items():
+        assert count >= 1, f"gossip_rejected_total never counted {reason}"
+    return {"forger_scores": forgers, "gossip_rejected": rejected}
+
+
+def _check_slashing_flood(runner: ScenarioRunner) -> dict:
+    """Pipeline gate for all three offenders + flood-specific evidence: no
+    block exceeded max_attester_slashings, conviction took >1 block, and
+    every pool pruned itself empty once the offenders were slashed."""
+    gate = _check_slashing_pipeline(runner)
+    assert len(gate["slashing_pipeline"]) == 3, (
+        f"expected 3 convictions, got {len(gate['slashing_pipeline'])}")
+    from .adversary import iter_canonical_blocks
+
+    node = runner._node(0)
+    chain, spec = node.chain, node.harness.spec
+    cap = spec.preset.max_attester_slashings
+    blocks_with, total = 0, 0
+    for block in iter_canonical_blocks(chain):
+        n = len(block.message.body.attester_slashings)
+        assert n <= cap, f"block packed {n} slashings (cap {cap})"
+        if n:
+            blocks_with += 1
+            total += n
+    assert blocks_with >= 2, (
+        "3 slashings against a cap of 2 must spread over >1 block")
+    for n_ in runner.sim.live_nodes:
+        left = n_.chain.op_pool.num_attester_slashings()
+        assert left == 0, (
+            f"{n_.peer_id}: {left} dead slashings still pooled after "
+            "conviction (prune failed)")
+    gate.update({"blocks_with_slashings": blocks_with,
+                 "included_slashings_total": total,
+                 "per_block_cap": cap})
+    return gate
+
+
 #: name -> factory(seed); the full matrix in documentation order
 SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     "smoke_partition": smoke_partition,
@@ -805,6 +1081,11 @@ SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     "device_breaker_mid_sync": device_breaker_mid_sync,
     "pipeline_mid_sync": pipeline_mid_sync,
     "spam_slow_peer": spam_slow_peer,
+    "byz_double_vote_smoke": byz_double_vote_smoke,
+    "byz_minority_equivocation": byz_minority_equivocation,
+    "byz_surround_nonfinality": byz_surround_nonfinality,
+    "byz_invalid_block_spam": byz_invalid_block_spam,
+    "byz_slashing_flood": byz_slashing_flood,
 }
 
 

@@ -18,10 +18,29 @@ from .network.node import LocalNode
 from .network.transport import Hub
 
 
+#: Slasher history window for simulator nodes — scenarios span a handful of
+#: epochs; the production default (4096) would cost ~12 MB of dense arrays
+#: per node for nothing.
+SIM_SLASHER_HISTORY = 512
+
+
+def _sim_slasher_kwargs(spec) -> dict:
+    from .slasher import SlasherConfig
+
+    return {
+        "enable_slasher": True,
+        "slasher_config": SlasherConfig(
+            history_length=SIM_SLASHER_HISTORY,
+            slots_per_epoch=spec.slots_per_epoch,
+        ),
+    }
+
+
 class SimNode:
     def __init__(self, *, index: int, hub: Optional[Hub], validator_count: int,
                  keys: List[int], genesis_time: int, spec=None,
-                 endpoint=None, chain=None, peer_id: Optional[str] = None):
+                 endpoint=None, chain=None, peer_id: Optional[str] = None,
+                 enable_slasher: bool = False):
         self.index = index
         if chain is not None:
             # Chain-only node (checkpoint-sync join): no duty keys, no
@@ -39,20 +58,25 @@ class SimNode:
         self.node = LocalNode(
             hub=hub, peer_id=peer_id or f"sim{index}",
             chain=self._chain, harness=self.harness, endpoint=endpoint,
+            **(_sim_slasher_kwargs(self._chain.spec) if enable_slasher else {}),
         )
 
     @classmethod
     def resurrect(cls, old: "SimNode", *, hub: Hub) -> "SimNode":
         """A restarted node: same chain, same keys, same peer id, fresh
-        network stack (the store survived the crash; the socket did not)."""
+        network stack (the store survived the crash; the socket did not —
+        and an in-memory slasher restarts empty, like the process did)."""
         fresh = cls.__new__(cls)
         fresh.index = old.index
         fresh.harness = old.harness
         fresh._chain = old.chain
         fresh.keys = old.keys
         fresh.alive = True
-        fresh.node = LocalNode(hub=hub, peer_id=old.peer_id,
-                               chain=old.chain, harness=old.harness)
+        fresh.node = LocalNode(
+            hub=hub, peer_id=old.peer_id, chain=old.chain, harness=old.harness,
+            **(_sim_slasher_kwargs(old.chain.spec)
+               if old.node.slasher is not None else {}),
+        )
         return fresh
 
     @property
@@ -72,17 +96,31 @@ class SimNode:
         clock.set_slot((clock.now() or 0) + 1)
         return self.chain.current_slot()
 
-    def run_duties(self, slot: int) -> Dict[str, int]:
+    def run_duties(self, slot: int,
+                   skip_validators: Optional[set] = None) -> Dict[str, int]:
         """One slot of duties for OUR validators: propose if ours, attest
-        with our committee members (published over gossip)."""
+        with our committee members (published over gossip).
+        ``skip_validators``: indices whose honest duties are suppressed this
+        slot — the byzantine controller's seam for replacing a validator's
+        honest message with a crafted one (adversary.py).  Suppression
+        covers the PROPOSAL duty too, deliberately: a suppressed validator's
+        proposer slot goes empty (slightly weakening the honest baseline for
+        a few slots) rather than interleaving an extra block whose packing
+        races the controller's crafted traffic — determinism outranks the
+        marginal baseline fidelity here."""
         out = {"proposed": 0, "attested": 0}
         if self.harness is None or not self.keys:
             return out
+        skip = skip_validators or set()
         harness, chain = self.harness, self.chain
         spec = harness.spec
         state, parent_root = chain.state_at_slot(slot)
         proposer = h.get_beacon_proposer_index(state, spec)
-        if proposer in self.keys:
+        # a slashed validator is still SELECTED as proposer but its block
+        # would fail process_block_header everywhere — the slot goes empty,
+        # exactly as it would on mainnet
+        if (proposer in self.keys and proposer not in skip
+                and not state.validators[proposer].slashed):
             signed = harness.produce_signed_block(slot=slot)
             chain.process_block(signed)
             self.node.publish_block(signed)
@@ -94,7 +132,7 @@ class SimNode:
             committee = h.get_beacon_committee(state, slot, index, spec)
             data = chain.produce_attestation_data(slot, index)
             for pos, vidx in enumerate(committee):
-                if int(vidx) not in self.keys:
+                if int(vidx) not in self.keys or int(vidx) in skip:
                     continue
                 bits = [False] * len(committee)
                 bits[pos] = True
@@ -134,12 +172,13 @@ class Simulator:
     def __init__(self, *, node_count: int = 3, validator_count: int = 16,
                  genesis_time: int = 1_600_000_000, spec=None,
                  transport: str = "hub", discovery: Optional[str] = None,
-                 seed: int = 0):
+                 seed: int = 0, enable_slasher: bool = False):
         if transport not in ("hub", "tcp_secured"):
             raise ValueError(f"unknown transport {transport!r}")
         tcp = transport == "tcp_secured"
         self.genesis_time = genesis_time
         self.validator_count = validator_count
+        self.enable_slasher = enable_slasher
         self.nodes: List[SimNode] = []
         self.boot_discv5 = None
         self.hub = None if tcp else Hub(seed=seed)
@@ -157,7 +196,7 @@ class Simulator:
                 self.nodes.append(SimNode(
                     index=i, hub=self.hub, validator_count=validator_count,
                     keys=shares[i], genesis_time=genesis_time, spec=spec,
-                    endpoint=endpoint,
+                    endpoint=endpoint, enable_slasher=enable_slasher,
                 ))
             # topology wiring
             if not tcp:
@@ -345,6 +384,7 @@ class Simulator:
             index=index, hub=self.hub, validator_count=self.validator_count,
             keys=[], genesis_time=self.genesis_time, chain=chain,
             peer_id=peer_id or f"sim{index}",
+            enable_slasher=self.enable_slasher,
         )
         self.nodes.append(joined)
         for other in self.live_nodes:

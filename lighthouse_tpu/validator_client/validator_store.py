@@ -135,6 +135,32 @@ class ValidatorStore:
         )
         return self._raw_sign(pubkey, signing_root)
 
+    # ------------------------------------------------- unsafe signing seam
+    #
+    # The ONLY way around the EIP-3076 veto.  Exists for the byzantine
+    # actor layer (adversary.py): scenario adversaries must be able to
+    # produce genuinely slashable messages while the honest sign_block /
+    # sign_attestation path keeps its protection intact (and asserted —
+    # the controller first proves the honest path refuses, then signs
+    # here).  Nothing in the production duty path may ever call these;
+    # neither checks NOR records in the slashing DB, so an adversary's
+    # slashable signature cannot poison the honest history either.
+
+    def sign_block_unsafe(self, pubkey: bytes, block) -> bytes:
+        """UNSAFE: proposer signature with the slashing-protection veto
+        bypassed.  Byzantine test seam only — see the section comment."""
+        epoch = int(block.slot) // self.spec.slots_per_epoch
+        domain = self._domain(DOMAIN_BEACON_PROPOSER, epoch)
+        signing_root = h.compute_signing_root(block.hash_tree_root(), domain)
+        return self._raw_sign(pubkey, signing_root)
+
+    def sign_attestation_unsafe(self, pubkey: bytes, data) -> bytes:
+        """UNSAFE: attestation signature with the slashing-protection veto
+        bypassed.  Byzantine test seam only — see the section comment."""
+        domain = self._domain(DOMAIN_BEACON_ATTESTER, int(data.target.epoch))
+        signing_root = h.compute_signing_root(data.hash_tree_root(), domain)
+        return self._raw_sign(pubkey, signing_root)
+
     def randao_reveal(self, pubkey: bytes, epoch: int) -> bytes:
         domain = self._domain(DOMAIN_RANDAO, epoch)
         root = h.compute_signing_root(uint64.hash_tree_root(epoch), domain)

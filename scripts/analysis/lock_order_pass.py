@@ -64,6 +64,10 @@ SCAN_DIRS = (
     # Condition, crossed by scheduler workers blocking on futures — the
     # exact shape the blocking-call-under-lock pass exists to audit.
     "lighthouse_tpu/device_pipeline.py",
+    # Byzantine actor layer (ISSUE 11): drives validator stores (locked
+    # EIP-3076 DB) and the hub fabric from the scenario pump loops — same
+    # discipline as the runner it rides in.
+    "lighthouse_tpu/adversary.py",
 )
 
 LOCK_CTORS = frozenset({"TimeoutLock", "Lock", "RLock", "Condition"})

@@ -54,6 +54,10 @@ REGISTERING_MODULES = (
     # device_pipeline_* metric constants live in lighthouse_tpu.metrics;
     # importing validates the pipeline wires against the registry cleanly
     "lighthouse_tpu.device_pipeline",
+    # gossip_rejected_total lives with the reject_gossip funnel it counts
+    "lighthouse_tpu.network.service",
+    # byzantine_offenses_total lives with the controller that emits them
+    "lighthouse_tpu.adversary",
 )
 
 

@@ -110,3 +110,112 @@ class TestPrune:
             h_.advance_slot()
         h_.chain.per_slot_task()
         assert h_.chain.op_pool.num_attestations() == 0
+
+
+class TestSlashingHygiene:
+    """ISSUE 11 satellite: dedup'd inserts, canonical (sorted) packing order
+    under the per-block caps, and pruning of dead (already-slashed)
+    slashings."""
+
+    @staticmethod
+    def _slashing(types, indices, target=3, salt=0):
+        def att(root):
+            return types.IndexedAttestation(
+                attesting_indices=sorted(indices),
+                data=types.AttestationData(
+                    slot=target * 8,
+                    index=0,
+                    beacon_block_root=root,
+                    source=types.Checkpoint(epoch=1, root=b"\x01" * 32),
+                    target=types.Checkpoint(epoch=target, root=b"\x02" * 32),
+                ),
+                signature=b"\xc0" + b"\x00" * 95,
+            )
+
+        # a double vote: same (validator, target), different data roots
+        return types.AttesterSlashing(
+            attestation_1=att(bytes([0xA0 + salt]) * 32),
+            attestation_2=att(bytes([0xB0 + salt]) * 32),
+        )
+
+    @pytest.fixture()
+    def hstate(self):
+        h_ = BeaconChainHarness(validator_count=16, fake_crypto=True)
+        h_.extend_chain(2)
+        return h_, h_.chain.head_state
+
+    def test_insert_dedup_by_root(self, hstate):
+        h_, _state = hstate
+        pool = OperationPool()
+        s = self._slashing(h_.types, [3])
+        pool.insert_attester_slashing(s)
+        pool.insert_attester_slashing(s)
+        pool.insert_attester_slashing(s.copy())
+        assert pool.num_attester_slashings() == 1
+
+    def test_packing_sorted_and_capped(self, hstate):
+        h_, state = hstate
+        spec = h_.spec
+        slashings = [
+            self._slashing(h_.types, [i], salt=i) for i in range(5)
+        ]
+        pool_fwd, pool_rev = OperationPool(), OperationPool()
+        for s in slashings:
+            pool_fwd.insert_attester_slashing(s)
+        for s in reversed(slashings):
+            pool_rev.insert_attester_slashing(s)
+        _, att_fwd = pool_fwd.get_slashings(state, spec, h_.types)
+        _, att_rev = pool_rev.get_slashings(state, spec, h_.types)
+        assert len(att_fwd) == spec.preset.max_attester_slashings
+        # arrival order must not leak into block content
+        assert [s.hash_tree_root() for s in att_fwd] == [
+            s.hash_tree_root() for s in att_rev
+        ]
+        assert [s.hash_tree_root() for s in att_fwd] == sorted(
+            s.hash_tree_root() for s in att_fwd
+        )
+
+    def test_proposer_slashings_sorted_by_index(self, hstate):
+        h_, state = hstate
+
+        def pslash(idx, salt):
+            def hdr(b):
+                return h_.types.SignedBeaconBlockHeader(
+                    message=h_.types.BeaconBlockHeader(
+                        slot=4, proposer_index=idx, parent_root=b"\x03" * 32,
+                        state_root=bytes([b]) * 32, body_root=b"\x04" * 32,
+                    ),
+                    signature=b"\xc0" + b"\x00" * 95,
+                )
+
+            return h_.types.ProposerSlashing(
+                signed_header_1=hdr(0x10 + salt), signed_header_2=hdr(0x20 + salt)
+            )
+
+        pool = OperationPool()
+        for idx in (7, 2, 11):
+            pool.insert_proposer_slashing(pslash(idx, idx))
+        proposer, _ = pool.get_slashings(state, h_.spec, h_.types)
+        got = [int(s.signed_header_1.message.proposer_index) for s in proposer]
+        assert got == [2, 7, 11]
+
+    def test_already_slashed_is_dead_block_space(self, hstate):
+        h_, state = hstate
+        pool = OperationPool()
+        pool.insert_attester_slashing(self._slashing(h_.types, [3], salt=1))
+        pool.insert_attester_slashing(self._slashing(h_.types, [5], salt=2))
+        scratch = state.copy()
+        scratch.validators[3].slashed = True
+        _, att = pool.get_slashings(scratch, h_.spec, h_.types)
+        offenders = {
+            int(i) for s in att for i in s.attestation_1.attesting_indices
+        }
+        assert offenders == {5}, "slashing for an already-slashed validator packed"
+        # and prune drops the dead one while keeping the live one
+        pool.prune(scratch, h_.spec)
+        assert pool.num_attester_slashings() == 1
+        assert {
+            int(i)
+            for s in pool.attester_slashings()
+            for i in s.attestation_1.attesting_indices
+        } == {5}

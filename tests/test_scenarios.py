@@ -88,3 +88,37 @@ def test_scenario_matrix_deterministic(name, tmp_path):
     )
     assert (results[0]["final_finalized_epoch"]
             == results[1]["final_finalized_epoch"])
+
+
+def test_byzantine_smoke_slashing_pipeline(tmp_path):
+    """Tier-1 byzantine gate (ISSUE 11): one double-voting validator, and
+    the SOAK artifact proves the complete pipeline — offense emitted →
+    slasher detection → gossiped slashing → op-pool pack → block inclusion
+    → ``validators[idx].slashed`` → zero fork-choice weight — while the
+    honest majority's convergence/finality gates still pass."""
+    from lighthouse_tpu.scenarios import byz_double_vote_smoke
+
+    artifact = run_scenario(byz_double_vote_smoke(seed=0), out_dir=str(tmp_path))
+    assert artifact["passed"]
+    # honest-majority gates held
+    result = artifact["result"]
+    assert result["final_finalized_epoch"] > result["finalized_at_window_end"]
+    # adversarial coverage is a tracked artifact
+    adv = artifact["adversary"]
+    assert adv["offenses_emitted"] == 1
+    assert adv["offenses_detected"] == 1
+    assert adv["offenses_included"] == 1
+    assert adv["veto_asserted"] == 1, "EIP-3076 veto was not asserted"
+    (offense,) = adv["offenses"]
+    assert offense["strategy"] == "double_vote"
+    assert offense["detection_latency_slots"] <= 8
+    assert offense["inclusion_latency_slots"] <= 8
+    # the pipeline gate's own evidence made it into the artifact
+    (conviction,) = artifact["extra"]["slashing_pipeline"]
+    assert conviction["slashing_kind"] == "attester"
+    assert conviction["validator"] == offense["validator"]
+    # and round-trips from disk with the adversary section attached
+    path = os.path.join(str(tmp_path), "SOAK_byz_double_vote_smoke_seed0.json")
+    with open(path) as f:
+        on_disk = json.load(f)
+    assert on_disk["adversary"]["offenders"] == [offense["validator"]]

@@ -256,3 +256,83 @@ def test_restart_recovers_undrained_slashing(harness):
     s2 = Slasher(harness.types, store=store)
     slashings, _ = s2.drain_slashings()
     assert len(slashings) >= 1, "undrained slashing lost across restart"
+
+
+# ---------------------------------------------- history-window ring (ISSUE 11)
+
+
+class TestHistoryWindowRing:
+    """Detection correctness when target epochs wrap the ``history_length``
+    ring (``t % H`` indexing), across validator-array growth, and the
+    prune-beyond-window behavior, pinned."""
+
+    H = 64
+
+    def _slasher(self, harness):
+        return Slasher(harness.types, SlasherConfig(history_length=self.H))
+
+    def test_double_vote_detected_after_ring_wrap(self, harness):
+        """A column that aliased an OLD target is overwritten by the newer
+        epoch; doubles at the new target are still caught."""
+        s = self._slasher(harness)
+        t = 10
+        assert s.on_attestation(_indexed(harness.types, [2], 0, t)) == 0
+        # a full ring later the same column holds the NEW target
+        assert s.on_attestation(
+            _indexed(harness.types, [2], 90, t + self.H,
+                     beacon_root=b"\xaa" * 32)) == 0
+        n = s.on_attestation(
+            _indexed(harness.types, [2], 90, t + self.H,
+                     beacon_root=b"\xbb" * 32))
+        assert n == 1, "double vote at the wrapped column missed"
+
+    def test_surround_detected_across_ring_distance(self, harness):
+        """new ⊃ old where the scan window wraps the circular axis."""
+        s = self._slasher(harness)
+        assert s.on_attestation(_indexed(harness.types, [3], 30, 40)) == 0
+        # (10, 100): the backward scan spans 37..99 — columns wrap % 64
+        assert s.on_attestation(_indexed(harness.types, [3], 10, 100)) == 1
+
+    def test_old_surrounds_new_across_ring_distance(self, harness):
+        s = self._slasher(harness)
+        assert s.on_attestation(_indexed(harness.types, [4], 1, 70)) == 0
+        # (3, 69): the forward scan 70..132 wraps and must validate stored
+        # targets, not trust aliased columns
+        assert s.on_attestation(_indexed(harness.types, [4], 3, 69)) == 1
+
+    def test_evidence_beyond_window_not_detected(self, harness):
+        """Surround evidence older than history_length is out of scope BY
+        DESIGN (the reference prunes the same way) — pinned so a window
+        regression is loud."""
+        s = self._slasher(harness)
+        assert s.on_attestation(_indexed(harness.types, [5], 30, 40)) == 0
+        # new target a full ring past the old one: (10, 300) surrounds
+        # (30, 40) mathematically, but 40 < 300 - H + 1 — aged out
+        assert s.on_attestation(_indexed(harness.types, [5], 10, 300)) == 0
+
+    def test_detection_survives_validator_array_growth(self, harness):
+        """Growing the validator axis (new high index) must preserve the
+        recorded history of existing validators mid-window."""
+        s = self._slasher(harness)
+        assert s.on_attestation(_indexed(harness.types, [6], 3, 6)) == 0
+        # force _ensure() growth well past the initial 64 rows
+        assert s.on_attestation(_indexed(harness.types, [9000], 0, 1)) == 0
+        assert s.on_attestation(_indexed(harness.types, [6], 1, 8)) == 1, (
+            "surround against pre-growth history lost after array growth")
+
+    def test_pruned_evidence_drops_finding(self, harness):
+        """A finding whose evidence attestation was pruned out of the
+        object map queues NOTHING and counts as dropped (the dense arrays
+        still flag it; the container cannot be built)."""
+        s = self._slasher(harness)
+        assert s.on_attestation(_indexed(harness.types, [7], 0, 5)) == 0
+        # jump far ahead: prune cadence fires, (7, 5) evidence is dropped
+        assert s.on_attestation(_indexed(harness.types, [7], 500, 600)) == 0
+        before = s.dropped_findings
+        # the (7,5) column survived in the dense arrays only if 5 % H aliases
+        # nothing newer; craft the aliased double — with the evidence gone
+        # the finding must be dropped, never a half-built slashing
+        n = s.on_attestation(
+            _indexed(harness.types, [7], 0, 5, beacon_root=b"\xee" * 32))
+        assert n == 0
+        assert s.dropped_findings >= before

@@ -462,3 +462,91 @@ def test_latency_measurement(vc_setup):
     assert len(out) == 2
     assert out[0]["latency"] is not None and out[0]["latency"] < 5
     assert out[1]["latency"] is None
+
+
+# ------------------------------------------------- EIP-3076 veto regression
+#
+# ISSUE 11 satellite: the HONEST signing path (sign_block/sign_attestation)
+# must refuse every slashable message, and the explicit unsafe seam
+# (sign_*_unsafe — the byzantine actor layer's signer, adversary.py) must be
+# the only way around the veto, without poisoning the honest history.
+
+
+class TestValidatorStoreVeto:
+    @pytest.fixture()
+    def store_setup(self):
+        from lighthouse_tpu.crypto.bls.backends import set_backend
+        from lighthouse_tpu.validator_client.validator_store import ValidatorStore
+
+        set_backend("fake")
+        harness = BeaconChainHarness(validator_count=4, fake_crypto=True)
+        sk = interop_secret_key(0)
+        store = ValidatorStore(
+            keys=[sk],
+            spec=harness.spec,
+            genesis_validators_root=bytes(
+                harness.chain.genesis_state.genesis_validators_root
+            ),
+            fake_signatures=True,
+        )
+        yield harness.types, store, sk.public_key().to_bytes()
+        set_backend("host")
+
+    @staticmethod
+    def _att_data(types, source, target, beacon_root=b"\x01" * 32):
+        return types.AttestationData(
+            slot=target * 8,
+            index=0,
+            beacon_block_root=beacon_root,
+            source=types.Checkpoint(epoch=source, root=b"\x0a" * 32),
+            target=types.Checkpoint(epoch=target, root=b"\x0b" * 32),
+        )
+
+    @staticmethod
+    def _header(types, slot, graffiti_byte=0):
+        return types.BeaconBlockHeader(
+            slot=slot,
+            proposer_index=0,
+            parent_root=b"\x0c" * 32,
+            state_root=bytes([graffiti_byte]) * 32,
+            body_root=b"\x0d" * 32,
+        )
+
+    def test_double_vote_refused_unsafe_signs(self, store_setup):
+        types, store, pk = store_setup
+        store.sign_attestation(pk, self._att_data(types, 2, 3, b"\xaa" * 32))
+        double = self._att_data(types, 2, 3, b"\xbb" * 32)
+        with pytest.raises(SlashingProtectionError):
+            store.sign_attestation(pk, double)
+        # the byzantine seam is the only bypass
+        assert store.sign_attestation_unsafe(pk, double)
+
+    def test_surround_refused_unsafe_signs(self, store_setup):
+        types, store, pk = store_setup
+        store.sign_attestation(pk, self._att_data(types, 3, 4))
+        surround = self._att_data(types, 2, 5, b"\xcc" * 32)
+        with pytest.raises(SlashingProtectionError):
+            store.sign_attestation(pk, surround)
+        assert store.sign_attestation_unsafe(pk, surround)
+
+    def test_double_propose_refused_unsafe_signs(self, store_setup):
+        types, store, pk = store_setup
+        store.sign_block(pk, self._header(types, 5, 1))
+        double = self._header(types, 5, 2)
+        with pytest.raises(SlashingProtectionError):
+            store.sign_block(pk, double)
+        assert store.sign_block_unsafe(pk, double)
+
+    def test_unsafe_does_not_poison_honest_history(self, store_setup):
+        """The unsafe seam neither checks NOR records: after a byzantine
+        double-sign the validator's honest future stays exactly as wide as
+        the honest history allows."""
+        types, store, pk = store_setup
+        store.sign_attestation(pk, self._att_data(types, 2, 3, b"\xaa" * 32))
+        store.sign_attestation_unsafe(pk, self._att_data(types, 0, 9, b"\xbb" * 32))
+        # (0,9) was never recorded, so the honest (3,4) still signs; had the
+        # unsafe sign been recorded, (3,4) would be a surrounded-by veto
+        store.sign_attestation(pk, self._att_data(types, 3, 4))
+        store.sign_block(pk, self._header(types, 7, 1))
+        store.sign_block_unsafe(pk, self._header(types, 7, 2))
+        store.sign_block(pk, self._header(types, 8, 3))
