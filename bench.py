@@ -354,6 +354,172 @@ def _pipeline_mode_main(force_cpu: bool) -> None:
     sys.stdout.flush()
 
 
+# ---------------------------------------------------------------------------
+# Mesh scaling mode: weak/strong scaling of the sharded verifier on the
+# 8-device virtual CPU mesh (device_mesh.py) -> MULTICHIP JSON.
+# ---------------------------------------------------------------------------
+
+MESH_MARKER = "MESH_RESULT_JSON:"
+MESH_N_DEVICES = int(os.environ.get("BENCH_MESH_DEVICES", "8"))
+MESH_WEAK_SETS_PER_DEVICE = int(os.environ.get("BENCH_MESH_WEAK_PER_DEV", "16"))
+MESH_STRONG_SETS = int(os.environ.get("BENCH_MESH_STRONG_SETS", "128"))
+
+
+def _mesh_measure(n_sets: int, mesh_spec, seed: int) -> dict:
+    """One scaling point: place a batch under the given mesh config (None =
+    single device), dispatch the production entry twice (warm + measured),
+    and record the per-device row split — the artifact's evidence that the
+    batch work really divides across the mesh."""
+    import jax
+
+    from __graft_entry__ import _build_example
+    from lighthouse_tpu import device_mesh
+    from lighthouse_tpu.ops import verify
+    from lighthouse_tpu.ops.pairing import fe_is_one
+
+    device_mesh.reset_for_tests()
+    if mesh_spec is not None:
+        device_mesh.configure(str(mesh_spec))
+    host_batch = _build_example(n_sets=n_sets, n_keys=2, seed=seed,
+                                tile_base=min(n_sets, 16))
+    placed, mesh, _ = verify.place_batch(host_batch)
+    lead = placed[0][0]
+    if mesh:
+        rows = sorted((s.data.shape[0] for s in lead.addressable_shards),
+                      reverse=True)
+        fn = verify._sharded_entry().callable()
+    else:
+        rows = [int(lead.shape[0])]
+        fn = verify._device_verify
+    t0 = time.perf_counter()
+    fe, w_z = fn(*placed)
+    jax.block_until_ready((fe, w_z))
+    warm_s = time.perf_counter() - t0
+    assert fe_is_one(fe), f"mesh bench batch ({n_sets} sets, mesh {mesh}) failed"
+    t0 = time.perf_counter()
+    fe, w_z = fn(*placed)
+    jax.block_until_ready((fe, w_z))
+    exec_s = time.perf_counter() - t0
+    device_mesh.reset_for_tests()
+    return {
+        "n_sets": n_sets,
+        "mesh": mesh,
+        "padded_rows": int(lead.shape[0]),
+        "per_device_rows": rows,
+        "warm_s": round(warm_s, 2),
+        "exec_s": round(exec_s, 2),
+        "sets_per_sec": round(n_sets / exec_s, 3) if exec_s else None,
+    }
+
+
+def _mesh_child_main() -> None:
+    """``bench.py --mesh-child``: runs under a CPU-forced interpreter with
+    the virtual device count fixed by the parent.  Checkpoints after every
+    scaling point (the schedule is compile-dominated on a cold cache)."""
+    sys.path.insert(0, HERE)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from lighthouse_tpu.ops.compile_cache import configure_persistent_cache
+
+    configure_persistent_cache()
+    out: dict = {
+        "n_devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+        "axis": "dp",
+        "note": (
+            "weak/strong scaling of the sharded bls_verify entry on the "
+            "virtual CPU mesh: per_device_rows is the load-division "
+            "evidence; cpu wall times share one physical core, so "
+            "sets_per_sec here measures sharding overhead, not speedup — "
+            "real scaling needs the TPU round (ROADMAP item 2)"
+        ),
+        "weak_scaling": [],
+        "strong_scaling": [],
+    }
+    try:
+        m = min(MESH_N_DEVICES, len(jax.devices()))
+        # Weak scaling: fixed sets/device, mesh 1 -> m.
+        for mesh_spec, n_sets in (
+            (None, MESH_WEAK_SETS_PER_DEVICE),
+            (m, MESH_WEAK_SETS_PER_DEVICE * m),
+        ):
+            out["weak_scaling"].append(
+                _mesh_measure(n_sets, mesh_spec, seed=13))
+            _checkpoint(dict(out, marker="mesh"))
+        # Strong scaling: fixed total sets, mesh 1 -> m.
+        for mesh_spec in (None, m):
+            out["strong_scaling"].append(
+                _mesh_measure(MESH_STRONG_SETS, mesh_spec, seed=17))
+            _checkpoint(dict(out, marker="mesh"))
+        out["ok"] = True
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        out["ok"] = False
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(MESH_MARKER + json.dumps(out))
+    sys.stdout.flush()
+
+
+def _mesh_mode_main(out_path: Optional[str]) -> int:
+    """``bench.py --mesh [--out MULTICHIP_rXX.json]``: re-exec a CPU child
+    with the virtual device count fixed before interpreter start (the same
+    discipline as ``__graft_entry__.dryrun_multichip``) and write the
+    MULTICHIP JSON artifact."""
+    argv = [sys.executable, os.path.abspath(__file__), "--mesh-child"]
+    env = _cpu_child_env()
+    flags = env.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={MESH_N_DEVICES}"
+    ).strip()
+    env.pop("LIGHTHOUSE_TPU_MESH", None)  # the child configures explicitly
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(HERE, ".jax_cache"))
+    scratch = os.path.join(HERE, ".bench_scratch")
+    os.makedirs(scratch, exist_ok=True)
+    result_file = os.path.join(scratch, f"mesh_{os.getpid()}.json")
+    env["BENCH_RESULT_FILE"] = result_file
+    timeout_s = float(os.environ.get("BENCH_MESH_TIMEOUT_S", "2700"))
+    tail, rc, timed_out = "", None, False
+    try:
+        proc = subprocess.run(argv, env=env, cwd=HERE, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, timeout=timeout_s)
+        tail, rc = proc.stdout.decode(errors="replace"), proc.returncode
+    except subprocess.TimeoutExpired as e:
+        # Same always-emit discipline as _run_child: a slow child loses the
+        # unfinished points, never the checkpointed ones.
+        timed_out = True
+        if e.stdout:
+            tail = e.stdout.decode(errors="replace")
+    result = {}
+    for line in tail.splitlines():
+        if line.startswith(MESH_MARKER):
+            result = json.loads(line[len(MESH_MARKER):])
+    if not result:  # child died/overran: the last checkpoint is the evidence
+        result = _read_json(result_file)
+        result.setdefault("ok", False)
+        result.setdefault(
+            "error",
+            f"mesh child timed out at {timeout_s:.0f}s" if timed_out
+            else f"mesh child rc={rc}",
+        )
+        result["tail"] = tail[-1000:]
+    try:
+        os.unlink(result_file)
+    except OSError:
+        pass
+    result["rc"] = rc
+    print(json.dumps(result, indent=2, sort_keys=True))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"bench: mesh artifact written to {out_path}", file=sys.stderr)
+    return 0 if result.get("ok") else 1
+
+
 def _child_main(force_cpu: bool) -> None:
     """Run the bench; checkpoint after each milestone; always exit 0."""
     os.environ.setdefault("JAX_ENABLE_X64", "0")
@@ -735,6 +901,13 @@ def main() -> None:
 if __name__ == "__main__":
     if "--pipeline" in sys.argv:
         _pipeline_mode_main(force_cpu="--cpu" in sys.argv)
+    elif "--mesh-child" in sys.argv:
+        _mesh_child_main()
+    elif "--mesh" in sys.argv:
+        out_path = None
+        if "--out" in sys.argv:
+            out_path = sys.argv[sys.argv.index("--out") + 1]
+        sys.exit(_mesh_mode_main(out_path))
     elif "--child" in sys.argv:
         _child_main(force_cpu="--cpu" in sys.argv)
     else:

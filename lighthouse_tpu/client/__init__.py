@@ -187,6 +187,18 @@ class ClientBuilder:
             except Exception:
                 log.warning("persistent compile-cache setup failed",
                             exc_info=True)
+            # Mesh sharding (device_mesh.py): LIGHTHOUSE_TPU_MESH=N|auto
+            # shards every bucketed device op's batch axis over the device
+            # mesh.  Configured eagerly at node assembly so the topology
+            # (and its per-device breakers) is logged and gauged before
+            # traffic arrives; <2 devices falls back to single-device
+            # dispatch transparently.
+            try:
+                from .. import device_mesh
+
+                device_mesh.configure()
+            except Exception:
+                log.warning("device mesh setup failed", exc_info=True)
             # Async device pipeline (device_pipeline.py): production nodes
             # stream every signature-set group through the persistent device
             # worker so block import / gossip / sync-committee work coalesce

@@ -1,0 +1,565 @@
+"""Mesh-sharding subsystem: the 8-device mesh in the production dispatch path.
+
+ROADMAP item 1.  ``tests/test_multichip.py`` proved (since the seed) that
+the fused device programs produce bit-identical results when their batch
+axis is sharded over a ``jax.sharding.Mesh`` — but nothing in production
+ever built that mesh.  This module is the missing layer between the
+``ops/batch_axes.py`` contract and the bucketed entry points:
+
+- **mesh construction** — :func:`configure` reads ``LIGHTHOUSE_TPU_MESH``
+  (``0`` = disabled, ``N`` = first N devices, ``auto`` = every device) and
+  builds a 1-D data-parallel mesh (axis ``"dp"``).  Fewer than 2 usable
+  devices disables the mesh transparently: every op falls back to the
+  exact single-device path that shipped before this module.
+- **mechanical spec derivation** — :class:`ShardedEntry` reads an entry
+  point's ``BATCH_AXES`` declaration and derives its ``PartitionSpec``\\ s:
+  ``batched_args`` shard their declared batch axis over ``("dp",)``,
+  ``replicated_args`` broadcast, and outputs shard or replicate per the
+  entry's ``out_batched`` flag (``reduces_over_batch`` programs lower
+  their batch-global sums through XLA-inserted ``psum``\\ s and stay in
+  ``device_supervisor.NO_SPLIT_OPS``).  No op hand-maintains a spec.
+- **the mesh placer** — :meth:`ShardedEntry.place` is the ONE sanctioned
+  ``jax.device_put`` site when the mesh is on (the sharding-ready static
+  pass flags placements that bypass it): it pads the batch axis up to a
+  multiple of the mesh size (jax rejects non-divisible input shardings;
+  the pad rows are the same neutral elements bucket padding already uses)
+  and uploads every argument under its derived ``NamedSharding``.
+- **per-device breakers** — a dispatch failure while the mesh is active is
+  charged to a *device* (parsed from the error when the runtime names one,
+  else the deterministic suspect — the highest-index survivor).  A device
+  whose breaker trips is removed and the mesh **re-shards over the
+  survivors**: specs re-derive, the per-topology jit/AOT warmup state is
+  invalidated (``device_telemetry.COMPILE_CACHE`` drops the old topology's
+  entries), ``device_mesh_size`` / ``device_mesh_reshards_total`` move,
+  and the supervisor retries the batch on the shrunk mesh.  Only when the
+  mesh is exhausted (fewer than 2 survivors) does the op-level breaker
+  resume sole ownership — host fallback remains the terminal state.
+
+Thread discipline: all mutable state sits behind one ``TimeoutLock``;
+``generation()`` is the cheap read callers key their caches on.  The
+module imports neither jax nor ``ops/`` at import time (the pipeline and
+scheduler import it for :func:`scale_target` without pulling a device
+runtime); jax loads lazily on :func:`configure`.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import re
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import metrics
+from .logs import get_logger
+from .timeout_lock import TimeoutLock
+
+log = get_logger("device_mesh")
+
+#: The one mesh axis: pure data parallelism over the batch axis.
+AXIS = "dp"
+
+MESH_ENV = "LIGHTHOUSE_TPU_MESH"
+
+#: Consecutive failures charged to one device before its breaker trips and
+#: the mesh re-shards without it.  Deliberately lower than the op breaker's
+#: threshold: shrinking the mesh is cheap and reversible-by-restart, while
+#: an op trip parks EVERY batch on the slow host path.
+DEVICE_FAILURE_THRESHOLD_ENV = "LIGHTHOUSE_TPU_MESH_DEVICE_FAILURES"
+DEFAULT_DEVICE_FAILURE_THRESHOLD = 2
+
+#: Runtimes that name the failing chip do it in one of these spellings
+#: (``TPU_3``, ``device 5``, ``device_ordinal: 2``, ...).
+_DEVICE_ID_RE = re.compile(
+    r"(?:TPU|device(?:_ordinal)?)[ _:#]*(\d+)", re.IGNORECASE
+)
+
+
+def _registry() -> dict:
+    # Lazy: ops/__init__ documents the package; batch_axes itself is a
+    # plain dict literal with no imports, so this cannot cycle back here.
+    from .ops.batch_axes import BATCH_AXES
+
+    return BATCH_AXES
+
+
+class _DeviceBreaker:
+    """Per-device failure counter: CLOSED until ``threshold`` consecutive
+    charged failures, then OPEN (sticky — a removed device rejoins only via
+    an operator reset/restart; auto re-admission would need a re-warm and
+    re-proof the failure was transient, which nothing here can see)."""
+
+    __slots__ = ("device_id", "threshold", "failures", "open", "last_reason")
+
+    def __init__(self, device_id: int, threshold: int):
+        self.device_id = device_id
+        self.threshold = threshold
+        self.failures = 0
+        self.open = False
+        self.last_reason: Optional[str] = None
+
+    def record(self, reason: str) -> bool:
+        """Charge one failure; True iff this charge tripped the breaker."""
+        self.failures += 1
+        self.last_reason = reason
+        if not self.open and self.failures >= self.threshold:
+            self.open = True
+            return True
+        return False
+
+    def snapshot(self) -> dict:
+        return {
+            "device": self.device_id,
+            "state": "open" if self.open else "closed",
+            "failures": self.failures,
+            "threshold": self.threshold,
+            "last_reason": self.last_reason,
+        }
+
+
+class MeshState:
+    """The process-wide mesh: device roster, breakers, topology generation."""
+
+    def __init__(self) -> None:
+        self._lock = TimeoutLock("device_mesh")
+        self._configured = False
+        self._devices: List[Any] = []          # live mesh members, id order
+        self._mesh = None                      # jax.sharding.Mesh | None
+        self._full_size = 0                    # size as originally configured
+        self._generation = 0
+        self._reshards_total = 0
+        self._breakers: Dict[int, _DeviceBreaker] = {}
+        self._threshold = DEFAULT_DEVICE_FAILURE_THRESHOLD
+
+    # ---------------------------------------------------------- configure
+
+    def configure(self, spec: Optional[str] = None) -> int:
+        """(Re)build the mesh per ``spec`` (default: the env var).  Returns
+        the active mesh size (0 = disabled).  Idempotent for a given spec;
+        an explicit call always rebuilds from the full device roster."""
+        raw = (spec if spec is not None
+               else os.environ.get(MESH_ENV, "0")).strip().lower()
+        threshold = max(1, int(os.environ.get(
+            DEVICE_FAILURE_THRESHOLD_ENV, str(DEFAULT_DEVICE_FAILURE_THRESHOLD))))
+        devices: List[Any] = []
+        if raw not in ("", "0", "off", "false"):
+            import jax
+
+            available = list(jax.devices())
+            want = len(available) if raw == "auto" else int(raw)
+            devices = available[: max(0, want)]
+        if len(devices) < 2:
+            devices = []  # single-device: the mesh buys nothing, stay off
+        with self._lock:
+            self._configured = True
+            self._threshold = threshold
+            self._devices = devices
+            self._full_size = len(devices)
+            self._breakers = {
+                int(d.id): _DeviceBreaker(int(d.id), threshold) for d in devices
+            }
+            self._mesh = self._build_mesh(devices)
+            self._generation += 1
+            size = len(devices)
+        metrics.DEVICE_MESH_SIZE.set(size)
+        for d in devices:
+            metrics.DEVICE_MESH_DEVICE_STATE.set(0, device=str(int(d.id)))
+        if size:
+            log.info("device mesh enabled", size=size, axis=AXIS,
+                     devices=[int(d.id) for d in devices])
+        return size
+
+    @staticmethod
+    def _build_mesh(devices: Sequence[Any]):
+        if len(devices) < 2:
+            return None
+        import numpy as np
+        from jax.sharding import Mesh
+
+        return Mesh(np.array(devices), (AXIS,))
+
+    def _ensure_configured(self) -> None:
+        with self._lock:
+            configured = self._configured
+        if not configured:
+            self.configure()
+
+    # ------------------------------------------------------------- reads
+
+    def enabled(self) -> bool:
+        self._ensure_configured()
+        with self._lock:
+            return self._mesh is not None
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._devices)
+
+    def full_size(self) -> int:
+        with self._lock:
+            return self._full_size
+
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def mesh(self):
+        with self._lock:
+            return self._mesh
+
+    def pad_rows(self, n: int) -> int:
+        """``n`` rounded up to a multiple of the mesh size (jax rejects
+        non-divisible input shardings); ``n`` unchanged when disabled."""
+        with self._lock:
+            m = len(self._devices)
+        if m < 2:
+            return n
+        return -(-n // m) * m
+
+    # ------------------------------------------------- failure accounting
+
+    def suspect_device(self, err: Optional[BaseException]) -> Optional[int]:
+        """The device a failure is charged to: the id the error names when
+        the runtime names one, else the deterministic suspect — the
+        highest-index survivor (degradation order is then reproducible,
+        which the 2-run scenario gate requires)."""
+        with self._lock:
+            if not self._devices:
+                return None
+            member_ids = {int(d.id) for d in self._devices}
+            fallback = int(self._devices[-1].id)
+        if err is not None:
+            m = _DEVICE_ID_RE.search(str(err))
+            if m and int(m.group(1)) in member_ids:
+                return int(m.group(1))
+        return fallback
+
+    def note_success(self) -> None:
+        """A meshed dispatch completed: clear the failure counters of every
+        still-CLOSED device breaker.  This is what makes the threshold
+        genuinely *consecutive* — without it, unattributable transients
+        hours apart would ratchet healthy devices out of the mesh one by
+        one (the deterministic suspect is always the highest-index
+        survivor).  OPEN breakers stay open: re-admission is
+        operator-driven."""
+        with self._lock:
+            for br in self._breakers.values():
+                if not br.open:
+                    br.failures = 0
+
+    def note_failure(self, reason: str,
+                     device_id: Optional[int] = None,
+                     err: Optional[BaseException] = None) -> bool:
+        """Charge one dispatch failure to a device; True iff the charge
+        tripped that device's breaker and the mesh re-sharded (the caller
+        should then retry the batch on the survivors)."""
+        if device_id is None:
+            device_id = self.suspect_device(err)
+        if device_id is None:
+            return False
+        transitions: List[int] = []
+        with self._lock:
+            br = self._breakers.get(device_id)
+            if br is None or self._mesh is None:
+                return False
+            tripped = br.record(reason)
+            if tripped:
+                transitions.append(device_id)
+                self._shrink_locked(device_id, reason)
+            size = len(self._devices)
+            gen = self._generation
+        metrics.DEVICE_MESH_DEVICE_FAILURES.inc(device=str(device_id))
+        for dev in transitions:
+            metrics.DEVICE_MESH_DEVICE_STATE.set(1, device=str(dev))
+            metrics.DEVICE_MESH_RESHARDS.inc(reason=reason)
+            metrics.DEVICE_MESH_SIZE.set(size)
+            log.warning("mesh device breaker tripped; re-sharded",
+                        device=dev, reason=reason, survivors=size,
+                        generation=gen)
+            self._invalidate_topology()
+        return bool(transitions)
+
+    def force_trip(self, device_id: int, reason: str = "forced") -> bool:
+        """Trip one device's breaker outright (admin/scenario seam: the
+        deterministic 'kill a device mid-sync' event)."""
+        with self._lock:
+            br = self._breakers.get(int(device_id))
+            if br is None or self._mesh is None or br.open:
+                return False
+            br.failures = max(br.failures, br.threshold)
+            br.open = True
+            br.last_reason = reason
+            self._shrink_locked(int(device_id), reason)
+            size = len(self._devices)
+        metrics.DEVICE_MESH_DEVICE_STATE.set(1, device=str(int(device_id)))
+        metrics.DEVICE_MESH_RESHARDS.inc(reason=reason)
+        metrics.DEVICE_MESH_SIZE.set(size)
+        log.warning("mesh device force-tripped; re-sharded",
+                    device=int(device_id), reason=reason, survivors=size)
+        self._invalidate_topology()
+        return True
+
+    def _shrink_locked(self, device_id: int, reason: str) -> None:
+        """Remove ``device_id`` and rebuild the mesh over the survivors
+        (lock held).  Below 2 survivors the mesh disables entirely — the
+        single-device path (and, past it, the op breaker's host fallback)
+        is the terminal degradation state."""
+        self._devices = [d for d in self._devices if int(d.id) != device_id]
+        self._reshards_total += 1
+        self._generation += 1
+        self._mesh = self._build_mesh(self._devices)
+        if self._mesh is None and self._devices:
+            log.warning("mesh exhausted; single-device dispatch",
+                        survivor=int(self._devices[0].id), reason=reason)
+
+    def _invalidate_topology(self) -> None:
+        """The old topology's executables are dead weight: drop its
+        compile-mirror entries (so telemetry re-attributes the survivors'
+        first dispatches as the compiles they are) — the AOT-warmup
+        invalidation half of a reshard.  jax-level caches are keyed by the
+        jitted wrapper identity, which :class:`ShardedEntry` rotates via
+        the generation."""
+        from . import device_telemetry
+
+        device_telemetry.COMPILE_CACHE.invalidate_meshed()
+
+    # ------------------------------------------------------------ surface
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self._mesh is not None,
+                "axis": AXIS,
+                "size": len(self._devices),
+                "full_size": self._full_size,
+                "generation": self._generation,
+                "reshards_total": self._reshards_total,
+                "device_failure_threshold": self._threshold,
+                "devices": [int(d.id) for d in self._devices],
+                "breakers": [b.snapshot()
+                             for _, b in sorted(self._breakers.items())],
+            }
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._configured = False
+            self._devices = []
+            self._mesh = None
+            self._full_size = 0
+            self._generation += 1
+            self._reshards_total = 0
+            self._breakers = {}
+        metrics.DEVICE_MESH_SIZE.set(0)
+
+
+STATE = MeshState()
+
+
+# ------------------------------------------------------------ module facade
+
+
+def configure(spec: Optional[str] = None) -> int:
+    return STATE.configure(spec)
+
+
+def enabled() -> bool:
+    return STATE.enabled()
+
+
+def size() -> int:
+    return STATE.size()
+
+
+def generation() -> int:
+    return STATE.generation()
+
+
+def pad_rows(n: int) -> int:
+    return STATE.pad_rows(n)
+
+
+def note_success() -> None:
+    STATE.note_success()
+
+
+def note_failure(reason: str, device_id: Optional[int] = None,
+                 err: Optional[BaseException] = None) -> bool:
+    return STATE.note_failure(reason, device_id=device_id, err=err)
+
+
+def grow_rows(arr, rows: int, fill):
+    """Grow a host array's leading (batch) axis to ``rows`` with ``fill``
+    (broadcast into the new rows) — the one shared mesh-divisibility pad
+    the ops' placement stages use next to :func:`pad_rows`."""
+    import numpy as np
+
+    if arr.shape[0] == rows:
+        return arr
+    out = np.empty((rows,) + arr.shape[1:], arr.dtype)
+    out[: arr.shape[0]] = arr
+    out[arr.shape[0]:] = fill
+    return out
+
+
+def force_trip(device_id: int, reason: str = "forced") -> bool:
+    return STATE.force_trip(device_id, reason)
+
+
+def summary() -> dict:
+    """The ``mesh`` section of ``GET /lighthouse/device``."""
+    return STATE.snapshot()
+
+
+def reset_for_tests() -> None:
+    STATE.reset_for_tests()
+
+
+def scale_target(target_sets: int) -> int:
+    """A batch-fill target scaled to the CURRENT mesh (the device pipeline
+    consults this per coalescing decision): a mesh shrunk from F to S
+    devices fills S/F of the configured target — waiting to fill lanes the
+    survivors no longer have would only add linger latency.  Identity when
+    the mesh is off or at full strength.  Never imports jax."""
+    with STATE._lock:
+        full, current = STATE._full_size, len(STATE._devices)
+    if full < 2 or current >= full or current < 2:
+        return target_sets
+    return max(1, target_sets * current // full)
+
+
+# ----------------------------------------------------------- sharded entry
+
+
+class ShardedEntry:
+    """One entry point's sharded lowering, derived from ``BATCH_AXES``.
+
+    ``fn`` is the *unwrapped* python callable (``entry.__wrapped__``) — the
+    jitted wrapper here carries the mesh ``in_shardings``/``out_shardings``
+    and is cached per topology generation, so a reshard transparently
+    recompiles for the surviving devices on the next dispatch.
+    """
+
+    def __init__(self, entry_key: str, fn, *,
+                 static_argnames: Tuple[str, ...] = ()):
+        decl = _registry().get(entry_key)
+        if decl is None:
+            raise KeyError(
+                f"{entry_key} has no ops/batch_axes.py declaration — the "
+                "mesh layer cannot derive its PartitionSpecs")
+        self.entry_key = entry_key
+        self.op = decl["op"]
+        self.fn = fn
+        self.static_argnames = tuple(static_argnames)
+        self.batch_axis = int(decl["batch_axis"])
+        self.out_batched = bool(decl.get("out_batched", False))
+        batched = list(decl["batched_args"])
+        replicated = list(decl["replicated_args"])
+        params = [
+            p.name for p in inspect.signature(fn).parameters.values()
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            and p.name not in self.static_argnames
+        ]
+        undeclared = [p for p in params if p not in batched + replicated]
+        if undeclared:
+            raise ValueError(
+                f"{entry_key}: parameters {undeclared} are neither batched "
+                "nor replicated in ops/batch_axes.py — declare them")
+        #: positional arg index -> True when batched
+        self.arg_batched: Tuple[bool, ...] = tuple(
+            name in batched for name in params
+        )
+        self._cache_lock = threading.Lock()
+        self._jitted: Dict[int, Any] = {}  # generation -> jitted wrapper
+
+    # ------------------------------------------------------------- specs
+
+    def _specs(self, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = [None] * (self.batch_axis + 1)
+        spec[self.batch_axis] = AXIS
+        dp = NamedSharding(mesh, P(*spec))
+        repl = NamedSharding(mesh, P())
+        return dp, repl
+
+    def in_shardings(self, mesh) -> tuple:
+        """Per-positional-arg sharding tree (each entry broadcasts over
+        that argument's leaves — jit/device_put accept prefix pytrees)."""
+        dp, repl = self._specs(mesh)
+        return tuple(dp if b else repl for b in self.arg_batched)
+
+    def out_sharding(self, mesh):
+        dp, repl = self._specs(mesh)
+        return dp if self.out_batched else repl
+
+    # --------------------------------------------------------- placement
+
+    def place(self, *args):
+        """THE mesh placer: upload every argument under its derived
+        ``NamedSharding`` on the current mesh.  Callers pad the batch axis
+        with :func:`pad_rows` first (this asserts divisibility rather than
+        letting jax produce an opaque sharding error mid-dispatch)."""
+        import jax
+
+        mesh = STATE.mesh()
+        if mesh is None:
+            raise RuntimeError("device mesh is not enabled")
+        shardings = self.in_shardings(mesh)
+        assert len(shardings) == len(args), (
+            f"{self.entry_key}: {len(args)} args vs "
+            f"{len(shardings)} declared parameters")
+        return tuple(
+            jax.device_put(a, s) for a, s in zip(args, shardings)
+        )
+
+    # ---------------------------------------------------------- dispatch
+
+    def callable(self, **static_kwargs):
+        """The jitted sharded wrapper for the current topology (compiled
+        lazily per (generation, static kwargs); stale generations are
+        dropped so an old mesh's executables cannot be dispatched to dead
+        devices).  Static keyword arguments (the epoch kernel's
+        ``in_leak``) are bound via ``functools.partial`` — pjit rejects
+        kwargs alongside ``in_shardings``, and a bound static forks the
+        compiled program exactly like ``static_argnames`` would."""
+        import functools
+
+        import jax
+
+        mesh = STATE.mesh()
+        if mesh is None:
+            raise RuntimeError("device mesh is not enabled")
+        unknown = set(static_kwargs) - set(self.static_argnames)
+        if unknown:
+            raise TypeError(f"{self.entry_key}: non-static kwargs {unknown}")
+        gen = STATE.generation()
+        key = (gen, tuple(sorted(static_kwargs.items())))
+        with self._cache_lock:
+            if not any(k[0] == gen for k in self._jitted):
+                self._jitted = {}  # topology changed: drop stale wrappers
+            fn = self._jitted.get(key)
+            if fn is None:
+                base = (functools.partial(self.fn, **static_kwargs)
+                        if static_kwargs else self.fn)
+                # One wrapper per (topology generation, static args); the
+                # dict IS the bounded cache, stale generations dropped.
+                # recompile-hazard: ok(per-generation wrapper cache)
+                fn = self._jitted[key] = jax.jit(
+                    base,
+                    in_shardings=self.in_shardings(mesh),
+                    out_shardings=self.out_sharding(mesh),
+                )
+            return fn
+
+    def __call__(self, *args, **static_kwargs):
+        return self.callable(**static_kwargs)(*args)
+
+    def shard_live_counts(self, n_live: int, padded_rows: int) -> List[int]:
+        """Host-side per-shard live-row counts (live rows are packed at the
+        front of every batch): the per-shard occupancy view — padding lands
+        on the LAST shards, and this shows exactly where."""
+        m = STATE.size()
+        if m < 2 or padded_rows % m:
+            return [n_live]
+        rows = padded_rows // m
+        return [max(0, min(rows, n_live - s * rows)) for s in range(m)]

@@ -267,14 +267,24 @@ class DevicePipeline:
 
     # ------------------------------------------------------------- builder
 
+    def _effective_target(self) -> int:
+        """The coalescing target scaled to the CURRENT mesh: a mesh shrunk
+        by per-device breaker trips fills proportionally fewer lanes, so
+        waiting for the full-strength target would only add linger latency
+        (identity when the mesh is off or at full strength)."""
+        from . import device_mesh
+
+        return device_mesh.scale_target(self.target_sets)
+
     def _take_batch(self) -> Optional[List[_Group]]:
         """Block until a batch is worth dispatching (target fill reached, the
         oldest group's linger expired, or shutdown-drain); pop and return it.
         Returns None only when shut down AND drained."""
         with self._cond:
             while True:
+                target = self._effective_target()
                 if self._pending:
-                    if self._shutdown or self._pending_sets >= self.target_sets:
+                    if self._shutdown or self._pending_sets >= target:
                         break
                     oldest = self._pending[0].future.submitted_pc
                     remaining = self.linger_s - (time.perf_counter() - oldest)
@@ -289,7 +299,7 @@ class DevicePipeline:
             n_sets = 0
             while self._pending:
                 g = self._pending[0]
-                if groups and n_sets + len(g.sets) > self.target_sets:
+                if groups and n_sets + len(g.sets) > target:
                     break
                 self._pending.popleft()
                 groups.append(g)
@@ -490,6 +500,9 @@ class DevicePipeline:
         return {
             "op": self.op,
             "target_sets": self.target_sets,
+            # identical to target_sets unless the device mesh is degraded
+            # (device_mesh.scale_target shrinks the fill target with it)
+            "effective_target_sets": self._effective_target(),
             "linger_s": self.linger_s,
             "pending_groups": pending_groups,
             "pending_sets": pending_sets,

@@ -23,6 +23,12 @@ this is the same discipline applied to the device boundary:
   (``crypto/bls/backends/host.py``, the numpy epoch/sha paths) without
   touching the device: the chain degrades to slow-but-correct instead of
   crashing.
+- **per-device breakers** (``device_mesh.py``) — when the data-parallel
+  mesh is active, a dispatch failure is first charged to a *device*; a
+  tripped device is removed, the mesh re-shards over the survivors and the
+  batch retries there (``device_mesh_reshards_total``/``device_mesh_size``)
+  before the op-level ladder above ever engages.  One sick chip costs one
+  mesh lane, not the whole op.
 
 Every state transition is exported via ``metrics/``
 (``device_breaker_state{op}``, ``device_breaker_transitions_total``),
@@ -376,6 +382,52 @@ class DeviceSupervisor:
             raise job.error
         return job.value
 
+    def _dispatch_meshed(self, op: str, fn: Callable[[], Any],
+                         deadline_s: float, info: dict) -> Any:
+        """The mesh-aware dispatch: while the device mesh is active, a
+        failure (device error OR watchdog timeout) is charged to a
+        *device* (``device_mesh.note_failure`` — parsed from the error
+        when the runtime names a chip, else the deterministic suspect).
+        A charge that trips that device's breaker re-shards the mesh over
+        the survivors and the batch RETRIES on the shrunk topology —
+        ``device_fn`` re-places its arrays against the new generation —
+        instead of tripping the whole op to host.  A failure that does not
+        reshard (threshold not reached, or the mesh is off/exhausted)
+        propagates into the existing split-retry / op-breaker ladder, so
+        host fallback remains the terminal degradation state."""
+        from . import device_mesh
+
+        while True:
+            meshed = device_mesh.enabled()
+            try:
+                result = self._dispatch(op, fn, deadline_s)
+                if meshed:
+                    # keep the per-device thresholds CONSECUTIVE: a clean
+                    # dispatch clears every still-closed breaker's counter
+                    device_mesh.note_success()
+                return result
+            except HostFallback:
+                raise  # a disclaimer, not a device failure
+            except DispatchTimeout as err:
+                if meshed and device_mesh.note_failure(
+                        "dispatch_timeout", err=err):
+                    info["mesh_reshards"] = info.get("mesh_reshards", 0) + 1
+                    log.warning("mesh resharded after dispatch timeout; "
+                                "retrying batch", op=op,
+                                survivors=device_mesh.size())
+                    continue
+                raise
+            except Exception as err:  # noqa: BLE001 — charged + re-raised
+                if meshed and device_mesh.note_failure(
+                        "device_error", err=err):
+                    info["mesh_reshards"] = info.get("mesh_reshards", 0) + 1
+                    log.warning("mesh resharded after device error; "
+                                "retrying batch", op=op,
+                                error=f"{type(err).__name__}: {err}",
+                                survivors=device_mesh.size())
+                    continue
+                raise
+
     def _emit(self, op: str, transitions: List[Tuple[str, str, str]]) -> None:
         """Metrics + SSE + log for breaker transitions (no locks held)."""
         for old, new, reason in transitions:
@@ -454,7 +506,7 @@ class DeviceSupervisor:
         deadline = self.deadline_for(op) if deadline_s is None else deadline_s
 
         try:
-            result = self._dispatch(op, device_fn, deadline)
+            result = self._dispatch_meshed(op, device_fn, deadline, info)
         except HostFallback as hf:
             # The device executed and disclaimed — not a device failure.
             self._emit(op, br.record_success())

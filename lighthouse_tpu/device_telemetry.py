@@ -58,8 +58,11 @@ FLIGHT_RECORDER_CAPACITY = int(
 MAX_PROFILE_SECONDS = 10.0
 
 
-def _shape_label(shape: Tuple[int, ...]) -> str:
-    return "x".join(str(int(s)) for s in shape)
+def _shape_label(shape: Tuple[int, ...], mesh: int = 0) -> str:
+    label = "x".join(str(int(s)) for s in shape)
+    # Sharded programs are distinct executables at the same bucket shape:
+    # the mesh size is part of the identity ("128x32@dp8").
+    return f"{label}@dp{int(mesh)}" if mesh else label
 
 
 def active_trace_id() -> Optional[str]:
@@ -85,26 +88,29 @@ class CompileCache:
         self._lock = threading.Lock()
         self._programs: Dict[Tuple[str, Tuple[int, ...]], dict] = {}
 
-    def note_dispatch(self, op: str, shape: Tuple[int, ...], seconds: float) -> bool:
+    def note_dispatch(self, op: str, shape: Tuple[int, ...], seconds: float,
+                      mesh: int = 0) -> bool:
         """Record one dispatch of ``op`` at ``shape``; True iff first seen
-        (the compiling call)."""
+        (the compiling call).  ``mesh`` > 0 marks a sharded executable —
+        the same bucket shape compiles separately per mesh topology."""
         shape = tuple(int(s) for s in shape)
+        mesh = int(mesh)
         now = time.time()
         with self._lock:
-            entry = self._programs.get((op, shape))
+            entry = self._programs.get((op, shape, mesh))
             if entry is not None:
                 entry["invocations"] += 1
                 entry["last_used_ms"] = int(now * 1000)
                 return False
-            self._programs[(op, shape)] = {
+            self._programs[(op, shape, mesh)] = {
                 "op": op,
-                "shape": _shape_label(shape),
+                "shape": _shape_label(shape, mesh),
                 "compile_seconds": round(seconds, 4),
                 "invocations": 1,
                 "first_seen_ms": int(now * 1000),
                 "last_used_ms": int(now * 1000),
             }
-        metrics.DEVICE_PROGRAM_COMPILES.inc(op=op, shape=_shape_label(shape))
+        metrics.DEVICE_PROGRAM_COMPILES.inc(op=op, shape=_shape_label(shape, mesh))
         metrics.DEVICE_PROGRAM_COMPILE_SECONDS.observe(seconds, op=op)
         return True
 
@@ -119,13 +125,13 @@ class CompileCache:
         shape = tuple(int(s) for s in shape)
         now = time.time()
         with self._lock:
-            entry = self._programs.get((op, shape))
+            entry = self._programs.get((op, shape, 0))
             # A production dispatch can race the background warmup compile
             # for the same shape; if it won, note_dispatch already counted
             # the compile — the warmup must not count it a second time.
             already_counted = entry is not None
             if entry is None:
-                entry = self._programs[(op, shape)] = {
+                entry = self._programs[(op, shape, 0)] = {
                     "op": op,
                     "shape": _shape_label(shape),
                     "compile_seconds": round(seconds, 4),
@@ -143,12 +149,22 @@ class CompileCache:
             metrics.DEVICE_PROGRAM_COMPILES.inc(op=op, shape=_shape_label(shape))
             metrics.DEVICE_PROGRAM_COMPILE_SECONDS.observe(seconds, op=op)
 
-    def seen(self, op: str, shape: Tuple[int, ...]) -> bool:
-        """True iff (op, shape) already has a cached executable — i.e. the
-        next dispatch will NOT compile.  Lets fault-injection sites target
-        ``device.compile`` deterministically."""
+    def seen(self, op: str, shape: Tuple[int, ...], mesh: int = 0) -> bool:
+        """True iff (op, shape, mesh) already has a cached executable —
+        i.e. the next dispatch will NOT compile.  Lets fault-injection
+        sites target ``device.compile`` deterministically."""
         with self._lock:
-            return (op, tuple(int(s) for s in shape)) in self._programs
+            return (op, tuple(int(s) for s in shape), int(mesh)) in self._programs
+
+    def invalidate_meshed(self) -> None:
+        """Drop every sharded program's mirror entry (device_mesh reshard:
+        the old topology's executables — AOT-warmed or production-compiled
+        — are unreachable; the survivors' first dispatches must count as
+        the compiles they are)."""
+        with self._lock:
+            self._programs = {
+                k: v for k, v in self._programs.items() if k[2] == 0
+            }
 
     def inventory(self) -> List[dict]:
         with self._lock:
@@ -167,8 +183,9 @@ class CompileCache:
 COMPILE_CACHE = CompileCache()
 
 
-def note_dispatch(op: str, shape: Tuple[int, ...], seconds: float) -> bool:
-    return COMPILE_CACHE.note_dispatch(op, shape, seconds)
+def note_dispatch(op: str, shape: Tuple[int, ...], seconds: float,
+                  mesh: int = 0) -> bool:
+    return COMPILE_CACHE.note_dispatch(op, shape, seconds, mesh=mesh)
 
 
 def note_warmup(op: str, shape: Tuple[int, ...], seconds: float, hit: bool) -> None:
@@ -244,21 +261,28 @@ def record_batch(
     compiled: bool = False,
     breaker_state: Optional[str] = None,
     dispatched: bool = True,
+    mesh: int = 0,
+    shard_live: Optional[List[int]] = None,
 ) -> dict:
     """Account one dispatched device batch: occupancy histograms +
     wasted-lane counters + a flight-recorder entry.  Returns the entry
-    (with its ``seq``) so callers can stamp the linkage on their span."""
+    (with its ``seq``) so callers can stamp the linkage on their span.
+    ``mesh`` > 0 marks a sharded dispatch; ``shard_live`` is the per-shard
+    live-row split (the per-shard occupancy view — bucket+mesh padding
+    lands on the last shards, and this is where that shows)."""
     shape = tuple(int(s) for s in shape)
     nb = shape[0]
     entry: Dict[str, Any] = {
         "t_ms": int(time.time() * 1000),
         "op": op,
-        "shape": _shape_label(shape),
+        "shape": _shape_label(shape, mesh),
         "n_live": int(n_live),
         "compiled": bool(compiled),
         "host_fallback": bool(host_fallback),
         "trace_id": trace_id,
     }
+    if mesh:
+        entry["mesh"] = int(mesh)
     if n_groups is not None:
         # Pipeline-coalesced batches: how many caller groups rode this one
         # dispatch, and which work kinds contributed how many sets.
@@ -283,6 +307,18 @@ def record_batch(
         entry["occupancy_sets"] = round(set_ratio, 4)
         metrics.DEVICE_BATCH_OCCUPANCY_RATIO.observe(set_ratio, op=op, axis="sets")
         metrics.DEVICE_BATCH_WASTED_LANES.inc(max(0, nb - n_live), op=op, axis="sets")
+    if dispatched and mesh and shard_live and nb > 0 and len(shard_live) > 1:
+        # Per-shard view: each device's live/padded ratio on this dispatch.
+        # Histogram axis "sets_per_shard" keeps the batch-level "sets"
+        # signal clean; the flight record carries the exact split.
+        rows = nb // len(shard_live)
+        ratios = [round(min(1.0, live / rows), 4) if rows else 0.0
+                  for live in shard_live]
+        entry["shard_live"] = [int(v) for v in shard_live]
+        entry["occupancy_per_shard"] = ratios
+        for r in ratios:
+            metrics.DEVICE_BATCH_OCCUPANCY_RATIO.observe(
+                r, op=op, axis="sets_per_shard")
     if dispatched and live_keys is not None and len(shape) >= 2 and nb * shape[1] > 0:
         lanes = nb * shape[1]
         key_ratio = min(1.0, live_keys / lanes)
@@ -392,10 +428,13 @@ def summary() -> dict:
         op: {axis: _percentiles(vals) for axis, vals in axes.items() if vals}
         for op, axes in occ.items()
     }
-    from . import device_pipeline, device_supervisor
+    from . import device_mesh, device_pipeline, device_supervisor
 
     return {
         "programs": COMPILE_CACHE.inventory(),
+        # Mesh-sharding subsystem (device_mesh.py): topology, per-device
+        # breakers, reshard count — the first stop when one chip is sick.
+        "mesh": device_mesh.summary(),
         "occupancy": occ,
         "host_fallbacks": host_fallback_counts(),
         # Async device pipeline (device_pipeline.py): pending depth, fill

@@ -404,6 +404,28 @@ DEVICE_PIPELINE_GROUPS = counter(
     "signature-set groups submitted to the device pipeline, by op and work kind",
 )
 
+# Mesh-sharding subsystem (device_mesh.py): the data-parallel device mesh
+# the bucketed entry points shard their batch axis over, and the per-device
+# breaker layer that shrinks it around a sick chip instead of tripping the
+# whole op to host.
+DEVICE_MESH_SIZE = gauge(
+    "device_mesh_size",
+    "devices in the active data-parallel mesh (0 = mesh disabled, "
+    "single-device dispatch)",
+)
+DEVICE_MESH_RESHARDS = counter(
+    "device_mesh_reshards_total",
+    "mesh topology rebuilds after a per-device breaker trip, by reason",
+)
+DEVICE_MESH_DEVICE_FAILURES = counter(
+    "device_mesh_device_failures_total",
+    "device-attributed dispatch failures recorded by the mesh layer, by device",
+)
+DEVICE_MESH_DEVICE_STATE = gauge(
+    "device_mesh_device_breaker_state",
+    "per-device mesh breaker state (0=closed, 1=open), by device",
+)
+
 # Scheduler queue depth, sampled by the manager loop (reference
 # beacon_processor per-queue length gauges): read NEXT TO
 # device_pipeline_pending_sets to attribute queue pressure vs batch fill.

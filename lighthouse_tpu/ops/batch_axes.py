@@ -1,14 +1,15 @@
 """Batch-axis registry: the sharding contract of every device entry point.
 
-ROADMAP item 2 (graduate multi-chip to the production dispatch path) shards
-the *batch axis* of the bucketed device programs over a
-``jax.sharding.Mesh``.  That only works if the batch axis is a real,
-declared property of each entry point — not folklore living in docstrings.
-This registry IS that declaration: one entry per jitted device entry point
-in ``ops/``, naming the op, the batch axis position of its batched
-arguments, and whether the program reduces over the batch axis (in which
-case a sharded lowering needs a collective sum and the supervisor must
-never split the batch — see ``device_supervisor.NO_SPLIT_OPS``).
+ROADMAP item 1 (the mesh in the production dispatch path — landed:
+``lighthouse_tpu/device_mesh.py``) shards the *batch axis* of the bucketed
+device programs over a ``jax.sharding.Mesh``.  That only works if the
+batch axis is a real, declared property of each entry point — not folklore
+living in docstrings.  This registry IS that declaration: one entry per
+jitted device entry point in ``ops/``, naming the op, the batch axis
+position of its batched arguments, and whether the program reduces over
+the batch axis (in which case the sharded lowering completes its
+batch-global sums through XLA-inserted ``psum``\\ s and the supervisor
+must never split the batch — see ``device_supervisor.NO_SPLIT_OPS``).
 
 Consumed three ways:
 
@@ -17,16 +18,22 @@ Consumed three ways:
   of ``lighthouse_tpu``) and fails when a jitted entry point in ``ops/`` is
   missing here, or when code inside a registered entry folds the batch
   axis into limb axes;
-- the future mesh-sharding layer builds its ``PartitionSpec``\\ s from
-  ``batch_axis``/``reduces_over_batch`` instead of hand-maintaining them;
+- ``device_mesh.ShardedEntry`` — the consumer this registry was written
+  for — derives each entry's ``NamedSharding``/``PartitionSpec``\\ s
+  mechanically from ``batch_axis``/``batched_args``/``replicated_args``/
+  ``out_batched`` instead of hand-maintaining them;
 - the HLO budget auditor (``scripts/analysis/hlo_budget.py``) keys its
-  per-(op, bucket) StableHLO budgets on the ``op`` names declared here.
+  per-(op, backend, bucket, mesh) StableHLO budgets on the ``op`` names
+  declared here.
 
 Keys are ``"<repo-relative path>:<function name>"``.  ``batch_axis`` is the
 axis of every *batched* argument that a mesh shards (non-batched arguments
-are listed under ``replicated_args`` — broadcast to every device).  This
-module must stay a plain dict literal with no imports: the static pass
-parses it, never imports it.
+are listed under ``replicated_args`` — broadcast to every device).
+``out_batched`` declares whether the program's OUTPUTS keep the batch axis
+(sharded over the mesh) or are batch-reductions (replicated) — bls_verify
+reduces to one pairing value even though it is splittable, so this cannot
+be inferred from ``reduces_over_batch``.  This module must stay a plain
+dict literal with no imports: the static pass parses it, never imports it.
 """
 
 #: sharding-readiness contract per jitted device entry point (see module
@@ -38,6 +45,7 @@ BATCH_AXES = {
         "batched_args": ["pk", "sig", "msg", "wbits", "live"],
         "replicated_args": [],
         "reduces_over_batch": False,
+        "out_batched": False,
         "notes": "per-set pairing rows; the N+1'th (-g1, W) pair is "
                  "assembled inside the program from a batch-wide MSM — a "
                  "sharded lowering psums the MSM then replicates the pair",
@@ -48,6 +56,7 @@ BATCH_AXES = {
         "batched_args": ["words"],
         "replicated_args": [],
         "reduces_over_batch": False,
+        "out_batched": True,
         "notes": "embarrassingly parallel over 64-byte blocks",
     },
     "lighthouse_tpu/ops/epoch_device.py:_deltas_kernel": {
@@ -63,6 +72,7 @@ BATCH_AXES = {
             "inactivity_score_recovery_rate", "quotient",
         ],
         "reduces_over_batch": True,
+        "out_batched": True,
         "notes": "participating-increment sums span the whole registry "
                  "(NO_SPLIT_OPS); sharding needs a psum per flag index",
     },
@@ -72,6 +82,7 @@ BATCH_AXES = {
         "batched_args": ["c", "p", "r_bits", "rz_bits"],
         "replicated_args": ["ry_bits", "tau", "g2gen"],
         "reduces_over_batch": True,
+        "out_batched": False,
         "notes": "tree-sum lincombs reduce the blob axis into one "
                  "2-pairing; sharding needs a collective point-sum",
     },
@@ -81,6 +92,7 @@ BATCH_AXES = {
         "batched_args": ["a8p", "b8p"],
         "replicated_args": [],
         "reduces_over_batch": False,
+        "out_batched": True,
         "notes": "bench-only opt-in kernel; tiles of 128 rows",
     },
     "lighthouse_tpu/ops/pallas_fq.py:_fq2_mul_pallas_flat": {
@@ -89,6 +101,7 @@ BATCH_AXES = {
         "batched_args": ["operands"],
         "replicated_args": [],
         "reduces_over_batch": False,
+        "out_batched": True,
         "notes": "bench-only opt-in kernel; tiles of 128 rows",
     },
 }

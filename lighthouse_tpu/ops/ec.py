@@ -215,7 +215,17 @@ def msm_windowed(ops: FieldOps, pts, bits, window: int = 4):
     per-set double-and-add followed by a tree-sum.  Rolled as a
     ``lax.fori_loop`` for compile-time economy."""
     n = pts[0].shape[0]
-    assert n & (n - 1) == 0, "msm_windowed requires power-of-two batch"
+    if n & (n - 1):
+        # Non-power-of-two batches arise only from mesh-divisibility padding
+        # (a shrunk mesh of e.g. 7 devices pads 128 -> 133 rows): pad to the
+        # next power of two with identity points + zero scalars — exact
+        # neutral contributions, and power-of-two inputs keep the original
+        # lowering untouched.
+        pts = _pad_identity_rows(ops, pts, 0, n)
+        pad = pts[0].shape[0] - n
+        bits = jnp.concatenate(
+            [bits, jnp.zeros((pad,) + bits.shape[1:], bits.dtype)], axis=0
+        )
     digits = _window_digits(bits, window)  # (N, S)
     table = _window_table(ops, pts, window)  # (2^w, N, ...)
     steps = digits.shape[-1]
@@ -231,14 +241,32 @@ def msm_windowed(ops: FieldOps, pts, bits, window: int = 4):
     return jax.lax.fori_loop(0, steps, body, acc0)
 
 
+def _pad_identity_rows(ops: FieldOps, pts, axis: int, n: int):
+    """Grow ``axis`` from ``n`` to the next power of two with identity
+    points (the group's exact neutral element — complete formulas absorb
+    them with no special-casing)."""
+    m = 1 << (n - 1).bit_length()
+    ident = identity(ops)
+    out = []
+    for c, idc in zip(pts, ident):
+        shape = list(c.shape)
+        shape[axis] = m - n
+        pad_block = jnp.broadcast_to(idc, tuple(shape))
+        out.append(jnp.concatenate([c, pad_block], axis=axis))
+    return tuple(out)
+
+
 def tree_sum(ops: FieldOps, pts, axis: int = 0):
     """Sum points along a batch axis by halving rounds of complete additions.
 
-    The axis length must be a power of two (pad with the identity); this is the
-    TPU analog of the reference's rayon reduce over aggregated pubkeys.
+    Power-of-two lengths take the original halving schedule untouched;
+    other lengths (mesh-divisibility padding, e.g. 133 rows on a 7-device
+    mesh) first pad with identity rows — exact neutral elements.
     """
     n = pts[0].shape[axis]
-    assert n & (n - 1) == 0, "tree_sum requires power-of-two length"
+    if n & (n - 1):
+        pts = _pad_identity_rows(ops, pts, axis, n)
+        n = pts[0].shape[axis]
     while n > 1:
         half = n // 2
 

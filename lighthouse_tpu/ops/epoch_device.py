@@ -114,6 +114,32 @@ def _deltas_kernel(
     return new_inactivity, rewards - penalties
 
 
+#: device_mesh.ShardedEntry for the epoch kernel (lazy).  The kernel's
+#: registry-wide participating-increment sums lower through XLA-inserted
+#: psums on the mesh — which is exactly why the op sits in
+#: ``device_supervisor.NO_SPLIT_OPS``.
+_SHARDED_ENTRY = None
+
+ENTRY_KEY = "lighthouse_tpu/ops/epoch_device.py:_deltas_kernel"
+
+#: Epoch far beyond any reachable epoch: mesh-pad rows use it as their
+#: activation epoch so they are never active/eligible and contribute
+#: exactly zero to every registry-wide sum.
+_PAD_ACTIVATION_EPOCH = 1 << 62
+
+
+def _sharded_entry():
+    global _SHARDED_ENTRY
+    if _SHARDED_ENTRY is None:
+        from .. import device_mesh
+
+        _SHARDED_ENTRY = device_mesh.ShardedEntry(
+            ENTRY_KEY, _deltas_kernel.__wrapped__,
+            static_argnames=("in_leak",),
+        )
+    return _SHARDED_ENTRY
+
+
 def epoch_deltas_device(
     arrays,
     prev_part: np.ndarray,
@@ -127,54 +153,82 @@ def epoch_deltas_device(
     spec,
 ):
     """numpy in, numpy out — the device analog of the per_epoch numpy block.
-    Returns ``(new_inactivity, balance_delta)`` (int64 arrays)."""
+    Returns ``(new_inactivity, balance_delta)`` (int64 arrays).
+
+    Mesh on: the registry pads to a multiple of the mesh size with
+    never-active rows (far-future activation — ineligible for every flag
+    mask, so the participating-increment psums are untouched), the batched
+    arrays shard over ``("dp",)`` and the scalars replicate; the pad rows
+    are sliced back off the outputs."""
     import time as _time
 
     from jax.experimental import enable_x64
 
-    from .. import device_telemetry, fault_injection
+    from .. import device_mesh, device_telemetry, fault_injection
 
     # One executable per (validator-count, in_leak) pair — in_leak is a
     # static argument, so it forks the compiled program like a shape does.
     op = "epoch_deltas_leak" if in_leak else "epoch_deltas"
     n = int(np.asarray(arrays.effective_balance).shape[0])
+    mesh = device_mesh.size() if device_mesh.enabled() else 0
+    np_ = device_mesh.pad_rows(n) if mesh else n
     if fault_injection.ACTIVE:
-        if not device_telemetry.COMPILE_CACHE.seen(op, (n,)):
+        if not device_telemetry.COMPILE_CACHE.seen(op, (np_,), mesh=mesh):
             fault_injection.check("device.compile", op=op)
         fault_injection.check("device.dispatch", op=op)
     with enable_x64():
-        t_dispatch = _time.perf_counter()
-        out = _deltas_kernel(
-            jnp.asarray(arrays.effective_balance, dtype=jnp.int64),
-            jnp.asarray(arrays.activation_epoch, dtype=jnp.int64),
-            jnp.asarray(arrays.exit_epoch, dtype=jnp.int64),
-            jnp.asarray(arrays.withdrawable_epoch, dtype=jnp.int64),
-            jnp.asarray(arrays.slashed),
-            jnp.asarray(prev_part, dtype=jnp.int64),
-            jnp.asarray(inactivity, dtype=jnp.int64),
-            jnp.int64(previous_epoch),
-            jnp.int64(base_reward_per_increment),
-            jnp.int64(total_active_balance),
-            jnp.int64(spec.effective_balance_increment),
-            jnp.int64(spec.inactivity_score_bias),
-            jnp.int64(spec.inactivity_score_recovery_rate),
-            jnp.int64(quotient),
-            in_leak=bool(in_leak),
+        batched = (
+            np.asarray(arrays.effective_balance, dtype=np.int64),
+            np.asarray(arrays.activation_epoch, dtype=np.int64),
+            np.asarray(arrays.exit_epoch, dtype=np.int64),
+            np.asarray(arrays.withdrawable_epoch, dtype=np.int64),
+            np.asarray(arrays.slashed, dtype=bool),
+            np.asarray(prev_part, dtype=np.int64),
+            np.asarray(inactivity, dtype=np.int64),
         )
+        scalars = (
+            previous_epoch, base_reward_per_increment, total_active_balance,
+            spec.effective_balance_increment, spec.inactivity_score_bias,
+            spec.inactivity_score_recovery_rate, quotient,
+        )
+        t_dispatch = _time.perf_counter()
+        if mesh:
+            if np_ != n:
+                fills = (0, _PAD_ACTIVATION_EPOCH, 0, 0, False, 0, 0)
+                batched = tuple(
+                    device_mesh.grow_rows(a, np_, f)
+                    for a, f in zip(batched, fills)
+                )
+            entry = _sharded_entry()
+            placed = entry.place(
+                *batched, *(jnp.int64(s) for s in scalars)
+            )
+            out = entry(*placed, in_leak=bool(in_leak))
+        else:
+            # recompile-hazard: ok(one executable per registry size; stable across epochs)
+            out = _deltas_kernel(
+                *(jnp.asarray(a) for a in batched),
+                *(jnp.int64(s) for s in scalars),
+                in_leak=bool(in_leak),
+            )
         dispatch_s = _time.perf_counter() - t_dispatch
-        compiled = device_telemetry.note_dispatch(op, (n,), dispatch_s)
+        compiled = device_telemetry.note_dispatch(op, (np_,), dispatch_s,
+                                                 mesh=mesh)
         t_wait = _time.perf_counter()
         new_inactivity, balance_delta = jax.device_get(out)
     device_telemetry.record_batch(
         op=op,
-        shape=(n,),
+        shape=(np_,),
         n_live=n,
         stages={"dispatch": dispatch_s,
                 "wait": _time.perf_counter() - t_wait},
         trace_id=device_telemetry.active_trace_id(),
         compiled=compiled,
+        mesh=mesh,
+        shard_live=(_sharded_entry().shard_live_counts(n, np_)
+                    if mesh else None),
     )
     return (
-        np.asarray(new_inactivity, dtype=np.int64),
-        np.asarray(balance_delta, dtype=np.int64),
+        np.asarray(new_inactivity[:n], dtype=np.int64),
+        np.asarray(balance_delta[:n], dtype=np.int64),
     )

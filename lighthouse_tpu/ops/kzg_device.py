@@ -67,6 +67,26 @@ def _bucket(n: int) -> int:
     raise ValueError(f"kzg batch of {n} exceeds max bucket {N_BUCKETS[-1]}")
 
 
+#: device_mesh.ShardedEntry for the kzg program (lazy).  The blob-axis
+#: tree-sum lincombs reduce across the mesh through XLA-inserted psums —
+#: the ``reduces_over_batch`` op the registry note promised, and why it
+#: sits in ``device_supervisor.NO_SPLIT_OPS``.
+_SHARDED_ENTRY = None
+
+ENTRY_KEY = "lighthouse_tpu/ops/kzg_device.py:_device_kzg_batch"
+
+
+def _sharded_entry():
+    global _SHARDED_ENTRY
+    if _SHARDED_ENTRY is None:
+        from .. import device_mesh
+
+        _SHARDED_ENTRY = device_mesh.ShardedEntry(
+            ENTRY_KEY, _device_kzg_batch.__wrapped__
+        )
+    return _SHARDED_ENTRY
+
+
 def _build_kzg_batch(c_pts, p_pts, r_powers, zs, ys, g2_tau, nb: int):
     """Host-side marshalling (limb packing, scalar-bit expansion) into
     padded device arrays — no device work beyond the uploads."""
@@ -95,14 +115,27 @@ def _build_kzg_batch(c_pts, p_pts, r_powers, zs, ys, g2_tau, nb: int):
         np.asarray(ec.G2_GEN_LIMBS[0]),
         np.asarray(ec.G2_GEN_LIMBS[1]),
     )
+    host = (
+        tuple(c), tuple(p), r_bits, rz_bits,
+        np.asarray(ry_bits),
+        tuple(np.asarray(a) for a in tau),
+        tuple(np.asarray(a) for a in g2gen),
+    )
+    from .. import device_mesh
+
+    if device_mesh.enabled():
+        # nb was already padded to a multiple of the mesh by the caller;
+        # the identity-point + zero-scalar pad rows contribute exact
+        # neutral elements to the psum'd lincombs.
+        return _sharded_entry().place(*host)
     return (
-        tuple(jnp.asarray(a) for a in c),
-        tuple(jnp.asarray(a) for a in p),
-        jnp.asarray(r_bits),
-        jnp.asarray(rz_bits),
-        jnp.asarray(ry_bits),
-        tuple(jnp.asarray(a) for a in tau),
-        tuple(jnp.asarray(a) for a in g2gen),
+        tuple(jnp.asarray(a) for a in host[0]),
+        tuple(jnp.asarray(a) for a in host[1]),
+        jnp.asarray(host[2]),
+        jnp.asarray(host[3]),
+        jnp.asarray(host[4]),
+        tuple(jnp.asarray(a) for a in host[5]),
+        tuple(jnp.asarray(a) for a in host[6]),
     )
 
 
@@ -123,11 +156,10 @@ def verify_kzg_proof_batch_device(
     host MSM golden model in ``crypto/kzg/kzg.py``) under the one shared
     breaker/fallback mechanism.  With ``host_fn=None`` failures propagate.
     """
-    from .. import device_supervisor, device_telemetry, fault_injection
+    from .. import device_mesh, device_supervisor, device_telemetry, fault_injection
 
     n = len(c_pts)
     assert n == len(p_pts) == len(r_powers) == len(zs) == len(ys)
-    nb = _bucket(max(1, n))
     holder: dict = {}
 
     def device_fn() -> bool:
@@ -139,20 +171,32 @@ def verify_kzg_proof_batch_device(
             # Marshalling (and its host→device uploads) happens INSIDE the
             # supervised leg: an OPEN breaker must not touch the device at
             # all, and a transfer raising on a dead device resolves through
-            # the host fallback like any other device failure.
+            # the host fallback like any other device failure.  Bucket and
+            # mesh pad are (re)computed here too, so a supervisor reshard
+            # retry re-places under the surviving topology.
             t_setup = _time.perf_counter()
+            mesh = device_mesh.size() if device_mesh.enabled() else 0
+            nb = _bucket(max(1, n))
+            if mesh:
+                nb = device_mesh.pad_rows(nb)
+            state_local["mesh"], state_local["nb"] = mesh, nb
             batch = _build_kzg_batch(c_pts, p_pts, r_powers, zs, ys,
                                      g2_tau, nb)
             stages_local["setup"] = _time.perf_counter() - t_setup
             if fault_injection.ACTIVE:
-                if not device_telemetry.COMPILE_CACHE.seen("kzg_batch", (nb,)):
+                if not device_telemetry.COMPILE_CACHE.seen("kzg_batch", (nb,),
+                                                           mesh=mesh):
                     fault_injection.check("device.compile", op="kzg_batch")
                 fault_injection.check("device.dispatch", op="kzg_batch")
             t_dispatch = _time.perf_counter()
-            fe = _device_kzg_batch(*batch)
+            if mesh:
+                fe = _sharded_entry()(*batch)
+            else:
+                fe = _device_kzg_batch(*batch)
             dispatch_s = _time.perf_counter() - t_dispatch
             stages_local["dispatch"] = dispatch_s
-            if device_telemetry.note_dispatch("kzg_batch", (nb,), dispatch_s):
+            if device_telemetry.note_dispatch("kzg_batch", (nb,), dispatch_s,
+                                              mesh=mesh):
                 state_local["compiled"] = True
             t_wait = _time.perf_counter()
             jax.block_until_ready(fe)
@@ -172,9 +216,13 @@ def verify_kzg_proof_batch_device(
     reason = info.get("fallback_reason")
     stages: dict = {}
     compiled = False
+    state: dict = {}
     if reason != "dispatch_timeout":
         stages = holder.get("stages") or {}
-        compiled = (holder.get("state") or {}).get("compiled", False)
+        state = holder.get("state") or {}
+        compiled = state.get("compiled", False)
+    mesh = state.get("mesh", 0)
+    nb = state.get("nb", _bucket(max(1, n)))
     device_telemetry.record_batch(
         op="kzg_batch",
         shape=(nb,),
@@ -187,5 +235,8 @@ def verify_kzg_proof_batch_device(
         compiled=compiled,
         breaker_state=info.get("breaker_state"),
         dispatched=reason != "breaker_open",
+        mesh=mesh,
+        shard_live=(_sharded_entry().shard_live_counts(n, nb)
+                    if mesh else None),
     )
     return bool(ok)

@@ -50,6 +50,23 @@ _PAD_WORDS[15] = 512
 N_BUCKETS = (256, 1024, 4096, 16384, 65536, 262144)
 
 
+#: device_mesh.ShardedEntry for the pair-hash kernel (lazy).
+_SHARDED_ENTRY = None
+
+ENTRY_KEY = "lighthouse_tpu/ops/sha256_device.py:_sha256_64byte_batch"
+
+
+def _sharded_entry():
+    global _SHARDED_ENTRY
+    if _SHARDED_ENTRY is None:
+        from .. import device_mesh
+
+        _SHARDED_ENTRY = device_mesh.ShardedEntry(
+            ENTRY_KEY, _sha256_64byte_batch.__wrapped__
+        )
+    return _SHARDED_ENTRY
+
+
 def _rotr(x, n):
     return (x >> n) | (x << (32 - n))
 
@@ -160,20 +177,38 @@ def _host_hash_pairs(data: bytes) -> bytes:
 
 def _dispatch_batch(words: np.ndarray, nb: int, stages: dict,
                     state: dict) -> np.ndarray:
-    """Dispatch + wait on the supervisor's watchdog worker."""
+    """Dispatch + wait on the supervisor's watchdog worker.
+
+    Mesh on: the word block pads to a multiple of the mesh size, uploads
+    through the mesh placer and runs the sharded lowering (every 64-byte
+    block is independent — pure data parallelism, no collectives); mesh
+    off: the original single-device dispatch, untouched."""
     import time as _time
 
-    from .. import device_telemetry, fault_injection
+    from .. import device_mesh, device_telemetry, fault_injection
 
+    mesh = 0
+    if device_mesh.enabled():
+        mesh = device_mesh.size()
+        nbp = device_mesh.pad_rows(nb)
+        words, nb = device_mesh.grow_rows(words, nbp, 0), nbp
+        state["mesh"], state["nb"] = mesh, nb
+        (placed,) = _sharded_entry().place(words)
     if fault_injection.ACTIVE:
-        if not device_telemetry.COMPILE_CACHE.seen("sha256_pairs", (nb,)):
+        if not device_telemetry.COMPILE_CACHE.seen("sha256_pairs", (nb,),
+                                                   mesh=mesh):
             fault_injection.check("device.compile", op="sha256_pairs")
         fault_injection.check("device.dispatch", op="sha256_pairs")
     t_dispatch = _time.perf_counter()
-    dev_out = _sha256_64byte_batch(jnp.asarray(words))
+    if mesh:
+        dev_out = _sharded_entry()(placed)
+    else:
+        # recompile-hazard: ok(nb is bucket-quantized; the mesh branch above only pads to the mesh multiple)
+        dev_out = _sha256_64byte_batch(jnp.asarray(words))
     dispatch_s = _time.perf_counter() - t_dispatch
     stages["dispatch"] = dispatch_s
-    if device_telemetry.note_dispatch("sha256_pairs", (nb,), dispatch_s):
+    if device_telemetry.note_dispatch("sha256_pairs", (nb,), dispatch_s,
+                                      mesh=mesh):
         state["compiled"] = True
     t_wait = _time.perf_counter()
     out = np.asarray(dev_out)
@@ -249,12 +284,16 @@ def hash_pairs_device(data: bytes) -> bytes:
     reason = info.get("fallback_reason")
     stages: dict = {}
     compiled = False
+    state: dict = {}
     if reason != "dispatch_timeout":
         stages = holder.get("stages") or {}
-        compiled = (holder.get("state") or {}).get("compiled", False)
+        state = holder.get("state") or {}
+        compiled = state.get("compiled", False)
+    mesh = state.get("mesh", 0)
+    nbp = state.get("nb", nb)  # mesh-divisibility pad, if any
     device_telemetry.record_batch(
         op="sha256_pairs",
-        shape=(nb,),
+        shape=(nbp,),
         n_live=n,
         stages=stages or None,
         host_fallback=info.get("route") == "host",
@@ -263,5 +302,8 @@ def hash_pairs_device(data: bytes) -> bytes:
         compiled=compiled,
         breaker_state=info.get("breaker_state"),
         dispatched=reason != "breaker_open",
+        mesh=mesh,
+        shard_live=(_sharded_entry().shard_live_counts(n, nbp)
+                    if mesh else None),
     )
     return out_bytes

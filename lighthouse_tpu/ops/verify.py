@@ -116,8 +116,83 @@ def _hash_to_g2_cached(message: bytes):
     return pt
 
 
+#: device_mesh.ShardedEntry for the verifier (lazy: the registry-derived
+#: specs and the per-topology jitted wrapper live in device_mesh).
+_SHARDED_ENTRY = None
+
+ENTRY_KEY = "lighthouse_tpu/ops/verify.py:_device_verify"
+
+
+def _sharded_entry():
+    global _SHARDED_ENTRY
+    if _SHARDED_ENTRY is None:
+        from .. import device_mesh
+
+        _SHARDED_ENTRY = device_mesh.ShardedEntry(
+            ENTRY_KEY, _device_verify.__wrapped__
+        )
+    return _SHARDED_ENTRY
+
+
+def _pad_host_rows(host_batch: tuple, nbp: int) -> tuple:
+    """Grow the batch axis to ``nbp`` rows with the exact neutral padding
+    ``build_batch`` uses (identity points, generator hash slots, zero
+    weights, dead ``live`` rows) — the mesh-divisibility pad."""
+    from .. import device_mesh
+
+    pk, sig, msg, wbits, live = host_batch
+    nb = live.shape[0]
+    if nbp == nb:
+        return host_batch
+    id1, id2 = ec.g1_to_limbs(None), ec.g2_to_limbs(None)
+    grow = device_mesh.grow_rows
+    pk = tuple(grow(pk[c], nbp, id1[c]) for c in range(3))
+    sig = tuple(grow(sig[c], nbp, id2[c]) for c in range(3))
+    msg = tuple(grow(msg[c], nbp, _G2_GEN_AFF[c]) for c in range(2))
+    wbits = grow(wbits, nbp, 0)
+    live = grow(live, nbp, False)
+    return pk, sig, msg, wbits, live
+
+
+def place_batch(host_batch: tuple) -> Tuple[tuple, int, int]:
+    """Stage 1b — upload a marshalled host batch to the device(s).
+
+    Mesh on: pad the batch axis to a multiple of the mesh size and upload
+    through the mesh placer (``device_mesh.ShardedEntry.place`` — batched
+    args shard axis 0 over ``("dp",)``).  Mesh off: plain single-device
+    arrays, byte-for-byte the pre-mesh path.  Returns ``(placed_args,
+    mesh_size, topology_generation)`` so a dispatch can detect a reshard
+    that happened between placement and execution."""
+    from .. import device_mesh
+
+    # Generation is snapshotted BEFORE padding/placement (but after the
+    # lazy configure `enabled()` may trigger): a reshard landing mid-place
+    # leaves this batch tagged with the pre-reshard generation, so
+    # ensure_placed() re-places it instead of dispatching arrays sharded
+    # for a dead topology.
+    meshed = device_mesh.enabled()
+    gen = device_mesh.generation()
+    if meshed:
+        entry = _sharded_entry()
+        nbp = device_mesh.pad_rows(int(host_batch[4].shape[0]))
+        placed = entry.place(*_pad_host_rows(host_batch, nbp))
+        return placed, device_mesh.size(), gen
+    pk, sig, msg, wbits, live = host_batch
+    placed = (
+        tuple(jnp.asarray(a) for a in pk),
+        tuple(jnp.asarray(a) for a in sig),
+        tuple(jnp.asarray(a) for a in msg),
+        jnp.asarray(wbits),
+        jnp.asarray(live),
+    )
+    return placed, 0, gen
+
+
 def build_batch(sets, rands) -> Optional[tuple]:
-    """Validate + marshal signature sets into padded device arrays.
+    """Validate + marshal signature sets into padded HOST arrays (numpy,
+    bucket-shaped).  Placement — single-device or mesh-sharded — is
+    :func:`place_batch`; jit accepts the numpy arrays directly, so callers
+    that dispatch these straight into ``_device_verify`` still work.
 
     Returns None if host-side validation already decides False.
     """
@@ -158,16 +233,10 @@ def build_batch(sets, rands) -> Optional[tuple]:
         wbits[i] = ec.bits_msb(r, 64)
         live[i] = True
 
-    return (
-        tuple(jnp.asarray(a) for a in pk),
-        tuple(jnp.asarray(a) for a in sig),
-        tuple(jnp.asarray(a) for a in msg),
-        jnp.asarray(wbits),
-        jnp.asarray(live),
-    )
+    return tuple(pk), tuple(sig), tuple(msg), wbits, live
 
 
-def _device_batch_verdict(batch, nb: int, kb: int, stages: dict,
+def _device_batch_verdict(built: "BuiltBatch", stages: dict,
                           state: dict) -> bool:
     """Dispatch + block-until-ready + verdict for one marshalled batch.
 
@@ -180,19 +249,25 @@ def _device_batch_verdict(batch, nb: int, kb: int, stages: dict,
     """
     from .. import device_supervisor, device_telemetry, fault_injection, metrics, tracing
 
+    built.ensure_placed()  # a reshard since placement re-pads + re-uploads
+    batch, nb, kb, mesh = built.batch, built.nb, built.kb, built.mesh
     if fault_injection.ACTIVE:
-        if not device_telemetry.COMPILE_CACHE.seen("bls_verify", (nb, kb)):
+        if not device_telemetry.COMPILE_CACHE.seen("bls_verify", (nb, kb),
+                                                   mesh=mesh):
             fault_injection.check("device.compile", op="bls_verify")
         fault_injection.check("device.dispatch", op="bls_verify")
     with tracing.span(
         "device_batch_dispatch", hist=metrics.DEVICE_DISPATCH_SECONDS,
-        n_bucket=nb, k_bucket=kb,
+        n_bucket=nb, k_bucket=kb, mesh=mesh,
     ) as sp_dispatch:
-        fe, w_z = _device_verify(*batch)
+        if mesh:
+            fe, w_z = _sharded_entry()(*batch)
+        else:
+            fe, w_z = _device_verify(*batch)
     # First dispatch of a shape pays trace+compile inside the call itself:
     # the dispatch duration IS the compile-time observation for that shape.
     compiled = device_telemetry.note_dispatch(
-        "bls_verify", (nb, kb), sp_dispatch.duration
+        "bls_verify", (nb, kb), sp_dispatch.duration, mesh=mesh
     )
     if compiled:
         sp_dispatch.fields["compiled"] = True
@@ -235,18 +310,22 @@ def _device_verify_subset(subset, seed: Optional[bytes]) -> bool:
     from .. import device_supervisor, device_telemetry, fault_injection
 
     rands = _rand_scalars(len(subset), seed)
-    batch = build_batch(subset, rands)
-    if batch is None:
+    host_batch = build_batch(subset, rands)
+    if host_batch is None:
         return False
+    batch, mesh, _ = place_batch(host_batch)
     nb, kb = int(batch[0][0].shape[0]), int(batch[0][0].shape[1])
     if fault_injection.ACTIVE:
         fault_injection.check("device.dispatch", op="bls_verify")
     import time as _time
 
     t0 = _time.perf_counter()
-    fe, w_z = _device_verify(*batch)
+    if mesh:
+        fe, w_z = _sharded_entry()(*batch)
+    else:
+        fe, w_z = _device_verify(*batch)
     device_telemetry.note_dispatch(
-        "bls_verify", (nb, kb), _time.perf_counter() - t0
+        "bls_verify", (nb, kb), _time.perf_counter() - t0, mesh=mesh
     )
     jax.block_until_ready((fe, w_z))
     if tower.fq2_from_limbs(np.asarray(w_z)).is_zero():
@@ -262,18 +341,36 @@ class BuiltBatch:
     (its builder thread calls :func:`build_device_batch`) with the in-flight
     device execution of batch N (its executor thread calls
     :func:`execute_built_batch`).  ``verify_signature_sets_device`` is the
-    two stages run back-to-back — the direct, non-pipelined path."""
+    two stages run back-to-back — the direct, non-pipelined path.
 
-    __slots__ = ("sets", "seed", "batch", "nb", "kb", "live_keys", "setup_s")
+    The HOST arrays are retained next to the placed ones: a mesh reshard
+    between build and dispatch (a per-device breaker trip) invalidates the
+    placement — shards on a removed device, a batch-axis pad for the wrong
+    mesh size — and :meth:`ensure_placed` re-pads + re-uploads from the
+    host copy under the surviving topology."""
 
-    def __init__(self, sets, seed, batch, setup_s: float):
+    __slots__ = ("sets", "seed", "host", "batch", "nb", "kb", "mesh",
+                 "generation", "live_keys", "setup_s")
+
+    def __init__(self, sets, seed, host_batch, setup_s: float):
         self.sets = sets
         self.seed = seed
-        self.batch = batch
-        self.nb = int(batch[0][0].shape[0])
-        self.kb = int(batch[0][0].shape[1])
+        self.host = host_batch
+        self.batch, self.mesh, self.generation = place_batch(host_batch)
+        self.nb = int(self.batch[0][0].shape[0])
+        self.kb = int(self.batch[0][0].shape[1])
         self.live_keys = sum(len(s.signing_keys) for s in sets)
         self.setup_s = setup_s
+
+    def ensure_placed(self) -> None:
+        """Re-place after any topology change since the last placement
+        (mesh enabled/disabled/resharded — all bump the generation)."""
+        from .. import device_mesh
+
+        if device_mesh.generation() != self.generation:
+            self.batch, self.mesh, self.generation = place_batch(self.host)
+            self.nb = int(self.batch[0][0].shape[0])
+            self.kb = int(self.batch[0][0].shape[1])
 
 
 def build_device_batch(sets, seed: Optional[bytes] = None) -> Optional[BuiltBatch]:
@@ -295,10 +392,15 @@ def build_device_batch(sets, seed: Optional[bytes] = None) -> Optional[BuiltBatc
         n_sets=len(sets),
     ) as sp_setup:
         rands = _rand_scalars(len(sets), seed)
-        batch = build_batch(sets, rands)
-    if batch is None:
-        return None
-    return BuiltBatch(sets, seed, batch, sp_setup.duration)
+        host_batch = build_batch(sets, rands)
+        if host_batch is None:
+            return None
+        # Placement (the mesh-pad + sharded upload, or the plain
+        # single-device upload) is part of the build stage so the pipeline
+        # overlaps it with the in-flight batch like the rest of setup.
+        built = BuiltBatch(sets, seed, host_batch, 0.0)
+    built.setup_s = sp_setup.duration
+    return built
 
 
 def execute_built_batch(built: BuiltBatch, *, n_groups: int = 1,
@@ -313,7 +415,6 @@ def execute_built_batch(built: BuiltBatch, *, n_groups: int = 1,
     from ..crypto.bls.backends import host
 
     sets, seed = built.sets, built.seed
-    batch, nb, kb = built.batch, built.nb, built.kb
     stages = {"setup": built.setup_s}
     # The watchdog worker writes stage durations into dicts IT owns and
     # publishes them via this one-slot holder when the device fn finishes.
@@ -326,7 +427,7 @@ def execute_built_batch(built: BuiltBatch, *, n_groups: int = 1,
         stages_local: dict = {}
         state_local = {"compiled": False}
         try:
-            return _device_batch_verdict(batch, nb, kb, stages_local, state_local)
+            return _device_batch_verdict(built, stages_local, state_local)
         finally:
             holder["stages"] = stages_local
             holder["state"] = state_local
@@ -355,9 +456,16 @@ def execute_built_batch(built: BuiltBatch, *, n_groups: int = 1,
     if reason != "dispatch_timeout":
         stages.update(holder.get("stages") or {})
         compiled = (holder.get("state") or {}).get("compiled", False)
+    # built.nb/mesh read AFTER the run: a mid-run reshard re-placed the
+    # batch, and the record must describe the topology that executed.
+    mesh = built.mesh
+    shard_live = (
+        _sharded_entry().shard_live_counts(len(sets), built.nb)
+        if mesh else None
+    )
     rec = device_telemetry.record_batch(
         op="bls_verify",
-        shape=(nb, kb),
+        shape=(built.nb, built.kb),
         n_live=len(sets),
         live_keys=built.live_keys,
         n_groups=n_groups,
@@ -372,6 +480,8 @@ def execute_built_batch(built: BuiltBatch, *, n_groups: int = 1,
         # breaker-OPEN batches never reached the device: keep them out of
         # the occupancy/wasted-lane tuning data.
         dispatched=reason != "breaker_open",
+        mesh=mesh,
+        shard_live=shard_live,
     )
     # Reverse link: the enclosing span (device_verify when routed through
     # the backend) carries the flight-recorder seq of this batch.

@@ -166,6 +166,7 @@ class ScenarioRunner:
         self._saved_host_impl = None
         self._breakers_touched = False
         self._pipeline_enabled = False
+        self._mesh_touched = False
         self._spam_endpoints: List[str] = []
 
     # ------------------------------------------------------------ helpers
@@ -332,6 +333,42 @@ class ScenarioRunner:
             device_pipeline.enable()
         else:
             device_pipeline.disable()
+
+    def _ev_device_mesh(self, enable: bool, spec: str = "auto") -> None:
+        """Shard the bucketed device ops over the data-parallel mesh
+        (device_mesh.py).  Sharded and single-device programs produce
+        identical bytes, so enabling the mesh never changes chain content —
+        the determinism gate covers exactly that.  Records whether a real
+        mesh came up (``ctx["mesh_enabled"]``): on a 1-device interpreter
+        the fallback is transparent, and the extra check fails loudly
+        rather than passing vacuously."""
+        from . import device_mesh
+
+        self._mesh_touched = True
+        if enable:
+            size = device_mesh.configure(spec)
+            self.ctx["mesh_enabled"] = size >= 2
+            self.ctx["mesh_size"] = size
+        else:
+            device_mesh.reset_for_tests()
+
+    def _ev_mesh_trip_device(self, device: int) -> None:
+        """Kill one mesh device mid-scenario: its breaker trips, the mesh
+        re-shards over the survivors, and every subsequent sharded dispatch
+        runs on the shrunk topology.  The full-strength evidence is
+        snapshotted HERE — the flight recorder is a bounded ring, and the
+        post-trip sync traffic would evict the pre-trip records before the
+        end-of-run check reads them."""
+        from . import device_mesh, device_telemetry
+
+        self.ctx["meshes_before_trip"] = sorted({
+            r["mesh"]
+            for r in device_telemetry.FLIGHT_RECORDER.recent(
+                limit=device_telemetry.FLIGHT_RECORDER.capacity)
+            if r.get("mesh")
+        })
+        self.ctx["mesh_tripped"] = device_mesh.force_trip(
+            int(device), reason="scenario_kill")
 
     def _ev_device_hashing(self, enable: bool, threshold_blocks: int = 4) -> None:
         """Route Merkle pair-hash layers of ``threshold_blocks``+ through
@@ -631,6 +668,10 @@ class ScenarioRunner:
 
     def _cleanup(self) -> None:
         fault_injection.clear()
+        if self._mesh_touched:
+            from . import device_mesh
+
+            device_mesh.reset_for_tests()
         if self._pipeline_enabled:
             from . import device_pipeline
 
@@ -742,6 +783,33 @@ def device_breaker_mid_sync(seed: int = 0) -> Scenario:
             Event(4, "device_hashing", {"enable": False}),
         ),
         extra_checks=_check_breaker_tripped,
+    )
+
+
+def mesh_degradation(seed: int = 0) -> Scenario:
+    """A device dies mid-sync and the mesh re-shards around it: the fleet
+    runs Merkle pair-hashing on the 8-device mesh (sha256_pairs sharded
+    over ``("dp",)``), a joining node range-syncs through it, and one mesh
+    device is killed mid-window — its per-device breaker trips, the mesh
+    re-shards to 7 survivors, and every later sharded dispatch runs on the
+    shrunk topology with identical bytes.  Gates: the fleet still
+    converges + finalizes (standard), the re-shard really happened, and
+    sharded work really flowed both before and after it.  Needs >= 2 jax
+    devices (the test suite's 8-device virtual CPU mesh; standalone runs
+    need ``XLA_FLAGS=--xla_force_host_platform_device_count=8``)."""
+    return Scenario(
+        name="mesh_degradation",
+        description="device killed mid-sync: mesh re-shards, fleet converges",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=32, fault_slots=8, recovery_slots=24,
+        events=(
+            Event(0, "device_mesh", {"enable": True}),
+            Event(0, "device_hashing", {"enable": True}),
+            Event(1, "join_checkpoint", {"anchor_from": 0}),
+            Event(2, "mesh_trip_device", {"device": 7}),
+            Event(6, "device_hashing", {"enable": False}),
+        ),
+        extra_checks=_check_mesh_resharded,
     )
 
 
@@ -961,6 +1029,41 @@ def _check_breaker_tripped(runner: ScenarioRunner) -> dict:
     return {"breaker": snapshot}
 
 
+def _check_mesh_resharded(runner: ScenarioRunner) -> dict:
+    """The mesh really came up, the killed device really left it, and
+    sharded dispatches ran on BOTH topologies (8 before the trip, 7
+    after) — otherwise the scenario proved nothing about degradation."""
+    from . import device_mesh, device_telemetry
+
+    assert runner.ctx.get("mesh_enabled"), (
+        "no device mesh came up — run under "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    assert runner.ctx.get("mesh_tripped"), "the kill event never tripped"
+    snap = device_mesh.summary()
+    assert snap["reshards_total"] >= 1, "mesh never resharded"
+    # <= rather than ==: an ORGANIC per-device trip on top of the scripted
+    # kill (a watchdog timeout under gate-box load is exactly what this
+    # layer exists to absorb) must not flake the gate
+    assert snap["size"] <= runner.ctx["mesh_size"] - 1, snap
+    assert 7 not in snap["devices"], "the killed device rejoined the mesh"
+    before = runner.ctx.get("meshes_before_trip", [])
+    after = {
+        r.get("mesh") for r in device_telemetry.FLIGHT_RECORDER.recent(
+            limit=device_telemetry.FLIGHT_RECORDER.capacity, op="sha256_pairs")
+        if r.get("mesh")
+    }
+    assert runner.ctx["mesh_size"] in before, (
+        f"no sharded dispatch ran on the full mesh before the kill "
+        f"(saw {before})")
+    assert any(m < runner.ctx["mesh_size"] for m in after), (
+        f"no sharded dispatch ran on a shrunk mesh after the kill "
+        f"(saw {sorted(after)})")
+    return {"mesh": {k: snap[k] for k in
+                     ("size", "full_size", "reshards_total", "generation")},
+            "sharded_topologies_before_trip": before,
+            "sharded_topologies_after_trip": sorted(after)}
+
+
 def _check_pipeline_active(runner: ScenarioRunner) -> dict:
     """The pipeline really carried traffic AND the breaker really tripped —
     otherwise the scenario proved nothing about their interplay."""
@@ -1079,6 +1182,7 @@ SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     "nonfinality_spell": nonfinality_spell,
     "checkpoint_join_lossy": checkpoint_join_lossy,
     "device_breaker_mid_sync": device_breaker_mid_sync,
+    "mesh_degradation": mesh_degradation,
     "pipeline_mid_sync": pipeline_mid_sync,
     "spam_slow_peer": spam_slow_peer,
     "byz_double_vote_smoke": byz_double_vote_smoke,

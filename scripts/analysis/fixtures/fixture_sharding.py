@@ -66,3 +66,13 @@ def pinning_transfer(x):
 
 def placed_transfer(x, mesh_sharding):
     return jax.device_put(x, mesh_sharding)  # placed: must not flag
+
+
+def bypassing_transfer(x):
+    # SEEDED: mesh-bypass-device-put (explicit single-device pin)
+    return jax.device_put(x, device=jax.devices()[0])
+
+
+def pragmad_bypass_transfer(x):
+    # sharding-ready: ok(fixture: reviewed single-device pin)
+    return jax.device_put(x, device=jax.devices()[0])

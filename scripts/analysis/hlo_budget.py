@@ -15,8 +15,24 @@ and compares against ``scripts/analysis/hlo_budget_baseline.json``:
   inflates this long before it shows on a bench);
 - ``transpose``    — layout shuffles (a batch-axis permutation sneaking
   into a lowering is a sharding hazard *and* a copy);
-- ``collective``   — all_reduce/all_gather/etc (zero today; the budget line
-  exists so ROADMAP item 2's sharded lowerings are auditable from day one).
+- ``collective``   — all_reduce/all_gather/etc.  Non-zero ONLY for the
+  ``|dp8`` sharded keys (the mesh lowerings of bls_verify/kzg_batch — the
+  batch-wide MSM / blob-axis lincombs complete through psums); every
+  unsharded (``|-``) key stays locked at zero.  GSPMD inserts the
+  collectives during partitioning, NOT in the traced StableHLO, so mesh
+  targets count this one metric from the COMPILED module
+  (``.lower(...).compile().as_text()`` — the persistent compile cache
+  makes re-audits a deserialize); their other metrics still come from the
+  pre-partitioning StableHLO, comparable with the unsharded keys.
+
+Budget keys are ``op|backend|bucket|mesh`` — ``mesh`` is ``-`` for the
+single-device lowering and ``dpN`` for the N-way mesh-sharded one
+(in/out shardings derived from ``ops/batch_axes.py`` via
+``device_mesh.ShardedEntry``, exactly as production derives them).  Mesh
+targets need ``N`` jax devices to lower; below that the auditor SKIPS them
+(reported, not failed) and ``--update-baseline`` keeps their committed
+budgets — the full audit runs in the test suite's 8-device virtual CPU
+mesh (``tests/test_hlo_audit.py``).
 
 Unlike the AST passes this needs jax + lighthouse_tpu, so it runs from the
 test suite (``tests/test_hlo_audit.py`` gates the small tier in tier-1, the
@@ -61,6 +77,12 @@ _COLLECTIVE_RE = re.compile(
     r"|collective_broadcast)\b"
 )
 
+#: Compiled (post-GSPMD) HLO spells collectives hyphenated.
+_COMPILED_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|all-to-all|reduce-scatter|collective-permute"
+    r"|collective-broadcast)\b"
+)
+
 
 # ----------------------------------------------------------------- counting
 
@@ -87,20 +109,29 @@ def count_budget(stablehlo_text: str) -> Dict[str, int]:
 
 
 class Target:
-    """One audited (op, backend, bucket): ``build()`` returns
-    ``(fresh_callable, abstract_args)`` ready for ``jax.jit(...).lower``."""
+    """One audited (op, backend, bucket, mesh): ``build()`` returns
+    ``(fresh_callable, abstract_args)`` ready for ``jax.jit(...).lower``.
+    ``mesh_size`` > 0 lowers through the registry-derived mesh shardings
+    (``entry_key`` names the ops/batch_axes.py declaration)."""
 
     def __init__(self, op: str, backend: str, bucket: str, tier: str,
-                 build: Callable[[], Tuple[Callable, tuple]]):
+                 build: Callable[[], Tuple[Callable, tuple]],
+                 mesh_size: int = 0, entry_key: Optional[str] = None):
         self.op = op
         self.backend = backend  # "int32" | "int8" | "-" (fq-independent)
         self.bucket = bucket
         self.tier = tier        # "small" (tier-1) | "slow"
         self.build = build
+        self.mesh_size = mesh_size
+        self.entry_key = entry_key
+
+    @property
+    def mesh(self) -> str:
+        return f"dp{self.mesh_size}" if self.mesh_size else "-"
 
     @property
     def key(self) -> str:
-        return f"{self.op}|{self.backend}|{self.bucket}"
+        return f"{self.op}|{self.backend}|{self.bucket}|{self.mesh}"
 
 
 def _targets() -> List[Target]:
@@ -209,10 +240,86 @@ def _targets() -> List[Target]:
         op = "epoch_deltas_leak" if in_leak else "epoch_deltas"
         out.append(Target(op, "-", "64", "small", epoch_build(64, in_leak)))
         out.append(Target(op, "-", "1024", "slow", epoch_build(1024, in_leak)))
+    # Mesh-sharded lowerings (device_mesh.py): the batch axis of the full
+    # entry points over the 8-way dp mesh.  These are the keys whose
+    # ``collective`` budget is NON-zero — the bls batch-wide MSM and the
+    # kzg blob-axis lincombs complete through psums.
+    def bls_mesh_build(nb: int, kb: int):
+        def build():
+            # the UNWRAPPED fn itself (not a *args lambda): ShardedEntry
+            # derives the per-parameter shardings from its signature
+            pk = tuple(S((nb, kb, 25), i32) for _ in range(3))
+            sig = tuple(S((nb, 2, 25), i32) for _ in range(3))
+            msg = tuple(S((nb, 2, 25), i32) for _ in range(2))
+            return (
+                unwrap(verify._device_verify),
+                (pk, sig, msg, S((nb, 64), i32), S((nb,), jnp.bool_)),
+            )
+        return build
+
+    def kzg_mesh_build(nb: int):
+        def build():
+            c = tuple(S((nb, 25), i32) for _ in range(3))
+            p = tuple(S((nb, 25), i32) for _ in range(3))
+            tau = tuple(S((2, 25), i32) for _ in range(2))
+            g2g = tuple(S((2, 25), i32) for _ in range(2))
+            return (
+                unwrap(kzg_device._device_kzg_batch),
+                (c, p, S((nb, 256), i32), S((nb, 256), i32),
+                 S((256,), i32), tau, g2g),
+            )
+        return build
+
+    # Tier split: the collective count needs a real (cacheable) compile —
+    # one bls mesh key carries the tier-1 psum lock; the int8 twin and the
+    # kzg mesh keys audit behind `slow` (cold compiles are ~80 s each on
+    # the 1-core gate box; warm persistent cache makes them a deserialize).
+    for backend, tier in (("int32", "small"), ("int8", "slow")):
+        out.append(Target(
+            "bls_verify", backend, "8x2", tier, bls_mesh_build(8, 2),
+            mesh_size=8,
+            entry_key="lighthouse_tpu/ops/verify.py:_device_verify"))
+        out.append(Target(
+            "kzg_batch", backend, "8", "slow", kzg_mesh_build(8),
+            mesh_size=8,
+            entry_key="lighthouse_tpu/ops/kzg_device.py:_device_kzg_batch"))
     return out
 
 
-def _lower_text(target: Target) -> str:
+def mesh_devices_available() -> int:
+    import jax
+
+    return len(jax.devices())
+
+
+def _mesh_jit(target: Target, fn):
+    """A jit wrapper carrying the registry-derived mesh shardings — the
+    SAME derivation production uses (device_mesh.ShardedEntry)."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    from lighthouse_tpu import device_mesh
+
+    mesh = Mesh(np.array(jax.devices()[: target.mesh_size]),
+                (device_mesh.AXIS,))
+    entry = device_mesh.ShardedEntry(target.entry_key, fn)
+    # the spec derivation needs fn's real signature (above), but the jit
+    # must wrap a FRESH closure — jax's trace cache keys on callable
+    # identity, and the raw module fn would replay the other backend's
+    # trace (the same discipline as the unsharded targets' lambdas)
+    return jax.jit(lambda *a: fn(*a),
+                   in_shardings=entry.in_shardings(mesh),
+                   out_shardings=entry.out_sharding(mesh))
+
+
+def measure_target(target: Target) -> Dict[str, int]:
+    """The budget metrics of one target.  Unsharded: counted from the
+    traced StableHLO (trace only, no XLA compile).  Mesh: the
+    ``collective`` metric comes from the COMPILED module — GSPMD inserts
+    the collectives during partitioning, so the traced text carries only
+    sharding annotations (the remaining metrics still count the traced
+    text, comparable with the unsharded keys)."""
     import jax
 
     from lighthouse_tpu.ops import fq
@@ -223,12 +330,22 @@ def _lower_text(target: Target) -> str:
     else:
         prev = fq.set_fq_backend("int32")  # fq-independent: pin for determinism
     try:
+        jitted = _mesh_jit(target, fn) if target.mesh_size else jax.jit(fn)
+
+        def measure():
+            lowered = jitted.lower(*args)
+            counts = count_budget(lowered.as_text())
+            if target.mesh_size:
+                counts["collective"] = len(_COMPILED_COLLECTIVE_RE.findall(
+                    lowered.compile().as_text()))
+            return counts
+
         if target.op.startswith("epoch_deltas"):
             from jax.experimental import enable_x64
 
             with enable_x64():
-                return jax.jit(fn).lower(*args).as_text()
-        return jax.jit(fn).lower(*args).as_text()
+                return measure()
+        return measure()
     finally:
         fq.set_fq_backend(prev)
 
@@ -277,7 +394,10 @@ def audit(tier: str = "small", verbose: bool = False,
     ("small" = tier-1 set, "all" = small + slow).  Baseline keys that no
     target declares anymore are mismatches too (a renamed/removed target
     must not leave an orphan budget reading as audited coverage — the
-    budget-file analog of the sharding pass's registry-stale)."""
+    budget-file analog of the sharding pass's registry-stale).  Mesh
+    targets are SKIPPED (not failed) when the interpreter has fewer
+    devices than their mesh — the full audit needs the test suite's
+    8-device virtual CPU mesh."""
     baseline = load_baseline()
     mismatches: List[str] = []
     measured: Dict[str, Dict[str, int]] = {}
@@ -288,14 +408,24 @@ def audit(tier: str = "small", verbose: bool = False,
             f"{key}: stale budget entry — no such audit target; "
             "run --update-baseline (it prunes undeclared keys)"
         )
+    n_devices = mesh_devices_available()
+    skipped = 0
     for target in targets:
         if tier != "all" and target.tier != "small":
             continue
-        got = count_budget(_lower_text(target))
+        if target.mesh_size > n_devices:
+            skipped += 1
+            continue
+        got = measure_target(target)
         measured[target.key] = got
         mismatches.extend(compare(target.key, baseline.get(target.key), got))
         if verbose:
             print(f"hlo_budget: {target.key}: {got}")
+    if skipped:
+        print(f"hlo_budget: skipped {skipped} mesh target(s) — "
+              f"{n_devices} device(s) here; run under "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+              "for the full audit", file=sys.stderr)
     return mismatches, measured
 
 
@@ -332,6 +462,23 @@ def self_test() -> List[str]:
             "self-test: a seeded budget perturbation was not detected — "
             "the comparator has gone blind"
         )
+    if len(jax.devices()) >= 2:
+        # The collective counter must SEE a psum: a batch-axis sum sharded
+        # over two devices partitions into an all-reduce by construction
+        # (GSPMD inserts it at compile time — count the compiled module).
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+        txt_c = jax.jit(
+            lambda x: x.sum(axis=0),
+            in_shardings=NamedSharding(mesh, P("dp")),
+            out_shardings=NamedSharding(mesh, P()),
+        ).lower(jax.ShapeDtypeStruct((8, 4), jnp.float32)).compile().as_text()
+        if len(_COMPILED_COLLECTIVE_RE.findall(txt_c)) < 1:
+            errors.append(
+                "self-test: a sharded batch-axis sum compiled with no "
+                "counted collective — the psum lock has gone blind"
+            )
     return errors
 
 

@@ -57,6 +57,7 @@ PASS = "host-sync"
 
 SCAN_DIRS = (
     "lighthouse_tpu/ops",
+    "lighthouse_tpu/device_mesh.py",
     "lighthouse_tpu/device_pipeline.py",
     "lighthouse_tpu/device_supervisor.py",
     "lighthouse_tpu/device_telemetry.py",

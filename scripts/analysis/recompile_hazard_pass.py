@@ -60,6 +60,7 @@ PASS = "recompile-hazard"
 
 SCAN_DIRS = (
     "lighthouse_tpu/ops",
+    "lighthouse_tpu/device_mesh.py",
     "lighthouse_tpu/device_pipeline.py",
     "lighthouse_tpu/device_supervisor.py",
     "bench.py",

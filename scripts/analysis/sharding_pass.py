@@ -23,6 +23,12 @@ gates both, turning the prerequisite from folklore into a build failure:
   ``device=``/``sharding=`` placement anywhere in the scan dirs: an
   unplaced transfer pins the array to device 0 and silently serializes a
   future mesh.
+- ``mesh-bypass-device-put`` — ``jax.device_put(x, device=...)``: an
+  explicit single-device pin bypasses the mesh placer
+  (``device_mesh.ShardedEntry.place``), so with ``LIGHTHOUSE_TPU_MESH``
+  on the transfer serializes onto one chip behind the mesh's back.  Route
+  placements through the placer (or pass a ``sharding=``), or pragma the
+  reviewed exception.
 - ``registry-missing``    — ``ops/batch_axes.py`` is absent or its
   ``BATCH_AXES`` literal fails to parse (the pass must fail loudly, not go
   blind).
@@ -56,6 +62,7 @@ PASS = "sharding-ready"
 
 SCAN_DIRS = (
     "lighthouse_tpu/ops",
+    "lighthouse_tpu/device_mesh.py",
     "lighthouse_tpu/device_pipeline.py",
     "bench.py",
 )
@@ -155,6 +162,20 @@ class _DevicePutChecker(ScopedVisitor):
                         "device_put without a device/sharding placement pins "
                         "the array to device 0 — pass the mesh sharding (or "
                         "pragma `# sharding-ready: ok(<reason>)`)",
+                    )
+                )
+            elif (
+                "device" in kw_names
+                and not self.pragmas.suppresses(PASS, node)
+            ):
+                self.violations.append(
+                    Violation(
+                        PASS, self.rel_path, node.lineno,
+                        "mesh-bypass-device-put", self.context,
+                        "device_put(device=...) pins the transfer to one "
+                        "chip behind the mesh placer's back — route it "
+                        "through device_mesh.ShardedEntry.place (or pass a "
+                        "sharding=, or pragma the reviewed exception)",
                     )
                 )
         self.generic_visit(node)

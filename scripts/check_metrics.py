@@ -47,6 +47,10 @@ REGISTERING_MODULES = (
     # breaker/watchdog metric constants live in lighthouse_tpu.metrics;
     # importing validates the module wires against the registry cleanly
     "lighthouse_tpu.device_supervisor",
+    # device_mesh_* metric constants live in lighthouse_tpu.metrics;
+    # importing validates the mesh layer wires against the registry (and
+    # that importing it pulls no jax — it must stay lazy)
+    "lighthouse_tpu.device_mesh",
     # scenario_runs_total / scenario_events_applied_total live with the
     # soak runner; the net_*/sync_*/backfill_* fabric counters it reports
     # are constants in lighthouse_tpu.metrics like everything else

@@ -119,10 +119,12 @@ SELF_TEST = {
             "batch-axis-fold": 2,
             "batch-axis-transpose": 1,
             "unsharded-device-put": 1,
+            "mesh-bypass-device-put": 1,
         },
         "must_not_flag_context": {
             "registered_clean_entry",
             "placed_transfer",
+            "pragmad_bypass_transfer",
         },
     },
 }

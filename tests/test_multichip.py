@@ -200,6 +200,201 @@ def test_sharded_bit_parity_vs_host_golden():
         "mesh FE value diverges from the host golden model")
 
 
+# ------------------------------------------------- the SUPERVISED mesh path
+#
+# Everything above drives the raw jitted program by hand.  These tests run
+# the PRODUCTION dispatch stack — device_mesh.ShardedEntry derives the
+# specs from ops/batch_axes.py, the supervisor wraps the dispatch, the
+# flight recorder carries the per-shard occupancy view — and assert the
+# sharded verdicts/bytes match the single-device path exactly.
+
+
+import contextlib
+
+import pytest
+
+
+@contextlib.contextmanager
+def _mesh(spec="auto"):
+    from lighthouse_tpu import device_mesh
+
+    size = device_mesh.configure(spec)
+    assert size == N_DEVICES, "conftest must provision 8 virtual CPU devices"
+    try:
+        yield size
+    finally:
+        device_mesh.reset_for_tests()
+
+
+def _example_sets(n_sets, n_keys=2, seed=7):
+    import random
+
+    from lighthouse_tpu.crypto.bls import api
+    from lighthouse_tpu.crypto.bls.params import R
+
+    rng = random.Random(seed)
+    sks = [api.SecretKey(rng.randrange(1, R)) for _ in range(n_keys)]
+    pks = [sk.public_key() for sk in sks]
+    agg = api.SecretKey(sum(sk.scalar for sk in sks) % R)
+    sets = []
+    for i in range(n_sets):
+        msg = (i.to_bytes(2, "big") + bytes([seed & 0xFF])) * 10 + b"\x00\x00"
+        sets.append(api.SignatureSet.multiple_pubkeys(agg.sign(msg), pks, msg))
+    return sets
+
+
+def test_supervised_sharded_bls_verify_matches_single_device():
+    """The production entry (`verify_signature_sets_device` — supervisor,
+    telemetry, the registry-derived placer) on the mesh: same verdict as
+    unsharded, per-shard live counts recorded, padding on the last shards
+    (12 live sets in the 16-bucket over 8 devices)."""
+    from lighthouse_tpu import device_telemetry
+    from lighthouse_tpu.ops.verify import verify_signature_sets_device
+
+    sets = _example_sets(12)
+    assert verify_signature_sets_device(sets, seed=b"mesh-par") is True
+    with _mesh():
+        assert verify_signature_sets_device(sets, seed=b"mesh-par") is True
+        rec = device_telemetry.FLIGHT_RECORDER.recent(1)[0]
+    assert rec["shape"] == "16x2@dp8"
+    assert rec["mesh"] == N_DEVICES
+    assert rec["shard_live"] == [2, 2, 2, 2, 2, 2, 0, 0]
+    assert not rec["host_fallback"]
+    assert rec["occupancy_per_shard"][-1] == 0.0  # padding lands last
+
+
+def test_supervised_sharded_bls_rejects_bad_set():
+    """A corrupted set fails on the mesh exactly as it fails unsharded
+    (same program shape -> same cached executables as the test above)."""
+    from lighthouse_tpu.crypto.bls import api
+    from lighthouse_tpu.ops.verify import verify_signature_sets_device
+
+    sets = _example_sets(12)
+    bad = _example_sets(1, seed=9)[0]
+    sets[5] = api.SignatureSet.multiple_pubkeys(
+        bad.signature, bad.signing_keys, b"a different message entirely")
+    assert verify_signature_sets_device(sets, seed=b"mesh-par") is False
+    with _mesh():
+        assert verify_signature_sets_device(sets, seed=b"mesh-par") is False
+
+
+def test_sharded_sha256_pairs_bit_identical_uneven():
+    """The supervised pair-hash on the mesh returns byte-identical digests
+    for a NON-divisible live count (100 blocks -> 256 bucket over 8), with
+    the padding accounted on the last shards."""
+    from lighthouse_tpu import device_telemetry
+    from lighthouse_tpu.ops import sha256_device
+
+    data = bytes(range(256)) * 25  # 100 64-byte blocks
+    host = sha256_device.hash_pairs_device(data)
+    with _mesh():
+        meshed = sha256_device.hash_pairs_device(data)
+        rec = device_telemetry.FLIGHT_RECORDER.recent(1)[0]
+    assert meshed == host
+    assert rec["shape"] == "256@dp8"
+    assert rec["shard_live"] == [32, 32, 32, 4, 0, 0, 0, 0]
+
+
+def test_sharded_epoch_deltas_bit_identical_uneven():
+    """The epoch kernel on the mesh — registry-wide participating sums
+    completing through psums — returns bit-identical int64 arrays for a
+    100-validator registry (pads to 104, never-active pad rows)."""
+    from lighthouse_tpu.ops import epoch_device
+
+    rng = np.random.default_rng(5)
+    n = 100
+
+    class _Arrays:
+        effective_balance = rng.integers(1, 32_000_000_000, n)
+        activation_epoch = rng.integers(0, 5, n)
+        exit_epoch = rng.integers(6, 100, n)
+        withdrawable_epoch = rng.integers(6, 200, n)
+        slashed = rng.random(n) < 0.1
+
+    class _Spec:
+        effective_balance_increment = 1_000_000_000
+        inactivity_score_bias = 4
+        inactivity_score_recovery_rate = 16
+
+    kw = dict(
+        previous_epoch=4, in_leak=False, base_reward_per_increment=512,
+        total_active_balance=int(_Arrays.effective_balance.sum()),
+        quotient=67_108_864, spec=_Spec(),
+    )
+    prev_part = rng.integers(0, 8, n)
+    inact = rng.integers(0, 10, n)
+    host = epoch_device.epoch_deltas_device(_Arrays, prev_part, inact, **kw)
+    with _mesh():
+        meshed = epoch_device.epoch_deltas_device(
+            _Arrays, prev_part, inact, **kw)
+    for h, m in zip(host, meshed):
+        assert np.array_equal(h, m)
+        assert m.shape == (n,)  # the mesh pad is sliced back off
+
+
+@pytest.mark.slow
+def test_sharded_kzg_batch_verdict_and_fe_identical():
+    """kzg_batch on the mesh: the blob-axis lincombs psum across devices
+    and the supervised verdict matches single-device (fabricated points —
+    verdict equality is the contract, the host golden model decides)."""
+    from lighthouse_tpu import device_telemetry
+    from lighthouse_tpu.crypto.bls import curve
+    from lighthouse_tpu.crypto.bls.params import R
+    from lighthouse_tpu.ops import kzg_device
+
+    npts = 5
+    c_pts = [curve.mul(curve.G1, i + 2) for i in range(npts)]
+    p_pts = [curve.mul(curve.G1, 3 * i + 1) for i in range(npts)]
+    r_powers = [pow(7, i, R) for i in range(npts)]
+    zs = [11 + i for i in range(npts)]
+    ys = [5 + 2 * i for i in range(npts)]
+    g2_tau = curve.mul(curve.G2, 1234567)
+    host = kzg_device.verify_kzg_proof_batch_device(
+        c_pts, p_pts, r_powers, zs, ys, g2_tau)
+    with _mesh():
+        meshed = kzg_device.verify_kzg_proof_batch_device(
+            c_pts, p_pts, r_powers, zs, ys, g2_tau)
+        rec = device_telemetry.FLIGHT_RECORDER.recent(1)[0]
+    assert meshed == host
+    assert rec["shape"] == "8@dp8"
+    assert rec["shard_live"] == [1, 1, 1, 1, 1, 0, 0, 0]
+    assert not rec["host_fallback"]
+
+
+@pytest.mark.slow
+def test_per_device_breaker_trip_reshards_mid_op(monkeypatch):
+    """The acceptance path: a device failure mid-op trips that device's
+    breaker, the mesh re-shards to 7 survivors, the SAME batch retries on
+    the shrunk topology (re-padded 16 -> 21 rows) and the verdict is
+    identical to single-device — no host fallback, no op-breaker trip."""
+    from lighthouse_tpu import device_mesh, device_supervisor, device_telemetry, fault_injection
+    from lighthouse_tpu.ops.verify import verify_signature_sets_device
+
+    monkeypatch.setenv(device_mesh.DEVICE_FAILURE_THRESHOLD_ENV, "1")
+    sets = _example_sets(12)
+    assert verify_signature_sets_device(sets, seed=b"trip") is True
+    device_supervisor.reset_for_tests()
+    with _mesh():
+        # exactly ONE dispatch fault: the charge trips the suspect device
+        # (threshold 1), the mesh re-shards, and the retry must succeed
+        for plan in fault_injection.parse_spec(
+                "device.dispatch[op=bls_verify]=error:first_n=1"):
+            fault_injection.REGISTRY.install(plan)
+        try:
+            assert verify_signature_sets_device(sets, seed=b"trip") is True
+        finally:
+            fault_injection.clear()
+        rec = device_telemetry.FLIGHT_RECORDER.recent(1)[0]
+        snap = device_mesh.summary()
+    assert snap["size"] == N_DEVICES - 1
+    assert snap["reshards_total"] == 1
+    assert rec["shape"] == "21x2@dp7"
+    assert rec["shard_live"] == [3, 3, 3, 3, 0, 0, 0]
+    assert not rec["host_fallback"]
+    # the op-level breaker never engaged: the device layer absorbed it
+    assert device_supervisor.breaker_state("bls_verify") == "closed"
+
+
 def test_dryrun_multichip_subprocess():
     """The driver-facing entry point must succeed from an arbitrary parent env.
 
