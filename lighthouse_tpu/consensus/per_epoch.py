@@ -295,7 +295,7 @@ def epoch_deltas(arrays, prev_part, inactivity, **kwargs):
         max_inact = int(inactivity.max()) if n else 0
         spec = kwargs["spec"]
         if max_eb * (max_inact + spec.inactivity_score_bias) <= _I64_MAX:
-            from .. import device_supervisor
+            from .. import device_pipeline, device_supervisor
             from ..ops.epoch_device import epoch_deltas_device
 
             # Supervised: a hung or failing device epoch pass resolves
@@ -303,13 +303,29 @@ def epoch_deltas(arrays, prev_part, inactivity, **kwargs):
             # computes registry-wide participation sums, so halves are not
             # independent).
             op = "epoch_deltas_leak" if kwargs.get("in_leak") else "epoch_deltas"
-            return device_supervisor.run(
-                op,
-                lambda: epoch_deltas_device(arrays, prev_part, inactivity, **kwargs),
-                host_fn=lambda: _epoch_deltas_numpy(
-                    arrays, prev_part, inactivity, **kwargs
-                ),
-            )
+
+            def supervised():
+                return device_supervisor.run(
+                    op,
+                    lambda: epoch_deltas_device(
+                        arrays, prev_part, inactivity, **kwargs),
+                    host_fn=lambda: _epoch_deltas_numpy(
+                        arrays, prev_part, inactivity, **kwargs
+                    ),
+                )
+
+            # Pipeline on: the epoch job queues for the shared device
+            # arbiter slot (epoch boundaries contend with block-import bls
+            # and tree-hash traffic there); breaker/host-fallback semantics
+            # run INSIDE the job, so attribution is exactly the direct
+            # path's.  A racing pipeline shutdown falls back to direct.
+            if device_pipeline.routes_job():
+                try:
+                    return device_pipeline.run_job(
+                        op, supervised, work="epoch_transition")
+                except device_pipeline.PipelineShutdown:
+                    pass
+            return supervised()
     return _epoch_deltas_numpy(arrays, prev_part, inactivity, **kwargs)
 
 

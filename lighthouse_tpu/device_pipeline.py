@@ -39,6 +39,31 @@ device-bound verification through one seam without touching any caller.
 Callers that pin ``seed=`` (reproducibility tests) or exceed the standard
 bucket bypass the pipeline and keep their exact semantics.
 
+Beyond bls_verify (the module's originally declared remaining scope, now
+landed): **sha256_pairs** and the **epoch ops** dispatch through here too,
+so block import, epoch boundaries and tree-hash traffic contend for the
+device through ONE arbiter (:class:`DeviceArbiter` — every pipelined
+dispatch acquires the shared slot, so "who is holding the device" is one
+scrape away):
+
+- :class:`HashPipeline` coalesces pair-hash groups (``ops/tree_hash.py``
+  dirty-path batches, Merkle layer builds) into one ``sha256_pairs``
+  dispatch and slices the digests back per group — 64-byte blocks are
+  independent, so attribution is exact by construction; a batch that fails
+  outside the supervisor's own fallback re-hashes per group on the host
+  kernel, so a transient error can never corrupt a group's digest.
+- :class:`JobPipeline` runs registry-wide jobs (``epoch_deltas[_leak]`` —
+  batch-global sums, nothing to coalesce) FIFO under the same arbiter; the
+  supervisor inside the job keeps breaker-open host routing exact.
+
+**Adaptive linger** (the self-tuning slice of ROADMAP item 2): unless
+pinned (env ``LIGHTHOUSE_TPU_PIPELINE_LINGER_S`` or an explicit
+``linger_s``), the effective linger follows the flight recorder's observed
+in-flight batch duration (``device_telemetry.recent_inflight_seconds``) —
+while a batch is in flight the pending queue fills for free, so lingering
+~half the in-flight time buys fill at zero throughput cost.  The snapshot
+exposes ``effective_linger_s`` next to the configured base.
+
 Observability: ``device_pipeline_{pending_sets,depth,batch_fill_ratio,
 linger_seconds,wait_seconds,batches_total,groups_total}`` metrics, a
 ``pipeline_batch`` trace root per coalesced dispatch (submit→coalesce→
@@ -87,6 +112,107 @@ DEFAULT_TARGET_SETS = int(
 #: Bounded ring of recent per-batch summaries for summary()/tests.
 RECENT_BATCHES = 64
 
+#: Hash groups larger than this many 64-byte blocks bypass the hash
+#: pipeline (the direct supervised op buckets them itself).  The top
+#: ``ops/sha256_device.N_BUCKETS`` bucket, kept as a literal so importing
+#: the pipeline never pulls jax (same convention as MAX_GROUP_SETS).
+MAX_HASH_GROUP_BLOCKS = 262144
+
+#: Default coalescing target for the hash pipeline (blocks per dispatched
+#: sha256_pairs batch).
+DEFAULT_HASH_TARGET_BLOCKS = int(
+    os.environ.get("LIGHTHOUSE_TPU_PIPELINE_HASH_TARGET_BLOCKS", "16384")
+)
+
+#: Adaptive linger clamps: the effective linger never exceeds the MAX (a
+#: pathological in-flight observation must not park gossip for seconds) and
+#: tracks ``FRACTION`` of the observed in-flight batch duration.
+ADAPTIVE_LINGER_MAX_S = 0.25
+ADAPTIVE_LINGER_FRACTION = 0.5
+
+#: An explicit env linger pins every pipeline (the operator override the
+#: adaptive default must never fight).
+_LINGER_ENV_PINNED = "LIGHTHOUSE_TPU_PIPELINE_LINGER_S" in os.environ
+
+
+def effective_linger(op: str, base_s: float, pinned: bool) -> float:
+    """The linger actually applied to the next coalescing decision:
+    ``base_s`` when pinned or unobserved, else ~half the flight recorder's
+    median in-flight batch duration for ``op`` (clamped; never below the
+    configured base — a fast device should not erase the floor)."""
+    if pinned:
+        return base_s
+    from . import device_telemetry
+
+    observed = device_telemetry.recent_inflight_seconds(op)
+    if observed is None:
+        return base_s
+    return max(base_s, min(ADAPTIVE_LINGER_MAX_S,
+                           observed * ADAPTIVE_LINGER_FRACTION))
+
+
+# ------------------------------------------------------------- the arbiter
+
+DEVICE_ARBITER_WAIT_SECONDS = metrics.histogram(
+    "device_arbiter_wait_seconds",
+    "wait to acquire the shared device-dispatch arbiter slot, by op",
+)
+DEVICE_ARBITER_GRANTS = metrics.counter(
+    "device_arbiter_grants_total",
+    "device-dispatch slots granted by the shared pipeline arbiter, by op",
+)
+
+
+class DeviceArbiter:
+    """THE device-access gate for pipelined dispatch: every pipeline
+    (bls_verify batches, sha256_pairs hash batches, epoch jobs) acquires
+    one shared slot around its device leg, so concurrent work types
+    *contend here* — visibly (`device_arbiter_wait_seconds{op}`) — instead
+    of interleaving dispatches blindly.  Direct (non-pipelined) callers are
+    deliberately not gated: their semantics predate the pipeline and the
+    supervisor already serializes per-op dispatch through its worker."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stats = threading.Lock()
+        self._grants: Dict[str, int] = {}
+        self._wait_s: Dict[str, float] = {}
+        self._holder: Optional[str] = None
+
+    @contextmanager
+    def slot(self, op: str):
+        t0 = time.perf_counter()
+        with self._lock:
+            wait = time.perf_counter() - t0
+            with self._stats:
+                self._grants[op] = self._grants.get(op, 0) + 1
+                self._wait_s[op] = self._wait_s.get(op, 0.0) + wait
+                self._holder = op
+            DEVICE_ARBITER_WAIT_SECONDS.observe(wait, op=op)
+            DEVICE_ARBITER_GRANTS.inc(op=op)
+            try:
+                yield
+            finally:
+                with self._stats:
+                    self._holder = None
+
+    def snapshot(self) -> dict:
+        with self._stats:
+            return {
+                "holding": self._holder,
+                "grants": dict(self._grants),
+                "wait_s": {k: round(v, 6) for k, v in self._wait_s.items()},
+            }
+
+    def reset_for_tests(self) -> None:
+        with self._stats:
+            self._grants.clear()
+            self._wait_s.clear()
+            self._holder = None
+
+
+ARBITER = DeviceArbiter()
+
 _WORK_KIND: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
     "lighthouse_tpu_pipeline_work_kind", default=None
 )
@@ -111,38 +237,59 @@ class PipelineShutdown(RuntimeError):
     """The pipeline was shut down without draining this group."""
 
 
-class VerifyFuture:
-    """Resolution handle for one submitted group."""
+class _FutureBase:
+    """Resolution handle for one submitted unit of pipeline work: the one
+    Event/result/error pattern every pipeline shares (verify groups, hash
+    groups, epoch jobs differ only in payload fields and result type)."""
 
-    __slots__ = ("_done", "_result", "_error", "submitted_pc", "work", "n_sets")
+    __slots__ = ("_done", "_result", "_error", "submitted_pc", "work")
 
-    def __init__(self, work: str, n_sets: int):
+    #: result(timeout) message on expiry; subclasses name their unit.
+    _timeout_msg = "pipeline result not available in time"
+
+    def __init__(self, work: str):
         self._done = threading.Event()
-        self._result: Optional[bool] = None
+        self._result = None
         self._error: Optional[BaseException] = None
         self.submitted_pc = time.perf_counter()
         self.work = work
-        self.n_sets = n_sets
 
     def done(self) -> bool:
         return self._done.is_set()
 
-    def set_result(self, value: bool) -> None:
-        self._result = bool(value)
+    def set_result(self, value) -> None:
+        self._result = value
         self._done.set()
 
     def set_error(self, err: BaseException) -> None:
         self._error = err
         self._done.set()
 
-    def result(self, timeout: Optional[float] = None) -> bool:
-        """Block until the group's verdict is known; raises the pipeline's
-        error if its batch failed outside verification semantics."""
+    def result(self, timeout: Optional[float] = None):
+        """Block until resolution; raises the pipeline's error if the work
+        failed outside the op's own semantics."""
         if not self._done.wait(timeout):
-            raise TimeoutError("pipeline verdict not available in time")
+            raise TimeoutError(self._timeout_msg)
         if self._error is not None:
             raise self._error
-        return bool(self._result)
+        return self._result
+
+
+class VerifyFuture(_FutureBase):
+    """Resolution handle for one submitted group (bool verdict out)."""
+
+    __slots__ = ("n_sets",)
+    _timeout_msg = "pipeline verdict not available in time"
+
+    def __init__(self, work: str, n_sets: int):
+        super().__init__(work)
+        self.n_sets = n_sets
+
+    def set_result(self, value) -> None:
+        super().set_result(bool(value))
+
+    def result(self, timeout: Optional[float] = None) -> bool:
+        return bool(super().result(timeout))
 
 
 class _Group:
@@ -192,7 +339,12 @@ class DevicePipeline:
         # stay buildable by ops/verify.build_device_batch
         self.target_sets = max(1, min(int(target_sets or DEFAULT_TARGET_SETS),
                                       MAX_GROUP_SETS))
-        self.linger_s = DEFAULT_LINGER_S if linger_s is None else float(linger_s)
+        # an explicit linger (ctor arg, later assignment, or the env var)
+        # PINS the value; otherwise the effective linger adapts to the
+        # observed in-flight batch duration (see effective_linger)
+        self._linger_pinned = linger_s is not None or _LINGER_ENV_PINNED
+        self._linger_s = (DEFAULT_LINGER_S if linger_s is None
+                          else float(linger_s))
         self._verify_flat_fn = verify_flat_fn
         self._recheck_fn = recheck_fn
         self._cond = threading.Condition()
@@ -267,6 +419,20 @@ class DevicePipeline:
 
     # ------------------------------------------------------------- builder
 
+    @property
+    def linger_s(self) -> float:
+        return self._linger_s
+
+    @linger_s.setter
+    def linger_s(self, value: float) -> None:
+        # assigning a linger anywhere (tests, scenarios, bench) pins it —
+        # the adaptive default must never fight an explicit choice
+        self._linger_s = float(value)
+        self._linger_pinned = True
+
+    def _effective_linger(self) -> float:
+        return effective_linger(self.op, self._linger_s, self._linger_pinned)
+
     def _effective_target(self) -> int:
         """The coalescing target scaled to the CURRENT mesh: a mesh shrunk
         by per-device breaker trips fills proportionally fewer lanes, so
@@ -281,13 +447,24 @@ class DevicePipeline:
         oldest group's linger expired, or shutdown-drain); pop and return it.
         Returns None only when shut down AND drained."""
         with self._cond:
+            # sampled once per take, at the moment the first group is seen:
+            # the adaptive signal only moves when a batch completes, so
+            # recomputing it (a flight-recorder scan) on every 50ms
+            # wait-loop wake under the lock is wasted work — but sampling
+            # at take ENTRY would bake a pre-pin value into a worker that
+            # was already parked on an empty queue when a test/scenario
+            # assigned linger_s
+            linger = None
             while True:
                 target = self._effective_target()
                 if self._pending:
                     if self._shutdown or self._pending_sets >= target:
                         break
+                    if linger is None:
+                        linger = self._effective_linger()
                     oldest = self._pending[0].future.submitted_pc
-                    remaining = self.linger_s - (time.perf_counter() - oldest)
+                    remaining = (linger
+                                 - (time.perf_counter() - oldest))
                     if remaining <= 0:
                         break
                     self._cond.wait(timeout=min(remaining, 0.05))
@@ -440,7 +617,10 @@ class DevicePipeline:
                     verdict = verdict and ok
                     g.future.set_result(ok)
             else:
-                verdict = self._verify_flat(batch)
+                # the one shared device slot: bls batches contend with hash
+                # and epoch pipeline traffic here, not at the driver
+                with ARBITER.slot(self.op):
+                    verdict = self._verify_flat(batch)
                 if verdict:
                     for g in batch.groups:
                         g.future.set_result(True)
@@ -503,7 +683,11 @@ class DevicePipeline:
             # identical to target_sets unless the device mesh is degraded
             # (device_mesh.scale_target shrinks the fill target with it)
             "effective_target_sets": self._effective_target(),
-            "linger_s": self.linger_s,
+            "linger_s": self._linger_s,
+            # the linger actually applied to the next take: adaptive
+            # (flight-recorder in-flight median) unless pinned
+            "effective_linger_s": round(self._effective_linger(), 6),
+            "linger_adaptive": not self._linger_pinned,
             "pending_groups": pending_groups,
             "pending_sets": pending_sets,
             "in_flight_groups": in_flight,
@@ -514,10 +698,390 @@ class DevicePipeline:
         }
 
 
+# ------------------------------------------------------------ hash pipeline
+
+
+class HashFuture(_FutureBase):
+    """Resolution handle for one submitted pair-hash group (bytes out)."""
+
+    __slots__ = ("n_blocks",)
+    _timeout_msg = "pipeline hash result not available in time"
+
+    def __init__(self, work: str, n_blocks: int):
+        super().__init__(work)
+        self.n_blocks = n_blocks
+
+
+class _HashGroup:
+    __slots__ = ("data", "future")
+
+    def __init__(self, data: bytes, future: HashFuture):
+        self.data = data
+        self.future = future
+
+
+class HashPipeline:
+    """One persistent pipeline for ``sha256_pairs`` pair-hash traffic.
+
+    Groups are byte buffers of independent 64-byte blocks (Merkle pair
+    batches from ``ops/tree_hash.py``, bulk layer builds), so coalescing is
+    concatenation and per-group result attribution is an exact slice of the
+    output digests — no re-check pass exists because none is needed.  The
+    single worker dispatches the joined batch through the SUPERVISED direct
+    op (``sha256_device.hash_pairs_device`` — watchdog, split-retry,
+    breaker → host kernel with identical bytes) under the shared
+    :data:`ARBITER` slot.  A failure that escapes the supervisor anyway
+    (bug territory) re-hashes each group on the host kernel so one poisoned
+    group cannot corrupt another's digest.
+
+    ``hash_flat_fn``: test seam — replaces the supervised device leg.
+    """
+
+    def __init__(self, *, target_blocks: Optional[int] = None,
+                 linger_s: Optional[float] = None, hash_flat_fn=None):
+        self.op = "sha256_pairs"
+        self.target_blocks = max(1, min(
+            int(target_blocks or DEFAULT_HASH_TARGET_BLOCKS),
+            MAX_HASH_GROUP_BLOCKS))
+        self._linger_pinned = linger_s is not None or _LINGER_ENV_PINNED
+        self._linger_s = (DEFAULT_LINGER_S if linger_s is None
+                          else float(linger_s))
+        self._hash_flat_fn = hash_flat_fn
+        self._cond = threading.Condition()
+        self._pending: deque = deque()          # _HashGroup FIFO
+        self._pending_blocks = 0
+        self._in_flight_groups = 0
+        self._shutdown = False
+        self._idle = threading.Event()
+        self._idle.set()
+        self._recent: deque = deque(maxlen=RECENT_BATCHES)
+        self.batches_total = 0
+        self.groups_total = 0
+        self.blocks_total = 0
+        self._worker = threading.Thread(
+            target=self._run_loop, name="device-pipeline-hash", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------- ingress
+
+    @property
+    def linger_s(self) -> float:
+        return self._linger_s
+
+    @linger_s.setter
+    def linger_s(self, value: float) -> None:
+        self._linger_s = float(value)
+        self._linger_pinned = True
+
+    def _effective_linger(self) -> float:
+        return effective_linger(self.op, self._linger_s, self._linger_pinned)
+
+    def submit(self, data: bytes, work: Optional[str] = None) -> HashFuture:
+        """Queue one pair-hash group (``len(data)`` a multiple of 64);
+        returns its future.  Raises :class:`PipelineShutdown` after
+        :meth:`shutdown`."""
+        n_blocks = len(data) // 64
+        if len(data) % 64:
+            raise ValueError("hash group must be a multiple of 64 bytes")
+        work = work or current_work_kind()
+        fut = HashFuture(work, n_blocks)
+        if n_blocks == 0:
+            fut.set_result(b"")
+            return fut
+        with self._cond:
+            if self._shutdown:
+                raise PipelineShutdown("sha256_pairs: pipeline is shut down")
+            self._pending.append(_HashGroup(data, fut))
+            self._pending_blocks += n_blocks
+            self.groups_total += 1
+            self.blocks_total += n_blocks
+            self._idle.clear()
+            metrics.DEVICE_PIPELINE_PENDING_SETS.set(
+                self._pending_blocks, op=self.op)
+            metrics.DEVICE_PIPELINE_DEPTH.set(
+                len(self._pending) + self._in_flight_groups, op=self.op)
+            self._cond.notify_all()
+        metrics.DEVICE_PIPELINE_GROUPS.inc(op=self.op, work=work)
+        return fut
+
+    # -------------------------------------------------------------- worker
+
+    def _take_batch(self) -> Optional[List[_HashGroup]]:
+        with self._cond:
+            # sampled once per take, at first-group observation — same
+            # rationale as DevicePipeline._take_batch
+            linger = None
+            while True:
+                if self._pending:
+                    if (self._shutdown
+                            or self._pending_blocks >= self.target_blocks):
+                        break
+                    if linger is None:
+                        linger = self._effective_linger()
+                    oldest = self._pending[0].future.submitted_pc
+                    remaining = (linger
+                                 - (time.perf_counter() - oldest))
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=min(remaining, 0.05))
+                elif self._shutdown:
+                    return None
+                else:
+                    self._cond.wait(timeout=0.1)
+            groups: List[_HashGroup] = []
+            n_blocks = 0
+            while self._pending:
+                g = self._pending[0]
+                if groups and n_blocks + g.future.n_blocks > self.target_blocks:
+                    break
+                self._pending.popleft()
+                groups.append(g)
+                n_blocks += g.future.n_blocks
+            self._pending_blocks -= n_blocks
+            self._in_flight_groups += len(groups)
+            metrics.DEVICE_PIPELINE_PENDING_SETS.set(
+                self._pending_blocks, op=self.op)
+            return groups
+
+    def _hash_flat(self, data: bytes) -> bytes:
+        if self._hash_flat_fn is not None:
+            return self._hash_flat_fn(data)
+        from .ops.sha256_device import hash_pairs_device
+
+        return hash_pairs_device(data)
+
+    def _run_loop(self) -> None:
+        while True:
+            try:
+                groups = self._take_batch()
+            except Exception:
+                log.error("hash pipeline take failed", exc_info=True)
+                continue
+            if groups is None:
+                with self._cond:
+                    if not self._pending and self._in_flight_groups == 0:
+                        self._idle.set()
+                return
+            try:
+                self._execute_one(groups)
+            finally:
+                with self._cond:
+                    self._in_flight_groups -= len(groups)
+                    metrics.DEVICE_PIPELINE_DEPTH.set(
+                        len(self._pending) + self._in_flight_groups,
+                        op=self.op)
+                    if not self._pending and self._in_flight_groups == 0:
+                        self._idle.set()
+                    self._cond.notify_all()
+
+    def _execute_one(self, groups: List[_HashGroup]) -> None:
+        oldest = min(g.future.submitted_pc for g in groups)
+        linger = max(0.0, time.perf_counter() - oldest)
+        n_blocks = sum(g.future.n_blocks for g in groups)
+        fill = min(1.0, n_blocks / self.target_blocks)
+        work_mix: Dict[str, int] = {}
+        for g in groups:
+            work_mix[g.future.work] = (
+                work_mix.get(g.future.work, 0) + g.future.n_blocks)
+        metrics.DEVICE_PIPELINE_BATCHES.inc(op=self.op)
+        metrics.DEVICE_PIPELINE_BATCH_FILL_RATIO.observe(fill, op=self.op)
+        metrics.DEVICE_PIPELINE_LINGER_SECONDS.observe(linger, op=self.op)
+        rehashed = 0
+        with tracing.span(
+            "pipeline_batch", op=self.op, n_blocks=n_blocks,
+            n_groups=len(groups), fill_ratio=round(fill, 4),
+            linger_s=round(linger, 6), work_mix=dict(work_mix),
+        ):
+            try:
+                joined = b"".join(g.data for g in groups)
+                with ARBITER.slot(self.op):
+                    out = self._hash_flat(joined)
+                offset = 0
+                for g in groups:
+                    size = g.future.n_blocks * 32
+                    g.future.set_result(out[offset: offset + size])
+                    offset += size
+            except Exception as err:  # noqa: BLE001 — per-group host rescue
+                # The supervised op resolves device faults itself; anything
+                # landing here is unexpected — isolate it per group so one
+                # poisoned buffer cannot corrupt the others' digests.
+                log.error("hash pipeline batch failed; groups re-hash on "
+                          "the host kernel",
+                          error=f"{type(err).__name__}: {err}")
+                tracing.annotate(group_rehash=True)
+                from .ops.sha256_device import _host_hash_pairs
+
+                for g in groups:
+                    rehashed += 1
+                    try:
+                        g.future.set_result(_host_hash_pairs(g.data))
+                    except Exception as host_err:  # noqa: BLE001
+                        g.future.set_error(host_err)
+        self.batches_total += 1
+        self._recent.append({
+            "t_ms": int(time.time() * 1000),
+            "n_blocks": n_blocks,
+            "n_groups": len(groups),
+            "fill_ratio": round(fill, 4),
+            "linger_s": round(linger, 6),
+            "work_mix": dict(work_mix),
+            "group_rehashes": rehashed,
+        })
+
+    # ------------------------------------------------------------- control
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        return self._idle.wait(timeout)
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        with self._cond:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            self._cond.notify_all()
+        self._worker.join(timeout=timeout)
+        with self._cond:
+            leftovers = list(self._pending)
+            self._pending.clear()
+            self._pending_blocks = 0
+        for g in leftovers:
+            if not g.future.done():
+                g.future.set_error(PipelineShutdown(
+                    "sha256_pairs: pipeline shut down before this group ran"))
+
+    def snapshot(self) -> dict:
+        with self._cond:
+            pending_groups = len(self._pending)
+            pending_blocks = self._pending_blocks
+            in_flight = self._in_flight_groups
+        return {
+            "op": self.op,
+            "target_blocks": self.target_blocks,
+            "linger_s": self._linger_s,
+            "effective_linger_s": round(self._effective_linger(), 6),
+            "linger_adaptive": not self._linger_pinned,
+            "pending_groups": pending_groups,
+            "pending_blocks": pending_blocks,
+            "in_flight_groups": in_flight,
+            "batches_total": self.batches_total,
+            "groups_total": self.groups_total,
+            "blocks_total": self.blocks_total,
+            "recent_batches": list(self._recent),
+        }
+
+
+# ------------------------------------------------------------- job pipeline
+
+
+class JobFuture(_FutureBase):
+    """Resolution handle for one pipelined device job (arbitrary result)."""
+
+    __slots__ = ()
+    _timeout_msg = "pipeline job result not available in time"
+
+
+class JobPipeline:
+    """FIFO pipeline for batch-global device jobs (the epoch ops).
+
+    An epoch transition is one registry-wide dispatch — its sums span the
+    whole batch (``device_supervisor.NO_SPLIT_OPS``), so there is nothing
+    to coalesce; what enrolment buys is the ARBITER: an epoch boundary
+    queues for the same device slot block import and tree-hash traffic use,
+    instead of dispatching into their middle.  The submitted thunk is the
+    caller's full supervised call (watchdog/breaker/host fallback run
+    inside it), so breaker-open host routing and result attribution are
+    exactly the direct path's."""
+
+    def __init__(self, op: str):
+        self.op = op
+        self._q: "queue.SimpleQueue[Optional[tuple]]" = queue.SimpleQueue()
+        self._shutdown = False
+        self._pending = 0
+        self._lock = threading.Lock()
+        self.jobs_total = 0
+        self._worker = threading.Thread(
+            target=self._run_loop, name=f"device-pipeline-job-{op}",
+            daemon=True)
+        self._worker.start()
+
+    def submit(self, fn, work: Optional[str] = None) -> JobFuture:
+        work = work or current_work_kind()
+        fut = JobFuture(work)
+        with self._lock:
+            if self._shutdown:
+                raise PipelineShutdown(f"{self.op}: pipeline is shut down")
+            self._pending += 1
+            self.jobs_total += 1
+            # enqueue under the lock (SimpleQueue.put never blocks): a job
+            # can then never land BEHIND shutdown's poison pill, which sets
+            # _shutdown under this same lock before putting None
+            self._q.put((fn, fut))
+        metrics.DEVICE_PIPELINE_GROUPS.inc(op=self.op, work=work)
+        metrics.DEVICE_PIPELINE_DEPTH.set(self._pending, op=self.op)
+        return fut
+
+    def _run_loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, fut = item
+            try:
+                with ARBITER.slot(self.op):
+                    fut.set_result(fn())
+            except BaseException as err:  # noqa: BLE001 — marshalled
+                fut.set_error(err)
+            finally:
+                with self._lock:
+                    self._pending -= 1
+                metrics.DEVICE_PIPELINE_DEPTH.set(self._pending, op=self.op)
+                metrics.DEVICE_PIPELINE_BATCHES.inc(op=self.op)
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            self._q.put(None)
+        self._worker.join(timeout=timeout)
+        # The lock-ordered put above guarantees every accepted job precedes
+        # the poison pill, so a clean worker exit leaves nothing behind;
+        # this sweep only matters if the join TIMED OUT on a hung worker —
+        # resolve whatever it abandoned so no caller blocks forever.
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                # leave the pill in place: a worker stuck past the join
+                # timeout may yet unstick, and swallowing its exit signal
+                # would park that thread on _q.get() forever
+                self._q.put(None)
+                break
+            _, fut = item
+            with self._lock:
+                self._pending -= 1
+            if not fut.done():
+                fut.set_error(PipelineShutdown(
+                    f"{self.op}: pipeline shut down before this job ran"))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "op": self.op,
+                "pending_jobs": self._pending,
+                "jobs_total": self.jobs_total,
+            }
+
+
 # ----------------------------------------------------------- module wiring
 
 _LOCK = threading.Lock()
 _PIPELINE: Optional[DevicePipeline] = None
+_HASH_PIPELINE: Optional[HashPipeline] = None
+_JOB_PIPELINES: Dict[str, JobPipeline] = {}
 _ENABLED = os.environ.get("LIGHTHOUSE_TPU_DEVICE_PIPELINE", "") == "1"
 
 
@@ -575,27 +1139,110 @@ def verify(sets: list) -> bool:
     return pipe.verify(sets)
 
 
+def get_hash_pipeline() -> HashPipeline:
+    """The process-wide sha256_pairs hash pipeline (lazily started)."""
+    global _HASH_PIPELINE
+    with _LOCK:
+        if _HASH_PIPELINE is None:
+            _HASH_PIPELINE = HashPipeline()
+        return _HASH_PIPELINE
+
+
+def routes_hash(n_blocks: int) -> bool:
+    """Should a pair-hash batch of ``n_blocks`` 64-byte blocks ride the
+    hash pipeline?  Oversized batches keep the direct supervised path; so
+    does everything when the pipeline is off."""
+    return _ENABLED and 0 < n_blocks <= MAX_HASH_GROUP_BLOCKS
+
+
+def hash_pairs(data: bytes, work: Optional[str] = None) -> bytes:
+    """Pair-hash ``data`` through the hash pipeline (the ``ops/tree_hash``
+    seam calls this after :func:`routes_hash`): same no-resurrection
+    discipline as :func:`verify` — a caller racing ``shutdown()`` gets
+    :class:`PipelineShutdown` and falls back to the direct path."""
+    global _HASH_PIPELINE
+    with _LOCK:
+        pipe = _HASH_PIPELINE
+        if pipe is None:
+            if not _ENABLED:
+                raise PipelineShutdown("pipeline disabled mid-call")
+            pipe = _HASH_PIPELINE = HashPipeline()
+    fut = pipe.submit(data, work=work)
+    try:
+        return fut.result()
+    finally:
+        tracing.record_span(
+            "pipeline_wait", start_pc=fut.submitted_pc,
+            hist=metrics.DEVICE_PIPELINE_WAIT_SECONDS,
+            hist_labels={"op": "sha256_pairs"},
+            n_blocks=fut.n_blocks, work=fut.work,
+        )
+
+
+def routes_job() -> bool:
+    """Should a batch-global device job (epoch ops) ride its job
+    pipeline — i.e. queue for the shared arbiter slot?"""
+    return _ENABLED
+
+
+def run_job(op: str, fn, work: Optional[str] = None):
+    """Run ``fn`` (a full supervised device call) on ``op``'s job pipeline
+    and return its result.  Raises :class:`PipelineShutdown` when racing a
+    shutdown — callers fall back to running ``fn`` directly."""
+    global _JOB_PIPELINES
+    with _LOCK:
+        pipe = _JOB_PIPELINES.get(op)
+        if pipe is None:
+            if not _ENABLED:
+                raise PipelineShutdown("pipeline disabled mid-call")
+            pipe = _JOB_PIPELINES[op] = JobPipeline(op)
+    fut = pipe.submit(fn, work=work)
+    try:
+        return fut.result()
+    finally:
+        tracing.record_span(
+            "pipeline_wait", start_pc=fut.submitted_pc,
+            hist=metrics.DEVICE_PIPELINE_WAIT_SECONDS,
+            hist_labels={"op": op}, work=fut.work,
+        )
+
+
 def summary() -> Optional[dict]:
-    """The pipeline section of ``GET /lighthouse/device`` (None until the
-    pipeline has been started)."""
+    """The pipeline section of ``GET /lighthouse/device`` (None until any
+    pipeline has been started).  The bls pipeline's snapshot keys stay
+    top-level (the section's original shape); the hash/job pipelines and
+    the shared arbiter ride as sub-sections."""
     with _LOCK:
         pipe = _PIPELINE
-    if pipe is None:
+        hash_pipe = _HASH_PIPELINE
+        jobs = dict(_JOB_PIPELINES)
+    if pipe is None and hash_pipe is None and not jobs:
         return None
-    return pipe.snapshot()
+    out = pipe.snapshot() if pipe is not None else {"op": "bls_verify"}
+    out["hash"] = hash_pipe.snapshot() if hash_pipe is not None else None
+    out["jobs"] = {op: p.snapshot() for op, p in sorted(jobs.items())} or None
+    out["arbiter"] = ARBITER.snapshot()
+    return out
 
 
 def shutdown(timeout: float = 30.0) -> None:
-    """Disable routing and drain the process pipeline (Client.stop).  New
-    verify calls fall back to the direct backend path immediately; in-flight
-    futures still resolve."""
-    global _PIPELINE
+    """Disable routing and drain every process pipeline (Client.stop).  New
+    verify/hash/job calls fall back to the direct paths immediately;
+    in-flight futures still resolve."""
+    global _PIPELINE, _HASH_PIPELINE, _JOB_PIPELINES
     disable()
     with _LOCK:
         pipe, _PIPELINE = _PIPELINE, None
+        hash_pipe, _HASH_PIPELINE = _HASH_PIPELINE, None
+        jobs, _JOB_PIPELINES = _JOB_PIPELINES, {}
     if pipe is not None:
         pipe.shutdown(timeout=timeout)
+    if hash_pipe is not None:
+        hash_pipe.shutdown(timeout=timeout)
+    for job_pipe in jobs.values():
+        job_pipe.shutdown(timeout=timeout)
 
 
 def reset_for_tests() -> None:
     shutdown(timeout=5.0)
+    ARBITER.reset_for_tests()

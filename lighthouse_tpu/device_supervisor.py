@@ -76,6 +76,7 @@ STATE_CODES = {STATE_CLOSED: 0, STATE_OPEN: 1, STATE_HALF_OPEN: 2}
 DEFAULT_DEADLINES = {
     "bls_verify": 300.0,
     "sha256_pairs": 120.0,
+    "tree_hash": 120.0,
     "epoch_deltas": 300.0,
     "epoch_deltas_leak": 300.0,
     "kzg_batch": 300.0,

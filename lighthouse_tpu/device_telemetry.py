@@ -340,6 +340,30 @@ def host_fallback_counts() -> Dict[str, int]:
         return dict(_FALLBACKS)
 
 
+def recent_inflight_seconds(op: str, min_samples: int = 3,
+                            window: int = 32) -> Optional[float]:
+    """Median observed in-flight duration (dispatch + wait stages) of the
+    last ``window`` dispatched ``op`` batches, or None below
+    ``min_samples``.  The adaptive-linger feedback signal: while a batch is
+    in flight the pipeline's pending queue fills for free, so the observed
+    in-flight duration is exactly how long a linger is throughput-neutral
+    (device_pipeline derives its effective linger from this)."""
+    durations: List[float] = []
+    for r in FLIGHT_RECORDER.recent(limit=window, op=op):
+        stages = r.get("stages_s")
+        # compiled batches carry jit time in their dispatch stage (minutes
+        # on CPU) — poison for a linger signal meant to track steady state
+        if not stages or r.get("host_fallback") or r.get("compiled"):
+            continue
+        d = stages.get("dispatch", 0.0) + stages.get("wait", 0.0)
+        if d > 0:
+            durations.append(d)
+    if len(durations) < min_samples:
+        return None
+    durations.sort()
+    return durations[len(durations) // 2]
+
+
 # ------------------------------------------------------------- device memory
 
 

@@ -76,6 +76,16 @@ BATCH_AXES = {
         "notes": "participating-increment sums span the whole registry "
                  "(NO_SPLIT_OPS); sharding needs a psum per flag index",
     },
+    "lighthouse_tpu/ops/tree_hash.py:_tree_hash_subtrees": {
+        "op": "tree_hash",
+        "batch_axis": 0,
+        "batched_args": ["leaves"],
+        "replicated_args": [],
+        "reduces_over_batch": False,
+        "out_batched": True,
+        "notes": "fused depth-5 Merkle subtrees; embarrassingly parallel "
+                 "over the subtree axis (every output level keeps it)",
+    },
     "lighthouse_tpu/ops/kzg_device.py:_device_kzg_batch": {
         "op": "kzg_batch",
         "batch_axis": 0,

@@ -13,8 +13,19 @@ reward intermediates reach ~1e13), so dispatch runs under the
 ``jax.enable_x64`` context — scoped to these calls, leaving
 the int32-limb BLS kernels untouched.
 
+Shape discipline: registries dispatch at power-of-two **registry buckets**
+(:data:`N_BUCKETS`, through 2^20 validators — mainnet shape), padded with
+never-active rows (far-future activation epoch, zero balance) that are
+ineligible for every flag mask and therefore contribute exactly zero to the
+registry-wide participating-increment sums.  A ~1M-validator network
+compiles a handful of executables instead of one per registry size — the
+same bucket story as ``ops/verify.py``/``ops/sha256_device.py``, and what
+lets the registry grow every epoch without a recompile.
+
 Semantics are bit-identical to the numpy path (same floor divisions, same
-masks); tests assert equality on randomized registries.
+masks); tests assert equality on randomized registries, including
+non-power-of-two live counts against exact-size golden runs
+(tests/test_epoch_buckets.py).
 """
 
 from __future__ import annotations
@@ -34,11 +45,7 @@ from ..types.spec import (
 )
 
 
-# Unbucketed by design: one executable per validator-count/in_leak pair —
-# the registry size is stable across epochs, so the compiled-program
-# population is two per network, not per batch.
 @partial(jax.jit, static_argnames=("in_leak",))
-# recompile-hazard: ok(one executable per registry size; stable across epochs)
 def _deltas_kernel(
     eff_bal,            # (n,) int64 gwei
     activation_epoch,   # (n,) int64
@@ -122,10 +129,30 @@ _SHARDED_ENTRY = None
 
 ENTRY_KEY = "lighthouse_tpu/ops/epoch_device.py:_deltas_kernel"
 
-#: Epoch far beyond any reachable epoch: mesh-pad rows use it as their
-#: activation epoch so they are never active/eligible and contribute
-#: exactly zero to every registry-wide sum.
+#: Epoch far beyond any reachable epoch: bucket- and mesh-pad rows use it
+#: as their activation epoch so they are never active/eligible and
+#: contribute exactly zero to every registry-wide sum.
 _PAD_ACTIVATION_EPOCH = 1 << 62
+
+#: Power-of-two registry buckets through 2^20 validators.  The bottom
+#: bucket keeps the tier-1/minimal-preset registries on one tiny
+#: executable; the top covers mainnet's ~1M.  A registry past the top
+#: bucket dispatches at its exact size — that is decades of deposits away,
+#: and one oversized executable beats refusing to process the chain.
+N_BUCKETS = (64, 256, 1024, 4096, 16384, 65536, 262144, 1048576)
+
+#: Per-pad-row fill values for the batched argument tuple (eff_bal,
+#: activation, exit, withdrawable, slashed, prev_part, inactivity): a row
+#: that is never active, never eligible, carries no balance and no flags.
+_PAD_FILLS = (0, _PAD_ACTIVATION_EPOCH, 0, 0, False, 0, 0)
+
+
+def _bucket(n: int) -> int:
+    """The registry bucket for ``n`` validators (exact size past the top)."""
+    for b in N_BUCKETS:
+        if n <= b:
+            return b
+    return n
 
 
 def _sharded_entry():
@@ -155,23 +182,24 @@ def epoch_deltas_device(
     """numpy in, numpy out — the device analog of the per_epoch numpy block.
     Returns ``(new_inactivity, balance_delta)`` (int64 arrays).
 
-    Mesh on: the registry pads to a multiple of the mesh size with
-    never-active rows (far-future activation — ineligible for every flag
-    mask, so the participating-increment psums are untouched), the batched
-    arrays shard over ``("dp",)`` and the scalars replicate; the pad rows
-    are sliced back off the outputs."""
+    The registry pads to its power-of-two bucket (:data:`N_BUCKETS`) —
+    mesh on, additionally to a multiple of the mesh size — with never-active
+    rows (far-future activation: ineligible for every flag mask, so the
+    participating-increment sums/psums are untouched); the pad rows are
+    sliced back off the outputs."""
     import time as _time
 
     from jax.experimental import enable_x64
 
     from .. import device_mesh, device_telemetry, fault_injection
 
-    # One executable per (validator-count, in_leak) pair — in_leak is a
+    # One executable per (registry-bucket, in_leak) pair — in_leak is a
     # static argument, so it forks the compiled program like a shape does.
     op = "epoch_deltas_leak" if in_leak else "epoch_deltas"
     n = int(np.asarray(arrays.effective_balance).shape[0])
+    nb = _bucket(n)
     mesh = device_mesh.size() if device_mesh.enabled() else 0
-    np_ = device_mesh.pad_rows(n) if mesh else n
+    np_ = device_mesh.pad_rows(nb) if mesh else nb
     if fault_injection.ACTIVE:
         if not device_telemetry.COMPILE_CACHE.seen(op, (np_,), mesh=mesh):
             fault_injection.check("device.compile", op=op)
@@ -191,21 +219,19 @@ def epoch_deltas_device(
             spec.effective_balance_increment, spec.inactivity_score_bias,
             spec.inactivity_score_recovery_rate, quotient,
         )
+        if np_ != n:
+            batched = tuple(
+                device_mesh.grow_rows(a, np_, f)
+                for a, f in zip(batched, _PAD_FILLS)
+            )
         t_dispatch = _time.perf_counter()
         if mesh:
-            if np_ != n:
-                fills = (0, _PAD_ACTIVATION_EPOCH, 0, 0, False, 0, 0)
-                batched = tuple(
-                    device_mesh.grow_rows(a, np_, f)
-                    for a, f in zip(batched, fills)
-                )
             entry = _sharded_entry()
             placed = entry.place(
                 *batched, *(jnp.int64(s) for s in scalars)
             )
             out = entry(*placed, in_leak=bool(in_leak))
         else:
-            # recompile-hazard: ok(one executable per registry size; stable across epochs)
             out = _deltas_kernel(
                 *(jnp.asarray(a) for a in batched),
                 *(jnp.int64(s) for s in scalars),

@@ -164,6 +164,7 @@ class ScenarioRunner:
         self.timeline: List[dict] = []
         self._saved_hash_impl = None
         self._saved_host_impl = None
+        self._state_hashing_on = False
         self._breakers_touched = False
         self._pipeline_enabled = False
         self._mesh_touched = False
@@ -399,6 +400,55 @@ class ScenarioRunner:
             ssz_mod.set_hash_pairs_impl(self._saved_hash_impl)
             sha256_device._HOST_IMPL = self._saved_host_impl
             self._saved_hash_impl = None
+
+    def _ev_state_hashing(self, enable: bool, threshold_blocks: int = 4) -> None:
+        """Route Merkle pair-hash layers of ``threshold_blocks``+ through
+        ``ops/tree_hash.hash_pairs`` — the pipeline-aware hash seam: with
+        the device pipeline on, layers coalesce through the
+        ``sha256_pairs`` hash pipeline (supervised inside — a
+        ``device.dispatch[op=sha256_pairs]`` fault plan bites the exact
+        production path, and breaker-open batches resolve through the host
+        kernel with identical bytes).  The tree-hash state PR's analog of
+        ``device_hashing``; reversible, with ``sha256_device._HOST_IMPL``
+        pointed at the saved kernel so the supervisor's fallback cannot
+        recurse into the hybrid."""
+        from .ops import sha256_device, tree_hash
+        from .types import ssz as ssz_mod
+
+        if enable:
+            if self._saved_hash_impl is not None:
+                return
+            host = self._saved_hash_impl = ssz_mod._hash_pairs
+            self._saved_host_impl = sha256_device._HOST_IMPL
+            sha256_device._HOST_IMPL = host
+            self._state_hashing_on = True
+            tree_hash.configure(enabled=True,
+                                device_min_blocks=threshold_blocks)
+            # pin a tight linger (same rationale as _ev_device_pipeline):
+            # the adaptive default tracks observed in-flight durations,
+            # which on the 1-core gate box would park every per-level
+            # Merkle batch far longer than the scenario budget tolerates.
+            # Starting the hash pipeline here makes THIS event a pipeline
+            # owner too — flag it so teardown shuts the worker down even
+            # when the scenario never ran a device_pipeline event
+            from . import device_pipeline
+
+            device_pipeline.get_hash_pipeline().linger_s = 0.002
+            self._pipeline_enabled = True
+
+            def hybrid(data: bytes) -> bytes:
+                n = len(data) // 64
+                if threshold_blocks <= n <= sha256_device.N_BUCKETS[-1]:
+                    return tree_hash.hash_pairs(data)
+                return host(data)
+
+            ssz_mod.set_hash_pairs_impl(hybrid)
+        elif self._saved_hash_impl is not None:
+            ssz_mod.set_hash_pairs_impl(self._saved_hash_impl)
+            sha256_device._HOST_IMPL = self._saved_host_impl
+            self._saved_hash_impl = None
+            self._state_hashing_on = False
+            tree_hash.reset_for_tests()
 
     def _ev_join_checkpoint(self, anchor_from: int = 0, lossy: bool = False,
                             backfill: bool = False,
@@ -677,7 +727,10 @@ class ScenarioRunner:
 
             device_pipeline.reset_for_tests()
         if self._saved_hash_impl is not None:
-            self._ev_device_hashing(enable=False)
+            if self._state_hashing_on:
+                self._ev_state_hashing(enable=False)
+            else:
+                self._ev_device_hashing(enable=False)
         if self._breakers_touched:
             from . import device_supervisor
 
@@ -839,6 +892,37 @@ def pipeline_mid_sync(seed: int = 0) -> Scenario:
             Event(4, "device_hashing", {"enable": False}),
         ),
         extra_checks=_check_pipeline_active,
+    )
+
+
+def state_hash_pipeline(seed: int = 0) -> Scenario:
+    """Tree-hash traffic through the async pipeline's shared arbiter:
+    Merkle pair-hash layers route through ``ops/tree_hash.hash_pairs``
+    (coalescing into ``sha256_pairs`` hash-pipeline batches) while every
+    bls verification rides the verify pipeline, a joining node range-syncs
+    through it all, and a fault plan trips the sha breaker mid-window —
+    hash futures must still resolve bit-identically through the host
+    kernel.  The 2-run gate proves batch COMPOSITION variance (which hash
+    groups coalesce together is timing-dependent) cannot leak into chain
+    content."""
+    return Scenario(
+        name="state_hash_pipeline",
+        description="pipelined tree-hash + bls traffic under sha faults",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=32, fault_slots=8, recovery_slots=24,
+        events=(
+            Event(0, "device_pipeline", {"enable": True}),
+            Event(0, "breaker_config",
+                  {"failure_threshold": 2, "open_cooldown_s": 300.0,
+                   "probe_successes": 1}),
+            Event(0, "state_hashing", {"enable": True}),
+            Event(0, "install_faults",
+                  {"spec": "device.dispatch[op=sha256_pairs]=error"}),
+            Event(1, "join_checkpoint", {"anchor_from": 0}),
+            Event(4, "clear_faults"),
+            Event(4, "state_hashing", {"enable": False}),
+        ),
+        extra_checks=_check_hash_pipeline,
     )
 
 
@@ -1081,6 +1165,32 @@ def _check_pipeline_active(runner: ScenarioRunner) -> dict:
             "breaker": br}
 
 
+def _check_hash_pipeline(runner: ScenarioRunner) -> dict:
+    """Tree-hash traffic really rode the hash pipeline, the sha breaker
+    really tripped (so breaker-open host routing with futures resolving is
+    what the convergence gate certified), and everything drained."""
+    from . import device_pipeline, device_supervisor
+
+    snap = device_pipeline.summary()
+    assert snap is not None, "no pipeline ever started"
+    hash_snap = snap.get("hash")
+    assert hash_snap is not None and hash_snap["batches_total"] >= 1, (
+        "no pair-hash batch rode the hash pipeline")
+    assert hash_snap["pending_groups"] == 0 and \
+        hash_snap["in_flight_groups"] == 0, "hash pipeline did not drain"
+    br = device_supervisor.SUPERVISOR.breaker("sha256_pairs").snapshot()
+    assert br["trips_total"] >= 1, "sha breaker never tripped mid-window"
+    grants = snap["arbiter"]["grants"]
+    assert grants.get("sha256_pairs", 0) >= 1, (
+        f"no sha256_pairs arbiter grant recorded ({grants})")
+    return {
+        "hash_pipeline": {k: hash_snap[k] for k in
+                          ("batches_total", "groups_total", "blocks_total")},
+        "arbiter_grants": grants,
+        "breaker": br,
+    }
+
+
 def _check_spammer_penalized(runner: ScenarioRunner) -> dict:
     spammer_id, victim = runner.ctx["spammer"]
     score = victim.node.service.peer_manager._peer(spammer_id).score
@@ -1184,6 +1294,7 @@ SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     "device_breaker_mid_sync": device_breaker_mid_sync,
     "mesh_degradation": mesh_degradation,
     "pipeline_mid_sync": pipeline_mid_sync,
+    "state_hash_pipeline": state_hash_pipeline,
     "spam_slow_peer": spam_slow_peer,
     "byz_double_vote_smoke": byz_double_vote_smoke,
     "byz_minority_equivocation": byz_minority_equivocation,

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from .chain import BeaconChainHarness
 from .consensus import helpers as h
 from .network.node import LocalNode
@@ -54,6 +56,7 @@ class SimNode:
             )
             self._chain = self.harness.chain
         self.keys = set(keys)  # validator indices this node runs
+        self._keys_mask: Optional[np.ndarray] = None  # bool over validators
         self.alive = True
         self.node = LocalNode(
             hub=hub, peer_id=peer_id or f"sim{index}",
@@ -71,6 +74,7 @@ class SimNode:
         fresh.harness = old.harness
         fresh._chain = old.chain
         fresh.keys = old.keys
+        fresh._keys_mask = None
         fresh.alive = True
         fresh.node = LocalNode(
             hub=hub, peer_id=old.peer_id, chain=old.chain, harness=old.harness,
@@ -125,18 +129,31 @@ class SimNode:
             chain.process_block(signed)
             self.node.publish_block(signed)
             out["proposed"] = 1
-        # committees are epoch-deterministic on the advanced state
+        # committees are epoch-deterministic on the advanced state.  The
+        # membership scan is vectorized: one boolean ownership mask over the
+        # registry, one fancy-index per committee — the old per-member
+        # Python loop was O(nodes x committees x committee_size) per slot,
+        # the scale wall of ROADMAP item 5.  Attestation data is only
+        # produced for committees this node actually owns members of, and
+        # emission order (committee index ascending, then position
+        # ascending) is IDENTICAL to the loop it replaces — the scenario
+        # soak's 2-run determinism gate hangs on that.
         epoch = slot // spec.slots_per_epoch
         committees = h.get_committee_count_per_slot(state, epoch, spec)
+        own = self._ownership_mask(len(state.validators), skip)
         for index in range(committees):
-            committee = h.get_beacon_committee(state, slot, index, spec)
+            committee = np.asarray(
+                h.get_beacon_committee(state, slot, index, spec))
+            mine = np.nonzero(own[committee])[0]
+            if mine.size == 0:
+                continue
             data = chain.produce_attestation_data(slot, index)
-            for pos, vidx in enumerate(committee):
-                if int(vidx) not in self.keys or int(vidx) in skip:
-                    continue
+            for pos in mine:
+                pos = int(pos)
+                vidx = int(committee[pos])
                 bits = [False] * len(committee)
                 bits[pos] = True
-                sig = harness.sign_attestation_data(state, data, int(vidx))
+                sig = harness.sign_attestation_data(state, data, vidx)
                 att = harness.types.Attestation(
                     aggregation_bits=bits, data=data, signature=sig.to_bytes()
                 )
@@ -147,6 +164,27 @@ class SimNode:
                 self.node.publish_attestation(att)
                 out["attested"] += 1
         return out
+
+    def _ownership_mask(self, n_validators: int,
+                        skip: set) -> np.ndarray:
+        """Boolean (n_validators,) mask of validators whose duties this
+        node performs this slot: our keys minus the suppressed set.  The
+        keys half is cached (the registry only grows); the skip overlay is
+        tiny and rebuilt per call."""
+        mask = self._keys_mask
+        if mask is None or len(mask) < n_validators:
+            mask = np.zeros(n_validators, dtype=bool)
+            owned = [k for k in self.keys if k < n_validators]
+            if owned:
+                mask[owned] = True
+            self._keys_mask = mask
+        own = mask[:n_validators]
+        if skip:
+            own = own.copy()
+            suppressed = [v for v in skip if v < n_validators]
+            if suppressed:
+                own[suppressed] = False
+        return own
 
     def shutdown(self) -> None:
         # sever the fabric links too: live peers must stop delivering into a

@@ -52,6 +52,32 @@ def _ceil_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
+def _make_tree(limit_chunks: int):
+    """The leaf-tree engine for one big field: the device-backed
+    ``ops/tree_hash.DeviceLeafTree`` when device tree hashing is enabled
+    (full rebuilds walk the fused subtree program five levels per dispatch;
+    dirty-path pair batches ride the pipeline-aware hash seam), else the
+    host :class:`_LeafTree`.  Both engines share the attribute layout
+    (leaves/layers/limit/depth/_root), so clone/deepcopy handles either —
+    and both are bit-identical to the hashlib golden model.
+
+    Import discipline: ``ops/tree_hash`` pulls jax, and this module must
+    stay hermetic for host-only tests — so the device engine is consulted
+    only when its module is already loaded (a runtime ``configure`` toggle)
+    or the env var opts in; otherwise no jax import ever happens here."""
+    import os
+    import sys
+
+    _tree_hash = sys.modules.get("lighthouse_tpu.ops.tree_hash")
+    if _tree_hash is None:
+        if os.environ.get("LIGHTHOUSE_TPU_DEVICE_TREE_HASH", "") != "1":
+            return _LeafTree(limit_chunks)
+        from ..ops import tree_hash as _tree_hash
+    if _tree_hash.enabled():
+        return _tree_hash.DeviceLeafTree(limit_chunks)
+    return _LeafTree(limit_chunks)
+
+
 class _LeafTree:
     """Incremental Merkle tree over 32-byte leaf chunks with a chunk limit.
 
@@ -70,19 +96,36 @@ class _LeafTree:
 
     # ------------------------------------------------------------- updates
 
-    def update(self, new_leaves: np.ndarray) -> bytes:
+    def update(self, new_leaves: np.ndarray,
+               dirty_hint: Optional[np.ndarray] = None) -> bytes:
         """Bring the tree to ``new_leaves`` (shape (n, 32) uint8), re-hashing
-        only changed paths; returns the root."""
+        only changed paths; returns the root.
+
+        ``dirty_hint``: indices the caller asserts are the only possibly-
+        changed leaves (hinted rows are still diffed; un-hinted rows are
+        trusted unchanged, skipping the O(n) leaf scan).  Only exact
+        sources may hint — the validator cache's fingerprint diff is one;
+        a wrong hint would serve a stale root."""
         n = len(new_leaves)
         if n > self.limit:
             raise ValueError(f"{n} chunks exceeds limit {self.limit}")
         if self.leaves is None or len(self.leaves) != n:
             return self._rebuild(new_leaves)
-        diff = np.any(self.leaves != new_leaves, axis=1)
-        if not diff.any():
-            return self._root
-        dirty = np.nonzero(diff)[0]
-        self.leaves = new_leaves.copy()
+        if dirty_hint is not None:
+            hint = np.unique(np.asarray(dirty_hint, dtype=np.int64))
+            if hint.size == 0:
+                return self._root
+            changed = np.any(self.leaves[hint] != new_leaves[hint], axis=1)
+            dirty = hint[changed]
+            if dirty.size == 0:
+                return self._root
+            self.leaves[dirty] = new_leaves[dirty]
+        else:
+            diff = np.any(self.leaves != new_leaves, axis=1)
+            if not diff.any():
+                return self._root
+            dirty = np.nonzero(diff)[0]
+            self.leaves = new_leaves.copy()
         level = self.leaves
         for d, layer in enumerate(self.layers):
             parents = np.unique(dirty >> 1)
@@ -151,7 +194,7 @@ class _BasicListCache:
     def __init__(self, elem_size: int, limit_elems: int, mix_length: bool):
         limit_chunks = max(1, (limit_elems * elem_size + 31) // 32)
         self.elem_size = elem_size
-        self.tree = _LeafTree(limit_chunks)
+        self.tree = _make_tree(limit_chunks)
         self.mix_length = mix_length
 
     def root(self, values) -> bytes:
@@ -177,7 +220,7 @@ class _RootListCache:
     randao_mixes, historical roots): each element IS a leaf chunk."""
 
     def __init__(self, limit_elems: int, mix_length: bool):
-        self.tree = _LeafTree(max(1, limit_elems))
+        self.tree = _make_tree(max(1, limit_elems))
         self.mix_length = mix_length
 
     def root(self, values) -> bytes:
@@ -198,7 +241,7 @@ class _ValidatorListCache:
 
     def __init__(self, elem_type, limit_elems: int):
         self.elem_type = elem_type  # _ContainerType of Validator
-        self.tree = _LeafTree(max(1, limit_elems))
+        self.tree = _make_tree(max(1, limit_elems))
         self.fingerprints: List[Optional[tuple]] = []
         self.roots: Optional[np.ndarray] = None  # (n, 32) uint8
 
@@ -249,7 +292,11 @@ class _ValidatorListCache:
                 hashed = _hash_blocks(level.tobytes())
                 level = np.frombuffer(hashed, dtype=np.uint8).reshape(k, width // 2 * 32)
             self.roots[dirty] = level.reshape(k, 32)
-        body = self.tree.update(self.roots)
+        # the fingerprint diff IS an exact dirty set (an empty one proves
+        # no element root changed): hint the tree so a 1%-dirty mainnet
+        # registry skips the O(n) root-leaf scan
+        body = self.tree.update(
+            self.roots, dirty_hint=np.asarray(dirty, dtype=np.int64))
         return mix_in_length(body, n)
 
 
@@ -267,7 +314,7 @@ class _ElementMemoListCache:
 
     def __init__(self, elem_type, limit_elems: int):
         self.elem_type = elem_type
-        self.tree = _LeafTree(max(1, limit_elems))
+        self.tree = _make_tree(max(1, limit_elems))
         self.fps: List[Optional[bytes]] = []
         self.roots: Optional[np.ndarray] = None  # (n, 32) uint8
 

@@ -1,0 +1,292 @@
+#!/usr/bin/env python
+"""Occupancy-driven bucket-vocabulary tuning report (ISSUE 13 satellite).
+
+The device telemetry layer already accounts padding waste per dispatched
+batch (``device_batch_occupancy_ratio{op,axis}`` + the flight recorder's
+per-batch ``occupancy_sets``/``occupancy_keys``), and ROADMAP item 2 names
+occupancy-driven bucket tuning as a self-tuning slice.  This script is the
+report-only half: it reads a captured telemetry summary — the JSON body of
+``GET /lighthouse/device``, or a BENCH JSON artifact carrying a
+``device_telemetry`` section — and prints suggested deltas for the three
+bucket vocabularies:
+
+- ``ops/verify.N_BUCKETS`` / ``K_BUCKETS``   (bls sets / keys-per-set)
+- ``ops/sha256_device.N_BUCKETS``            (pair-hash blocks)
+- ``ops/epoch_device.N_BUCKETS``             (registry buckets)
+- ``ops/tree_hash.N_BUCKETS``                (Merkle subtrees)
+
+Heuristics (documented so the report is reviewable, not oracular):
+
+- p50 occupancy below ``DENSIFY_BELOW`` → the vocabulary is too sparse
+  around the observed live sizes: suggest inserting the midpoint bucket
+  between the two surrounding powers of two (occupancy can then never drop
+  below ~50% at that size).
+- p90 occupancy above ``WIDEN_ABOVE`` with the top bucket saturated →
+  traffic is pressing the ceiling: suggest the next power of two.
+- too few samples → say so and suggest nothing (a tuning change must rest
+  on evidence, ``MIN_SAMPLES`` batches per op/axis).
+
+REPORT-ONLY by design: it changes no behavior and writes no files — the
+output is a reviewed diff away from the vocabularies it names.
+
+Usage::
+
+    python scripts/analysis/bucket_tuning.py --from-json device_summary.json
+    curl -s localhost:5052/lighthouse/device | \
+        python scripts/analysis/bucket_tuning.py --from-json -
+
+Import-free of lighthouse_tpu/jax (runs anywhere, same discipline as
+check_static); the vocabularies above are quoted as literals and
+self-tested against seeded fixtures on every run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+#: The committed vocabularies this report suggests deltas against (kept as
+#: literals so the script never imports jax; the self-test cross-checks the
+#: spellings against the source files when run from the repo).
+VOCABULARIES: Dict[str, List[int]] = {
+    "bls_verify/sets": [1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                        4096],
+    "bls_verify/keys": [1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048],
+    "sha256_pairs/sets": [256, 1024, 4096, 16384, 65536, 262144],
+    "epoch_deltas/sets": [64, 256, 1024, 4096, 16384, 65536, 262144,
+                          1048576],
+    "tree_hash/sets": [8, 128, 2048, 32768],
+}
+
+#: op/axis (as telemetry spells them) -> vocabulary key
+AXIS_TO_VOCAB = {
+    ("bls_verify", "sets"): "bls_verify/sets",
+    ("bls_verify", "keys"): "bls_verify/keys",
+    ("sha256_pairs", "sets"): "sha256_pairs/sets",
+    ("epoch_deltas", "sets"): "epoch_deltas/sets",
+    ("epoch_deltas_leak", "sets"): "epoch_deltas/sets",
+    ("tree_hash", "sets"): "tree_hash/sets",
+}
+
+DENSIFY_BELOW = 0.5   # p50 occupancy under this → suggest midpoint buckets
+WIDEN_ABOVE = 0.98    # p90 at the top bucket over this → suggest next pow2
+MIN_SAMPLES = 8
+
+
+def _occupancy_sections(doc: dict) -> Optional[dict]:
+    """The ``occupancy`` section from either a /lighthouse/device summary
+    or a BENCH JSON artifact (``device_telemetry.occupancy``)."""
+    if "occupancy" in doc:
+        return doc["occupancy"]
+    dt = doc.get("device_telemetry")
+    if isinstance(dt, dict) and "occupancy" in dt:
+        return dt["occupancy"]
+    return None
+
+
+def suggest(doc: dict) -> List[dict]:
+    """The report rows: one dict per (op, axis) with evidence + suggestion."""
+    occ = _occupancy_sections(doc)
+    rows: List[dict] = []
+    if not occ:
+        return rows
+    for op, axes in sorted(occ.items()):
+        for axis, stats in sorted((axes or {}).items()):
+            if not stats:
+                continue
+            vocab_key = AXIS_TO_VOCAB.get((op, axis))
+            row = {
+                "op": op,
+                "axis": axis,
+                "samples": stats.get("n", 0),
+                "p50": stats.get("p50"),
+                "p90": stats.get("p90"),
+                "vocabulary": vocab_key,
+                "suggestion": None,
+                "reason": None,
+            }
+            rows.append(row)
+            if vocab_key is None:
+                row["reason"] = "no bucket vocabulary maps to this axis"
+                continue
+            if row["samples"] < MIN_SAMPLES:
+                row["reason"] = (
+                    f"only {row['samples']} batches in the window "
+                    f"(need {MIN_SAMPLES}) — no suggestion on thin evidence")
+                continue
+            vocab = VOCABULARIES[vocab_key]
+            p50 = row["p50"] if row["p50"] is not None else 1.0
+            p90 = row["p90"] if row["p90"] is not None else p50
+            if p50 < DENSIFY_BELOW:
+                # Padding-waste dominated: the median batch fills under half
+                # its bucket, so the gap between adjacent buckets is too
+                # wide around the live sizes.  Midpoints bound occupancy at
+                # ~50% by construction.
+                mids = sorted({
+                    (vocab[i] + vocab[i + 1]) // 2
+                    for i in range(len(vocab) - 1)
+                    if vocab[i + 1] > 2 * vocab[i]  # only real gaps
+                })
+                if mids:
+                    row["suggestion"] = {"insert_buckets": mids[:4]}
+                    row["reason"] = (
+                        f"p50 occupancy {p50:.2f} < {DENSIFY_BELOW}: the "
+                        "median batch wastes over half its lanes — densify "
+                        "the vocabulary with midpoint buckets")
+                else:
+                    # ratio-2 (pure power-of-two) vocabulary: occupancy
+                    # can't drop below 50% from bucket gaps, so a low p50
+                    # means tiny live batches — a traffic question (linger,
+                    # coalescing target), not a vocabulary one
+                    row["reason"] = (
+                        f"p50 occupancy {p50:.2f} < {DENSIFY_BELOW} but the "
+                        "vocabulary is already ratio-2 dense — no midpoint "
+                        "exists; look at coalescing (linger/target), not "
+                        "buckets")
+            elif p90 >= WIDEN_ABOVE:
+                row["suggestion"] = {"append_bucket": vocab[-1] * 2}
+                row["reason"] = (
+                    f"p90 occupancy {p90:.2f} >= {WIDEN_ABOVE}: traffic is "
+                    "pressing the top bucket — consider the next power of "
+                    "two (compile-cost review required)")
+            else:
+                row["reason"] = (
+                    f"occupancy healthy (p50 {p50:.2f}, p90 {p90:.2f}) — "
+                    "no change suggested")
+    return rows
+
+
+def render(rows: List[dict]) -> str:
+    if not rows:
+        return ("bucket_tuning: no occupancy data in the input — pass the "
+                "JSON body of GET /lighthouse/device (or a BENCH artifact "
+                "with a device_telemetry section)")
+    lines = ["bucket_tuning: occupancy-driven bucket report (report-only; "
+             "edit the named vocabulary and review the diff)"]
+    for row in rows:
+        head = (f"  {row['op']}/{row['axis']}: n={row['samples']} "
+                f"p50={row['p50']} p90={row['p90']}")
+        lines.append(head)
+        lines.append(f"    -> {row['reason']}")
+        if row["suggestion"]:
+            lines.append(
+                f"    -> suggest {json.dumps(row['suggestion'])} "
+                f"in {row['vocabulary']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- self-test
+
+
+def self_test() -> List[str]:
+    """Seeded fixtures: the heuristics must still see — a waste-heavy
+    fixture must suggest densifying, a saturated one widening, a thin one
+    nothing; and (when run from the repo) the quoted vocabularies must
+    match the source literals."""
+    errors: List[str] = []
+    waste = {"occupancy": {"sha256_pairs": {
+        "sets": {"n": 32, "p50": 0.12, "p90": 0.4}}}}
+    rows = suggest(waste)
+    if not rows or not rows[0]["suggestion"] or \
+            rows[0]["suggestion"].get("insert_buckets", [None])[0] != 640:
+        errors.append("waste fixture produced no densify suggestion")
+    # a pure power-of-two vocabulary has no midpoints: low occupancy must
+    # fall through to the "already dense" reason, never an empty suggestion
+    pow2_waste = {"occupancy": {"bls_verify": {
+        "sets": {"n": 32, "p50": 0.12, "p90": 0.4}}}}
+    rows = suggest(pow2_waste)
+    if not rows or rows[0]["suggestion"] is not None or \
+            "ratio-2 dense" not in (rows[0]["reason"] or ""):
+        errors.append("pow2 waste fixture should suggest nothing "
+                      "(already ratio-2 dense)")
+    full = {"occupancy": {"sha256_pairs": {
+        "sets": {"n": 32, "p50": 0.99, "p90": 1.0}}}}
+    rows = suggest(full)
+    if not rows or not rows[0]["suggestion"] or \
+            rows[0]["suggestion"].get("append_bucket") != 524288:
+        errors.append("saturated fixture produced no widen suggestion")
+    thin = {"occupancy": {"bls_verify": {
+        "sets": {"n": 2, "p50": 0.1, "p90": 0.1}}}}
+    rows = suggest(thin)
+    if not rows or rows[0]["suggestion"] is not None:
+        errors.append("thin-evidence fixture still suggested a change")
+    bench_shape = {"device_telemetry": {"occupancy": {"bls_verify": {
+        "sets": {"n": 32, "p50": 0.9, "p90": 0.95}}}}}
+    if not suggest(bench_shape):
+        errors.append("BENCH-shaped input (device_telemetry section) unread")
+    errors.extend(_check_vocabulary_rot())
+    return errors
+
+
+def _check_vocabulary_rot() -> List[str]:
+    """The quoted literals must match the source vocabularies (text scan,
+    no imports).  Skipped silently when the sources are absent (the script
+    can run on a bare telemetry dump anywhere)."""
+    import os
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(os.path.dirname(here))
+    sources = {
+        "bls_verify/sets": ("lighthouse_tpu/ops/verify.py", "N_BUCKETS"),
+        "bls_verify/keys": ("lighthouse_tpu/ops/verify.py", "K_BUCKETS"),
+        "sha256_pairs/sets": ("lighthouse_tpu/ops/sha256_device.py",
+                              "N_BUCKETS"),
+        "epoch_deltas/sets": ("lighthouse_tpu/ops/epoch_device.py",
+                              "N_BUCKETS"),
+        "tree_hash/sets": ("lighthouse_tpu/ops/tree_hash.py", "N_BUCKETS"),
+    }
+    errors: List[str] = []
+    for key, (rel, name) in sources.items():
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+        m = re.search(rf"^{name}\s*=\s*\(([^)]*)\)", text, re.MULTILINE)
+        if not m:
+            errors.append(f"{rel}: no {name} literal found for {key}")
+            continue
+        found = [int(v.strip()) for v in m.group(1).split(",") if v.strip()]
+        if found != VOCABULARIES[key]:
+            errors.append(
+                f"{key}: quoted vocabulary {VOCABULARIES[key]} != source "
+                f"{name} {found} in {rel} — update this script")
+    return errors
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--from-json", dest="src", default=None,
+                    help="path to a GET /lighthouse/device body or BENCH "
+                         "JSON artifact ('-' = stdin)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report rows as JSON instead of text")
+    ap.add_argument("--no-self-test", action="store_true")
+    args = ap.parse_args()
+
+    if not args.no_self_test:
+        errors = self_test()
+        if errors:
+            for e in errors:
+                print(f"bucket_tuning: FAIL: {e}", file=sys.stderr)
+            return 1
+
+    if args.src is None:
+        print("bucket_tuning: self-test OK (pass --from-json to analyze a "
+              "telemetry dump)")
+        return 0
+
+    raw = sys.stdin.read() if args.src == "-" else open(args.src).read()
+    rows = suggest(json.loads(raw))
+    if args.json:
+        print(json.dumps(rows, indent=2, sort_keys=True))
+    else:
+        print(render(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -145,6 +145,7 @@ def _targets() -> List[Target]:
         pairing,
         sha256_device,
         tower,
+        tree_hash,
         verify,
     )
 
@@ -234,12 +235,28 @@ def _targets() -> List[Target]:
                           bls_build(128, 32)))
         out.append(Target("kzg_batch", backend, "1", "small", kzg_build(1)))
         out.append(Target("kzg_batch", backend, "128", "slow", kzg_build(128)))
+    def tree_build(m: int):
+        def build():
+            return (
+                (lambda l: unwrap(tree_hash._tree_hash_subtrees)(l)),
+                (S((m, 32, 8), jnp.uint32),),
+            )
+        return build
+
     out.append(Target("sha256_pairs", "-", "256", "small", sha_build(256)))
     out.append(Target("sha256_pairs", "-", "4096", "slow", sha_build(4096)))
+    # tree_hash: the fused depth-5 Merkle subtree program (ISSUE 13) —
+    # small bucket in tier-1, the 2^20-leaf level's bucket behind slow.
+    out.append(Target("tree_hash", "-", "8", "small", tree_build(8)))
+    out.append(Target("tree_hash", "-", "32768", "slow", tree_build(32768)))
     for in_leak in (False, True):
         op = "epoch_deltas_leak" if in_leak else "epoch_deltas"
         out.append(Target(op, "-", "64", "small", epoch_build(64, in_leak)))
         out.append(Target(op, "-", "1024", "slow", epoch_build(1024, in_leak)))
+        # the mainnet registry bucket (2^20 validators): trace-only like
+        # every unsharded key, but big — slow tier
+        out.append(Target(op, "-", "1048576", "slow",
+                          epoch_build(1048576, in_leak)))
     # Mesh-sharded lowerings (device_mesh.py): the batch axis of the full
     # entry points over the 8-way dp mesh.  These are the keys whose
     # ``collective`` budget is NON-zero — the bls batch-wide MSM and the

@@ -88,6 +88,8 @@ SANCTIONED_CONTEXTS: Dict[str, Tuple[str, ...]] = {
     ),
     # sha pair-hash dispatch leg (device_fn/_device_half call into it)
     "lighthouse_tpu/ops/sha256_device.py": ("_dispatch_batch",),
+    # tree-hash subtree dispatch leg — same watchdog-worker discipline
+    "lighthouse_tpu/ops/tree_hash.py": ("_dispatch_subtrees",),
     # the epoch kernel entry IS the supervisor's device_fn (per_epoch.py)
     "lighthouse_tpu/ops/epoch_device.py": ("epoch_deltas_device",),
     # kzg device_fn — supervised since this PR

@@ -353,3 +353,227 @@ class TestTelemetry:
         rec = device_telemetry.FLIGHT_RECORDER.recent(limit=1)[0]
         assert rec["n_groups"] == 3
         assert rec["work_mix"]["block_import"] == 4
+
+
+# ----------------------------------------- hash pipeline (ISSUE 13)
+
+
+class TestHashPipeline:
+    def test_groups_coalesce_with_exact_slice_attribution(self):
+        """Unequal-size groups coalesce into one batch; each future's
+        digests are the exact slice for its blocks (bit-identical to
+        hashing the group alone)."""
+        from lighthouse_tpu.device_pipeline import HashPipeline
+        from lighthouse_tpu.ops.tree_hash import golden_hash_pairs
+
+        pipe = HashPipeline(target_blocks=64, linger_s=0.5,
+                            hash_flat_fn=golden_hash_pairs)
+        try:
+            groups = [bytes([i]) * (64 * k) for i, k in
+                      ((1, 1), (2, 3), (3, 2))]
+            futs = [pipe.submit(g, work=f"w{i}")
+                    for i, g in enumerate(groups)]
+            for g, fut in zip(groups, futs):
+                assert fut.result(timeout=30.0) == golden_hash_pairs(g)
+            snap = pipe.snapshot()
+            assert snap["batches_total"] == 1  # one coalesced dispatch
+            assert snap["groups_total"] == 3
+            assert snap["blocks_total"] == 6
+            rec = snap["recent_batches"][-1]
+            assert rec["n_groups"] == 3 and rec["n_blocks"] == 6
+            assert rec["work_mix"] == {"w0": 1, "w1": 3, "w2": 2}
+        finally:
+            pipe.shutdown()
+
+    def test_flat_failure_rescues_each_group_on_host(self):
+        """A failure escaping the supervised leg re-hashes per group on the
+        host kernel — digests stay exact, nothing is corrupted."""
+        from lighthouse_tpu.device_pipeline import HashPipeline
+        from lighthouse_tpu.ops.tree_hash import golden_hash_pairs
+
+        def poisoned(data):
+            raise RuntimeError("flat leg poisoned")
+
+        pipe = HashPipeline(target_blocks=64, linger_s=0.2,
+                            hash_flat_fn=poisoned)
+        try:
+            groups = [b"\xaa" * 64, b"\xbb" * 128]
+            futs = [pipe.submit(g) for g in groups]
+            for g, fut in zip(groups, futs):
+                assert fut.result(timeout=30.0) == golden_hash_pairs(g)
+            rec = pipe.snapshot()["recent_batches"][-1]
+            assert rec["group_rehashes"] == 2
+        finally:
+            pipe.shutdown()
+
+    def test_misaligned_group_rejected_and_empty_resolves(self):
+        from lighthouse_tpu.device_pipeline import HashPipeline
+        from lighthouse_tpu.ops.tree_hash import golden_hash_pairs
+
+        pipe = HashPipeline(target_blocks=8, linger_s=0.01,
+                            hash_flat_fn=golden_hash_pairs)
+        try:
+            with pytest.raises(ValueError):
+                pipe.submit(b"x" * 63)
+            fut = pipe.submit(b"")
+            assert fut.done() and fut.result(0.0) == b""
+        finally:
+            pipe.shutdown()
+
+    def test_module_hash_seam_and_shutdown_fallback(self):
+        """routes_hash gates on enablement and size; after shutdown the
+        module seam raises PipelineShutdown (callers fall back direct)."""
+        from lighthouse_tpu.ops.tree_hash import golden_hash_pairs
+
+        assert not device_pipeline.routes_hash(16)  # disabled
+        device_pipeline.enable()
+        assert device_pipeline.routes_hash(16)
+        assert not device_pipeline.routes_hash(
+            device_pipeline.MAX_HASH_GROUP_BLOCKS + 1)
+        data = b"\x5a" * 256
+        # module-level hash_pairs lazily starts the pipeline and resolves
+        assert device_pipeline.hash_pairs(data) == golden_hash_pairs(data)
+        snap = device_pipeline.summary()
+        assert snap["hash"]["groups_total"] >= 1
+        assert snap["arbiter"]["grants"].get("sha256_pairs", 0) >= 1
+        device_pipeline.shutdown()
+        with pytest.raises(PipelineShutdown):
+            device_pipeline.hash_pairs(data)
+
+
+# ------------------------------------------ job pipeline (ISSUE 13)
+
+
+class TestJobPipeline:
+    def test_epoch_deltas_routes_through_job_pipeline(self):
+        """The per_epoch device path rides run_job when the pipeline is on:
+        same arrays as the numpy golden, a job accounted on the epoch op,
+        and an arbiter grant for it."""
+        import numpy as np
+
+        from lighthouse_tpu.consensus import per_epoch
+        from test_epoch_buckets import _registry
+
+        arrays, prev_part, inact, kw = _registry(48, seed=31)
+        golden = per_epoch._epoch_deltas_numpy(arrays, prev_part, inact, **kw)
+        device_pipeline.enable()
+        per_epoch.set_epoch_backend("device")
+        try:
+            out = per_epoch.epoch_deltas(arrays, prev_part, inact, **kw)
+        finally:
+            per_epoch.set_epoch_backend("numpy")
+        for g, d in zip(golden, out):
+            assert np.array_equal(g, d)
+        snap = device_pipeline.summary()
+        assert snap["jobs"]["epoch_deltas"]["jobs_total"] == 1
+        assert snap["jobs"]["epoch_deltas"]["pending_jobs"] == 0
+        assert snap["arbiter"]["grants"].get("epoch_deltas", 0) == 1
+
+    def test_breaker_open_job_still_routes_to_host_exactly(self):
+        """Breaker open on the epoch op + pipeline on: the job runs, the
+        supervisor inside it routes to the numpy host path, and the result
+        is still exact (attribution preserved through the pipeline)."""
+        import numpy as np
+
+        from lighthouse_tpu.consensus import per_epoch
+        from test_epoch_buckets import _registry
+
+        device_supervisor.SUPERVISOR.configure(
+            config=device_supervisor.BreakerConfig(
+                failure_threshold=1, open_cooldown_s=300.0))
+        device_supervisor.SUPERVISOR.breaker("epoch_deltas").record_failure(
+            "device_error")
+        assert device_supervisor.breaker_state("epoch_deltas") == "open"
+
+        arrays, prev_part, inact, kw = _registry(40, seed=37)
+        golden = per_epoch._epoch_deltas_numpy(arrays, prev_part, inact, **kw)
+        before = metrics.DEVICE_HOST_FALLBACK.get(reason="breaker_open")
+        device_pipeline.enable()
+        per_epoch.set_epoch_backend("device")
+        try:
+            out = per_epoch.epoch_deltas(arrays, prev_part, inact, **kw)
+        finally:
+            per_epoch.set_epoch_backend("numpy")
+        for g, d in zip(golden, out):
+            assert np.array_equal(g, d)
+        assert metrics.DEVICE_HOST_FALLBACK.get(
+            reason="breaker_open") == before + 1
+        assert device_pipeline.summary()["jobs"]["epoch_deltas"][
+            "jobs_total"] == 1
+
+    def test_job_error_propagates_and_shutdown_refuses(self):
+        device_pipeline.enable()
+        with pytest.raises(RuntimeError, match="job boom"):
+            device_pipeline.run_job(
+                "epoch_deltas", lambda: (_ for _ in ()).throw(
+                    RuntimeError("job boom")))
+        device_pipeline.shutdown()
+        with pytest.raises(PipelineShutdown):
+            device_pipeline.run_job("epoch_deltas", lambda: 1)
+
+
+# ---------------------------------------- adaptive linger (ISSUE 13)
+
+
+class TestAdaptiveLinger:
+    def test_pinned_and_unobserved_return_base(self):
+        from lighthouse_tpu.device_pipeline import effective_linger
+
+        assert effective_linger("linger_op_a", 0.02, pinned=True) == 0.02
+        # no flight-recorder samples for this op -> base
+        assert effective_linger("linger_op_a", 0.02, pinned=False) == 0.02
+
+    def test_tracks_observed_inflight_median_with_clamps(self):
+        from lighthouse_tpu import device_telemetry
+        from lighthouse_tpu.device_pipeline import effective_linger
+
+        for _ in range(4):
+            device_telemetry.record_batch(
+                op="linger_op_b", shape=(8,), n_live=8,
+                stages={"dispatch": 0.05, "wait": 0.15})
+        # median in-flight 0.2s -> half is 0.1, above the 0.02 floor
+        assert effective_linger("linger_op_b", 0.02, pinned=False) == \
+            pytest.approx(0.1)
+        for _ in range(8):
+            device_telemetry.record_batch(
+                op="linger_op_c", shape=(8,), n_live=8,
+                stages={"dispatch": 5.0, "wait": 5.0})
+        # pathological observation clamps at the max
+        assert effective_linger("linger_op_c", 0.02, pinned=False) == \
+            device_pipeline.ADAPTIVE_LINGER_MAX_S
+        # a fast device never erases the configured floor
+        for _ in range(4):
+            device_telemetry.record_batch(
+                op="linger_op_d", shape=(8,), n_live=8,
+                stages={"dispatch": 0.001, "wait": 0.001})
+        assert effective_linger("linger_op_d", 0.05, pinned=False) == 0.05
+
+    def test_host_fallback_and_compile_batches_do_not_feed_the_signal(self):
+        """Host fallbacks never saw the device; compile batches carry jit
+        time in their dispatch stage (minutes on CPU) — neither belongs in
+        a steady-state linger signal."""
+        from lighthouse_tpu import device_telemetry
+
+        for _ in range(4):
+            device_telemetry.record_batch(
+                op="linger_op_e", shape=(8,), n_live=8,
+                stages={"dispatch": 0.2, "wait": 0.2}, host_fallback=True)
+        assert device_telemetry.recent_inflight_seconds("linger_op_e") is None
+        for _ in range(4):
+            device_telemetry.record_batch(
+                op="linger_op_f", shape=(8,), n_live=8,
+                stages={"dispatch": 60.0, "wait": 0.01}, compiled=True)
+        assert device_telemetry.recent_inflight_seconds("linger_op_f") is None
+
+    def test_assignment_pins_the_pipeline_linger(self):
+        pipe = DevicePipeline("bls_verify", target_sets=8, linger_s=None,
+                              verify_flat_fn=lambda s: True)
+        try:
+            snap = pipe.snapshot()
+            assert snap["linger_adaptive"] is True
+            pipe.linger_s = 0.07
+            snap = pipe.snapshot()
+            assert snap["linger_adaptive"] is False
+            assert snap["effective_linger_s"] == pytest.approx(0.07)
+        finally:
+            pipe.shutdown()

@@ -1,0 +1,128 @@
+"""Registry bucketing for the device epoch pass (ops/epoch_device.py,
+ISSUE 13): power-of-two buckets through 2^20, never-active padding rows
+provably inert against the exact-size numpy golden model, bucket promotion
+at the boundaries, occupancy telemetry, and mesh-sharded parity at a
+bucketed size."""
+
+import contextlib
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu import device_mesh, device_supervisor, device_telemetry
+from lighthouse_tpu.consensus.per_epoch import EpochArrays, _epoch_deltas_numpy
+from lighthouse_tpu.ops import epoch_device
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    device_supervisor.reset_for_tests()
+    device_mesh.reset_for_tests()
+
+
+class _Spec:
+    effective_balance_increment = 1_000_000_000
+    inactivity_score_bias = 4
+    inactivity_score_recovery_rate = 16
+
+
+def _registry(n, seed=5):
+    rng = np.random.default_rng(seed)
+    arrays = EpochArrays.__new__(EpochArrays)
+    arrays.n = n
+    arrays.effective_balance = rng.integers(
+        1_000_000_000, 32_000_000_000, n).astype(np.int64)
+    arrays.activation_epoch = rng.integers(0, 5, n).astype(np.int64)
+    arrays.exit_epoch = rng.integers(6, 100, n).astype(np.int64)
+    arrays.withdrawable_epoch = rng.integers(6, 200, n).astype(np.int64)
+    arrays.slashed = rng.random(n) < 0.1
+    kw = dict(
+        previous_epoch=4, in_leak=False, base_reward_per_increment=512,
+        total_active_balance=int(arrays.effective_balance.sum()),
+        quotient=67_108_864, spec=_Spec(),
+    )
+    prev_part = rng.integers(0, 8, n)
+    inact = rng.integers(0, 10, n)
+    return arrays, prev_part, inact, kw
+
+
+def test_bucket_promotion_at_boundaries():
+    assert epoch_device._bucket(1) == 64
+    assert epoch_device._bucket(64) == 64
+    assert epoch_device._bucket(65) == 256
+    assert epoch_device._bucket(256) == 256
+    assert epoch_device._bucket(1024) == 1024
+    assert epoch_device._bucket(1 << 20) == 1 << 20
+    # past the top bucket: exact size (never refuse to process the chain)
+    assert epoch_device._bucket((1 << 20) + 1) == (1 << 20) + 1
+
+
+@pytest.mark.parametrize("n", [48, 63, 64, 65, 100])
+def test_padded_rows_inert_vs_exact_size_golden(n):
+    """Non-power-of-two live counts through the bucketed device path must
+    be BIT-IDENTICAL to the exact-size numpy golden — the never-active
+    padding rows contribute zero to every registry-wide sum, so balances
+    and rewards are unchanged."""
+    arrays, prev_part, inact, kw = _registry(n, seed=n)
+    golden = _epoch_deltas_numpy(arrays, prev_part, inact, **kw)
+    dev = epoch_device.epoch_deltas_device(arrays, prev_part, inact, **kw)
+    for g, d in zip(golden, dev):
+        assert d.shape == (n,)          # the pad is sliced back off
+        assert np.array_equal(g, d)
+
+
+def test_in_leak_bucketed_parity():
+    arrays, prev_part, inact, kw = _registry(48, seed=77)
+    kw["in_leak"] = True
+    golden = _epoch_deltas_numpy(arrays, prev_part, inact, **kw)
+    dev = epoch_device.epoch_deltas_device(arrays, prev_part, inact, **kw)
+    for g, d in zip(golden, dev):
+        assert np.array_equal(g, d)
+
+
+def test_occupancy_recorded_for_padded_registry():
+    """A 48-validator registry dispatches at the 64 bucket; the flight
+    record carries the padding waste (the bucket-tuning signal)."""
+    arrays, prev_part, inact, kw = _registry(48, seed=9)
+    epoch_device.epoch_deltas_device(arrays, prev_part, inact, **kw)
+    rec = device_telemetry.FLIGHT_RECORDER.recent(1, op="epoch_deltas")[0]
+    assert rec["shape"] == "64"
+    assert rec["n_live"] == 48
+    assert rec["occupancy_sets"] == 0.75
+
+
+def test_same_bucket_shares_one_executable():
+    """Two different live sizes inside one bucket must register ONE
+    compiled program in the mirror — the whole point of bucketing."""
+    device_telemetry.COMPILE_CACHE.clear()
+    for n in (40, 48):
+        arrays, prev_part, inact, kw = _registry(n, seed=n)
+        epoch_device.epoch_deltas_device(arrays, prev_part, inact, **kw)
+    shapes = {
+        p["shape"] for p in device_telemetry.COMPILE_CACHE.inventory()
+        if p["op"] == "epoch_deltas"
+    }
+    assert shapes == {"64"}
+
+
+def test_mesh_sharded_bucketed_parity():
+    """One bucketed epoch size on the 8-device mesh: 48 live rows bucket to
+    64, shard 8 rows/device, and the psum'd participating sums still return
+    bit-identical int64 arrays."""
+    arrays, prev_part, inact, kw = _registry(48, seed=21)
+    host = epoch_device.epoch_deltas_device(arrays, prev_part, inact, **kw)
+    size = device_mesh.configure("auto")
+    assert size == 8, "conftest must provision 8 virtual CPU devices"
+    try:
+        meshed = epoch_device.epoch_deltas_device(
+            arrays, prev_part, inact, **kw)
+        rec = device_telemetry.FLIGHT_RECORDER.recent(
+            1, op="epoch_deltas")[0]
+    finally:
+        device_mesh.reset_for_tests()
+    for h, m in zip(host, meshed):
+        assert np.array_equal(h, m)
+        assert m.shape == (48,)
+    assert rec["shape"] == "64@dp8"
+    assert rec["shard_live"] == [8, 8, 8, 8, 8, 8, 0, 0]

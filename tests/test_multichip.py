@@ -298,7 +298,7 @@ def test_sharded_sha256_pairs_bit_identical_uneven():
 def test_sharded_epoch_deltas_bit_identical_uneven():
     """The epoch kernel on the mesh — registry-wide participating sums
     completing through psums — returns bit-identical int64 arrays for a
-    100-validator registry (pads to 104, never-active pad rows)."""
+    100-validator registry (buckets to 256, never-active pad rows)."""
     from lighthouse_tpu.ops import epoch_device
 
     rng = np.random.default_rng(5)
