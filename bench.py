@@ -1091,6 +1091,382 @@ def _run_child(force_cpu: bool, timeout_s: float) -> dict:
     return res
 
 
+# --------------------------------------------------------------- serve mode
+# ``bench.py --serve [--out BENCH_rXX.json]``: the beacon-API load harness
+# (ISSUE 14 / ROADMAP item 3).  Deterministic chain, thousands of concurrent
+# duty/state/rewards clients plus SSE subscribers, three phases:
+#
+#   1. uncached baseline — every request recomputed (permissive admission,
+#      so queueing is visible instead of shed),
+#   2. cached            — same load against the checkpoint-keyed cache,
+#   3. overload          — bulk flood at ``overload x`` the bulk admission
+#      bound while consensus-critical probes measure their own p99 (the
+#      shedding contract: critical latency stays bounded).
+#
+# Runs entirely in-process on the CPU (fake BLS backend): serving perf is
+# host-path work, provable on the CI box — unlike the device rounds.
+
+SERVE_CLIENTS = int(os.environ.get("BENCH_SERVE_CLIENTS", "1000"))
+SERVE_REQS_PER_CLIENT = int(os.environ.get("BENCH_SERVE_REQS", "9"))
+SERVE_SSE_SUBSCRIBERS = int(os.environ.get("BENCH_SERVE_SSE", "256"))
+SERVE_OVERLOAD_FACTOR = int(os.environ.get("BENCH_SERVE_OVERLOAD", "4"))
+SERVE_VALIDATORS = int(os.environ.get("BENCH_SERVE_VALIDATORS", "64"))
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def _serve_request_mix(epoch: int, n_validators: int):
+    """(label, method, path, body) — the hot read routes, weighted toward
+    the heavy ones so the uncached baseline pays real recompute cost."""
+    ids = [str(i) for i in range(n_validators)]
+    return [
+        ("duties_proposer", "GET",
+         f"/eth/v1/validator/duties/proposer/{epoch}", None),
+        ("duties_attester", "POST",
+         f"/eth/v1/validator/duties/attester/{epoch}", ids),
+        # next-epoch duties: what every VC asks at the epoch boundary —
+        # uncached this pays a full epoch advance per request
+        ("duties_attester_next", "POST",
+         f"/eth/v1/validator/duties/attester/{epoch + 1}", ids),
+        ("state_validators", "GET",
+         "/eth/v1/beacon/states/head/validators", None),
+        ("state_balances", "GET",
+         "/eth/v1/beacon/states/head/validator_balances", None),
+        ("state_committees", "GET",
+         f"/eth/v1/beacon/states/head/committees?epoch={epoch}", None),
+        ("rewards_blocks", "GET",
+         "/eth/v1/beacon/rewards/blocks/head", None),
+        ("rewards_attestations", "POST",
+         f"/eth/v1/beacon/rewards/attestations/{max(epoch - 1, 0)}", None),
+        ("headers", "GET", "/eth/v1/beacon/headers/head", None),
+    ]
+
+
+def _serve_run_phase(port: int, clients: int, reqs_per_client: int, mix,
+                     timeout_s: float = 600.0):
+    """``clients`` threads, each cycling through ``mix`` — returns
+    ``(per_route_stats, error_count, wall_s)``."""
+    import http.client
+    import threading
+
+    buckets = {}   # label -> list of latencies (merged after join)
+    thread_out = []
+    start_gate = threading.Event()
+
+    def worker(tid: int):
+        local = []
+        errors = 0
+        # Connect BEFORE the gate: a thousand simultaneous TCP handshakes
+        # are harness noise, not serving latency.
+        conn = None
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=timeout_s)
+            conn.connect()
+        except Exception:
+            conn = None
+        start_gate.wait()
+        # Stagger the first shot over ~1 s so steady-state queueing — not
+        # the synchronized stampede — is what the percentiles measure.
+        time.sleep((tid % 97) * 0.01)
+        for r in range(reqs_per_client):
+            label, method, path, body = mix[(tid + r) % len(mix)]
+            payload = None if body is None else json.dumps(body)
+            t0 = time.perf_counter()
+            try:
+                if conn is None:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", port, timeout=timeout_s)
+                headers = ({"Content-Type": "application/json"}
+                           if payload else {})
+                conn.request(method, path, body=payload, headers=headers)
+                resp = conn.getresponse()
+                resp.read()
+                status = resp.status
+            except Exception:
+                status = -1
+                conn = None  # reconnect next time
+            dt = time.perf_counter() - t0
+            if status == 200:
+                local.append((label, dt))
+            else:
+                errors += 1
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+        thread_out.append((local, errors))
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    wall0 = time.perf_counter()
+    start_gate.set()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - wall0
+    errors = 0
+    for local, errs in thread_out:
+        errors += errs
+        for label, dt in local:
+            buckets.setdefault(label, []).append(dt)
+    stats = {}
+    for label, vals in sorted(buckets.items()):
+        vals.sort()
+        stats[label] = {
+            "n": len(vals),
+            "p50_s": round(_percentile(vals, 0.50), 6),
+            "p99_s": round(_percentile(vals, 0.99), 6),
+            "mean_s": round(sum(vals) / len(vals), 6),
+        }
+    return stats, errors, wall
+
+
+def _serve_sse_phase(harness, server, n_subscribers: int) -> dict:
+    """SSE subscribers riding live chain traffic: each must see the head +
+    block events the slots publish, without ever blocking the chain."""
+    import socket
+    import threading
+
+    received = []
+    stop = threading.Event()
+
+    def subscriber():
+        got = 0
+        try:
+            s = socket.create_connection(("127.0.0.1", server.port),
+                                         timeout=30)
+            s.sendall(b"GET /eth/v1/events?topics=head,block HTTP/1.1\r\n"
+                      b"Host: localhost\r\n\r\n")
+            s.settimeout(0.5)
+            buf = b""
+            while not stop.is_set():
+                try:
+                    chunk = s.recv(4096)
+                except socket.timeout:
+                    continue
+                if not chunk:
+                    break
+                buf += chunk
+                got = buf.count(b"event: ")
+            s.close()
+        except Exception:
+            pass
+        received.append(got)
+
+    threads = [threading.Thread(target=subscriber, daemon=True)
+               for _ in range(n_subscribers)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)  # let every subscriber attach
+    n_slots = 2
+    for _ in range(n_slots):
+        harness.extend_chain(1)
+    expected = 2 * n_slots  # head + block per slot
+    time.sleep(2.0)  # drain: every queued event reaches its subscriber
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    from lighthouse_tpu import metrics as _m
+
+    return {
+        "subscribers": n_subscribers,
+        "events_expected_per_subscriber": expected,
+        "subscribers_fully_served": sum(1 for g in received if g >= expected),
+        "events_received_total": sum(received),
+        "events_dropped_total": sum(
+            v for _k, v in _m.SSE_EVENTS_DROPPED.snapshot().items()),
+    }
+
+
+def _serve_mode_main(out_path) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+
+    set_backend("fake")
+    from lighthouse_tpu import metrics as _m
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.http_api import HttpApiServer
+    from lighthouse_tpu.scheduler import (
+        AdmissionController,
+        BeaconProcessor,
+        ClassPolicy,
+    )
+    from lighthouse_tpu.scheduler.admission import (
+        CLASS_BULK,
+        CLASS_CRITICAL,
+        CLASS_DUTIES,
+        HTTP_REQUESTS_SHED,
+    )
+
+    t_start = time.time()
+    harness = BeaconChainHarness(
+        validator_count=SERVE_VALIDATORS, fake_crypto=True)
+    harness.extend_chain(10)
+    chain = harness.chain
+    epoch = chain.current_slot() // chain.spec.slots_per_epoch
+    mix = _serve_request_mix(epoch, SERVE_VALIDATORS)
+
+    def permissive():
+        # the latency phases measure caching, not shedding: bounds far
+        # above the client count, deadlines far above any queue wait
+        return AdmissionController([
+            ClassPolicy(CLASS_CRITICAL, 1 << 20, 900.0, 1),
+            ClassPolicy(CLASS_DUTIES, 1 << 20, 900.0, 1),
+            ClassPolicy(CLASS_BULK, 1 << 20, 900.0, 1),
+        ])
+
+    result = {
+        "config": {
+            "clients": SERVE_CLIENTS,
+            "requests_per_client": SERVE_REQS_PER_CLIENT,
+            "validators": SERVE_VALIDATORS,
+            "chain_slots": chain.current_slot(),
+            "sse_subscribers": SERVE_SSE_SUBSCRIBERS,
+            "overload_factor": SERVE_OVERLOAD_FACTOR,
+            "routes": [m[0] for m in mix],
+        },
+    }
+
+    # --- phase 1: uncached baseline
+    processor = BeaconProcessor(max_workers=4)
+    server = HttpApiServer(chain, processor=processor, response_cache=False,
+                           admission=permissive()).start()
+    server.spawner.timeout = 900.0
+    stats, errors, wall = _serve_run_phase(
+        server.port, SERVE_CLIENTS, SERVE_REQS_PER_CLIENT, mix)
+    server.stop()
+    processor.shutdown()
+    result["uncached"] = {"per_route": stats, "errors": errors,
+                          "wall_s": round(wall, 3)}
+    print(f"serve-bench: uncached done in {wall:.1f}s "
+          f"({errors} errors)", file=sys.stderr)
+
+    # --- phase 2: cached
+    processor = BeaconProcessor(max_workers=4)
+    server = HttpApiServer(chain, processor=processor,
+                           admission=permissive()).start()
+    server.spawner.timeout = 900.0
+    # Warm pass (one sequential client): the steady-state claim is about
+    # hit serving — between head events a production cache IS warm, and
+    # the misses' recompute cost is exactly what phase 1 measured.
+    _serve_run_phase(server.port, 1, len(mix), mix)
+    stats_c, errors_c, wall_c = _serve_run_phase(
+        server.port, SERVE_CLIENTS, SERVE_REQS_PER_CLIENT, mix)
+    cache_snap = server.response_cache.snapshot()
+    result["cached"] = {"per_route": stats_c, "errors": errors_c,
+                        "wall_s": round(wall_c, 3), "cache": cache_snap}
+    print(f"serve-bench: cached done in {wall_c:.1f}s "
+          f"(hit rate {cache_snap['hit_rate']})", file=sys.stderr)
+
+    # per-route p99 speedup.  The headline figure is the min over the
+    # recompute-bound hot read routes (state/rewards/headers) — the family
+    # the cache exists for.  Duties are reported separately: their own
+    # priority queue (api_request_duties, this PR's admission layer) keeps
+    # their UNCACHED p99 low by design, so their cache ratio measures the
+    # client harness's noise floor, not the cache.
+    speedup = {}
+    for label in stats:
+        if label in stats_c and stats_c[label]["p99_s"] > 0:
+            speedup[label] = round(
+                stats[label]["p99_s"] / stats_c[label]["p99_s"], 2)
+    hot_reads = [l for l in speedup if not l.startswith("duties_")]
+    result["p99_speedup"] = speedup
+    result["p99_speedup_min"] = min(speedup.values()) if speedup else None
+    result["p99_speedup_hot_reads_min"] = (
+        min(speedup[l] for l in hot_reads) if hot_reads else None)
+    result["duties_p99_cached_s"] = {
+        l: stats_c[l]["p99_s"] for l in stats_c if l.startswith("duties_")}
+
+    # --- phase 3: overload (strict default admission, cache stays on)
+    shed_before = {k: v for k, v in HTTP_REQUESTS_SHED.snapshot().items()}
+    crit_mix = [("attestation_data", "GET",
+                 "/eth/v1/validator/attestation_data"
+                 f"?slot={chain.current_slot()}&committee_index=0", None)]
+    bulk_mix = [("bulk_flood", "GET",
+                 "/lighthouse/ui/validator_count", None)]
+    server.stop()
+    processor.shutdown()
+    processor = BeaconProcessor(max_workers=4)
+    server = HttpApiServer(chain, processor=processor).start()  # defaults
+    bulk_bound = server.spawner.admission.policy(CLASS_BULK).max_inflight
+    # solo: critical latency on the strict server with nothing else running
+    crit_solo, _, _ = _serve_run_phase(server.port, 32, 8, crit_mix,
+                                       timeout_s=60.0)
+    flood_clients = SERVE_OVERLOAD_FACTOR * bulk_bound
+    import threading as _th
+
+    crit_out = {}
+
+    def crit_probe():
+        crit_out["stats"], crit_out["errors"], _ = _serve_run_phase(
+            server.port, 32, 8, crit_mix, timeout_s=60.0)
+
+    probe_thread = _th.Thread(target=crit_probe, daemon=True)
+    flood_thread = _th.Thread(
+        target=lambda: _serve_run_phase(
+            server.port, flood_clients, 6, bulk_mix, timeout_s=60.0),
+        daemon=True)
+    flood_thread.start()
+    time.sleep(0.5)  # flood first, then probe inside the storm
+    probe_thread.start()
+    probe_thread.join()
+    flood_thread.join()
+    shed_after = HTTP_REQUESTS_SHED.snapshot()
+    shed_delta = {
+        "|".join(f"{k}={v}" for k, v in key): shed_after[key]
+        - shed_before.get(key, 0.0)
+        for key in shed_after
+    }
+    crit_stats = crit_out.get("stats", {}).get("attestation_data", {})
+    solo_stats = crit_solo.get("attestation_data", {})
+    result["overload"] = {
+        "flood_clients": flood_clients,
+        "bulk_inflight_bound": bulk_bound,
+        "critical_p99_solo_s": solo_stats.get("p99_s"),
+        "critical_p99_under_overload_s": crit_stats.get("p99_s"),
+        "critical_errors": crit_out.get("errors"),
+        "shed": shed_delta,
+    }
+    print(f"serve-bench: overload done (critical p99 "
+          f"{crit_stats.get('p99_s')}s vs solo {solo_stats.get('p99_s')}s)",
+          file=sys.stderr)
+
+    # --- phase 4: SSE subscribers riding live slots
+    result["sse"] = _serve_sse_phase(harness, server, SERVE_SSE_SUBSCRIBERS)
+    server.stop()
+    processor.shutdown()
+
+    result["duration_s"] = round(time.time() - t_start, 1)
+    artifact = {
+        "ok": True,
+        "platform": "cpu",
+        "mode": "serve",
+        "serve": result,
+        "note": (
+            "beacon-API load harness (ISSUE 14): per-route p50/p99 over "
+            f"{SERVE_CLIENTS} concurrent clients, cached vs uncached, plus "
+            "admission-shedding overload and SSE phases; device throughput "
+            "unchanged this round — see BENCH_r06.json / MULTICHIP_r06.json"
+        ),
+    }
+    line = json.dumps(artifact, sort_keys=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(json.dumps(artifact, indent=1, sort_keys=True) + "\n")
+    print(f"{MARKER} {line}")
+    return 0
+
+
 def main() -> None:
     atexit.register(_final_emit)
     for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
@@ -1126,7 +1502,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--state-scale" in sys.argv:
+    if "--serve" in sys.argv:
+        out_path = None
+        if "--out" in sys.argv:
+            out_path = sys.argv[sys.argv.index("--out") + 1]
+        sys.exit(_serve_mode_main(out_path))
+    elif "--state-scale" in sys.argv:
         out_path = None
         if "--out" in sys.argv:
             out_path = sys.argv[sys.argv.index("--out") + 1]

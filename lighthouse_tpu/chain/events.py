@@ -12,7 +12,7 @@ import threading
 
 from .. import metrics
 from ..timeout_lock import TimeoutLock
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 TOPIC_HEAD = "head"
 TOPIC_BLOCK = "block"
@@ -60,7 +60,21 @@ class EventSubscription:
 class EventBus:
     def __init__(self) -> None:
         self._subs: List[EventSubscription] = []
+        # Synchronous in-process listeners (fn(topic, data)) — the HTTP
+        # response cache's invalidation feed.  Unlike subscriptions these
+        # run inline on the publishing (chain) thread, so they must be
+        # cheap and must never raise into the chain.
+        self._listeners: List[Callable[[str, dict], None]] = []
         self._lock = TimeoutLock("event_bus")
+
+    def add_listener(self, fn: Callable[[str, dict], None]) -> None:
+        with self._lock:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[str, dict], None]) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     def subscribe(self, topics: List[str]) -> EventSubscription:
         bad = [t for t in topics if t not in ALL_TOPICS]
@@ -79,6 +93,14 @@ class EventBus:
     def publish(self, topic: str, data: dict) -> None:
         with self._lock:
             subs = list(self._subs)
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(topic, data)
+            except Exception:
+                # A broken listener (cache invalidation hook) must never
+                # break head recompute / block import.
+                pass
         for sub in subs:
             if topic in sub.topics:
                 try:

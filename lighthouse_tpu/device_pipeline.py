@@ -161,6 +161,11 @@ DEVICE_ARBITER_GRANTS = metrics.counter(
     "device_arbiter_grants_total",
     "device-dispatch slots granted by the shared pipeline arbiter, by op",
 )
+DEVICE_ARBITER_API_TIMEOUTS = metrics.counter(
+    "device_arbiter_api_timeouts_total",
+    "API-side arbiter acquisitions that timed out and proceeded ungated, "
+    "by op",
+)
 
 
 class DeviceArbiter:
@@ -195,6 +200,49 @@ class DeviceArbiter:
             finally:
                 with self._stats:
                     self._holder = None
+
+    @contextmanager
+    def api_slot(self, op: str, timeout: float = 2.0, hold: bool = True):
+        """Arbiter contention for a NON-pipelined caller — the HTTP API's
+        cache-miss state queries (ROADMAP item 4 REMAINING: API work must
+        stop bypassing the arbiter).  Differs from :meth:`slot` in two
+        deliberate ways:
+
+        - ``timeout``-bounded acquire: an API thread that cannot get the
+          slot proceeds UNGATED (counted on
+          ``device_arbiter_api_timeouts_total``) instead of deadlocking —
+          a read query is never worth wedging the serving thread pool.
+        - ``hold=False`` runs the body AFTER releasing the slot (a
+          turnstile): the caller waits its turn behind in-flight device
+          dispatch, but does not exclude the pipelines while its own body
+          runs.  Required whenever the body may submit pipeline jobs
+          (``run_job`` legs acquire the slot from the pipeline worker —
+          holding here while waiting on their futures is a deadlock)."""
+        t0 = time.perf_counter()
+        acquired = self._lock.acquire(timeout=timeout)
+        wait = time.perf_counter() - t0
+        if not acquired:
+            DEVICE_ARBITER_API_TIMEOUTS.inc(op=op)
+            yield
+            return
+        with self._stats:
+            self._grants[op] = self._grants.get(op, 0) + 1
+            self._wait_s[op] = self._wait_s.get(op, 0.0) + wait
+            self._holder = op
+        DEVICE_ARBITER_WAIT_SECONDS.observe(wait, op=op)
+        DEVICE_ARBITER_GRANTS.inc(op=op)
+        if not hold:
+            with self._stats:
+                self._holder = None
+            self._lock.release()
+            yield
+            return
+        try:
+            yield
+        finally:
+            with self._stats:
+                self._holder = None
+            self._lock.release()
 
     def snapshot(self) -> dict:
         with self._stats:
@@ -1183,6 +1231,20 @@ def routes_job() -> bool:
     """Should a batch-global device job (epoch ops) ride its job
     pipeline — i.e. queue for the shared arbiter slot?"""
     return _ENABLED
+
+
+@contextmanager
+def api_arbiter_slot(op: str = "http_state_query"):
+    """Arbiter contention for an API-side device-bearing computation (the
+    HTTP layer's cache-miss state/duties/rewards work).  When the pipelines
+    are routing, this is a turnstile — the caller queues for the slot like
+    any pipelined work, then releases before running so its own nested
+    ``run_job`` legs (epoch deltas, hash batches) can re-contend from the
+    pipeline workers without deadlocking.  When the pipelines are off, the
+    slot is held across the body: the API thread's direct device dispatches
+    are then serialized against any other direct callers."""
+    with ARBITER.api_slot(op, hold=not _ENABLED):
+        yield
 
 
 def run_job(op: str, fn, work: Optional[str] = None):

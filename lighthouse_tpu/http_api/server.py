@@ -23,9 +23,12 @@ from urllib.parse import parse_qs, urlparse
 from .. import metrics, tracing
 from ..chain import events as ev
 from ..consensus import helpers as h
+from ..device_pipeline import api_arbiter_slot
+from ..scheduler.admission import CLASS_DUTIES, AdmissionController, ShedError
 from ..types.spec import FAR_FUTURE_EPOCH
+from .response_cache import CKPT, CKPT_BLOCKS, CacheEntry, ResponseCache
 from .serde import container_from_json, to_json
-from .task_spawner import P0, P1, OverloadedError, TaskSpawner
+from .task_spawner import P0, P1, PD, OverloadedError, TaskSpawner
 
 VERSION_STRING = "lighthouse-tpu/0.2.0"
 
@@ -209,13 +212,31 @@ class Context:
 
 ROUTES: List[Tuple[str, str, str, Callable[[Context], Any]]] = []
 
+#: (method, pattern) -> invalidation topics for every response-cached route.
+#: The contract the static check (scripts/check_metrics.py) enforces: a
+#: route may only be cached by *declaring* which chain events invalidate it
+#: — there is no way to add a silently-stale route.
+CACHED_ROUTES: Dict[Tuple[str, str], Tuple[str, ...]] = {}
 
-def route(method: str, pattern: str, priority: str = P1):
+
+def route(method: str, pattern: str, priority: str = P1,
+          cache: Optional[Tuple[str, ...]] = None,
+          klass: Optional[str] = None):
+    """Register a handler.  ``cache`` (a tuple of chain-event topics, e.g.
+    ``response_cache.CKPT``) opts the route into the checkpoint-keyed
+    response cache AND routes its cache-miss execution through the device
+    arbiter slot; ``klass`` overrides the admission class derived from
+    ``priority`` (see task_spawner.DEFAULT_CLASS)."""
     segs = pattern.strip("/").split("/")
 
     def deco(fn):
         ROUTES.append((method, pattern, priority, fn))
         fn._segs = segs
+        if cache is not None:
+            fn._cache_topics = tuple(cache)
+            CACHED_ROUTES[(method, pattern)] = tuple(cache)
+        if klass is not None:
+            fn._klass = klass
         return fn
 
     return deco
@@ -334,7 +355,7 @@ def _finality_meta(ctx, block_root):
     return {"execution_optimistic": False, "finalized": finalized}
 
 
-@route("GET", "/eth/v1/beacon/states/{state_id}/root")
+@route("GET", "/eth/v1/beacon/states/{state_id}/root", cache=CKPT)
 def state_root(ctx):
     state, broot = ctx.resolve_state(ctx.params["state_id"])
     out = {"data": {"root": "0x" + state.hash_tree_root().hex()}}
@@ -342,7 +363,7 @@ def state_root(ctx):
     return out
 
 
-@route("GET", "/eth/v1/beacon/states/{state_id}/fork")
+@route("GET", "/eth/v1/beacon/states/{state_id}/fork", cache=CKPT)
 def state_fork(ctx):
     state, broot = ctx.resolve_state(ctx.params["state_id"])
     out = {"data": to_json(state.fork)}
@@ -350,7 +371,7 @@ def state_fork(ctx):
     return out
 
 
-@route("GET", "/eth/v1/beacon/states/{state_id}/finality_checkpoints")
+@route("GET", "/eth/v1/beacon/states/{state_id}/finality_checkpoints", cache=CKPT)
 def state_finality(ctx):
     state, broot = ctx.resolve_state(ctx.params["state_id"])
     out = {"data": {
@@ -400,7 +421,7 @@ def _parse_validator_id(state, vid: str) -> Optional[int]:
     return idx if 0 <= idx < len(state.validators) else None
 
 
-@route("GET", "/eth/v1/beacon/states/{state_id}/validators")
+@route("GET", "/eth/v1/beacon/states/{state_id}/validators", cache=CKPT)
 def state_validators(ctx):
     state, broot = ctx.resolve_state(ctx.params["state_id"])
     epoch = h.get_current_epoch(state, ctx.chain.spec)
@@ -423,7 +444,7 @@ def state_validators(ctx):
     return out
 
 
-@route("POST", "/eth/v1/beacon/states/{state_id}/validators")
+@route("POST", "/eth/v1/beacon/states/{state_id}/validators", cache=CKPT)
 def state_validators_post(ctx):
     body = ctx.body or {}
     ctx.query = dict(ctx.query)
@@ -434,7 +455,7 @@ def state_validators_post(ctx):
     return state_validators(ctx)
 
 
-@route("GET", "/eth/v1/beacon/states/{state_id}/validators/{validator_id}")
+@route("GET", "/eth/v1/beacon/states/{state_id}/validators/{validator_id}", cache=CKPT)
 def state_validator(ctx):
     state, broot = ctx.resolve_state(ctx.params["state_id"])
     epoch = h.get_current_epoch(state, ctx.chain.spec)
@@ -446,7 +467,7 @@ def state_validator(ctx):
     return out
 
 
-@route("GET", "/eth/v1/beacon/states/{state_id}/validator_balances")
+@route("GET", "/eth/v1/beacon/states/{state_id}/validator_balances", cache=CKPT)
 def state_balances(ctx):
     state, broot = ctx.resolve_state(ctx.params["state_id"])
     ids = ctx.query.get("id")
@@ -466,7 +487,7 @@ def state_balances(ctx):
     return out
 
 
-@route("GET", "/eth/v1/beacon/states/{state_id}/committees")
+@route("GET", "/eth/v1/beacon/states/{state_id}/committees", cache=CKPT)
 def state_committees(ctx):
     state, broot = ctx.resolve_state(ctx.params["state_id"])
     spec = ctx.chain.spec
@@ -498,7 +519,7 @@ def state_committees(ctx):
     return out
 
 
-@route("GET", "/eth/v1/beacon/states/{state_id}/sync_committees")
+@route("GET", "/eth/v1/beacon/states/{state_id}/sync_committees", cache=CKPT)
 def state_sync_committees(ctx):
     state, broot = ctx.resolve_state(ctx.params["state_id"])
     if not hasattr(state, "current_sync_committee"):
@@ -519,7 +540,7 @@ def state_sync_committees(ctx):
     return out
 
 
-@route("GET", "/eth/v1/beacon/states/{state_id}/randao")
+@route("GET", "/eth/v1/beacon/states/{state_id}/randao", cache=CKPT)
 def state_randao(ctx):
     state, broot = ctx.resolve_state(ctx.params["state_id"])
     spec = ctx.chain.spec
@@ -553,7 +574,7 @@ def _header_json(ctx, root: bytes, signed_block) -> dict:
     }
 
 
-@route("GET", "/eth/v1/beacon/headers")
+@route("GET", "/eth/v1/beacon/headers", cache=CKPT_BLOCKS)
 def beacon_headers(ctx):
     slot = ctx.q1("slot")
     parent_root = ctx.q1("parent_root")
@@ -580,7 +601,7 @@ def beacon_headers(ctx):
     }
 
 
-@route("GET", "/eth/v1/beacon/headers/{block_id}")
+@route("GET", "/eth/v1/beacon/headers/{block_id}", cache=CKPT)
 def beacon_header(ctx):
     root, block = ctx.resolve_block(ctx.params["block_id"])
     out = {"data": _header_json(ctx, root, block)}
@@ -588,7 +609,7 @@ def beacon_header(ctx):
     return out
 
 
-@route("GET", "/eth/v2/beacon/blocks/{block_id}")
+@route("GET", "/eth/v2/beacon/blocks/{block_id}", cache=CKPT)
 def beacon_block(ctx):
     root, block = ctx.resolve_block(ctx.params["block_id"])
     fork = type(block.message).fork_name
@@ -606,7 +627,7 @@ def beacon_block(ctx):
     return out
 
 
-@route("GET", "/eth/v1/beacon/blocks/{block_id}")
+@route("GET", "/eth/v1/beacon/blocks/{block_id}", cache=CKPT)
 def beacon_block_v1(ctx):
     """v1 block fetch: bare {data} envelope (reference get_beacon_block is
     version-generic via any_version; V1 responses carry no version key)."""
@@ -617,7 +638,7 @@ def beacon_block_v1(ctx):
     return {"data": to_json(block)}
 
 
-@route("GET", "/eth/v1/beacon/blocks/{block_id}/root")
+@route("GET", "/eth/v1/beacon/blocks/{block_id}/root", cache=CKPT)
 def beacon_block_root(ctx):
     root = ctx.resolve_block_root(ctx.params["block_id"])
     out = {"data": {"root": "0x" + root.hex()}}
@@ -625,7 +646,7 @@ def beacon_block_root(ctx):
     return out
 
 
-@route("GET", "/eth/v1/beacon/blocks/{block_id}/attestations")
+@route("GET", "/eth/v1/beacon/blocks/{block_id}/attestations", cache=CKPT)
 def beacon_block_attestations(ctx):
     root, block = ctx.resolve_block(ctx.params["block_id"])
     out = {"data": [to_json(a) for a in block.message.body.attestations]}
@@ -1031,7 +1052,7 @@ def _dependent_root(ctx, epoch: int) -> bytes:
     return root if root is not None else chain.genesis_block_root
 
 
-@route("GET", "/eth/v1/validator/duties/proposer/{epoch}", P0)
+@route("GET", "/eth/v1/validator/duties/proposer/{epoch}", PD, cache=CKPT)
 def duties_proposer(ctx):
     chain = ctx.chain
     spec = chain.spec
@@ -1057,7 +1078,7 @@ def duties_proposer(ctx):
     }
 
 
-@route("POST", "/eth/v1/validator/duties/attester/{epoch}", P0)
+@route("POST", "/eth/v1/validator/duties/attester/{epoch}", PD, cache=CKPT)
 def duties_attester(ctx):
     chain = ctx.chain
     spec = chain.spec
@@ -1088,7 +1109,7 @@ def duties_attester(ctx):
     }
 
 
-@route("POST", "/eth/v1/validator/duties/sync/{epoch}", P0)
+@route("POST", "/eth/v1/validator/duties/sync/{epoch}", PD, cache=CKPT)
 def duties_sync(ctx):
     chain = ctx.chain
     epoch = int(ctx.params["epoch"])
@@ -1347,7 +1368,7 @@ def _validator_indices(state, raw_ids):
     return out
 
 
-@route("POST", "/eth/v1/beacon/rewards/attestations/{epoch}", P1)
+@route("POST", "/eth/v1/beacon/rewards/attestations/{epoch}", P1, cache=CKPT)
 def rewards_attestations(ctx):
     """Attestation rewards for ``epoch`` (reference attestation_rewards.rs):
     computed on a state in epoch+1, whose previous-epoch participation IS
@@ -1371,7 +1392,7 @@ def rewards_attestations(ctx):
     return {"execution_optimistic": False, "finalized": False, "data": data}
 
 
-@route("GET", "/eth/v1/beacon/rewards/blocks/{block_id}", P1)
+@route("GET", "/eth/v1/beacon/rewards/blocks/{block_id}", P1, cache=CKPT)
 def rewards_blocks(ctx):
     from ..chain import rewards as rewards_mod
 
@@ -1382,7 +1403,7 @@ def rewards_blocks(ctx):
     return {"execution_optimistic": False, "finalized": False, "data": data}
 
 
-@route("POST", "/eth/v1/beacon/rewards/sync_committee/{block_id}", P1)
+@route("POST", "/eth/v1/beacon/rewards/sync_committee/{block_id}", P1, cache=CKPT)
 def rewards_sync_committee(ctx):
     from ..chain import rewards as rewards_mod
     from ..consensus.per_slot import process_slots
@@ -1547,12 +1568,12 @@ def _head_entries(ctx, with_optimistic: bool):
     return heads
 
 
-@route("GET", "/eth/v1/debug/beacon/heads")
+@route("GET", "/eth/v1/debug/beacon/heads", cache=CKPT_BLOCKS)
 def debug_heads(ctx):
     return {"data": _head_entries(ctx, with_optimistic=False)}
 
 
-@route("GET", "/eth/v2/debug/beacon/heads")
+@route("GET", "/eth/v2/debug/beacon/heads", cache=CKPT_BLOCKS)
 def debug_heads_v2(ctx):
     """v2 adds per-head execution_optimistic (reference get_debug_beacon_heads
     accepts any endpoint version via its any_version filter)."""
@@ -1592,7 +1613,7 @@ def debug_fork_choice(ctx):
 # Reference beacon_node/http_api/src/lib.rs routes absent until round 4.
 
 
-@route("GET", "/eth/v1/beacon/blinded_blocks/{block_id}")
+@route("GET", "/eth/v1/beacon/blinded_blocks/{block_id}", cache=CKPT)
 def beacon_blinded_block(ctx):
     """The stored block served in blinded form (payload summarized to its
     header) — identical hash_tree_root by construction.  Reads the store's
@@ -1634,7 +1655,7 @@ def pool_bls_changes_get(ctx):
     return {"data": [to_json(c) for c in changes]}
 
 
-@route("GET", "/eth/v1/builder/states/{state_id}/expected_withdrawals")
+@route("GET", "/eth/v1/builder/states/{state_id}/expected_withdrawals", cache=CKPT)
 def expected_withdrawals(ctx):
     """The withdrawals the next payload built on this state must contain."""
     state, _ = ctx.resolve_state(ctx.params["state_id"])
@@ -1665,7 +1686,7 @@ def produce_block_v2(ctx):
     return {"version": type(block).fork_name, "data": to_json(block)}
 
 
-@route("POST", "/eth/v1/beacon/states/{state_id}/validator_balances")
+@route("POST", "/eth/v1/beacon/states/{state_id}/validator_balances", cache=CKPT)
 def state_validator_balances_post(ctx):
     """POST variant: ids in the body (the GET query-string variant caps out
     on URL length for big id sets)."""
@@ -2292,8 +2313,26 @@ def lighthouse_faults_clear(ctx):
 def lighthouse_events_subscribers(ctx):
     """Per-subscriber SSE state: topics, queue depth, delivered and dropped
     event counts (the per-topic aggregates live on /metrics as
-    ``sse_events_{sent,dropped}_total``)."""
+    ``http_sse_events_{sent,dropped}_total``)."""
     return {"data": ctx.chain.events.summary()}
+
+
+@route("GET", "/lighthouse/serving", P1)
+def lighthouse_serving(ctx):
+    """The serving-performance surface in one read: response-cache
+    occupancy/hit-rate, per-class admission state, and the device
+    arbiter's grant table (is API work contending like pipeline work?)."""
+    from .. import device_pipeline
+
+    cache = ctx.server.response_cache
+    return {"data": {
+        "cache": cache.snapshot() if cache is not None else None,
+        "admission": ctx.server.spawner.admission.snapshot(),
+        "arbiter": device_pipeline.ARBITER.snapshot(),
+        "cached_routes": {
+            f"{m} {p}": list(t) for (m, p), t in sorted(CACHED_ROUTES.items())
+        },
+    }}
 
 
 # ------------------------------------------------------------------ server
@@ -2302,6 +2341,11 @@ def lighthouse_events_subscribers(ctx):
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = VERSION_STRING
+    # Responses go out as (at least) two segments — buffered headers, then
+    # body.  With Nagle on, the body write sits behind the peer's delayed
+    # ACK: a measured ~40 ms floor per response on loopback, which would
+    # bury the cache's sub-millisecond hits.
+    disable_nagle_algorithm = True
 
     def log_message(self, fmt, *args):  # quiet
         pass
@@ -2310,14 +2354,39 @@ class _Handler(BaseHTTPRequestHandler):
     def api(self) -> "HttpApiServer":
         return self.server.api_server  # type: ignore[attr-defined]
 
-    def _write_json(self, code: int, payload) -> None:
+    def _write_json(self, code: int, payload,
+                    headers: Optional[Dict[str, str]] = None) -> None:
         body = b"" if payload is None else json.dumps(payload).encode()
+        self._write_json_bytes(code, body, headers)
+
+    def _write_json_bytes(self, code: int, body: bytes,
+                          headers: Optional[Dict[str, str]] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        for hk, hv in (headers or {}).items():
+            self.send_header(hk, hv)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         if body:
             self.wfile.write(body)
+
+    def _write_ssz(self, data: bytes, version: Optional[str],
+                   headers: Dict[str, str]) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        if version:
+            self.send_header("Eth-Consensus-Version", version)
+        for hk, hv in headers.items():
+            self.send_header(hk, hv)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _write_cached(self, entry: "CacheEntry") -> None:
+        if entry.kind == "ssz":
+            self._write_ssz(entry.body, entry.version, dict(entry.headers))
+        else:
+            self._write_json_bytes(200, entry.body)
 
     def _handle(self, method: str) -> None:
         parsed = urlparse(self.path)
@@ -2386,20 +2455,59 @@ class _Handler(BaseHTTPRequestHandler):
                             self._write_json(400, {"code": 400, "message": "invalid JSON"})
                             return
                 ctx = Context(self.api, params, parse_qs(parsed.query), body, self.headers)
+                # Checkpoint-keyed response cache (response_cache.py): a hit
+                # replays stored bytes from the HTTP thread — no admission,
+                # no scheduler queue, no handler.
+                cache = self.api.response_cache
+                topics = getattr(fn, "_cache_topics", None)
+                ckey = None
+                if cache is not None and topics:
+                    ckey = cache.make_key(
+                        method, route, params, ctx.query, body, ctx.wants_ssz)
+                if ckey is not None:
+                    hit = cache.get(ckey, route)
+                    if hit is not None:
+                        tracing.annotate(cache="hit")
+                        self._write_cached(hit)
+                        return
+                    tracing.annotate(cache="miss")
+                gen_box = {}
+                if ckey is not None:
+                    # Cache-miss state work must contend at the shared
+                    # device arbiter like pipeline work does (ROADMAP item
+                    # 4 REMAINING) — one bounded-cardinality op label for
+                    # the whole API surface.  The cache generation is read
+                    # on the worker thread just before the handler runs:
+                    # put() refuses the entry if any invalidation event
+                    # fired during execution (mid-handler reorg guard).
+                    def call(fn=fn, ctx=ctx, cache=cache, gen_box=gen_box):
+                        gen_box["gen"] = cache.generation
+                        with api_arbiter_slot("http_api"):
+                            return fn(ctx)
+                else:
+                    def call(fn=fn, ctx=ctx):
+                        return fn(ctx)
                 try:
-                    result = self.api.spawner.blocking_json_task(priority, lambda: fn(ctx))
+                    result = self.api.spawner.blocking_json_task(
+                        priority, call, klass=getattr(fn, "_klass", None))
+                    # Store BEFORE writing: the moment the response bytes
+                    # reach the client it may fire the next request, which
+                    # must hit.
                     if isinstance(result, SszResponse):
-                        self.send_response(200)
-                        self.send_header("Content-Type", "application/octet-stream")
-                        if result.version:
-                            self.send_header("Eth-Consensus-Version", result.version)
-                        for hk, hv in result.headers.items():
-                            self.send_header(hk, hv)
-                        self.send_header("Content-Length", str(len(result.data)))
-                        self.end_headers()
-                        self.wfile.write(result.data)
+                        if ckey is not None:
+                            cache.put(ckey, route, CacheEntry(
+                                "ssz", result.data, result.version,
+                                tuple(result.headers.items()), ckey[0], topics),
+                                generation=gen_box.get("gen"))
+                        self._write_ssz(result.data, result.version, result.headers)
                     else:
-                        self._write_json(200, result)
+                        body_bytes = (b"" if result is None
+                                      else json.dumps(result).encode())
+                        if ckey is not None and result is not None:
+                            cache.put(ckey, route, CacheEntry(
+                                "json", body_bytes, None, (), ckey[0], topics),
+                                generation=gen_box.get("gen"))
+                        self._write_json_bytes(200, body_bytes)
                 except ValueError as e:
                     # Malformed user-supplied ints/hex parse straight to
                     # ValueError — a contract 400.  Other exception types stay
@@ -2414,8 +2522,16 @@ class _Handler(BaseHTTPRequestHandler):
                         except (json.JSONDecodeError, TypeError):
                             payload = {"code": e.code, "message": e.message}
                         self._write_json(e.code, payload)
+                except ShedError as e:
+                    # Admission shed: immediate 503 + Retry-After so a
+                    # well-behaved client backs off instead of hammering.
+                    tracing.annotate(shed=e.reason)
+                    self._write_json(
+                        503, {"code": 503, "message": str(e)},
+                        headers={"Retry-After": str(e.retry_after_s)})
                 except OverloadedError as e:
-                    self._write_json(503, {"code": 503, "message": str(e)})
+                    self._write_json(503, {"code": 503, "message": str(e)},
+                                     headers={"Retry-After": "1"})
                 except TimeoutError as e:
                     self._write_json(504, {"code": 504, "message": str(e)})
             except BrokenPipeError:
@@ -2500,6 +2616,16 @@ class _Handler(BaseHTTPRequestHandler):
         self._handle("DELETE")
 
 
+class _ApiHTTPServer(ThreadingHTTPServer):
+    """Thread-per-connection server with a listen backlog sized for load
+    bursts: the stdlib default of 5 refuses connections the moment a
+    thousand clients arrive in one RTT, which turns a load spike into
+    connect errors before admission control ever sees the requests."""
+
+    request_queue_size = 1024
+    daemon_threads = True
+
+
 class HttpApiServer:
     """Serve the beacon API for a chain over TCP.
 
@@ -2519,16 +2645,30 @@ class HttpApiServer:
         peer_manager=None,
         publish_block_fn=None,
         publish_attestation_fn=None,
+        response_cache: bool = True,
+        admission: Optional[AdmissionController] = None,
     ):
         self.chain = chain
-        self.spawner = TaskSpawner(processor)
+        self.spawner = TaskSpawner(processor, admission=admission)
         self.peer_id = peer_id
         self.peer_manager = peer_manager
         self.publish_block_fn = publish_block_fn
         self.publish_attestation_fn = publish_attestation_fn
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        # Checkpoint-keyed response cache, invalidated by the chain's own
+        # head/finalization events.  ``response_cache=False`` (or the env
+        # kill switch) serves every request uncached — the baseline the
+        # load harness and the api_load scenario compare against.
+        import os as _os
+
+        enabled = (response_cache
+                   and _os.environ.get("LIGHTHOUSE_TPU_API_CACHE", "1") != "0")
+        self.response_cache: Optional[ResponseCache] = (
+            ResponseCache(chain) if enabled else None
+        )
+        if self.response_cache is not None:
+            self.response_cache.attach(chain.events)
+        self._httpd = _ApiHTTPServer((host, port), _Handler)
         self._httpd.api_server = self  # type: ignore[attr-defined]
-        self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
         self._shutdown = threading.Event()
 
@@ -2550,6 +2690,8 @@ class HttpApiServer:
 
     def stop(self) -> None:
         self._shutdown.set()
+        if self.response_cache is not None:
+            self.response_cache.detach()
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:

@@ -440,14 +440,37 @@ WEB3SIGNER_RETRIES = counter(
     "web3signer requests retried after a connection error, by request kind",
 )
 
-# SSE event bus (chain/events.py): per-topic delivery vs slow-consumer drops.
+# SSE event bus (chain/events.py): per-topic delivery vs slow-consumer
+# drops.  The drop counter is the SSE backpressure contract: a slow
+# subscriber loses events (bounded queue, non-blocking publish) and the
+# loss is visible here before a user reports missing heads.
 SSE_EVENTS_SENT = counter(
-    "sse_events_sent_total",
+    "http_sse_events_sent_total",
     "server-sent events written to a subscriber stream, by topic",
 )
 SSE_EVENTS_DROPPED = counter(
-    "sse_events_dropped_total",
+    "http_sse_events_dropped_total",
     "server-sent events dropped on a full subscriber queue, by topic",
+)
+
+# Checkpoint-keyed HTTP response cache (http_api/response_cache.py): per
+# route-template hit/miss (hit rate per route in one PromQL expression),
+# invalidations by the chain event that fired them, and occupancy.
+HTTP_CACHE_HITS = counter(
+    "http_response_cache_hits_total",
+    "Beacon API responses served from the checkpoint-keyed cache, by route",
+)
+HTTP_CACHE_MISSES = counter(
+    "http_response_cache_misses_total",
+    "cacheable Beacon API requests that missed the cache, by route",
+)
+HTTP_CACHE_INVALIDATIONS = counter(
+    "http_response_cache_invalidations_total",
+    "cache entries invalidated by a chain event, by topic",
+)
+HTTP_CACHE_ENTRIES = gauge(
+    "http_response_cache_entries",
+    "live entries in the checkpoint-keyed response cache",
 )
 
 # In-process fault fabric (network/transport.py Hub): what the seeded

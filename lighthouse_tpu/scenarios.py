@@ -169,6 +169,7 @@ class ScenarioRunner:
         self._pipeline_enabled = False
         self._mesh_touched = False
         self._spam_endpoints: List[str] = []
+        self._api_servers: List[Any] = []  # (cached, uncached) HTTP pairs
 
     # ------------------------------------------------------------ helpers
 
@@ -547,6 +548,48 @@ class ScenarioRunner:
                 kind="gossip", sender=spammer_id, topic=topic, data=junk))
         self.ctx["spammer"] = (spammer_id, victim)
 
+    def _ev_api_serve(self, node: int = 0) -> None:
+        """Stand up the serving pair over ``node``'s chain — one server with
+        the checkpoint-keyed response cache, one without (the bit-identity
+        oracle) — and leave both running across subsequent slots so the
+        cache's event-driven invalidation is exercised by real head /
+        finalization traffic, not by synthetic events."""
+        from .http_api import HttpApiServer
+
+        chain = self._node(node).chain
+        cached = HttpApiServer(chain).start()
+        uncached = HttpApiServer(chain, response_cache=False).start()
+        self._api_servers.extend([cached, uncached])
+        self.ctx["api_pair"] = (cached, uncached)
+        self.ctx["api_probes"] = []
+
+    def _ev_api_probe(self, label: str = "window") -> None:
+        """Replay the deterministic hot-route request list against both
+        servers — twice, so the second pass hits the cache — and record
+        byte-identity plus a response digest."""
+        import hashlib
+
+        cached, uncached = self.ctx["api_pair"]
+        chain = cached.chain
+        digest = hashlib.sha256()
+        mismatches: List[str] = []
+        n_requests = 0
+        for method, path, body in _api_probe_requests(chain):
+            for _pass in (0, 1):
+                sc, bc = _api_http(cached.port, method, path, body)
+                su, bu = _api_http(uncached.port, method, path, body)
+                n_requests += 1
+                if (sc, bc) != (su, bu):
+                    mismatches.append(f"{method} {path} [pass {_pass}]")
+                digest.update(bc)
+        self.ctx["api_probes"].append({
+            "label": label,
+            "n_requests": n_requests,
+            "mismatches": mismatches,
+            "digest": digest.hexdigest(),
+            "cache": cached.response_cache.snapshot(),
+        })
+
     # ------------------------------------------------------------ the run
 
     def run(self) -> dict:
@@ -737,10 +780,59 @@ class ScenarioRunner:
             device_supervisor.reset_for_tests()
         if self.byz is not None:
             self.byz.cleanup()
+        for server in self._api_servers:
+            try:
+                server.stop()
+            except Exception:
+                pass
+        self._api_servers = []
         if self.sim is not None:
             for spammer in self._spam_endpoints:
                 self.sim.hub.unregister(spammer)
             self.sim.shutdown()
+
+
+# ------------------------------------------------------- api-load helpers
+
+
+def _api_http(port: int, method: str, path: str, body) -> Tuple[int, bytes]:
+    """One raw request -> (status, body bytes); byte-exact comparison needs
+    the wire bytes, not a parsed view."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        payload = None if body is None else json.dumps(body)
+        headers = {"Content-Type": "application/json"} if payload else {}
+        conn.request(method, path, body=payload, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _api_probe_requests(chain) -> List[Tuple[str, str, Any]]:
+    """The deterministic hot-route list: duties, state queries, rewards,
+    headers/heads — every family the response cache covers."""
+    epoch = chain.current_slot() // chain.spec.slots_per_epoch
+    n_validators = len(chain.head_state.validators)
+    ids = [str(i) for i in range(n_validators)]
+    return [
+        ("GET", f"/eth/v1/validator/duties/proposer/{epoch}", None),
+        ("POST", f"/eth/v1/validator/duties/attester/{epoch}", ids),
+        ("POST", f"/eth/v1/validator/duties/sync/{epoch}", ids),
+        ("GET", "/eth/v1/beacon/states/head/validators", None),
+        ("GET", "/eth/v1/beacon/states/head/validator_balances", None),
+        ("GET", "/eth/v1/beacon/states/head/finality_checkpoints", None),
+        ("GET", "/eth/v1/beacon/states/head/root", None),
+        ("GET", f"/eth/v1/beacon/states/head/committees?epoch={epoch}", None),
+        ("GET", "/eth/v1/beacon/headers", None),
+        ("GET", "/eth/v1/beacon/headers/head", None),
+        ("GET", "/eth/v1/debug/beacon/heads", None),
+        ("GET", "/eth/v1/beacon/rewards/blocks/head", None),
+        ("POST", f"/eth/v1/beacon/rewards/attestations/{max(epoch - 1, 0)}",
+         None),
+    ]
 
 
 # --------------------------------------------------------------- built-ins
@@ -759,6 +851,31 @@ def smoke_partition(seed: int = 0) -> Scenario:
             Event(4, "heal"),
         ),
         extra_checks=_check_reorg,
+    )
+
+
+def api_load(seed: int = 0) -> Scenario:
+    """The serving-layer scenario (ISSUE 14): the cached beacon API rides a
+    partition/heal/reorg cycle and must stay byte-identical to an uncached
+    server at every probe point — while its cache is populated, invalidated
+    by real head events, and repopulated.  The 2-run determinism gate makes
+    the probe digests reproducible evidence."""
+    return Scenario(
+        name="api_load",
+        description="cached vs uncached beacon API bit-identity across "
+                    "partition, heal, and reorg",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=8, fault_slots=6, recovery_slots=24,
+        events=(
+            Event(0, "api_serve", {"node": 0}),
+            Event(0, "partition", {"groups": [[0], [1, 2]]}),
+            # mid-partition: node 0's minority fork is what's being served
+            Event(2, "api_probe", {"label": "partitioned"}),
+            Event(4, "heal"),
+            # post-heal: the reorg just invalidated the minority entries
+            Event(5, "api_probe", {"label": "healed"}),
+        ),
+        extra_checks=_check_api_load,
     )
 
 
@@ -1082,6 +1199,31 @@ def _check_reorg(runner: ScenarioRunner) -> dict:
     return {"max_distinct_heads": forked}
 
 
+def _check_api_load(runner: ScenarioRunner) -> dict:
+    """Every probe byte-identical, the cache actually used (hits) and
+    actually invalidated by chain traffic — plus one final probe on the
+    converged chain."""
+    runner._ev_api_probe(label="recovered")
+    probes = runner.ctx.get("api_probes") or []
+    assert len(probes) >= 3, "api probes did not run"
+    for p in probes:
+        assert not p["mismatches"], (
+            f"cached vs uncached responses diverged: {p['mismatches']}")
+    final = probes[-1]["cache"]
+    assert final["hits"] > 0, "cache never served a hit"
+    assert final["invalidated"] > 0, (
+        "head/finalization traffic never invalidated a cache entry")
+    # the partition really forked the fleet while we were serving it
+    forked = max(t["distinct_heads"] for t in runner.timeline)
+    assert forked >= 2, "partition never produced distinct heads"
+    return {"api_load": {
+        "probes": [{k: p[k] for k in ("label", "n_requests", "digest")}
+                   for p in probes],
+        "cache": final,
+        "max_distinct_heads": forked,
+    }}
+
+
 def _check_stall(runner: ScenarioRunner) -> dict:
     """Finality stalled while >1/3 were offline (the timeline's
     max_finalized must be flat across the first half of the window)."""
@@ -1288,6 +1430,7 @@ def _check_slashing_flood(runner: ScenarioRunner) -> dict:
 #: name -> factory(seed); the full matrix in documentation order
 SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     "smoke_partition": smoke_partition,
+    "api_load": api_load,
     "partition_deep_reorg": partition_deep_reorg,
     "nonfinality_spell": nonfinality_spell,
     "checkpoint_join_lossy": checkpoint_join_lossy,

@@ -1,0 +1,213 @@
+"""Prioritized admission control and load shedding for API work.
+
+The serving half of the reference's ``beacon_processor`` drop/requeue
+semantics: the processor's bounded per-class queues protect the node from
+*gossip* floods, but nothing protected it from *HTTP read* floods — every
+duty/state/rewards query used to queue unconditionally and time out 30 s
+later, long after the client gave up.  This module puts a policy object in
+front of :class:`~lighthouse_tpu.scheduler.processor.BeaconProcessor`:
+
+- inbound HTTP work is classified (``critical`` > ``duties`` > ``bulk``),
+- each class holds a bounded number of admitted-but-unfinished requests —
+  past the bound the request is shed *immediately* (503 + Retry-After),
+  which costs microseconds instead of a queue slot,
+- admitted work that waited past its class deadline before a worker picked
+  it up is shed at dequeue (the reference's stale-work drop: a duties
+  answer delivered after the client's own timeout is pure waste),
+- every decision is visible: ``http_requests_shed_total{class,reason}``
+  and the ``http_admission_wait_seconds{class}`` queue-wait histogram.
+
+It also generalizes the processor's ad-hoc ``is_syncing`` callable into
+:class:`DropPolicy` — the one object that decides which enqueued work is
+discarded instead of queued (``drop_during_sync`` was the first policy;
+admission deadlines are the second).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+from .. import metrics
+
+# ------------------------------------------------------------ HTTP classes
+
+#: Consensus-critical API work: block/attestation/aggregate submission and
+#: production — shedding these risks missed duties network-wide, so their
+#: bound is the loosest and their deadline the longest.
+CLASS_CRITICAL = "critical"
+#: Validator duties queries (proposer/attester/sync) — latency-sensitive
+#: but recomputable; a VC retries on its own schedule.
+CLASS_DUTIES = "duties"
+#: Bulk read traffic: state dumps, rewards, analysis — the first thing to
+#: shed under overload.
+CLASS_BULK = "bulk"
+
+HTTP_REQUESTS_SHED = metrics.counter(
+    "http_requests_shed_total",
+    "Beacon API requests shed by admission control, by class and reason "
+    "(admission_full|deadline)",
+)
+HTTP_ADMISSION_WAIT_SECONDS = metrics.histogram(
+    "http_admission_wait_seconds",
+    "admission-to-execution wait for admitted API work, by class",
+)
+HTTP_ADMISSION_INFLIGHT = metrics.gauge(
+    "http_admission_inflight",
+    "admitted-but-unfinished API requests, by class",
+)
+
+
+class ShedError(Exception):
+    """The request was shed; the server answers 503 with Retry-After."""
+
+    def __init__(self, klass: str, reason: str, retry_after_s: int):
+        super().__init__(
+            f"overloaded: {klass} request shed ({reason}); "
+            f"retry after {retry_after_s}s"
+        )
+        self.klass = klass
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+@dataclass(frozen=True)
+class ClassPolicy:
+    """Admission bounds for one work class.
+
+    ``max_inflight`` caps admitted-but-unfinished requests (the cheap
+    early shed); ``deadline_s`` bounds how stale an admitted request may
+    be when a worker finally picks it up (the dequeue shed);
+    ``retry_after_s`` is what a shed response tells the client."""
+
+    name: str
+    max_inflight: int
+    deadline_s: float
+    retry_after_s: int
+
+
+#: Defaults sized for the minimal-preset CI host; production deployments
+#: scale ``max_inflight`` with worker count the way the reference scales
+#: its queue lengths with the validator count.
+DEFAULT_POLICIES = (
+    ClassPolicy(CLASS_CRITICAL, max_inflight=512, deadline_s=8.0, retry_after_s=1),
+    ClassPolicy(CLASS_DUTIES, max_inflight=256, deadline_s=4.0, retry_after_s=2),
+    ClassPolicy(CLASS_BULK, max_inflight=128, deadline_s=2.0, retry_after_s=5),
+)
+
+
+class Ticket:
+    """One admitted request: stamped at admission, released when finished
+    (shed or served).  ``check_deadline`` is called by the worker just
+    before running the handler — the dequeue-side shed."""
+
+    __slots__ = ("controller", "policy", "admitted_pc")
+
+    def __init__(self, controller: "AdmissionController", policy: ClassPolicy):
+        self.controller = controller
+        self.policy = policy
+        self.admitted_pc = time.perf_counter()
+
+    def check_deadline(self) -> float:
+        """Record the queue wait; raise :class:`ShedError` when this request
+        waited past its class deadline.  Returns the wait in seconds."""
+        wait = time.perf_counter() - self.admitted_pc
+        HTTP_ADMISSION_WAIT_SECONDS.observe(wait, **{"class": self.policy.name})
+        if wait > self.policy.deadline_s:
+            HTTP_REQUESTS_SHED.inc(**{"class": self.policy.name,
+                                      "reason": "deadline"})
+            self.controller._count_shed()
+            raise ShedError(self.policy.name, "deadline",
+                            self.policy.retry_after_s)
+        return wait
+
+    def release(self) -> None:
+        self.controller._release(self.policy.name)
+
+
+class AdmissionController:
+    """Bounded per-class admission in front of the processor."""
+
+    def __init__(self, policies=DEFAULT_POLICIES):
+        self._policies: Dict[str, ClassPolicy] = {p.name: p for p in policies}
+        self._inflight: Dict[str, int] = {p.name: 0 for p in policies}
+        self._lock = threading.Lock()
+        self.shed = 0  # process-lifetime total, for snapshots/tests
+
+    def policy(self, klass: str) -> ClassPolicy:
+        return self._policies[klass]
+
+    def try_admit(self, klass: str) -> Ticket:
+        """Admit or shed.  Unknown classes are admitted unbounded (a route
+        added without a policy must not 503 by accident — it just isn't
+        protected yet)."""
+        policy = self._policies.get(klass)
+        if policy is None:
+            policy = ClassPolicy(klass, max_inflight=1 << 30,
+                                 deadline_s=60.0, retry_after_s=1)
+            with self._lock:
+                self._policies.setdefault(klass, policy)
+                self._inflight.setdefault(klass, 0)
+        with self._lock:
+            if self._inflight[policy.name] >= policy.max_inflight:
+                self.shed += 1
+                HTTP_REQUESTS_SHED.inc(**{"class": policy.name,
+                                          "reason": "admission_full"})
+                raise ShedError(policy.name, "admission_full",
+                                policy.retry_after_s)
+            self._inflight[policy.name] += 1
+            HTTP_ADMISSION_INFLIGHT.set(self._inflight[policy.name],
+                                        **{"class": policy.name})
+        return Ticket(self, policy)
+
+    def _count_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    def _release(self, klass: str) -> None:
+        with self._lock:
+            self._inflight[klass] = max(0, self._inflight[klass] - 1)
+            HTTP_ADMISSION_INFLIGHT.set(self._inflight[klass],
+                                        **{"class": klass})
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "inflight": dict(self._inflight),
+                "bounds": {k: p.max_inflight for k, p in self._policies.items()},
+                "deadlines_s": {k: p.deadline_s for k, p in self._policies.items()},
+                "shed_total": self.shed,
+            }
+
+
+# ------------------------------------------------------------ drop policy
+
+
+class DropPolicy:
+    """Decides whether an enqueued :class:`WorkEvent` should be discarded
+    instead of queued.  Returns a drop *reason* (metric label) or ``None``
+    to admit — the generalization of the processor's original hard-coded
+    ``drop_during_sync and is_syncing()`` test."""
+
+    def should_drop(self, event) -> Optional[str]:  # pragma: no cover
+        return None
+
+
+class SyncDropPolicy(DropPolicy):
+    """The original policy: while ``is_syncing()`` holds, events flagged
+    ``drop_during_sync`` are discarded (stale gossip is useless to a
+    syncing chain and crowds out the sync work itself)."""
+
+    def __init__(self, is_syncing: Optional[Callable[[], bool]]):
+        self.is_syncing = is_syncing
+
+    def should_drop(self, event) -> Optional[str]:
+        if (
+            event.drop_during_sync
+            and self.is_syncing is not None
+            and self.is_syncing()
+        ):
+            return "syncing"
+        return None

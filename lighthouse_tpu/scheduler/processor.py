@@ -96,13 +96,24 @@ class BeaconProcessor:
         max_workers: int = 4,
         queue_lengths: Optional[dict] = None,
         is_syncing: Optional[Callable[[], bool]] = None,
+        drop_policy: Optional["DropPolicy"] = None,
     ):
         """``is_syncing``: zero-arg callable consulted on enqueue; while it
         returns True, events flagged ``drop_during_sync`` are discarded
         (reference ``beacon_processor`` drops stale gossip during sync
-        instead of queueing work the chain can't use yet)."""
+        instead of queueing work the chain can't use yet).
+
+        ``drop_policy``: the generalized form (scheduler/admission.py
+        :class:`DropPolicy`) — decides per-event whether to discard instead
+        of queue.  When omitted, ``is_syncing`` is wrapped in the original
+        :class:`SyncDropPolicy`; passing both composes (either may drop)."""
+        from .admission import SyncDropPolicy
+
         self.max_workers = max(1, max_workers)
         self.is_syncing = is_syncing
+        self._drop_policies = [SyncDropPolicy(is_syncing)]
+        if drop_policy is not None:
+            self._drop_policies.append(drop_policy)
         self._drain_set = frozenset(DRAIN_ORDER)
         self._queues: Dict[str, deque] = {}
         self._limits = dict(DEFAULT_QUEUE_LENGTHS)
@@ -125,12 +136,19 @@ class BeaconProcessor:
         was dropped (reference: queue-full drop + metric)."""
         if event.work_type not in self._drain_set:
             raise ValueError(f"unknown work type {event.work_type!r} (not in DRAIN_ORDER)")
-        # Stale-while-syncing gossip is discarded, not queued: attestations
-        # and aggregates against a head we don't have yet would only fail
-        # later and crowd out the sync work itself.
-        if event.drop_during_sync and self.is_syncing is not None and self.is_syncing():
-            self.metrics.bump(self.metrics.dropped_during_sync, event.work_type)
-            return False
+        # Policy-driven discard (scheduler/admission.py): stale-while-syncing
+        # gossip is the canonical case — attestations and aggregates against
+        # a head we don't have yet would only fail later and crowd out the
+        # sync work itself.  Only the "syncing" reason counts on the
+        # dropped-during-sync series; custom policies' drops land on the
+        # generic dropped counter so the sync metric never lies.
+        for policy in self._drop_policies:
+            reason = policy.should_drop(event)
+            if reason is not None:
+                table = (self.metrics.dropped_during_sync
+                         if reason == "syncing" else self.metrics.dropped)
+                self.metrics.bump(table, event.work_type)
+                return False
         # Carry the sender's trace context across the thread hop; stamp the
         # enqueue instant for the worker-side queue-wait span.
         if event.trace_parent is None:

@@ -27,6 +27,10 @@ class W:
     CHAIN_SEGMENT = "chain_segment"
     # priority API
     API_REQUEST_P0 = "api_request_p0"
+    # duties API: proposer/attester/sync duty queries — below
+    # consensus-critical submissions, above bulk reads (the serving
+    # admission layer's middle class; see scheduler/admission.py)
+    API_REQUEST_DUTIES = "api_request_duties"
     # aggregates & proofs
     GOSSIP_AGGREGATE = "gossip_aggregate"
     GOSSIP_AGGREGATE_BATCH = "gossip_aggregate_batch"
@@ -71,6 +75,7 @@ DRAIN_ORDER = (
     W.GOSSIP_ATTESTATION,
     W.UNKNOWN_BLOCK_AGGREGATE,
     W.UNKNOWN_BLOCK_ATTESTATION,
+    W.API_REQUEST_DUTIES,
     W.GOSSIP_SYNC_CONTRIBUTION,
     W.GOSSIP_SYNC_SIGNATURE,
     W.GOSSIP_ATTESTER_SLASHING,
@@ -100,6 +105,7 @@ DEFAULT_QUEUE_LENGTHS = {
     W.UNKNOWN_BLOCK_AGGREGATE: 4096,
     W.BACKFILL_SYNC: 1024,
     W.API_REQUEST_P0: 1024,
+    W.API_REQUEST_DUTIES: 1024,
     W.API_REQUEST_P1: 1024,
 }
 DEFAULT_QUEUE_LENGTH = 4096

@@ -216,6 +216,15 @@ def span(name: str, hist=None, hist_labels: Optional[dict] = None, **fields):
             TRACES.push(trace)
 
 
+def span_event(name: str, **fields) -> Optional[Span]:
+    """A zero-duration marker child on the active span — for point events
+    that explain a trace without timing anything (a response-cache
+    invalidation inside ``head_recompute``, a shed decision inside an HTTP
+    span).  No-op (returns None) outside any trace."""
+    now = time.perf_counter()
+    return record_span(name, start_pc=now, end_pc=now, **fields)
+
+
 def record_span(name: str, start_pc: float, end_pc: Optional[float] = None,
                 hist=None, hist_labels: Optional[dict] = None,
                 **fields) -> Optional[Span]:

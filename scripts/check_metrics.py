@@ -62,7 +62,62 @@ REGISTERING_MODULES = (
     "lighthouse_tpu.network.service",
     # byzantine_offenses_total lives with the controller that emits them
     "lighthouse_tpu.adversary",
+    # http_requests_shed_total / http_admission_* live with the admission
+    # policy layer they count for
+    "lighthouse_tpu.scheduler.admission",
+    # http_response_cache_* constants live in lighthouse_tpu.metrics;
+    # importing validates the cache wires against the registry cleanly
+    "lighthouse_tpu.http_api.response_cache",
 )
+
+# The serving layer's metric contract (ISSUE 14): per-route latency,
+# response-cache hit/miss/invalidation, admission shed/wait, and SSE
+# backpressure.  A refactor that silently drops one of these fails CI.
+REQUIRED_SERVING_METRICS = (
+    "http_api_requests_total",
+    "http_api_request_seconds",
+    "http_response_cache_hits_total",
+    "http_response_cache_misses_total",
+    "http_response_cache_invalidations_total",
+    "http_response_cache_entries",
+    "http_requests_shed_total",
+    "http_admission_wait_seconds",
+    "http_admission_inflight",
+    "http_sse_events_sent_total",
+    "http_sse_events_dropped_total",
+    "device_arbiter_api_timeouts_total",
+)
+
+
+def check_cached_routes(errors) -> None:
+    """Every response-cached route must declare valid, nonempty
+    invalidation topics — the no-silently-stale-routes rule.  Importing the
+    server module is the check: caching is only reachable through the
+    ``route(..., cache=...)`` declaration this inspects."""
+    from lighthouse_tpu.http_api import response_cache, server
+
+    if not server.CACHED_ROUTES:
+        errors.append("CACHED_ROUTES is empty: the response cache is wired "
+                      "to no route")
+    valid = set(response_cache.VALID_INVALIDATION_TOPICS)
+    for (method, pattern), topics in sorted(server.CACHED_ROUTES.items()):
+        if not topics:
+            errors.append(f"{method} {pattern}: cached with no invalidation "
+                          "topics")
+            continue
+        bad = set(topics) - valid
+        if bad:
+            errors.append(f"{method} {pattern}: unknown invalidation "
+                          f"topics {sorted(bad)}")
+        if "head" not in topics:
+            errors.append(f"{method} {pattern}: cached route must at least "
+                          "invalidate on 'head'")
+    # and the registered handlers must agree with the registry
+    for m, pattern, _prio, fn in server.ROUTES:
+        declared = getattr(fn, "_cache_topics", None)
+        if declared and (m, pattern) not in server.CACHED_ROUTES:
+            errors.append(f"{m} {pattern}: handler declares cache topics "
+                          "but is missing from CACHED_ROUTES")
 
 
 def main() -> int:
@@ -79,6 +134,13 @@ def main() -> int:
             errors.append(f"{name}: name does not match [a-z_:][a-z0-9_:]*")
         if not metric.help.strip():
             errors.append(f"{name}: missing help text")
+
+    for name in REQUIRED_SERVING_METRICS:
+        if name not in metrics._REGISTRY:
+            errors.append(f"{name}: required serving metric is not "
+                          "registered")
+
+    check_cached_routes(errors)
 
     for name, old_kind, new_kind in metrics.DUPLICATE_REGISTRATIONS:
         errors.append(

@@ -325,7 +325,8 @@ class TestSseDropAccounting:
 
     def test_sse_stream_counts_sent(self):
         """End to end: events written to a live /eth/v1/events stream bump
-        sse_events_sent_total{topic} and the subscriber's sent figure."""
+        http_sse_events_sent_total{topic} and the subscriber's sent
+        figure."""
         from lighthouse_tpu.chain import BeaconChainHarness
         from lighthouse_tpu.crypto.bls.backends import set_backend
         from lighthouse_tpu.http_api import HttpApiServer

@@ -1,0 +1,307 @@
+"""The serving performance layer (ISSUE 14): checkpoint-keyed response
+caching with event-driven invalidation, prioritized admission/shedding in
+front of the scheduler, SSE backpressure, and the arbiter contention of
+cache-miss API state work.
+
+Correctness contract under test: a cached server must be *bit-identical*
+to an uncached one at every point in chain history — including across a
+reorg — and a head/finalization event must invalidate exactly the affected
+``(head, finalized)`` keys.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu import device_pipeline, metrics
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.chain.events import EventBus
+from lighthouse_tpu.crypto.bls.backends import set_backend
+from lighthouse_tpu.http_api import HttpApiServer
+from lighthouse_tpu.http_api.server import CACHED_ROUTES
+from lighthouse_tpu.http_api.response_cache import VALID_INVALIDATION_TOPICS
+from lighthouse_tpu.scheduler import (
+    AdmissionController,
+    BeaconProcessor,
+    ClassPolicy,
+    ShedError,
+)
+from lighthouse_tpu.scheduler.admission import (
+    CLASS_BULK,
+    CLASS_CRITICAL,
+    CLASS_DUTIES,
+)
+
+
+def _get(port: int, path: str, method: str = "GET", body=None):
+    """Raw request -> (status, headers, body bytes) — byte-exact compares
+    need the wire bytes, not the client's parsed view."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    payload = None if body is None else json.dumps(body)
+    headers = {"Content-Type": "application/json"} if payload else {}
+    conn.request(method, path, body=payload, headers=headers)
+    resp = conn.getresponse()
+    data = resp.read()
+    out = (resp.status, dict(resp.getheaders()), data)
+    conn.close()
+    return out
+
+
+#: Deterministic hot-route probe: duties, state queries, rewards, headers.
+def _probe_requests(epoch: int):
+    return [
+        ("GET", f"/eth/v1/validator/duties/proposer/{epoch}", None),
+        ("POST", f"/eth/v1/validator/duties/attester/{epoch}",
+         [str(i) for i in range(16)]),
+        ("GET", "/eth/v1/beacon/states/head/validators", None),
+        ("GET", "/eth/v1/beacon/states/head/validator_balances?id=0,1,2", None),
+        ("GET", "/eth/v1/beacon/states/head/finality_checkpoints", None),
+        ("GET", "/eth/v1/beacon/states/head/root", None),
+        ("GET", "/eth/v1/beacon/headers", None),
+        ("GET", "/eth/v1/beacon/headers/head", None),
+        ("GET", "/eth/v1/debug/beacon/heads", None),
+        ("GET", "/eth/v1/beacon/rewards/blocks/head", None),
+    ]
+
+
+@pytest.fixture()
+def harness():
+    set_backend("fake")
+    h = BeaconChainHarness(validator_count=16, fake_crypto=True)
+    h.extend_chain(4)
+    yield h
+    set_backend("host")
+
+
+@pytest.fixture()
+def served_pair(harness):
+    """One chain, two servers: cached and uncached — the bit-identity
+    oracle."""
+    processor = BeaconProcessor(max_workers=2)
+    cached = HttpApiServer(harness.chain, processor=processor).start()
+    uncached = HttpApiServer(harness.chain, response_cache=False).start()
+    yield harness, cached, uncached
+    cached.stop()
+    uncached.stop()
+    processor.shutdown()
+
+
+class TestResponseCache:
+    def test_hit_is_bit_identical_and_counted(self, served_pair):
+        harness, cached, uncached = served_pair
+        epoch = harness.chain.current_slot() // harness.spec.slots_per_epoch
+        for method, path, body in _probe_requests(epoch):
+            s1, _, b1 = _get(cached.port, path, method, body)   # miss
+            s2, _, b2 = _get(cached.port, path, method, body)   # hit
+            s3, _, b3 = _get(uncached.port, path, method, body)  # oracle
+            assert s1 == s2 == s3 == 200, path
+            assert b1 == b2, f"cached replay differs: {path}"
+            assert b1 == b3, f"cached vs uncached differ: {path}"
+        snap = cached.response_cache.snapshot()
+        assert snap["hits"] >= len(_probe_requests(epoch))
+        assert snap["misses"] >= len(_probe_requests(epoch))
+        assert uncached.response_cache is None
+
+    def test_head_event_invalidates_exactly_stale_keys(self, served_pair):
+        harness, cached, _ = served_pair
+        cache = cached.response_cache
+        epoch = harness.chain.current_slot() // harness.spec.slots_per_epoch
+        for method, path, body in _probe_requests(epoch):
+            _get(cached.port, path, method, body)
+        old_fp = cache.fingerprint()
+        assert len(cache) > 0
+        assert all(k[0] == old_fp for k in cache.keys_snapshot())
+
+        # Seed one entry under the CURRENT fingerprint *after* the head
+        # moves, then fire another head event: only dead-fingerprint keys
+        # may be dropped.
+        harness.extend_chain(1)  # publishes a head event
+        new_fp = cache.fingerprint()
+        assert new_fp != old_fp
+        # every old-head key is gone (exact invalidation)
+        assert all(k[0] != old_fp for k in cache.keys_snapshot())
+        inval_after_first = cache.invalidated
+        assert inval_after_first > 0
+
+        _get(cached.port, "/eth/v1/beacon/states/head/root")  # repopulate
+        fresh_keys = [k for k in cache.keys_snapshot() if k[0] == new_fp]
+        assert fresh_keys
+        # a head event that does NOT change the fingerprint must keep them
+        harness.chain.events.publish("head", {"slot": "0"})
+        kept = [k for k in cache.keys_snapshot() if k[0] == new_fp]
+        assert kept == fresh_keys, "same-fingerprint keys must survive"
+
+    def test_stale_read_across_reorg(self, served_pair):
+        """Bit-identical vs the uncached oracle before AND after a reorg —
+        the cached server must never serve the abandoned branch."""
+        harness, cached, uncached = served_pair
+        chain = harness.chain
+        roots = harness.extend_chain(2, attest=False)
+        harness.advance_slot()
+        slot = chain.current_slot()
+        canonical = harness.produce_signed_block(slot=slot)
+        fork_block = harness.produce_signed_block(
+            slot=slot, parent_root=roots[0], graffiti=b"\x42" * 32)
+
+        c_root = chain.process_block(canonical, block_delay_seconds=1.0)
+        assert chain.head_root == c_root
+        probe = [
+            ("GET", "/eth/v1/beacon/states/head/root", None),
+            ("GET", "/eth/v1/beacon/headers/head", None),
+            ("GET", "/eth/v1/debug/beacon/heads", None),
+        ]
+        before = [_get(cached.port, p, m, b)[2] for m, p, b in probe]
+        assert before == [_get(uncached.port, p, m, b)[2] for m, p, b in probe]
+
+        # competing import; whether or not the head flips, the cached
+        # server must track the uncached one exactly
+        inval_before = cached.response_cache.invalidated
+        chain.process_block(fork_block, block_delay_seconds=20.0)
+        after_cached = [_get(cached.port, p, m, b)[2] for m, p, b in probe]
+        after_uncached = [_get(uncached.port, p, m, b)[2] for m, p, b in probe]
+        assert after_cached == after_uncached
+        # the import's block event fired invalidation (at minimum the
+        # block-sensitive debug-heads entry is re-derived, not replayed)
+        assert cached.response_cache.invalidated > inval_before
+
+    def test_put_refused_after_invalidation_event(self, served_pair):
+        """The mid-handler reorg guard: an entry computed while ANY
+        invalidation event fired must not be stored (an A->B->A reorg
+        passes the fingerprint equality check but not the generation
+        check)."""
+        from lighthouse_tpu.http_api.response_cache import CacheEntry
+
+        _, cached, _ = served_pair
+        cache = cached.response_cache
+        key = cache.make_key("GET", "/probe", {}, {}, None, False)
+        entry = lambda: CacheEntry("json", b"{}", None, (), key[0], ("head",))  # noqa: E731
+        gen = cache.generation
+        cache.on_event("head", {})  # same fingerprint, but an event fired
+        assert not cache.put(key, "/probe", entry(), generation=gen)
+        assert cache.put(key, "/probe", entry(), generation=cache.generation)
+
+    def test_cache_miss_contends_at_device_arbiter(self, served_pair):
+        harness, cached, _ = served_pair
+        grants_before = device_pipeline.ARBITER.snapshot()["grants"].get(
+            "http_api", 0)
+        _get(cached.port, "/eth/v1/beacon/states/head/validators")
+        grants_after = device_pipeline.ARBITER.snapshot()["grants"].get(
+            "http_api", 0)
+        assert grants_after > grants_before
+
+    def test_every_cached_route_declares_valid_topics(self):
+        assert CACHED_ROUTES, "cache registry must not be empty"
+        for (method, pattern), topics in CACHED_ROUTES.items():
+            assert topics, f"{method} {pattern}: empty invalidation topics"
+            bad = set(topics) - set(VALID_INVALIDATION_TOPICS)
+            assert not bad, f"{method} {pattern}: unknown topics {bad}"
+            # every cached route must prune on head movement at minimum
+            assert "head" in topics, f"{method} {pattern}: missing 'head'"
+
+    def test_duties_ride_their_own_queue(self, served_pair):
+        harness, cached, _ = served_pair
+        processor = cached.spawner.processor
+        epoch = harness.chain.current_slot() // harness.spec.slots_per_epoch
+        cached.response_cache.clear()
+        _get(cached.port, f"/eth/v1/validator/duties/proposer/{epoch}")
+        assert processor.metrics.received.get("api_request_duties", 0) >= 1
+
+
+class TestAdmission:
+    def test_admission_full_sheds_503_with_retry_after(self, harness):
+        admission = AdmissionController([
+            ClassPolicy(CLASS_CRITICAL, 64, 8.0, 1),
+            ClassPolicy(CLASS_DUTIES, 64, 4.0, 2),
+            ClassPolicy(CLASS_BULK, 0, 2.0, 5),  # shed every bulk request
+        ])
+        server = HttpApiServer(harness.chain, admission=admission,
+                               response_cache=False).start()
+        try:
+            status, headers, body = _get(server.port, "/lighthouse/health")
+            assert status == 503
+            assert headers.get("Retry-After") == "5"
+            assert b"overloaded" in body
+            # critical traffic is untouched by the bulk bound
+            status, _, _ = _get(
+                server.port,
+                "/eth/v1/validator/attestation_data?slot=1&committee_index=0")
+            assert status != 503
+        finally:
+            server.stop()
+        snap = admission.snapshot()
+        assert snap["shed_total"] >= 1
+        from lighthouse_tpu.scheduler.admission import HTTP_REQUESTS_SHED
+
+        assert HTTP_REQUESTS_SHED.get(**{"class": CLASS_BULK,
+                                         "reason": "admission_full"}) >= 1
+
+    def test_deadline_shed_at_dequeue(self):
+        admission = AdmissionController([ClassPolicy(CLASS_BULK, 8, 0.0, 5)])
+        ticket = admission.try_admit(CLASS_BULK)
+        time.sleep(0.01)
+        with pytest.raises(ShedError) as e:
+            ticket.check_deadline()
+        assert e.value.reason == "deadline"
+        ticket.release()
+        snap = admission.snapshot()
+        assert snap["inflight"][CLASS_BULK] == 0
+        assert snap["shed_total"] == 1  # deadline sheds count too
+
+    def test_inflight_accounting_releases(self):
+        admission = AdmissionController([ClassPolicy(CLASS_BULK, 2, 5.0, 5)])
+        t1 = admission.try_admit(CLASS_BULK)
+        t2 = admission.try_admit(CLASS_BULK)
+        with pytest.raises(ShedError):
+            admission.try_admit(CLASS_BULK)
+        t1.release()
+        t3 = admission.try_admit(CLASS_BULK)
+        t2.release()
+        t3.release()
+        assert admission.snapshot()["inflight"][CLASS_BULK] == 0
+
+    def test_drop_policy_generalizes_drop_during_sync(self):
+        from lighthouse_tpu.scheduler import DropPolicy, W, WorkEvent
+
+        class DropEverything(DropPolicy):
+            def should_drop(self, event):
+                return "test"
+
+        processor = BeaconProcessor(max_workers=1, drop_policy=DropEverything())
+        try:
+            ran = threading.Event()
+            accepted = processor.send(WorkEvent(
+                work_type=W.GOSSIP_ATTESTATION, process=lambda _i: ran.set()))
+            assert not accepted
+            # a custom policy's drop lands on the GENERIC dropped counter —
+            # dropped_during_sync stays reserved for the "syncing" reason
+            assert processor.metrics.dropped.get(W.GOSSIP_ATTESTATION) == 1
+            assert processor.metrics.dropped_during_sync.get(
+                W.GOSSIP_ATTESTATION, 0) == 0
+            assert not ran.wait(0.1)
+        finally:
+            processor.shutdown()
+
+
+class TestSseBackpressure:
+    def test_slow_subscriber_drops_without_blocking(self):
+        bus = EventBus()
+        sub = bus.subscribe(["head"])
+        before = metrics.SSE_EVENTS_DROPPED.get(topic="head")
+        t0 = time.perf_counter()
+        for i in range(sub.q.maxsize + 50):
+            bus.publish("head", {"slot": str(i)})
+        elapsed = time.perf_counter() - t0
+        # non-blocking: hundreds of publishes against a wedged subscriber
+        # finish in well under a second
+        assert elapsed < 1.0
+        assert sub.q.qsize() == sub.q.maxsize  # bounded, not unbounded
+        assert sub.dropped == 50
+        assert metrics.SSE_EVENTS_DROPPED.get(topic="head") == before + 50
+        bus.unsubscribe(sub)
+
+    def test_drop_counter_is_the_required_serving_metric(self):
+        assert metrics.SSE_EVENTS_DROPPED.name == "http_sse_events_dropped_total"
+        assert metrics.SSE_EVENTS_SENT.name == "http_sse_events_sent_total"

@@ -1,0 +1,93 @@
+"""The beacon-API load harness (``bench.py --serve``).
+
+Tier-1 keeps a structural smoke: the phase runner produces per-route
+p50/p99 stats against a live served pair and the cached server actually
+hits.  The full harness — 1k concurrent clients, the overload/shedding
+phase, SSE riders, the committed BENCH artifact — is ``slow``-marked so
+the 870 s dots budget never pays for it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import bench  # noqa: E402
+
+from lighthouse_tpu.chain import BeaconChainHarness  # noqa: E402
+from lighthouse_tpu.crypto.bls.backends import set_backend  # noqa: E402
+from lighthouse_tpu.http_api import HttpApiServer  # noqa: E402
+
+
+def test_percentile_helper():
+    assert bench._percentile([], 0.99) == 0.0
+    vals = [float(i) for i in range(1, 101)]
+    assert bench._percentile(vals, 0.50) == 51.0
+    assert bench._percentile(vals, 0.99) == 99.0
+    assert bench._percentile(vals, 1.0) == 100.0
+
+
+def test_phase_runner_smoke():
+    """A tiny phase run end-to-end: stats for every route, zero errors,
+    and the cache serving hits on the second wave."""
+    set_backend("fake")
+    try:
+        harness = BeaconChainHarness(validator_count=16, fake_crypto=True)
+        harness.extend_chain(4)
+        server = HttpApiServer(harness.chain).start()
+        epoch = harness.chain.current_slot() // harness.spec.slots_per_epoch
+        mix = bench._serve_request_mix(epoch, 16)
+        stats, errors, wall = bench._serve_run_phase(
+            server.port, clients=6, reqs_per_client=len(mix), mix=mix,
+            timeout_s=60.0)
+        assert errors == 0
+        assert set(stats) == {m[0] for m in mix}
+        for label, s in stats.items():
+            assert s["n"] == 6, label
+            assert s["p99_s"] >= s["p50_s"] >= 0.0
+        snap = server.response_cache.snapshot()
+        assert snap["hits"] > 0, "second wave never hit the cache"
+        server.stop()
+    finally:
+        set_backend("host")
+
+
+@pytest.mark.slow
+def test_full_load_harness_artifact(tmp_path):
+    """The real harness at reduced-but-honest scale: cached beats uncached
+    on every route, bulk overload sheds, SSE subscribers get their events,
+    and the artifact has the shape BENCH_r07.json commits."""
+    out = tmp_path / "BENCH_serve.json"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_SERVE_CLIENTS": "200",
+        "BENCH_SERVE_REQS": "3",
+        "BENCH_SERVE_SSE": "32",
+    }
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"), "--serve",
+         "--out", str(out)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=1200, env=env,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    artifact = json.loads(out.read_text())
+    serve = artifact["serve"]
+    assert artifact["ok"] and artifact["mode"] == "serve"
+    for phase in ("uncached", "cached"):
+        for label, s in serve[phase]["per_route"].items():
+            assert s["n"] > 0 and s["p99_s"] > 0, (phase, label)
+    assert serve["cached"]["cache"]["hit_rate"] > 0.5
+    # the recompute-bound hot reads must win clearly even at this reduced
+    # scale; the committed BENCH_r07.json records the full-scale figures
+    assert serve["p99_speedup_hot_reads_min"] > 1.5
+    shed = serve["overload"]["shed"]
+    assert any(v > 0 for v in shed.values()), "overload never shed"
+    assert serve["overload"]["critical_errors"] == 0
+    sse = serve["sse"]
+    assert sse["subscribers_fully_served"] == sse["subscribers"]
