@@ -207,6 +207,18 @@ class ClientBuilder:
             from .. import device_pipeline
 
             device_pipeline.enable()
+            # Self-tuning control plane (autotune.py): under
+            # LIGHTHOUSE_TPU_AUTOTUNE=live this measures the fq backend
+            # (FQ_BACKEND=auto only; cached per device kind) and starts the
+            # periodic controller that overlays bucket vocabularies from
+            # the flight recorder.  The default mode (pinned) starts
+            # nothing — decisions then replay only from an installed pin.
+            try:
+                from .. import autotune
+
+                autotune.maybe_start_from_env()
+            except Exception:
+                log.warning("autotune startup failed", exc_info=True)
         if os.environ.get("LIGHTHOUSE_TPU_DEVICE_SHA") == "1":
             from ..ops.sha256_device import install_device_hash
 

@@ -80,6 +80,10 @@ DEFAULT_DEADLINES = {
     "epoch_deltas": 300.0,
     "epoch_deltas_leak": 300.0,
     "kzg_batch": 300.0,
+    # the autotune fq A/B microbench (autotune.measure_fq_backend): small
+    # batch, but the first run pays both backends' probe compiles — the
+    # deadline guards node startup against a hung device, not a compiler
+    "autotune_probe": 120.0,
 }
 DEFAULT_DEADLINE_S = 300.0
 

@@ -452,10 +452,17 @@ def summary() -> dict:
         op: {axis: _percentiles(vals) for axis, vals in axes.items() if vals}
         for op, axes in occ.items()
     }
-    from . import device_mesh, device_pipeline, device_supervisor
+    from . import autotune, device_mesh, device_pipeline, device_supervisor
 
     return {
         "programs": COMPILE_CACHE.inventory(),
+        # Self-tuning control plane (autotune.py): mode + live vocabulary
+        # overlay — the flight recorder below is its evidence stream, and
+        # GET /lighthouse/autotune is the full decision log.
+        "autotune": {
+            "mode": autotune.mode(),
+            "overlay": {k: list(v) for k, v in autotune.overlay().items()},
+        },
         # Mesh-sharding subsystem (device_mesh.py): topology, per-device
         # breakers, reshard count — the first stop when one chip is sick.
         "mesh": device_mesh.summary(),

@@ -54,6 +54,8 @@ POINTS = (
     "net.deliver",       # network/transport.py Hub.deliver: error=drop,
                          # hang=stall the sender, corrupt=flip a payload byte
                          # (op selector matches the envelope kind)
+    "api.handler",       # bench.py autotune phase: hang=inject a handler-
+                         # latency step the admission EWMAs must track
 )
 
 MODES = ("error", "hang", "corrupt")

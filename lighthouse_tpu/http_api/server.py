@@ -2317,6 +2317,21 @@ def lighthouse_events_subscribers(ctx):
     return {"data": ctx.chain.events.summary()}
 
 
+@route("GET", "/lighthouse/autotune", P1)
+def lighthouse_autotune(ctx):
+    """The self-tuning control plane in one read (autotune.py): mode,
+    static vs live bucket vocabularies, the decision log (every adoption /
+    drop / refusal with its guardrail reason), warmup states, the measured
+    fq-backend selection, and the admission layer's effective (latency-
+    tracked) bounds next to its static configuration.  The first stop when
+    "the controller made a bad decision" — see OBSERVABILITY.md."""
+    from .. import autotune
+
+    data = autotune.snapshot()
+    data["admission"] = ctx.server.spawner.admission.snapshot()
+    return {"data": data}
+
+
 @route("GET", "/lighthouse/serving", P1)
 def lighthouse_serving(ctx):
     """The serving-performance surface in one read: response-cache

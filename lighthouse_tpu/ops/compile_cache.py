@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import os
 import threading
-import time
 from typing import List, Optional, Sequence, Tuple
 
 CACHE_DIR_ENV = "LIGHTHOUSE_TPU_COMPILE_CACHE_DIR"
@@ -127,6 +126,94 @@ def _bucket_shape_structs(nb: int, kb: int):
     return pk, sig, msg, wbits, live
 
 
+def _aot_compile(op: str, shape: Tuple[int, ...], lower_thunk,
+                 hit_threshold_s: float = WARMUP_HIT_THRESHOLD_S) -> dict:
+    """One ahead-of-time compile: run ``lower_thunk`` (an abstract
+    ``.lower(...).compile()`` call), classify hit (persistent-cache
+    deserialize) vs miss (real XLA compile) by watching the cache dir, and
+    feed the compile-mirror telemetry (``device_telemetry.note_warmup``).
+    Returns the per-shape record warmup callers aggregate."""
+    import time as _time
+
+    from .. import device_telemetry
+    from ..logs import get_logger
+
+    log = get_logger("compile_cache")
+    label = "x".join(str(int(s)) for s in shape)
+    record = {"op": op, "shape": label}
+    t0 = _time.perf_counter()
+    cache_files_before = _cache_file_count()
+    try:
+        lower_thunk()
+    except Exception as e:  # noqa: BLE001 — warmup must never kill startup
+        record["seconds"] = round(_time.perf_counter() - t0, 3)
+        record["outcome"] = "error"
+        record["error"] = f"{type(e).__name__}: {e}"
+        log.warning("AOT warmup failed", **record)
+        return record
+    dt = _time.perf_counter() - t0
+    # A real compile writes new entries into the persistent cache dir
+    # (min_compile_time 1.0s); a deserialize does not.  The wall-clock
+    # threshold is the fallback when the dir is not observable.
+    cache_files_after = _cache_file_count()
+    if cache_files_before is not None and cache_files_after is not None:
+        hit = cache_files_after == cache_files_before
+    else:
+        hit = dt < hit_threshold_s
+    record["seconds"] = round(dt, 3)
+    record["outcome"] = "hit" if hit else "miss"
+    device_telemetry.note_warmup(op, shape, dt, hit)
+    log.info("AOT warmup", **record)
+    return record
+
+
+def aot_warmup_op(op: str, nb: int) -> List[dict]:
+    """AOT-compile one op's bucket ``nb`` off the production path — the
+    autotune controller's adoption prerequisite (a live-mode bucket is
+    only adopted after its compile cost is paid here, never inside a
+    caller's dispatch).  Covers the three tunable vocabularies; the epoch
+    op warms BOTH leak modes (``in_leak`` forks the compiled program)."""
+    import jax
+    import numpy as np
+
+    nb = int(nb)
+    if op == "bls_verify":
+        from .verify import _device_verify
+
+        return [_aot_compile(
+            "bls_verify", (nb, 32),
+            lambda: _device_verify.lower(
+                *_bucket_shape_structs(nb, 32)).compile())]
+    if op == "sha256_pairs":
+        from .sha256_device import _sha256_64byte_batch
+
+        words = jax.ShapeDtypeStruct((nb, 16), np.uint32)
+        return [_aot_compile(
+            "sha256_pairs", (nb,),
+            lambda: _sha256_64byte_batch.lower(words).compile())]
+    if op in ("epoch_deltas", "epoch_deltas_leak"):
+        from jax.experimental import enable_x64
+
+        from .epoch_device import _deltas_kernel
+
+        def epoch_thunk(in_leak: bool):
+            def thunk():
+                with enable_x64():
+                    i64 = jax.ShapeDtypeStruct((nb,), np.int64)
+                    s64 = jax.ShapeDtypeStruct((), np.int64)
+                    args = ([i64] * 4
+                            + [jax.ShapeDtypeStruct((nb,), np.bool_)]
+                            + [i64] * 2 + [s64] * 7)
+                    _deltas_kernel.lower(*args, in_leak=in_leak).compile()
+            return thunk
+
+        return [
+            _aot_compile("epoch_deltas", (nb,), epoch_thunk(False)),
+            _aot_compile("epoch_deltas_leak", (nb,), epoch_thunk(True)),
+        ]
+    raise ValueError(f"no AOT warmup recipe for op {op!r}")
+
+
 def warmup_standard_buckets(
     buckets: Optional[Sequence[Tuple[int, int]]] = None,
     *,
@@ -139,7 +226,6 @@ def warmup_standard_buckets(
     (:func:`device_telemetry.note_warmup`), so ``GET /lighthouse/device``
     shows warmed buckets before the first batch arrives.
     """
-    from .. import device_telemetry
     from ..logs import get_logger
     from .verify import _device_verify
 
@@ -156,32 +242,13 @@ def warmup_standard_buckets(
         buckets = buckets or list(DEFAULT_WARMUP_BUCKETS)
     results: List[dict] = []
     for nb, kb in buckets:
-        record = {"op": "bls_verify", "shape": f"{int(nb)}x{int(kb)}"}
-        t0 = time.perf_counter()
-        cache_files_before = _cache_file_count()
-        try:
-            _device_verify.lower(*_bucket_shape_structs(int(nb), int(kb))).compile()
-        except Exception as e:  # noqa: BLE001 — warmup must never kill startup
-            record["seconds"] = round(time.perf_counter() - t0, 3)
-            record["outcome"] = "error"
-            record["error"] = f"{type(e).__name__}: {e}"
-            log.warning("AOT warmup failed", **record)
-            results.append(record)
-            continue
-        dt = time.perf_counter() - t0
-        # A real compile writes new entries into the persistent cache dir
-        # (min_compile_time 1.0s); a deserialize does not.  The wall-clock
-        # threshold is the fallback when the dir is not observable.
-        cache_files_after = _cache_file_count()
-        if cache_files_before is not None and cache_files_after is not None:
-            hit = cache_files_after == cache_files_before
-        else:
-            hit = dt < hit_threshold_s
-        record["seconds"] = round(dt, 3)
-        record["outcome"] = "hit" if hit else "miss"
-        device_telemetry.note_warmup("bls_verify", (int(nb), int(kb)), dt, hit)
-        log.info("AOT warmup", **record)
-        results.append(record)
+        nb, kb = int(nb), int(kb)
+        results.append(_aot_compile(
+            "bls_verify", (nb, kb),
+            lambda nb=nb, kb=kb: _device_verify.lower(
+                *_bucket_shape_structs(nb, kb)).compile(),
+            hit_threshold_s=hit_threshold_s,
+        ))
     return results
 
 

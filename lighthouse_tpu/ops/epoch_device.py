@@ -37,6 +37,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import autotune
 from ..types.spec import (
     PARTICIPATION_FLAG_WEIGHTS,
     TIMELY_HEAD_FLAG_INDEX,
@@ -147,9 +148,32 @@ N_BUCKETS = (64, 256, 1024, 4096, 16384, 65536, 262144, 1048576)
 _PAD_FILLS = (0, _PAD_ACTIVATION_EPOCH, 0, 0, False, 0, 0)
 
 
+def _aot_warmup(nb: int) -> None:
+    from .compile_cache import aot_warmup_op
+
+    aot_warmup_op("epoch_deltas", nb)
+
+
+# Self-tuning enrolment (autotune.py): the registry vocabulary is ratio-4
+# past the 256 bucket, so a mid-size network parked between buckets (say
+# ~2.5k validators padding to 4096) can earn a midpoint registry bucket.
+# One adoption must be budgeted for BOTH lowerings (leak and non-leak —
+# in_leak forks the compiled program like a shape does), and the warmup
+# pays both compiles off-path.
+autotune.register_vocabulary(
+    "epoch_deltas", N_BUCKETS,
+    telemetry_ops=("epoch_deltas", "epoch_deltas_leak"),
+    budget_key=lambda nb: (f"epoch_deltas|-|{nb}|-",
+                           f"epoch_deltas_leak|-|{nb}|-"),
+    warmup=_aot_warmup,
+)
+
+
 def _bucket(n: int) -> int:
-    """The registry bucket for ``n`` validators (exact size past the top)."""
-    for b in N_BUCKETS:
+    """The registry bucket for ``n`` validators (exact size past the top),
+    against the live vocabulary (static :data:`N_BUCKETS` + any
+    controller-adopted overlay buckets)."""
+    for b in autotune.bucket_vocabulary("epoch_deltas", N_BUCKETS):
         if n <= b:
             return b
     return n

@@ -141,10 +141,31 @@ def active_fq_backend() -> str:
                 f"{FQ_BACKEND_ENV}={choice!r}: expected int8, int32 or auto"
             )
         if choice == "auto":
+            # Measurement beats the platform guess: the autotune layer's
+            # in-situ A/B microbench caches its winner per (device_kind,
+            # jax version) next to the persistent compile cache
+            # (autotune.measure_fq_backend); consult it first.  Guess only
+            # when no measurement exists (or autotune is off).
+            measured = None
             try:
-                choice = "int8" if jax.default_backend() == "tpu" else "int32"
+                from .. import autotune
+
+                # compute_key=True: deriving the cache key touches the
+                # jax platform — acceptable here, where the fallback
+                # guess queries it anyway
+                decision = autotune.cached_fq_backend(compute_key=True)
+                if decision is not None:
+                    measured = decision["backend"]
             except Exception:
-                choice = "int32"
+                measured = None
+            if measured in _FQ_BACKENDS:
+                choice = measured
+            else:
+                try:
+                    choice = ("int8" if jax.default_backend() == "tpu"
+                              else "int32")
+                except Exception:
+                    choice = "int32"
         _backend = choice
     return _backend
 
@@ -164,6 +185,40 @@ def set_fq_backend(name: Optional[str]) -> Optional[str]:
         raise ValueError(f"unknown fq backend {name!r}")
     prev, _backend = _backend, name
     return prev
+
+
+def measure_backend_seconds(backend: str, rows: int = 512,
+                            reps: int = 3) -> float:
+    """In-situ A/B probe for the measured backend selection
+    (``autotune.measure_fq_backend``): time one small deterministic
+    operand batch through ``backend``'s lowering, best-of-``reps`` after
+    a warmup call (so compile / persistent-cache deserialize stays out of
+    the figure).  Runs on the supervisor's ``autotune_probe`` watchdog
+    worker — the sanctioned sync context for this function.
+
+    The probe traces the per-backend lowerings DIRECTLY
+    (``_fq_mul_int8`` / ``_fq_mul_int32``) through fresh closures — the
+    process-global backend selection is never touched, so production
+    batches tracing concurrently (node startup runs this on a background
+    thread) can never bake the probe's backend into their cached
+    traces."""
+    import time as _time
+
+    if backend not in _FQ_BACKENDS:
+        raise ValueError(f"unknown fq backend {backend!r}")
+    lowering = _fq_mul_int8 if backend == "int8" else _fq_mul_int32
+    rng = np.random.default_rng(0xF0F0)
+    a = rng.integers(0, 1 << 16, size=(int(rows), L16), dtype=np.int32)
+    b = rng.integers(0, 1 << 16, size=(int(rows), L16), dtype=np.int32)
+    # recompile-hazard: ok(the A/B probe needs one fresh trace per backend — a shared jit identity would replay the other backend's lowering)
+    probe = jax.jit(lambda x, y: lowering(x, y))
+    jax.block_until_ready(probe(a, b))  # compile/deserialize, excluded
+    best = float("inf")
+    for _ in range(max(1, int(reps))):
+        t0 = _time.perf_counter()
+        jax.block_until_ready(probe(a, b))
+        best = min(best, _time.perf_counter() - t0)
+    return best
 
 # ------------------------------------------------------------------ core ops
 

@@ -16,11 +16,13 @@ where dispatch overhead dominates — ``hash_pairs_device`` is the drop-in
 from __future__ import annotations
 
 import functools
-from typing import Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from .. import autotune
 
 _K = np.array([
     0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
@@ -48,6 +50,26 @@ _PAD_WORDS[0] = 0x80000000
 _PAD_WORDS[15] = 512
 
 N_BUCKETS = (256, 1024, 4096, 16384, 65536, 262144)
+
+
+def _aot_warmup(nb: int) -> None:
+    from .compile_cache import aot_warmup_op
+
+    aot_warmup_op("sha256_pairs", nb)
+
+
+# Self-tuning enrolment (autotune.py): this ratio-4 vocabulary has real
+# gaps, so the controller's densify heuristic can overlay midpoint buckets
+# (e.g. 640 between 256 and 1024) when the flight recorder shows the
+# median dispatched layer wasting over half its lanes.  N_BUCKETS stays
+# the floor and its top bucket the device-size ceiling; every adoption is
+# gated on a committed hlo_budget entry plus off-path AOT warmup.
+autotune.register_vocabulary(
+    "sha256_pairs", N_BUCKETS,
+    telemetry_ops=("sha256_pairs",),
+    budget_key=lambda nb: f"sha256_pairs|-|{nb}|-",
+    warmup=_aot_warmup,
+)
 
 
 #: device_mesh.ShardedEntry for the pair-hash kernel (lazy).
@@ -122,7 +144,12 @@ def _sha256_64byte_batch(words):
     return state
 
 
-def _bucket(n: int, buckets: Sequence[int] = N_BUCKETS) -> int:
+def _bucket(n: int, buckets: Optional[Sequence[int]] = None) -> int:
+    if buckets is None:
+        # the live vocabulary: static N_BUCKETS plus controller-adopted
+        # overlay buckets (autotune.py) — identical to N_BUCKETS when the
+        # controller is off or has adopted nothing
+        buckets = autotune.bucket_vocabulary("sha256_pairs", N_BUCKETS)
     for b in buckets:
         if n <= b:
             return b

@@ -33,6 +33,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import autotune
 from ..crypto.bls import curve
 from ..crypto.bls.backends.host import _rand_scalars
 from ..crypto.bls.fields import Fq2
@@ -62,6 +63,30 @@ def _bucket(n: int, buckets: Sequence[int]) -> int:
 N_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 K_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048)
 MAX_SETS_PER_DISPATCH = N_BUCKETS[-1]
+
+def _aot_warmup(nb: int) -> None:
+    from .compile_cache import aot_warmup_op
+
+    aot_warmup_op("bls_verify", nb)
+
+
+# Enroll the set-axis vocabulary in the self-tuning control plane
+# (autotune.py): live mode may overlay midpoint buckets below the static
+# top (N_BUCKETS stays the floor, MAX_SETS_PER_DISPATCH the ceiling) —
+# though this ratio-2 vocabulary has no real gaps, so in practice the
+# controller's densify heuristic never fires here and the registration
+# exists so a FUTURE vocabulary edit is tunable without re-wiring.  The
+# budget key and the AOT warmup cover the STANDARD 32-key tier only: an
+# editor introducing a real gap here must extend both to every K tier
+# the new bucket serves, or off-tier dispatches pay an on-path compile
+# through an unaudited lowering (today any adoption is refused — no
+# committed budget key exists for a midpoint).
+autotune.register_vocabulary(
+    "bls_verify", N_BUCKETS,
+    telemetry_ops=("bls_verify",),
+    budget_key=lambda nb: f"bls_verify|{fq.active_fq_backend()}|{nb}x32|-",
+    warmup=_aot_warmup,
+)
 
 
 @jax.jit
@@ -197,7 +222,10 @@ def build_batch(sets, rands) -> Optional[tuple]:
     Returns None if host-side validation already decides False.
     """
     n = len(sets)
-    nb = _bucket(n, N_BUCKETS)
+    # The set axis buckets against the LIVE vocabulary (static N_BUCKETS
+    # plus any controller-adopted overlay buckets); the key axis stays
+    # static — padding waste there is bounded by the committee shape.
+    nb = _bucket(n, autotune.bucket_vocabulary("bls_verify", N_BUCKETS))
     kb = _bucket(max(len(s.signing_keys) for s in sets), K_BUCKETS)
 
     pk = [np.zeros((nb, kb, 25), np.int32) for _ in range(3)]

@@ -168,6 +168,7 @@ class ScenarioRunner:
         self._breakers_touched = False
         self._pipeline_enabled = False
         self._mesh_touched = False
+        self._autotune_touched = False
         self._spam_endpoints: List[str] = []
         self._api_servers: List[Any] = []  # (cached, uncached) HTTP pairs
 
@@ -256,6 +257,13 @@ class ScenarioRunner:
             settle()
         sim.hub.advance_tick()
         settle()
+        if self._autotune_touched:
+            # The controller's clock inside a scenario is the per-slot
+            # evaluation index — never wall-clock — so a pinned decision
+            # list replays at the same slots in both determinism-gate runs.
+            from . import autotune
+
+            autotune.CONTROLLER.evaluate()
         if self.byz is not None:
             self.byz.observe_slot(slot)
         heads = {n.chain.head_root for n in sim.live_nodes}
@@ -318,6 +326,21 @@ class ScenarioRunner:
         self._breakers_touched = True
         device_supervisor.SUPERVISOR.configure(
             config=device_supervisor.BreakerConfig(**kwargs))
+
+    def _ev_autotune(self, mode: str = "pinned",
+                     pin: Optional[Sequence[dict]] = None) -> None:
+        """Enable the self-tuning controller for this scenario.  ``pinned``
+        (the only mode a deterministic scenario should run) replays the
+        given ``pin`` — a recorded decision list keyed by evaluation
+        index; the runner then drives one evaluation per stepped slot, so
+        both determinism-gate runs apply identical decisions at identical
+        slots."""
+        from . import autotune
+
+        self._autotune_touched = True
+        autotune.set_mode(mode)
+        if pin is not None:
+            autotune.CONTROLLER.install_pin(pin)
 
     def _ev_device_pipeline(self, enable: bool, linger_s: float = 0.002) -> None:
         """Route every node's ``verify_signature_sets`` through the async
@@ -778,6 +801,10 @@ class ScenarioRunner:
             from . import device_supervisor
 
             device_supervisor.reset_for_tests()
+        if self._autotune_touched:
+            from . import autotune
+
+            autotune.reset_for_tests()
         if self.byz is not None:
             self.byz.cleanup()
         for server in self._api_servers:
@@ -1040,6 +1067,50 @@ def state_hash_pipeline(seed: int = 0) -> Scenario:
             Event(4, "state_hashing", {"enable": False}),
         ),
         extra_checks=_check_hash_pipeline,
+    )
+
+
+def autotune_pinned(seed: int = 0) -> Scenario:
+    """The self-tuning controller in its deterministic mode, under device
+    faults: the fleet hashes through the supervised sha path while a fault
+    plan trips the ``sha256_pairs`` breaker mid-sync, and the autotune
+    controller replays a PINNED decision list — adopt the 640 midpoint
+    sha bucket at evaluation 2 (through the committed-hlo_budget gate, the
+    static-gate honesty check), drop it at evaluation 6.  The 2-run
+    determinism gate then proves the controller's whole machinery — mode
+    plumbing, per-slot evaluation clock, overlay swap in the live
+    ``_bucket`` path — cannot leak wall-clock into chain content: both
+    runs must apply the identical adopted-bucket sequence AND finish on
+    identical heads."""
+    pin = [
+        {"after_evaluation": 2, "vocab": "sha256_pairs",
+         "action": "adopt", "bucket": 640},
+        {"after_evaluation": 6, "vocab": "sha256_pairs",
+         "action": "drop", "bucket": 640},
+        # a pin must not be able to smuggle an unbudgeted lowering past
+        # the static gate: this entry is REFUSED (no committed hlo_budget
+        # key for 900) and the refusal is part of the pinned sequence
+        {"after_evaluation": 8, "vocab": "sha256_pairs",
+         "action": "adopt", "bucket": 900},
+    ]
+    return Scenario(
+        name="autotune_pinned",
+        description="pinned autotune decisions replay under device faults",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=32, fault_slots=8, recovery_slots=24,
+        events=(
+            Event(0, "autotune", {"mode": "pinned", "pin": pin}),
+            Event(0, "breaker_config",
+                  {"failure_threshold": 2, "open_cooldown_s": 300.0,
+                   "probe_successes": 1}),
+            Event(0, "device_hashing", {"enable": True}),
+            Event(0, "install_faults",
+                  {"spec": "device.dispatch[op=sha256_pairs]=error"}),
+            Event(1, "join_checkpoint", {"anchor_from": 0}),
+            Event(4, "clear_faults"),
+            Event(4, "device_hashing", {"enable": False}),
+        ),
+        extra_checks=_check_autotune_pinned,
     )
 
 
@@ -1333,6 +1404,39 @@ def _check_hash_pipeline(runner: ScenarioRunner) -> dict:
     }
 
 
+def _check_autotune_pinned(runner: ScenarioRunner) -> dict:
+    """The pinned decision list really replayed — same sequence, same
+    evaluation indices, guardrails live — and the device fault plan really
+    bit (so the replay happened under the degraded conditions the scenario
+    advertises).  Identity of this evidence ACROSS the two gate runs is
+    what the matrix's head comparison certifies."""
+    from . import autotune, device_supervisor
+
+    log_ = autotune.CONTROLLER.decision_log()
+    applied = [(d["action"], d["bucket"], d["evaluation"], d["outcome"])
+               for d in log_ if d.get("knob") == "bucket"]
+    assert applied == [
+        ("adopt", 640, 2, "adopted"),
+        ("drop", 640, 6, "dropped"),
+        ("adopt", 900, 8, "refused_no_budget"),
+    ], f"pinned replay diverged: {applied}"
+    assert all(d.get("via") == "pin" for d in log_), log_
+    assert autotune.overlay() == {}, (
+        "overlay not empty after the pinned drop")
+    # the scenario ran the controller every stepped slot (8 fault + 24
+    # recovery), so the pin's indices were all reachable
+    assert autotune.CONTROLLER.evaluations >= 10, (
+        f"only {autotune.CONTROLLER.evaluations} evaluations ran")
+    br = device_supervisor.SUPERVISOR.breaker("sha256_pairs").snapshot()
+    assert br["trips_total"] >= 1, "sha breaker never tripped: the fault "\
+        "plan did not bite"
+    return {
+        "autotune": {"decisions": applied,
+                     "evaluations": autotune.CONTROLLER.evaluations},
+        "breaker": br,
+    }
+
+
 def _check_spammer_penalized(runner: ScenarioRunner) -> dict:
     spammer_id, victim = runner.ctx["spammer"]
     score = victim.node.service.peer_manager._peer(spammer_id).score
@@ -1438,6 +1542,7 @@ SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     "mesh_degradation": mesh_degradation,
     "pipeline_mid_sync": pipeline_mid_sync,
     "state_hash_pipeline": state_hash_pipeline,
+    "autotune_pinned": autotune_pinned,
     "spam_slow_peer": spam_slow_peer,
     "byz_double_vote_smoke": byz_double_vote_smoke,
     "byz_minority_equivocation": byz_minority_equivocation,

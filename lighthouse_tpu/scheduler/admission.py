@@ -21,14 +21,38 @@ It also generalizes the processor's ad-hoc ``is_syncing`` callable into
 :class:`DropPolicy` — the one object that decides which enqueued work is
 discarded instead of queued (``drop_during_sync`` was the first policy;
 admission deadlines are the second).
+
+**Latency-driven bounds (ISSUE 15).**  The configured
+:class:`ClassPolicy` values are *static guesses*; the observed handler
+latency is a *measurement* — every :class:`Ticket` release feeds a
+per-class service-time EWMA.  When the autotune layer runs live
+(``LIGHTHOUSE_TPU_AUTOTUNE=live``, or ``adaptive=True`` on the
+controller), the effective dequeue deadline tracks
+``DEADLINE_LATENCY_FACTOR`` × EWMA and the effective inflight bound tracks
+how many requests one worker can clear inside that deadline — both clamped
+to a band whose ceiling IS the configured static value (the statics remain
+the contract; the controller only tightens inside it).  Fast handlers →
+static bounds shed late and waste queue slots on stale answers;
+slow handlers → static bounds admit work that cannot possibly be served in
+time.  Both are visible on the ``http_admission_effective_*`` gauges.
+
+**Measured Retry-After.**  A shed response's Retry-After used to be a
+per-class constant.  It now reflects the class's *observed drain rate*
+(completions over a sliding window): the hint is the time for roughly half
+the currently-inflight requests to drain, clamped to
+[1, :data:`RETRY_AFTER_MAX_S`] — falling back to the configured constant
+below :data:`DRAIN_MIN_SAMPLES` completions.  This path is always on
+(it shapes a response hint, not an admission decision).
 """
 
 from __future__ import annotations
 
+import math
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
-from typing import Callable, Dict, Optional
+from typing import Callable, Deque, Dict, Optional, Tuple
 
 from .. import metrics
 
@@ -58,6 +82,39 @@ HTTP_ADMISSION_INFLIGHT = metrics.gauge(
     "http_admission_inflight",
     "admitted-but-unfinished API requests, by class",
 )
+HTTP_ADMISSION_LATENCY_EWMA = metrics.gauge(
+    "http_admission_latency_ewma_seconds",
+    "observed handler service-time EWMA feeding the latency-driven "
+    "admission bounds, by class",
+)
+HTTP_ADMISSION_EFFECTIVE_DEADLINE = metrics.gauge(
+    "http_admission_effective_deadline_seconds",
+    "dequeue deadline currently in force (static, or latency-tracked in "
+    "autotune live mode), by class",
+)
+HTTP_ADMISSION_EFFECTIVE_INFLIGHT = metrics.gauge(
+    "http_admission_effective_max_inflight",
+    "inflight bound currently in force (static, or latency-tracked in "
+    "autotune live mode), by class",
+)
+
+#: Service-time EWMA smoothing (~20 samples to converge on a step).
+EWMA_ALPHA = 0.2
+#: The effective deadline targets this multiple of the observed service
+#: time: an admitted request that already waited 4 service times is deep
+#: into diminishing-value territory.
+DEADLINE_LATENCY_FACTOR = 4.0
+#: Band floors (the configured static value is the ceiling for both): the
+#: controller may tighten a deadline to a quarter of its static value and
+#: an inflight bound to an eighth — never below, so a latency spike can
+#: only narrow service, not collapse it.
+DEADLINE_FLOOR_FRACTION = 0.25
+INFLIGHT_FLOOR_FRACTION = 0.125
+
+#: Retry-After derivation: sliding completion window + sample floor.
+DRAIN_WINDOW = 64
+DRAIN_MIN_SAMPLES = 8
+RETRY_AFTER_MAX_S = 30
 
 
 class ShedError(Exception):
@@ -80,7 +137,9 @@ class ClassPolicy:
     ``max_inflight`` caps admitted-but-unfinished requests (the cheap
     early shed); ``deadline_s`` bounds how stale an admitted request may
     be when a worker finally picks it up (the dequeue shed);
-    ``retry_after_s`` is what a shed response tells the client."""
+    ``retry_after_s`` is what a shed response tells the client when the
+    drain rate is unobserved.  All three are the STATIC configuration —
+    the latency-driven layer narrows inside them, never past them."""
 
     name: str
     max_inflight: int
@@ -103,41 +162,131 @@ class Ticket:
     (shed or served).  ``check_deadline`` is called by the worker just
     before running the handler — the dequeue-side shed."""
 
-    __slots__ = ("controller", "policy", "admitted_pc")
+    __slots__ = ("controller", "policy", "admitted_pc", "started_pc", "shed")
 
     def __init__(self, controller: "AdmissionController", policy: ClassPolicy):
         self.controller = controller
         self.policy = policy
         self.admitted_pc = time.perf_counter()
+        self.started_pc: Optional[float] = None
+        self.shed = False
 
     def check_deadline(self) -> float:
         """Record the queue wait; raise :class:`ShedError` when this request
-        waited past its class deadline.  Returns the wait in seconds."""
-        wait = time.perf_counter() - self.admitted_pc
+        waited past its class's EFFECTIVE deadline (static, or
+        latency-tracked in live mode).  Returns the wait in seconds."""
+        now = time.perf_counter()
+        wait = now - self.admitted_pc
         HTTP_ADMISSION_WAIT_SECONDS.observe(wait, **{"class": self.policy.name})
-        if wait > self.policy.deadline_s:
+        _, deadline_s = self.controller.effective_bounds(self.policy.name)
+        if wait > deadline_s:
+            self.shed = True
             HTTP_REQUESTS_SHED.inc(**{"class": self.policy.name,
                                       "reason": "deadline"})
             self.controller._count_shed()
             raise ShedError(self.policy.name, "deadline",
-                            self.policy.retry_after_s)
+                            self.controller.retry_after(self.policy.name))
+        self.started_pc = now
         return wait
 
     def release(self) -> None:
-        self.controller._release(self.policy.name)
+        # Only a request whose handler actually RAN (check_deadline set
+        # started_pc) feeds the latency EWMA: a shed one never ran, and a
+        # queue-full rejection released straight after try_admit would
+        # record its ~microsecond enqueue failure as a 'service time' —
+        # dragging the EWMA to zero exactly when the system is overloaded.
+        duration: Optional[float] = None
+        if not self.shed and self.started_pc is not None:
+            duration = time.perf_counter() - self.started_pc
+        self.controller._release(self.policy.name, duration)
 
 
 class AdmissionController:
-    """Bounded per-class admission in front of the processor."""
+    """Bounded per-class admission in front of the processor.
 
-    def __init__(self, policies=DEFAULT_POLICIES):
+    ``adaptive=None`` (production) follows the autotune mode — the bounds
+    track latency only under ``LIGHTHOUSE_TPU_AUTOTUNE=live``; ``True`` /
+    ``False`` pins the behavior (tests, the bench harness)."""
+
+    def __init__(self, policies=DEFAULT_POLICIES,
+                 adaptive: Optional[bool] = None):
         self._policies: Dict[str, ClassPolicy] = {p.name: p for p in policies}
         self._inflight: Dict[str, int] = {p.name: 0 for p in policies}
         self._lock = threading.Lock()
+        self._adaptive = adaptive
+        self._ewma: Dict[str, float] = {}
+        self._done: Dict[str, Deque[float]] = {
+            p.name: deque(maxlen=DRAIN_WINDOW) for p in policies
+        }
         self.shed = 0  # process-lifetime total, for snapshots/tests
 
     def policy(self, klass: str) -> ClassPolicy:
         return self._policies[klass]
+
+    # ------------------------------------------------- latency-driven bounds
+
+    def _adaptive_on(self) -> bool:
+        if self._adaptive is not None:
+            return self._adaptive
+        from .. import autotune
+
+        return autotune.live()
+
+    def effective_bounds(self, klass: str) -> Tuple[int, float]:
+        """(max_inflight, deadline_s) currently in force for ``klass``:
+        the static policy values, or — adaptive mode with an observed
+        EWMA — the latency-tracked values inside the static band.
+
+        The deadline targets :data:`DEADLINE_LATENCY_FACTOR` × EWMA
+        (floor ``static × DEADLINE_FLOOR_FRACTION``, ceiling static); the
+        inflight bound is how many requests one worker clears inside that
+        deadline, ``deadline / EWMA`` (floor ``static ×
+        INFLIGHT_FLOOR_FRACTION``, ceiling static) — Little's law with the
+        observed service rate.  Fast handlers pin both at the static
+        ceiling's spirit: a tight deadline sheds stale work early while
+        the large drain keeps the inflight bound at its ceiling."""
+        policy = self._policies.get(klass)
+        if policy is None:
+            return (1 << 30, 60.0)
+        with self._lock:
+            ewma = self._ewma.get(klass)
+        if ewma is None or ewma <= 0 or not self._adaptive_on():
+            return (policy.max_inflight, policy.deadline_s)
+        return self._bounds_from_ewma(policy, ewma)
+
+    @staticmethod
+    def _bounds_from_ewma(policy: ClassPolicy,
+                          ewma: float) -> Tuple[int, float]:
+        deadline = min(policy.deadline_s,
+                       max(policy.deadline_s * DEADLINE_FLOOR_FRACTION,
+                           DEADLINE_LATENCY_FACTOR * ewma))
+        floor = max(1, int(policy.max_inflight * INFLIGHT_FLOOR_FRACTION))
+        max_inflight = min(policy.max_inflight,
+                           max(floor, int(deadline / ewma)))
+        return (max_inflight, deadline)
+
+    def retry_after(self, klass: str) -> int:
+        """The Retry-After hint for a shed ``klass`` request: time for
+        roughly half the inflight requests to drain at the observed
+        completion rate, clamped to [1, :data:`RETRY_AFTER_MAX_S`].  Below
+        :data:`DRAIN_MIN_SAMPLES` completions (cold start, idle class) the
+        configured constant stands — a hint must never be derived from
+        noise."""
+        policy = self._policies.get(klass)
+        fallback = policy.retry_after_s if policy is not None else 1
+        with self._lock:
+            done = self._done.get(klass)
+            if done is None or len(done) < DRAIN_MIN_SAMPLES:
+                return fallback
+            span = done[-1] - done[0]
+            if span <= 0:
+                return fallback
+            rate = (len(done) - 1) / span  # completions per second
+            backlog = max(1, self._inflight.get(klass, 0))
+        return max(1, min(RETRY_AFTER_MAX_S,
+                          int(math.ceil((backlog / 2.0) / rate))))
+
+    # ------------------------------------------------------------ admission
 
     def try_admit(self, klass: str) -> Ticket:
         """Admit or shed.  Unknown classes are admitted unbounded (a route
@@ -150,34 +299,78 @@ class AdmissionController:
             with self._lock:
                 self._policies.setdefault(klass, policy)
                 self._inflight.setdefault(klass, 0)
+                self._done.setdefault(klass, deque(maxlen=DRAIN_WINDOW))
+        bound, _ = self.effective_bounds(policy.name)
         with self._lock:
-            if self._inflight[policy.name] >= policy.max_inflight:
-                self.shed += 1
-                HTTP_REQUESTS_SHED.inc(**{"class": policy.name,
-                                          "reason": "admission_full"})
-                raise ShedError(policy.name, "admission_full",
-                                policy.retry_after_s)
-            self._inflight[policy.name] += 1
-            HTTP_ADMISSION_INFLIGHT.set(self._inflight[policy.name],
-                                        **{"class": policy.name})
-        return Ticket(self, policy)
+            if self._inflight[policy.name] < bound:
+                self._inflight[policy.name] += 1
+                HTTP_ADMISSION_INFLIGHT.set(self._inflight[policy.name],
+                                            **{"class": policy.name})
+                return Ticket(self, policy)
+            self.shed += 1
+            HTTP_REQUESTS_SHED.inc(**{"class": policy.name,
+                                      "reason": "admission_full"})
+        # Retry-After derivation re-acquires the lock — raise outside it.
+        raise ShedError(policy.name, "admission_full",
+                        self.retry_after(policy.name))
 
     def _count_shed(self) -> None:
         with self._lock:
             self.shed += 1
 
-    def _release(self, klass: str) -> None:
+    def _release(self, klass: str, duration: Optional[float] = None) -> None:
         with self._lock:
             self._inflight[klass] = max(0, self._inflight[klass] - 1)
             HTTP_ADMISSION_INFLIGHT.set(self._inflight[klass],
                                         **{"class": klass})
+            if duration is not None:
+                prev = self._ewma.get(klass)
+                ewma = duration if prev is None else (
+                    EWMA_ALPHA * duration + (1.0 - EWMA_ALPHA) * prev)
+                self._ewma[klass] = ewma
+                done = self._done.setdefault(klass,
+                                             deque(maxlen=DRAIN_WINDOW))
+                done.append(time.perf_counter())
+        if duration is not None:
+            # bounds derived from the ewma just computed — no second trip
+            # through the lock on the per-completion hot path
+            HTTP_ADMISSION_LATENCY_EWMA.set(ewma, **{"class": klass})
+            policy = self._policies.get(klass)
+            if policy is not None and self._adaptive_on():
+                bound, deadline = self._bounds_from_ewma(policy, ewma)
+            elif policy is not None:
+                bound, deadline = policy.max_inflight, policy.deadline_s
+            else:
+                return
+            HTTP_ADMISSION_EFFECTIVE_INFLIGHT.set(bound, **{"class": klass})
+            HTTP_ADMISSION_EFFECTIVE_DEADLINE.set(deadline,
+                                                  **{"class": klass})
 
     def snapshot(self) -> dict:
         with self._lock:
+            # copy under the lock: try_admit registers unknown classes into
+            # _policies concurrently, and effective_bounds/retry_after each
+            # re-acquire the lock themselves (so they run on the copy)
+            policies = dict(self._policies)
+        effective = {k: self.effective_bounds(k) for k in policies}
+        retry = {k: self.retry_after(k) for k in policies}
+        adaptive = self._adaptive_on()  # resolves autotune mode: outside the lock
+        with self._lock:
             return {
                 "inflight": dict(self._inflight),
-                "bounds": {k: p.max_inflight for k, p in self._policies.items()},
-                "deadlines_s": {k: p.deadline_s for k, p in self._policies.items()},
+                "bounds": {k: p.max_inflight for k, p in policies.items()},
+                "deadlines_s": {k: p.deadline_s for k, p in policies.items()},
+                # the RESOLVED state (ctor pin, else the live autotune
+                # mode) — OBSERVABILITY.md's triage reads this to decide
+                # whether tightened bounds can be autotune's doing
+                "adaptive": adaptive,
+                "latency_ewma_s": {k: round(v, 6)
+                                   for k, v in self._ewma.items()},
+                "effective": {
+                    k: {"max_inflight": b, "deadline_s": round(d, 4)}
+                    for k, (b, d) in effective.items()
+                },
+                "retry_after_s": retry,
                 "shed_total": self.shed,
             }
 

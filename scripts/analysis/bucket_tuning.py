@@ -26,8 +26,13 @@ Heuristics (documented so the report is reviewable, not oracular):
 - too few samples → say so and suggest nothing (a tuning change must rest
   on evidence, ``MIN_SAMPLES`` batches per op/axis).
 
-REPORT-ONLY by design: it changes no behavior and writes no files — the
-output is a reviewed diff away from the vocabularies it names.
+This SCRIPT stays report-only (it changes no behavior and writes no
+files); the same heuristics run live inside the node via
+``lighthouse_tpu/autotune.py`` (ISSUE 15), where adoptions are guarded by
+the committed hlo_budget baseline and off-path AOT warmup.  The
+vocabularies are read LIVE from the ``ops/batch_axes.py``-registered
+modules so suggestions cannot go stale against the sources; the committed
+fallback snapshot only serves bare-dump triage outside the repo.
 
 Usage::
 
@@ -43,14 +48,20 @@ self-tested against seeded fixtures on every run.
 from __future__ import annotations
 
 import argparse
+import ast
 import json
+import os
+import re
 import sys
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
-#: The committed vocabularies this report suggests deltas against (kept as
-#: literals so the script never imports jax; the self-test cross-checks the
-#: spellings against the source files when run from the repo).
-VOCABULARIES: Dict[str, List[int]] = {
+#: Fallback snapshot for running on a bare telemetry dump OUTSIDE the repo
+#: (laptop triage of a prod JSON).  Inside the repo the vocabularies are
+#: READ LIVE from the ``ops/batch_axes.py``-registered modules — these
+#: literals are never consulted when the sources are present, and the
+#: self-test fails if they drift from the live values (a stale snapshot
+#: must not silently mis-advise an offline triage).
+FALLBACK_VOCABULARIES: Dict[str, List[int]] = {
     "bls_verify/sets": [1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
                         4096],
     "bls_verify/keys": [1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048],
@@ -58,6 +69,7 @@ VOCABULARIES: Dict[str, List[int]] = {
     "epoch_deltas/sets": [64, 256, 1024, 4096, 16384, 65536, 262144,
                           1048576],
     "tree_hash/sets": [8, 128, 2048, 32768],
+    "kzg_batch/sets": [1, 2, 4, 8, 16, 32, 64, 128, 256, 512],
 }
 
 #: op/axis (as telemetry spells them) -> vocabulary key
@@ -68,7 +80,105 @@ AXIS_TO_VOCAB = {
     ("epoch_deltas", "sets"): "epoch_deltas/sets",
     ("epoch_deltas_leak", "sets"): "epoch_deltas/sets",
     ("tree_hash", "sets"): "tree_hash/sets",
+    ("kzg_batch", "sets"): "kzg_batch/sets",
 }
+
+#: Registered ops with no bucket vocabulary BY DESIGN: the Pallas kernels
+#: are bench-only opt-ins that tile rows instead of bucketing.  Anything
+#: else registered in batch_axes.py without a readable vocabulary fails
+#: the self-test — a new device entry point must be tunable or exempted
+#: here with a reason.
+VOCABULARY_EXEMPT_OPS = frozenset({"pallas_fq_mul", "pallas_fq2_mul"})
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(os.path.dirname(_HERE))
+_BATCH_AXES_PATH = os.path.join(_ROOT, "lighthouse_tpu", "ops",
+                                "batch_axes.py")
+
+
+def _literal_vocab(text: str, name: str) -> Optional[List[int]]:
+    m = re.search(rf"^{name}\s*=\s*\(([^)]*)\)", text, re.MULTILINE)
+    if not m:
+        return None
+    vals = [int(v.strip()) for v in m.group(1).split(",") if v.strip()]
+    return vals or None
+
+
+def _registered_modules() -> Optional[Dict[str, str]]:
+    """op name -> repo-relative module path, from the batch-axis registry
+    (parsed with ast.literal_eval — this script stays import-free of
+    lighthouse_tpu/jax, same discipline as the sharding pass).  None when
+    the registry is absent (bare-dump mode)."""
+    try:
+        with open(_BATCH_AXES_PATH, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "BATCH_AXES"
+                for t in node.targets):
+            try:
+                registry = ast.literal_eval(node.value)
+            except ValueError:
+                return None
+            return {
+                entry["op"]: key.split(":")[0]
+                for key, entry in registry.items()
+            }
+    return None
+
+
+def read_live_vocabularies() -> Tuple[Optional[Dict[str, List[int]]],
+                                      List[str]]:
+    """(vocabularies, errors) read LIVE from the registered modules'
+    ``N_BUCKETS``/``K_BUCKETS`` literals — suggestions can never go stale
+    against the sources.  ``(None, [])`` when the repo sources are absent
+    (callers fall back to the committed snapshot); a registered op with no
+    readable vocabulary is an ERROR unless exempted above."""
+    modules = _registered_modules()
+    if modules is None:
+        return None, []
+    vocabs: Dict[str, List[int]] = {}
+    errors: List[str] = []
+    for op, rel in sorted(modules.items()):
+        if op in VOCABULARY_EXEMPT_OPS:
+            continue
+        path = os.path.join(_ROOT, rel)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            errors.append(f"{op}: registered module {rel} unreadable")
+            continue
+        n_buckets = _literal_vocab(text, "N_BUCKETS")
+        if n_buckets is None:
+            errors.append(
+                f"{op}: registered module {rel} declares no N_BUCKETS "
+                "vocabulary — a device entry point must be tunable (or "
+                "exempted in VOCABULARY_EXEMPT_OPS with a reason)")
+            continue
+        # telemetry spells the leak-mode epoch op separately but both
+        # share one registry vocabulary (AXIS_TO_VOCAB folds them)
+        base = "epoch_deltas" if op.startswith("epoch_deltas") else op
+        vocabs[f"{base}/sets"] = n_buckets
+        k_buckets = _literal_vocab(text, "K_BUCKETS")
+        if k_buckets is not None:
+            vocabs[f"{base}/keys"] = k_buckets
+    return vocabs, errors
+
+
+_VOCAB_CACHE: Optional[Dict[str, List[int]]] = None
+
+
+def get_vocabularies() -> Dict[str, List[int]]:
+    """The vocabularies suggestions run against: live-read inside the
+    repo, the committed fallback snapshot elsewhere."""
+    global _VOCAB_CACHE
+    if _VOCAB_CACHE is None:
+        live, _ = read_live_vocabularies()
+        _VOCAB_CACHE = live if live else dict(FALLBACK_VOCABULARIES)
+    return _VOCAB_CACHE
 
 DENSIFY_BELOW = 0.5   # p50 occupancy under this → suggest midpoint buckets
 WIDEN_ABOVE = 0.98    # p90 at the top bucket over this → suggest next pow2
@@ -92,6 +202,7 @@ def suggest(doc: dict) -> List[dict]:
     rows: List[dict] = []
     if not occ:
         return rows
+    vocabularies = get_vocabularies()
     for op, axes in sorted(occ.items()):
         for axis, stats in sorted((axes or {}).items()):
             if not stats:
@@ -116,7 +227,11 @@ def suggest(doc: dict) -> List[dict]:
                     f"only {row['samples']} batches in the window "
                     f"(need {MIN_SAMPLES}) — no suggestion on thin evidence")
                 continue
-            vocab = VOCABULARIES[vocab_key]
+            vocab = vocabularies.get(vocab_key)
+            if not vocab:
+                row["reason"] = (f"vocabulary {vocab_key} not readable from "
+                                 "the registered sources")
+                continue
             p50 = row["p50"] if row["p50"] is not None else 1.0
             p90 = row["p90"] if row["p90"] is not None else p50
             if p50 < DENSIFY_BELOW:
@@ -183,8 +298,9 @@ def render(rows: List[dict]) -> str:
 def self_test() -> List[str]:
     """Seeded fixtures: the heuristics must still see — a waste-heavy
     fixture must suggest densifying, a saturated one widening, a thin one
-    nothing; and (when run from the repo) the quoted vocabularies must
-    match the source literals."""
+    nothing; and (when run from the repo) every batch_axes-registered op
+    must yield a live vocabulary, with the fallback snapshot matching the
+    live read."""
     errors: List[str] = []
     waste = {"occupancy": {"sha256_pairs": {
         "sets": {"n": 32, "p50": 0.12, "p90": 0.4}}}}
@@ -216,44 +332,60 @@ def self_test() -> List[str]:
         "sets": {"n": 32, "p50": 0.9, "p90": 0.95}}}}}
     if not suggest(bench_shape):
         errors.append("BENCH-shaped input (device_telemetry section) unread")
-    errors.extend(_check_vocabulary_rot())
+    errors.extend(_check_live_vocabularies())
     return errors
 
 
-def _check_vocabulary_rot() -> List[str]:
-    """The quoted literals must match the source vocabularies (text scan,
-    no imports).  Skipped silently when the sources are absent (the script
-    can run on a bare telemetry dump anywhere)."""
-    import os
-    import re
+def _check_live_vocabularies() -> List[str]:
+    """Inside the repo: every batch_axes-registered op must yield a live
+    vocabulary (the read_live_vocabularies errors ARE self-test failures —
+    a registered device entry point with nothing to tune is either a
+    missing N_BUCKETS or a missing exemption), and the offline fallback
+    snapshot must match the live read.  Silently skipped on a bare
+    telemetry dump outside the repo."""
+    live, read_errors = read_live_vocabularies()
+    if live is None:
+        return []
+    errors = list(read_errors)
+    for key, snapshot in FALLBACK_VOCABULARIES.items():
+        got = live.get(key)
+        if got is not None and got != snapshot:
+            errors.append(
+                f"{key}: fallback snapshot {snapshot} != live source {got} "
+                "— update FALLBACK_VOCABULARIES (offline triage would "
+                "mis-advise)")
+    for key in live:
+        if key not in FALLBACK_VOCABULARIES:
+            errors.append(
+                f"{key}: live vocabulary has no fallback snapshot — add it "
+                "to FALLBACK_VOCABULARIES")
+    errors.extend(_check_runtime_thresholds())
+    return errors
 
-    here = os.path.dirname(os.path.abspath(__file__))
-    root = os.path.dirname(os.path.dirname(here))
-    sources = {
-        "bls_verify/sets": ("lighthouse_tpu/ops/verify.py", "N_BUCKETS"),
-        "bls_verify/keys": ("lighthouse_tpu/ops/verify.py", "K_BUCKETS"),
-        "sha256_pairs/sets": ("lighthouse_tpu/ops/sha256_device.py",
-                              "N_BUCKETS"),
-        "epoch_deltas/sets": ("lighthouse_tpu/ops/epoch_device.py",
-                              "N_BUCKETS"),
-        "tree_hash/sets": ("lighthouse_tpu/ops/tree_hash.py", "N_BUCKETS"),
-    }
-    errors: List[str] = []
-    for key, (rel, name) in sources.items():
-        path = os.path.join(root, rel)
-        if not os.path.exists(path):
-            continue
+
+def _check_runtime_thresholds() -> List[str]:
+    """The runtime controller (lighthouse_tpu/autotune.py) runs these same
+    densify heuristics live — a threshold edited on one side silently
+    diverges report from runtime, so the literals are drift-checked (text
+    scan, import-free; skipped outside the repo)."""
+    path = os.path.join(_ROOT, "lighthouse_tpu", "autotune.py")
+    try:
         with open(path, "r", encoding="utf-8") as f:
             text = f.read()
-        m = re.search(rf"^{name}\s*=\s*\(([^)]*)\)", text, re.MULTILINE)
+    except OSError:
+        return []
+    errors: List[str] = []
+    for name, here in (("DENSIFY_BELOW", DENSIFY_BELOW),
+                       ("MIN_SAMPLES", MIN_SAMPLES)):
+        m = re.search(rf"^{name}\s*=\s*([0-9.]+)", text, re.MULTILINE)
         if not m:
-            errors.append(f"{rel}: no {name} literal found for {key}")
-            continue
-        found = [int(v.strip()) for v in m.group(1).split(",") if v.strip()]
-        if found != VOCABULARIES[key]:
+            errors.append(f"autotune.py: no {name} literal found — the "
+                          "runtime/report heuristic pairing broke")
+        elif float(m.group(1)) != float(here):
             errors.append(
-                f"{key}: quoted vocabulary {VOCABULARIES[key]} != source "
-                f"{name} {found} in {rel} — update this script")
+                f"{name}: report {here} != runtime {m.group(1)} in "
+                "lighthouse_tpu/autotune.py — the offline report would "
+                "suggest buckets the live controller disagrees about")
     return errors
 
 

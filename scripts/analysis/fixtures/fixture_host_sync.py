@@ -55,6 +55,15 @@ def hot_path_annotated(batch):
     return np.asarray(out)  # SEEDED: hot-path-sync (via annotated assign)
 
 
+def autotune_controller_reads_device(batch):
+    """ISSUE 15 coverage seed: an autotune-shaped controller leg that
+    materializes a device value while 'reading telemetry'.  The real
+    controller (lighthouse_tpu/autotune.py, in the scan dirs) must stay
+    host-side only — this fixture proves the pass would catch the drift."""
+    observed = sync_fixture_kernel(batch)
+    return float(observed.sum())  # SEEDED: hot-path-sync (controller syncs device)
+
+
 def host_marshalling_is_fine(rows):
     packed = np.asarray(rows)  # host data: no device taint, must not flag
     staged = jnp.asarray(sync_fixture_kernel(packed))  # jnp: no-op, not a sync

@@ -244,6 +244,11 @@ def _targets() -> List[Target]:
         return build
 
     out.append(Target("sha256_pairs", "-", "256", "small", sha_build(256)))
+    # 640: the midpoint bucket the autotune controller (ISSUE 15) may
+    # adopt between 256 and 1024 — adoption is REFUSED unless this key is
+    # committed, so the budget is the adoption license.  Trace-only like
+    # every unsharded key; cheap enough for tier-1.
+    out.append(Target("sha256_pairs", "-", "640", "small", sha_build(640)))
     out.append(Target("sha256_pairs", "-", "4096", "slow", sha_build(4096)))
     # tree_hash: the fused depth-5 Merkle subtree program (ISSUE 13) —
     # small bucket in tier-1, the 2^20-leaf level's bucket behind slow.

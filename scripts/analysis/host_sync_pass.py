@@ -61,6 +61,11 @@ SCAN_DIRS = (
     "lighthouse_tpu/device_pipeline.py",
     "lighthouse_tpu/device_supervisor.py",
     "lighthouse_tpu/device_telemetry.py",
+    # Self-tuning controller (ISSUE 15): reads telemetry rings and JSON
+    # files HOST-side only — zero device syncs, enforced here (the
+    # device-touching legs live in ops/compile_cache.py and ops/fq.py
+    # under their own sanctioned contexts).
+    "lighthouse_tpu/autotune.py",
     "bench.py",
 )
 
@@ -96,6 +101,10 @@ SANCTIONED_CONTEXTS: Dict[str, Tuple[str, ...]] = {
     "lighthouse_tpu/ops/kzg_device.py": (
         "verify_kzg_proof_batch_device.device_fn",
     ),
+    # the autotune fq A/B probe: runs on the supervisor's autotune_probe
+    # watchdog worker (autotune.measure_fq_backend wraps it) — timing the
+    # dispatch IS its job
+    "lighthouse_tpu/ops/fq.py": ("measure_backend_seconds",),
     # the bench harness measures the device; blocking is its job
     "bench.py": ("*",),
 }

@@ -68,6 +68,10 @@ SCAN_DIRS = (
     # EIP-3076 DB) and the hub fabric from the scenario pump loops — same
     # discipline as the runner it rides in.
     "lighthouse_tpu/adversary.py",
+    # Self-tuning controller (ISSUE 15): overlay/decision/budget-cache
+    # state under locks, touched from dispatch hot paths
+    # (bucket_vocabulary) and the HTTP surface — same discipline.
+    "lighthouse_tpu/autotune.py",
     # Mesh-sharding subsystem (ISSUE 12): topology + per-device breaker
     # state behind a TimeoutLock, mutated from supervisor failure paths
     # and read per pipeline coalescing decision — same discipline.

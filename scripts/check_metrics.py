@@ -68,6 +68,10 @@ REGISTERING_MODULES = (
     # http_response_cache_* constants live in lighthouse_tpu.metrics;
     # importing validates the cache wires against the registry cleanly
     "lighthouse_tpu.http_api.response_cache",
+    # autotune_* live with the self-tuning controller; importing also
+    # proves the module stays importable without jax (it is host-side
+    # telemetry-plumbing only — the host-sync pass enforces the same)
+    "lighthouse_tpu.autotune",
 )
 
 # The serving layer's metric contract (ISSUE 14): per-route latency,
@@ -86,6 +90,12 @@ REQUIRED_SERVING_METRICS = (
     "http_sse_events_sent_total",
     "http_sse_events_dropped_total",
     "device_arbiter_api_timeouts_total",
+    # the latency-driven admission surface (ISSUE 15): the effective
+    # bounds and the EWMA they track must stay observable
+    "http_admission_latency_ewma_seconds",
+    "http_admission_effective_deadline_seconds",
+    "http_admission_effective_max_inflight",
+    "autotune_decisions_total",
 )
 
 

@@ -106,7 +106,10 @@ SELF_TEST = {
         },
     },
     "host-sync": {
-        "must_fire": {"hot-path-sync": 6},
+        # 7th seed: the autotune-shaped controller leg (ISSUE 15) — the
+        # real lighthouse_tpu/autotune.py is in SCAN_DIRS with a zero-sync
+        # contract, and this proves the pass would see it drift
+        "must_fire": {"hot-path-sync": 7},
         "must_not_flag_context": {
             "host_marshalling_is_fine",
             "suppressed_sync",

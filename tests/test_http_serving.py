@@ -89,6 +89,34 @@ def served_pair(harness):
     processor.shutdown()
 
 
+class TestAutotuneSurface:
+    def test_lighthouse_autotune_route(self, served_pair):
+        """GET /lighthouse/autotune: the self-tuning snapshot plus the
+        live admission state — the operator's one-read triage surface
+        (ISSUE 15)."""
+        # registration mirrors module imports — pull the ops in so every
+        # tunable vocabulary is visible on the surface
+        from lighthouse_tpu.ops import epoch_device  # noqa: F401
+        from lighthouse_tpu.ops import sha256_device  # noqa: F401
+        from lighthouse_tpu.ops import verify  # noqa: F401
+
+        _, cached, _ = served_pair
+        status, _, body = _get(cached.port, "/lighthouse/autotune")
+        assert status == 200
+        data = json.loads(body)["data"]
+        assert data["mode"] in ("0", "pinned", "live")
+        # the registered ops' vocabularies are all visible, static+overlay
+        for vocab in ("bls_verify", "sha256_pairs", "epoch_deltas"):
+            v = data["vocabularies"][vocab]
+            assert v["static"] and set(v["effective"]) >= set(v["static"])
+        adm = data["admission"]
+        assert set(adm["effective"]) == {CLASS_CRITICAL, CLASS_DUTIES,
+                                         CLASS_BULK}
+        for klass, eff in adm["effective"].items():
+            assert eff["max_inflight"] <= adm["bounds"][klass]
+            assert eff["deadline_s"] <= adm["deadlines_s"][klass]
+
+
 class TestResponseCache:
     def test_hit_is_bit_identical_and_counted(self, served_pair):
         harness, cached, uncached = served_pair
