@@ -225,6 +225,34 @@ class CommitteeCache:
             ),
         )
 
+    @classmethod
+    def from_precomputed(cls, state, epoch: int, spec: ChainSpec,
+                         active_indices, shuffling, seed: bytes
+                         ) -> "CommitteeCache":
+        """Build the cache from an already-computed shuffling (the fused
+        epoch-boundary dispatch returns the next epoch's whole-list shuffle;
+        recomputing it host-side would redo the O(n) work the device just
+        did).  The caller is responsible for ``active_indices``/``seed``
+        matching the state — ``per_epoch._prime_duty_caches`` validates
+        both before seeding."""
+        self = cls.__new__(cls)
+        self.epoch = epoch
+        self.spec = spec
+        self.active_indices = np.asarray(active_indices, dtype=np.int64)
+        n = len(self.active_indices)
+        if n == 0:
+            raise ValueError(f"no active validators at epoch {epoch}")
+        self.seed = seed
+        self.shuffling = np.asarray(shuffling, dtype=np.int64)
+        self.committees_per_slot = max(
+            1,
+            min(
+                spec.preset.max_committees_per_slot,
+                n // spec.slots_per_epoch // spec.preset.target_committee_size,
+            ),
+        )
+        return self
+
     def get_beacon_committee(self, slot: int, index: int) -> np.ndarray:
         spec = self.spec
         assert compute_epoch_at_slot(slot, spec) == self.epoch

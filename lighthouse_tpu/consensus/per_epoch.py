@@ -59,6 +59,10 @@ class EpochArrays:
         self.withdrawable_epoch = np.fromiter(
             (min(v.withdrawable_epoch, 2**63 - 1) for v in vs), dtype=np.int64, count=n
         )
+        self.activation_eligibility_epoch = np.fromiter(
+            (min(v.activation_eligibility_epoch, 2**63 - 1) for v in vs),
+            dtype=np.int64, count=n,
+        )
         self.slashed = np.fromiter((v.slashed for v in vs), dtype=bool, count=n)
 
     def active_mask(self, epoch: int) -> np.ndarray:
@@ -329,6 +333,262 @@ def epoch_deltas(arrays, prev_part, inactivity, **kwargs):
     return _epoch_deltas_numpy(arrays, prev_part, inactivity, **kwargs)
 
 
+# ------------------------------------------------- fused epoch boundary
+#
+# With the device backend on and the fused boundary enabled, the whole
+# epoch-boundary per-validator pass — deltas, balance application,
+# effective-balance hysteresis, registry-update masks, the NEXT epoch's
+# attester shuffling and per-slot proposer selection — dispatches as ONE
+# supervised, arbiter-slotted device program
+# (ops/shuffle_device.py:_boundary_kernel), with the exact numpy composite
+# below as the breaker's host fallback.
+
+_FUSED_BOUNDARY = False
+
+
+def set_fused_boundary(enabled: bool) -> None:
+    """Fuse the epoch boundary into one device dispatch (requires the
+    'device' epoch backend; ineligible states fall back to the staged
+    path automatically)."""
+    global _FUSED_BOUNDARY
+    _FUSED_BOUNDARY = bool(enabled)
+
+
+def _build_boundary_plan(
+    state, arrays: EpochArrays, prev_part, inactivity, balances,
+    *,
+    previous_epoch: int,
+    base_reward_per_increment: int,
+    total_active_balance: int,
+    quotient: int,
+    spec: ChainSpec,
+):
+    """Host-precomputed inputs for one fused boundary dispatch.  Built
+    AFTER justification (the activation mask reads the finalized epoch)
+    and BEFORE any registry mutation."""
+    from ..ops.shuffle_device import BoundaryPlan
+
+    current_epoch = h.get_current_epoch(state, spec)
+    next_epoch = current_epoch + 1
+    fork = type(state).fork_name
+    n = arrays.n
+    # Active set at the NEXT epoch is already determined: every epoch
+    # transition assigns activation/exit epochs at least one lookahead
+    # past next_epoch, so the pre-transition registry snapshot decides it.
+    active_idx = np.nonzero(
+        (arrays.activation_epoch <= next_epoch)
+        & (next_epoch < arrays.exit_epoch)
+    )[0].astype(np.int64)
+    increment = spec.effective_balance_increment
+    hysteresis_increment = increment // spec.preset.hysteresis_quotient
+    if fork == "electra":
+        eb_cap = np.fromiter(
+            (h.get_max_effective_balance(v, spec) for v in state.validators),
+            dtype=np.int64, count=n,
+        )
+        queue_lo, queue_hi = spec.min_activation_balance, 1 << 62
+    else:
+        eb_cap = np.full(n, spec.max_effective_balance, dtype=np.int64)
+        queue_lo = queue_hi = spec.max_effective_balance
+    proposer_epoch_seed = h.get_seed(
+        state, next_epoch, h.DOMAIN_BEACON_PROPOSER, spec)
+    slot_seeds = tuple(
+        h.hash(proposer_epoch_seed + h.uint_to_bytes(slot))
+        for slot in range(
+            next_epoch * spec.slots_per_epoch,
+            (next_epoch + 1) * spec.slots_per_epoch,
+        )
+    )
+    return BoundaryPlan(
+        effective_balance=arrays.effective_balance,
+        activation_epoch=arrays.activation_epoch,
+        exit_epoch=arrays.exit_epoch,
+        withdrawable_epoch=arrays.withdrawable_epoch,
+        slashed=arrays.slashed,
+        prev_part=np.asarray(prev_part, dtype=np.int64),
+        inactivity=np.asarray(inactivity, dtype=np.int64),
+        balance=np.asarray(balances, dtype=np.int64),
+        activation_eligibility_epoch=arrays.activation_eligibility_epoch,
+        eb_cap=eb_cap,
+        active_idx=active_idx,
+        attester_seed=h.get_seed(
+            state, next_epoch, h.DOMAIN_BEACON_ATTESTER, spec),
+        slot_seeds=slot_seeds,
+        rounds=spec.preset.shuffle_round_count,
+        previous_epoch=previous_epoch,
+        base_reward_per_increment=base_reward_per_increment,
+        total_active_balance=total_active_balance,
+        increment=increment,
+        inactivity_score_bias=spec.inactivity_score_bias,
+        inactivity_score_recovery_rate=spec.inactivity_score_recovery_rate,
+        quotient=quotient,
+        current_epoch=current_epoch,
+        downward=hysteresis_increment * spec.preset.hysteresis_downward_multiplier,
+        upward=hysteresis_increment * spec.preset.hysteresis_upward_multiplier,
+        ejection_balance=spec.ejection_balance,
+        far_future=min(FAR_FUTURE_EPOCH, 2**63 - 1),
+        finalized_epoch=int(state.finalized_checkpoint.epoch),
+        max_effective_balance=spec.max_effective_balance,
+        queue_lo=queue_lo,
+        queue_hi=queue_hi,
+    )
+
+
+def _epoch_boundary_numpy(plan, *, in_leak: bool):
+    """Exact numpy composite of the fused boundary kernel — the host
+    fallback the supervisor resolves through, bit-identical to the device
+    program (chaos tests assert verdict identity)."""
+    from hashlib import sha256
+
+    from .shuffling import compute_shuffled_index, shuffle_list
+
+    class _Spec:
+        effective_balance_increment = plan.increment
+        inactivity_score_bias = plan.inactivity_score_bias
+        inactivity_score_recovery_rate = plan.inactivity_score_recovery_rate
+
+    arrays = EpochArrays.__new__(EpochArrays)
+    arrays.n = plan.n
+    arrays.effective_balance = plan.effective_balance
+    arrays.activation_epoch = plan.activation_epoch
+    arrays.exit_epoch = plan.exit_epoch
+    arrays.withdrawable_epoch = plan.withdrawable_epoch
+    arrays.slashed = plan.slashed
+    new_inactivity, balance_delta = _epoch_deltas_numpy(
+        arrays, plan.prev_part, plan.inactivity,
+        previous_epoch=plan.previous_epoch,
+        in_leak=in_leak,
+        base_reward_per_increment=plan.base_reward_per_increment,
+        total_active_balance=plan.total_active_balance,
+        quotient=plan.quotient,
+        spec=_Spec(),
+    )
+    # safe-arith: ok(int64 vector apply, deltas bounded by guarded pass)
+    new_bal = np.maximum(0, plan.balance + balance_delta)
+    eff = plan.effective_balance
+    # safe-arith: ok(int64 vector hysteresis, gwei + small thresholds)
+    needs = (new_bal + plan.downward < eff) | (eff + plan.upward < new_bal)
+    new_eff = np.where(
+        needs,
+        np.minimum(new_bal - new_bal % plan.increment, plan.eb_cap),
+        eff,
+    )
+    active_cur = (plan.activation_epoch <= plan.current_epoch) & (
+        plan.current_epoch < plan.exit_epoch)
+    ejection_mask = active_cur & (eff <= plan.ejection_balance)
+    queue_mask = (
+        (plan.activation_eligibility_epoch == plan.far_future)
+        & (eff >= plan.queue_lo)
+        & (eff <= plan.queue_hi)
+    )
+    activation_mask = (
+        plan.activation_eligibility_epoch <= plan.finalized_epoch
+    ) & (plan.activation_epoch == plan.far_future)
+    shuffling = shuffle_list(
+        plan.active_idx, plan.attester_seed, plan.rounds
+    ).astype(np.int64)
+    m = plan.m
+    s = len(plan.slot_seeds)
+    proposer = np.full(s, -1, dtype=np.int64)
+    found = np.zeros(s, dtype=bool)
+    if m:
+        from ..ops.shuffle_device import PROPOSER_CANDIDATES
+
+        for si, seed in enumerate(plan.slot_seeds):
+            for i in range(PROPOSER_CANDIDATES):
+                cand = int(plan.active_idx[
+                    compute_shuffled_index(i % m, m, seed, plan.rounds)])
+                random_byte = sha256(
+                    seed + (i // 32).to_bytes(8, "little")).digest()[i % 32]
+                if int(new_eff[cand]) * 255 >= (
+                        # safe-arith: ok(spec acceptance product, bounded by max_eb*255)
+                        plan.max_effective_balance * random_byte):
+                    proposer[si] = cand
+                    found[si] = True
+                    break
+    return (new_inactivity, balance_delta,
+            np.asarray(new_eff, dtype=np.int64),
+            ejection_mask, queue_mask, activation_mask,
+            shuffling, proposer, found)
+
+
+def _run_boundary(plan, *, in_leak: bool):
+    """Supervised + pipeline-routed fused boundary dispatch."""
+    from .. import device_pipeline, device_supervisor
+    from ..ops.shuffle_device import epoch_boundary_device
+
+    op = "epoch_boundary_leak" if in_leak else "epoch_boundary"
+
+    def supervised():
+        return device_supervisor.run(
+            op,
+            lambda: epoch_boundary_device(plan, in_leak=in_leak),
+            host_fn=lambda: _epoch_boundary_numpy(plan, in_leak=in_leak),
+        )
+
+    if device_pipeline.routes_job():
+        try:
+            return device_pipeline.run_job(
+                op, supervised, work="epoch_transition")
+        except device_pipeline.PipelineShutdown:
+            pass
+    return supervised()
+
+
+def _fused_boundary_eligible(arrays, inactivity, spec: ChainSpec) -> bool:
+    """Fused boundary only with the device backend on, the flag set, and
+    the int64 overflow guard satisfied (same bound as the staged device
+    deltas path)."""
+    if _EPOCH_BACKEND != "device" or not _FUSED_BOUNDARY:
+        return False
+    n = arrays.n
+    if not n:
+        return False
+    max_eb = int(arrays.effective_balance.max())
+    max_inact = int(inactivity.max()) if n else 0
+    return max_eb * (max_inact + spec.inactivity_score_bias) <= _I64_MAX
+
+
+def _prime_duty_caches(
+    state, plan, shuffling, proposer, found, eff_clean: bool,
+    spec: ChainSpec,
+) -> None:
+    """Seed the freshly-invalidated committee/proposer caches from the
+    fused dispatch's outputs — iff the post-transition state still matches
+    the plan (the registry-update rules guarantee it in the common case;
+    a mismatch just leaves the lazy scalar path in charge)."""
+    from .. import device_telemetry
+
+    next_epoch = plan.current_epoch + 1
+    active_now = h.get_active_validator_indices(state, next_epoch)
+    seed_now = h.get_seed(state, next_epoch, h.DOMAIN_BEACON_ATTESTER, spec)
+    if not (
+        np.array_equal(active_now, plan.active_idx)
+        and seed_now == plan.attester_seed
+    ):
+        device_telemetry.note_boundary_prime(False, "active_set_changed")
+        return
+    try:
+        cache = h.CommitteeCache.from_precomputed(
+            state, next_epoch, spec, active_now, shuffling, seed_now)
+    except ValueError:
+        device_telemetry.note_boundary_prime(False, "empty_active_set")
+        return
+    h._caches(state).setdefault("committees", {})[next_epoch] = cache
+    # Proposer acceptance read the kernel's post-update effective balances;
+    # only seed slots when the live registry ended up with exactly those
+    # (no dirty recompute touched any validator, registry length unchanged).
+    if eff_clean and len(state.validators) == plan.n:
+        pc = h._caches(state).setdefault("proposers", {})
+        base_slot = next_epoch * spec.slots_per_epoch
+        for si in range(len(plan.slot_seeds)):
+            if found[si]:
+                pc[base_slot + si] = int(proposer[si])
+        device_telemetry.note_boundary_prime(True, "committees+proposers")
+    else:
+        device_telemetry.note_boundary_prime(True, "committees_only")
+
+
 def _unslashed_participating_mask(
     arrays: EpochArrays, participation: np.ndarray, flag_index: int, epoch: int
 ) -> np.ndarray:
@@ -373,7 +633,10 @@ def process_epoch_altair(state, types, spec: ChainSpec) -> None:
 
     # --- inactivity updates + rewards/penalties: the fused per-validator
     # pass (reference single_pass.rs), via the selected array backend
-    # (numpy, or the jnp device kernel in ops/epoch_device.py).
+    # (numpy, or the jnp device kernel in ops/epoch_device.py).  With the
+    # fused boundary on, the whole boundary (deltas + hysteresis + registry
+    # masks + next-epoch shuffling/proposers) is ONE device dispatch.
+    boundary = plan = None
     if current_epoch > GENESIS_EPOCH:
         inactivity = np.fromiter(state.inactivity_scores, dtype=np.int64, count=n)
         base_reward_per_increment = sa.safe_div(
@@ -386,22 +649,43 @@ def process_epoch_altair(state, types, spec: ChainSpec) -> None:
             if fork == "altair"
             else spec.inactivity_penalty_quotient_bellatrix
         )
-        new_inactivity, balance_delta = epoch_deltas(
-            arrays, prev_part, inactivity,
-            previous_epoch=previous_epoch,
-            in_leak=in_leak,
-            base_reward_per_increment=base_reward_per_increment,
-            total_active_balance=total_active_balance,
-            quotient=quotient,
-            spec=spec,
-        )
+        if _fused_boundary_eligible(arrays, inactivity, spec):
+            plan = _build_boundary_plan(
+                state, arrays, prev_part, inactivity, balances,
+                previous_epoch=previous_epoch,
+                base_reward_per_increment=base_reward_per_increment,
+                total_active_balance=total_active_balance,
+                quotient=quotient,
+                spec=spec,
+            )
+            if plan.m:  # no active validators next epoch: staged path
+                boundary = _run_boundary(plan, in_leak=in_leak)
+        if boundary is not None:
+            (new_inactivity, balance_delta, new_eff, ejection_mask,
+             queue_mask, activation_mask, shuffling, proposer,
+             proposer_found) = boundary
+        else:
+            new_inactivity, balance_delta = epoch_deltas(
+                arrays, prev_part, inactivity,
+                previous_epoch=previous_epoch,
+                in_leak=in_leak,
+                base_reward_per_increment=base_reward_per_increment,
+                total_active_balance=total_active_balance,
+                quotient=quotient,
+                spec=spec,
+            )
         state.inactivity_scores = [int(x) for x in new_inactivity]
         # safe-arith: ok(int64 vector apply, deltas bounded by guarded pass)
         balances = np.maximum(0, balances + balance_delta)
         state.balances = [int(x) for x in balances]
 
     # --- registry updates, slashings, resets (shared with phase0)
-    _process_registry_updates(state, arrays, spec)
+    if boundary is not None:
+        _process_registry_updates(
+            state, arrays, spec,
+            masks=(ejection_mask, queue_mask, activation_mask))
+    else:
+        _process_registry_updates(state, arrays, spec)
     _process_slashings(state, arrays, balances, total_active_balance, spec)
     _process_eth1_data_reset(state, spec)
     if type(state).fork_name == "electra":
@@ -409,7 +693,16 @@ def process_epoch_altair(state, types, spec: ChainSpec) -> None:
 
         process_pending_deposits(state, types, spec)
         process_pending_consolidations(state, types, spec)
-    _process_effective_balance_updates(state, arrays, spec)
+    if boundary is not None:
+        # `balances` still holds the post-delta snapshot the kernel saw —
+        # any index whose live balance has since diverged (slashings,
+        # electra deposits/consolidations) is recomputed on the scalar path.
+        eff_clean = _process_effective_balance_updates(
+            state, arrays, spec,
+            precomputed=new_eff, baseline_balances=balances)
+    else:
+        eff_clean = False
+        _process_effective_balance_updates(state, arrays, spec)
     _process_slashings_reset(state, spec)
     _process_randao_mixes_reset(state, spec)
     _process_historical_update(state, types, spec)
@@ -425,6 +718,14 @@ def process_epoch_altair(state, types, spec: ChainSpec) -> None:
         state.next_sync_committee = h.get_next_sync_committee(state, types, spec)
 
     h.invalidate_caches(state)
+
+    # --- duty-cache priming: the fused dispatch already produced the next
+    # epoch's shuffling and proposers; seed the fresh caches with them when
+    # the post-transition state still matches the plan.
+    if boundary is not None:
+        _prime_duty_caches(
+            state, plan, shuffling, proposer, proposer_found, eff_clean,
+            spec)
 
 
 # ------------------------------------------------------------ phase0 path
@@ -582,9 +883,39 @@ def _phase0_attestation_deltas(state, arrays: EpochArrays, total_active_balance:
 # ------------------------------------------------------- shared sub-steps
 
 
-def _process_registry_updates(state, arrays: EpochArrays, spec: ChainSpec) -> None:
+def _process_registry_updates(
+    state, arrays: EpochArrays, spec: ChainSpec, masks=None
+) -> None:
+    """Registry updates; with ``masks`` (the fused boundary's
+    ``(ejection, queue, activation)`` per-validator masks) only the flagged
+    validators are visited — order-equivalent to the full scan because the
+    eligibility write never feeds the same pass's ejection decision, and
+    ejections are applied in ascending index order either way (the exit
+    queue depends on that order)."""
     current_epoch = h.get_current_epoch(state, spec)
     fork = type(state).fork_name
+    if masks is not None:
+        ejection_mask, queue_mask, activation_mask = masks
+        vs = state.validators
+        for index in np.nonzero(queue_mask)[0]:
+            vs[int(index)].activation_eligibility_epoch = current_epoch + 1
+        for index in np.nonzero(ejection_mask)[0]:
+            h.initiate_validator_exit(state, int(index), spec)
+        if fork == "electra":
+            for index in np.nonzero(activation_mask)[0]:
+                vs[int(index)].activation_epoch = (
+                    h.compute_activation_exit_epoch(current_epoch, spec))
+            return
+        queue = sorted(
+            (int(i) for i in np.nonzero(activation_mask)[0]),
+            key=lambda i: (vs[i].activation_eligibility_epoch, i),
+        )
+        churn = h.get_validator_activation_churn_limit(state, spec)
+        for index in queue[:churn]:
+            vs[index].activation_epoch = h.compute_activation_exit_epoch(
+                current_epoch, spec
+            )
+        return
     # eligibility + ejections
     for index, v in enumerate(state.validators):
         if h.is_eligible_for_activation_queue(v, spec, fork=fork):
@@ -667,13 +998,25 @@ def _process_eth1_data_reset(state, spec: ChainSpec) -> None:
         state.eth1_data_votes = []
 
 
-def _process_effective_balance_updates(state, arrays: EpochArrays, spec: ChainSpec) -> None:
+def _process_effective_balance_updates(
+    state, arrays: EpochArrays, spec: ChainSpec,
+    precomputed=None, baseline_balances=None,
+) -> bool:
+    """Effective-balance hysteresis.  With ``precomputed`` (the fused
+    boundary's per-validator new effective balances, computed from the
+    ``baseline_balances`` post-delta snapshot), clean validators take the
+    kernel's answer directly and only DIRTY indices — live balance diverged
+    from the snapshot (slashings, electra deposits/consolidations) or rows
+    appended after the snapshot — rerun the scalar spec body.  Returns True
+    iff every validator ended up with exactly the precomputed value (the
+    proposer-cache priming gate)."""
     increment = spec.effective_balance_increment
     hysteresis_increment = increment // spec.preset.hysteresis_quotient
     downward = hysteresis_increment * spec.preset.hysteresis_downward_multiplier
     upward = hysteresis_increment * spec.preset.hysteresis_upward_multiplier
     is_electra = type(state).fork_name == "electra"
-    for index, v in enumerate(state.validators):
+
+    def scalar_update(index: int, v) -> None:
         balance = int(state.balances[index])
         if (
             sa.safe_add(balance, downward) < v.effective_balance
@@ -687,6 +1030,24 @@ def _process_effective_balance_updates(state, arrays: EpochArrays, spec: ChainSp
             v.effective_balance = min(
                 sa.safe_sub(balance, sa.safe_mod(balance, increment)), cap
             )
+
+    if precomputed is not None:
+        vs = state.validators
+        n0 = arrays.n
+        final = _balances_array(state, len(vs))
+        clean = final[:n0] == baseline_balances
+        changed = clean & (precomputed != arrays.effective_balance)
+        for index in np.nonzero(changed)[0]:
+            vs[int(index)].effective_balance = int(precomputed[index])
+        dirty = [int(i) for i in np.nonzero(~clean)[0]]
+        appended = list(range(n0, len(vs)))
+        for index in dirty + appended:
+            scalar_update(index, vs[index])
+        return not dirty and not appended
+
+    for index, v in enumerate(state.validators):
+        scalar_update(index, v)
+    return False
 
 
 def _process_slashings_reset(state, spec: ChainSpec) -> None:

@@ -41,6 +41,35 @@ def compute_shuffled_index(index: int, index_count: int, seed: bytes, rounds: in
     return index
 
 
+def round_digest_table(seed: bytes, rounds: int, num_chunks: int,
+                       index_count: int):
+    """Per-round pivots and source digests for a swap-or-not network.
+
+    Returns ``(pivots, digests)`` where ``pivots[r]`` is the round-``r``
+    pivot and ``digests[r]`` is the round's ``num_chunks`` source digests
+    laid out flat (``num_chunks * 32`` bytes): the byte covering
+    ``position`` lives at flat offset ``position >> 3``, because chunk
+    ``position // 256`` starts at byte ``32 * (position // 256)`` and the
+    in-chunk offset is ``(position % 256) // 8``.  Hashes land directly in
+    one preallocated buffer — no per-round ``b"".join`` churn.  Shared by
+    the host ``shuffle_list`` fast path and the device-kernel host-side
+    precompute (``ops/shuffle_device.py``).
+    """
+    pivots = np.empty(rounds, dtype=np.int64)
+    digests = np.empty((rounds, num_chunks * 32), dtype=np.uint8)
+    for r in range(rounds):
+        rb = bytes([r])
+        pivots[r] = int.from_bytes(
+            sha256(seed + rb).digest()[:8], "little") % index_count
+        row = digests[r]
+        for c in range(num_chunks):
+            row[c * 32:(c + 1) * 32] = np.frombuffer(
+                sha256(seed + rb + c.to_bytes(4, "little")).digest(),
+                dtype=np.uint8,
+            )
+    return pivots, digests
+
+
 def shuffle_list(values, seed: bytes, rounds: int) -> np.ndarray:
     """Whole-list shuffle such that ``out[i] = values[compute_shuffled_index(i)]``.
 
@@ -55,19 +84,13 @@ def shuffle_list(values, seed: bytes, rounds: int) -> np.ndarray:
         return arr.copy()
     i = np.arange(n, dtype=np.int64)
     num_chunks = (n + 255) // 256
+    pivots, digests = round_digest_table(seed, rounds, num_chunks, n)
     for r in range(rounds - 1, -1, -1):
-        rb = bytes([r])
-        pivot = int.from_bytes(sha256(seed + rb).digest()[:8], "little") % n
-        flip = (pivot - i) % n
+        flip = (pivots[r] - i) % n
         position = np.maximum(i, flip)
-        srcs = np.frombuffer(
-            b"".join(
-                sha256(seed + rb + c.to_bytes(4, "little")).digest()
-                for c in range(num_chunks)
-            ),
-            dtype=np.uint8,
-        ).reshape(num_chunks, 32)
-        byte = srcs[position // 256, (position % 256) // 8]
-        bit = (byte >> (position % 8).astype(np.uint8)) & 1
+        # Flat digest layout: `position >> 3` replaces the two-step
+        # `[position // 256, (position % 256) // 8]` chunk/offset math.
+        byte = digests[r, position >> 3]
+        bit = (byte >> (position & 7).astype(np.uint8)) & 1
         arr = np.where(bit.astype(bool), arr[flip], arr)
     return arr

@@ -451,7 +451,14 @@ class ShardedEntry:
         self.fn = fn
         self.static_argnames = tuple(static_argnames)
         self.batch_axis = int(decl["batch_axis"])
-        self.out_batched = bool(decl.get("out_batched", False))
+        raw_out = decl.get("out_batched", False)
+        # A list declares one flag per output leaf (mixed batched /
+        # replicated results, e.g. the fused epoch-boundary kernel's
+        # per-validator arrays alongside its replicated proposer table).
+        self.out_batched = (
+            tuple(bool(b) for b in raw_out)
+            if isinstance(raw_out, (list, tuple)) else bool(raw_out)
+        )
         batched = list(decl["batched_args"])
         replicated = list(decl["replicated_args"])
         params = [
@@ -490,6 +497,8 @@ class ShardedEntry:
 
     def out_sharding(self, mesh):
         dp, repl = self._specs(mesh)
+        if isinstance(self.out_batched, tuple):
+            return tuple(dp if b else repl for b in self.out_batched)
         return dp if self.out_batched else repl
 
     # --------------------------------------------------------- placement

@@ -79,6 +79,12 @@ DEFAULT_DEADLINES = {
     "tree_hash": 120.0,
     "epoch_deltas": 300.0,
     "epoch_deltas_leak": 300.0,
+    # the fused boundary composes deltas + shuffle + proposer into one
+    # program — its first-bucket compile is the longest of the epoch ops
+    "epoch_boundary": 600.0,
+    "epoch_boundary_leak": 600.0,
+    "shuffle": 300.0,
+    "proposer_select": 300.0,
     "kzg_batch": 300.0,
     # the autotune fq A/B microbench (autotune.measure_fq_backend): small
     # batch, but the first run pays both backends' probe compiles — the
@@ -96,7 +102,13 @@ DEFAULT_DEADLINE_S = 300.0
 #: shape.  Failures for these ops go straight to the host fallback.  Must
 #: stay in sync with the ``reduces_over_batch`` entries in
 #: ``ops/batch_axes.py`` (the sharding contract reads the same property).
-NO_SPLIT_OPS = frozenset({"epoch_deltas", "epoch_deltas_leak", "kzg_batch"})
+NO_SPLIT_OPS = frozenset({
+    "epoch_deltas", "epoch_deltas_leak", "kzg_batch",
+    # the fused boundary embeds the same registry-wide sums; the shuffle
+    # and proposer walks are whole-permutation computations — no half of
+    # a swap-or-not network is a smaller swap-or-not network
+    "epoch_boundary", "epoch_boundary_leak", "shuffle", "proposer_select",
+})
 
 
 class DispatchTimeout(RequeueWork):

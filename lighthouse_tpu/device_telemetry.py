@@ -340,6 +340,27 @@ def host_fallback_counts() -> Dict[str, int]:
         return dict(_FALLBACKS)
 
 
+# Fused-epoch duty-cache priming tally (per_epoch._prime_duty_caches): how
+# often the fused boundary's precomputed shuffling/proposers actually
+# seeded the caches vs. were discarded, by reason.  A climbing discard
+# count means the device did the O(n) shuffle work and the node threw it
+# away — the first triage stop when epoch-boundary latency regresses with
+# the fused path on (see OBSERVABILITY.md).
+_BOUNDARY_PRIMES: Dict[str, int] = {}
+_BOUNDARY_PRIMES_LOCK = threading.Lock()
+
+
+def note_boundary_prime(seeded: bool, reason: str) -> None:
+    key = f"{'seeded' if seeded else 'discarded'}:{reason}"
+    with _BOUNDARY_PRIMES_LOCK:
+        _BOUNDARY_PRIMES[key] = _BOUNDARY_PRIMES.get(key, 0) + 1
+
+
+def boundary_prime_counts() -> Dict[str, int]:
+    with _BOUNDARY_PRIMES_LOCK:
+        return dict(_BOUNDARY_PRIMES)
+
+
 def recent_inflight_seconds(op: str, min_samples: int = 3,
                             window: int = 32) -> Optional[float]:
     """Median observed in-flight duration (dispatch + wait stages) of the
@@ -468,6 +489,9 @@ def summary() -> dict:
         "mesh": device_mesh.summary(),
         "occupancy": occ,
         "host_fallbacks": host_fallback_counts(),
+        # Fused epoch boundary: duty-cache priming outcomes (seeded vs
+        # discarded, by reason) — empty until the fused path has run.
+        "boundary_primes": boundary_prime_counts(),
         # Async device pipeline (device_pipeline.py): pending depth, fill
         # and linger of the coalescing layer feeding the batches above
         # (None until a pipeline has started in this process).
@@ -491,6 +515,8 @@ def reset_for_tests() -> None:
     FLIGHT_RECORDER.clear()
     with _FALLBACKS_LOCK:
         _FALLBACKS.clear()
+    with _BOUNDARY_PRIMES_LOCK:
+        _BOUNDARY_PRIMES.clear()
 
 
 # ----------------------------------------------------------------- profiler

@@ -31,10 +31,11 @@ cost on the device dispatch path (BENCH-verified in ISSUE 5).
 
 from __future__ import annotations
 
+import hashlib
 import os
 import random
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from . import metrics
 from .logs import get_logger
@@ -73,6 +74,41 @@ FAULT_INJECTIONS_FIRED = metrics.counter(
 
 class InjectedFault(RuntimeError):
     """Raised at an injection point by an ``error``-mode fault plan."""
+
+
+# ------------------------------------------------------------ slot keying
+#
+# Scenario runs route many concurrent dispatches through the same injection
+# points, and the ARRIVAL ORDER of those calls is scheduler-dependent — a
+# ``first_n``/``probability`` plan keyed on a call counter fires on a
+# different dispatch from run to run (the ~1/6 ``device_breaker_mid_sync``
+# determinism flake).  When the embedding harness can name the logical
+# *slot* a call belongs to, plans key their decisions on (slot, per-slot
+# call index) instead: same fault plan + same slot timeline => the same
+# dispatches fault, regardless of thread interleaving across slots.
+
+#: Returns the current logical slot, or ``None`` outside any slot context.
+_SLOT_PROVIDER: Optional[Callable[[], Optional[int]]] = None
+
+
+def set_slot_provider(fn: Optional[Callable[[], Optional[int]]]) -> None:
+    """Install (or clear, with ``None``) the logical-slot source.  The
+    scenario runner installs its simulator clock here for the duration of
+    a run; production never sets one, so plans keep arrival-order
+    semantics outside the harness."""
+    global _SLOT_PROVIDER
+    _SLOT_PROVIDER = fn
+
+
+def current_slot() -> Optional[int]:
+    fn = _SLOT_PROVIDER
+    if fn is None:
+        return None
+    try:
+        slot = fn()
+    except Exception:
+        return None
+    return None if slot is None else int(slot)
 
 
 class FaultPlan:
@@ -114,17 +150,43 @@ class FaultPlan:
         self._calls = 0
         # Seeded RNG => a probabilistic chaos run replays identically.
         self._rng = random.Random(0xFA17 if seed is None else seed)
+        # Slot-keyed state (see the module's slot-keying section).
+        self._first_slot: Optional[int] = None
+        self._slot_calls: Dict[int, int] = {}
 
     def matches(self, op: Optional[str]) -> bool:
         return self.op is None or self.op == op
 
     def should_fire(self) -> bool:
-        """Decide this call (caller holds the registry lock)."""
-        self._calls += 1
+        """Decide this call (caller holds the registry lock).  With a slot
+        provider installed the decision is a pure function of
+        ``(plan, slot, per-slot call index)`` — thread interleaving across
+        slots cannot move which dispatch faults."""
+        slot = current_slot()
+        if slot is None:
+            self._calls += 1
+            if self.first_n is not None:
+                return self._calls <= self.first_n
+            if self.probability is not None:
+                return self._rng.random() < self.probability
+            return True
+        k = self._slot_calls.get(slot, 0)
+        self._slot_calls[slot] = k + 1
         if self.first_n is not None:
-            return self._calls <= self.first_n
+            # All first_n firings land in the first slot this plan SEES —
+            # a later-slot call can never steal the budget from it.
+            if self._first_slot is None:
+                self._first_slot = slot
+            return slot == self._first_slot and k < self.first_n
         if self.probability is not None:
-            return self._rng.random() < self.probability
+            seed = 0xFA17 if self.seed is None else self.seed
+            digest = hashlib.sha256(
+                seed.to_bytes(8, "little", signed=True)
+                + slot.to_bytes(8, "little", signed=True)
+                + k.to_bytes(8, "little")
+            ).digest()
+            draw = int.from_bytes(digest[:8], "little") / 2.0 ** 64
+            return draw < self.probability
         return True
 
     def to_dict(self) -> dict:

@@ -211,6 +211,71 @@ def aot_warmup_op(op: str, nb: int) -> List[dict]:
             _aot_compile("epoch_deltas", (nb,), epoch_thunk(False)),
             _aot_compile("epoch_deltas_leak", (nb,), epoch_thunk(True)),
         ]
+    if op == "shuffle":
+        from .shuffle_device import _shuffle_kernel
+
+        def shuffle_thunk():
+            r = 90  # mainnet shuffle_round_count — the production shape
+            chunks = max(1, (nb + 255) // 256)
+            values = jax.ShapeDtypeStruct((nb,), np.int32)
+            pivots = jax.ShapeDtypeStruct((r,), np.int32)
+            digests = jax.ShapeDtypeStruct((r, chunks * 32), np.uint8)
+            n_live = jax.ShapeDtypeStruct((), np.int32)
+            _shuffle_kernel.lower(values, pivots, digests, n_live).compile()
+
+        return [_aot_compile("shuffle", (nb,), shuffle_thunk)]
+    if op == "proposer_select":
+        from jax.experimental import enable_x64
+
+        from .shuffle_device import PROPOSER_CANDIDATES, _proposer_kernel
+
+        def proposer_thunk():
+            with enable_x64():
+                s, r = 32, 90  # mainnet slots-per-epoch / rounds
+                seed_words = jax.ShapeDtypeStruct((s, 8), np.uint32)
+                pivots = jax.ShapeDtypeStruct((s, r), np.int32)
+                rbytes = jax.ShapeDtypeStruct(
+                    (s, PROPOSER_CANDIDATES), np.int32)
+                eff = jax.ShapeDtypeStruct((nb,), np.int64)
+                i32 = jax.ShapeDtypeStruct((), np.int32)
+                i64 = jax.ShapeDtypeStruct((), np.int64)
+                _proposer_kernel.lower(
+                    seed_words, pivots, rbytes, eff, i32, i64).compile()
+
+        return [_aot_compile("proposer_select", (nb,), proposer_thunk)]
+    if op in ("epoch_boundary", "epoch_boundary_leak"):
+        from jax.experimental import enable_x64
+
+        from .shuffle_device import PROPOSER_CANDIDATES, _boundary_kernel
+
+        def boundary_thunk(in_leak: bool):
+            def thunk():
+                with enable_x64():
+                    s, r = 32, 90
+                    chunks = max(1, (nb + 255) // 256)
+                    i64 = jax.ShapeDtypeStruct((nb,), np.int64)
+                    args = (
+                        [i64] * 4
+                        + [jax.ShapeDtypeStruct((nb,), np.bool_)]
+                        + [i64] * 5
+                        + [jax.ShapeDtypeStruct((nb,), np.int32)]
+                        + [jax.ShapeDtypeStruct((r,), np.int32),
+                           jax.ShapeDtypeStruct((r, chunks * 32), np.uint8),
+                           jax.ShapeDtypeStruct((s, 8), np.uint32),
+                           jax.ShapeDtypeStruct((s, r), np.int32),
+                           jax.ShapeDtypeStruct(
+                               (s, PROPOSER_CANDIDATES), np.int32)]
+                        + [jax.ShapeDtypeStruct((), np.int64)] * 16
+                        + [jax.ShapeDtypeStruct((), np.int32)]
+                    )
+                    _boundary_kernel.lower(
+                        *args, in_leak=in_leak).compile()
+            return thunk
+
+        return [
+            _aot_compile("epoch_boundary", (nb,), boundary_thunk(False)),
+            _aot_compile("epoch_boundary_leak", (nb,), boundary_thunk(True)),
+        ]
     raise ValueError(f"no AOT warmup recipe for op {op!r}")
 
 

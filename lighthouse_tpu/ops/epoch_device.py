@@ -46,8 +46,7 @@ from ..types.spec import (
 )
 
 
-@partial(jax.jit, static_argnames=("in_leak",))
-def _deltas_kernel(
+def _deltas_core(
     eff_bal,            # (n,) int64 gwei
     activation_epoch,   # (n,) int64
     exit_epoch,         # (n,) int64
@@ -65,6 +64,9 @@ def _deltas_kernel(
     *,
     in_leak: bool,
 ):
+    """Traceable body of the deltas pass — shared between the standalone
+    :func:`_deltas_kernel` entry and the fused epoch-boundary program
+    (``ops/shuffle_device.py:_boundary_kernel``)."""
     active_prev = (activation_epoch <= previous_epoch) & (previous_epoch < exit_epoch)
     eligible = active_prev | (slashed & (previous_epoch + 1 < withdrawable_epoch))
 
@@ -120,6 +122,65 @@ def _deltas_kernel(
         eligible & ~prev_target, inactivity_penalty, 0
     )
     return new_inactivity, rewards - penalties
+
+
+@partial(jax.jit, static_argnames=("in_leak",))
+def _deltas_kernel(
+    eff_bal, activation_epoch, exit_epoch, withdrawable_epoch, slashed,
+    prev_part, inactivity, previous_epoch, base_reward_per_increment,
+    total_active_balance, increment, inactivity_score_bias,
+    inactivity_score_recovery_rate, quotient, *, in_leak: bool,
+):
+    return _deltas_core(
+        eff_bal, activation_epoch, exit_epoch, withdrawable_epoch, slashed,
+        prev_part, inactivity, previous_epoch, base_reward_per_increment,
+        total_active_balance, increment, inactivity_score_bias,
+        inactivity_score_recovery_rate, quotient, in_leak=in_leak,
+    )
+
+
+def _balance_core(
+    balance,            # (n,) int64 post-delta balances
+    eff_bal,            # (n,) int64 current effective balances
+    activation_epoch,   # (n,) int64
+    exit_epoch,         # (n,) int64
+    act_elig_epoch,     # (n,) int64 activation_eligibility_epoch
+    eb_cap,             # (n,) int64 per-validator effective-balance cap
+    current_epoch,      # () int64
+    increment,          # () int64
+    downward,           # () int64 hysteresis downward threshold
+    upward,             # () int64 hysteresis upward threshold
+    ejection_balance,   # () int64
+    far_future,         # () int64 FAR_FUTURE_EPOCH (clamped to int64)
+    finalized_epoch,    # () int64
+    queue_lo,           # () int64 activation-queue eligibility low bound
+    queue_hi,           # () int64 activation-queue eligibility high bound
+):
+    """Effective-balance hysteresis + registry-update masks, the device
+    half of ``per_epoch._process_effective_balance_updates`` /
+    ``_process_registry_updates``.  Bucket-pad rows (zero balances,
+    activation epoch ``_PAD_ACTIVATION_EPOCH``, eligibility epoch 0,
+    cap 1) satisfy none of the masks and keep a zero effective balance.
+
+    Returns ``(new_eff, ejection_mask, queue_mask, activation_mask)``.
+    """
+    needs = (balance + downward < eff_bal) | (eff_bal + upward < balance)
+    new_eff = jnp.where(
+        needs,
+        jnp.minimum(balance - jnp.mod(balance, increment), eb_cap),
+        eff_bal,
+    )
+    active_cur = (activation_epoch <= current_epoch) & (
+        current_epoch < exit_epoch)
+    ejection_mask = active_cur & (eff_bal <= ejection_balance)
+    queue_mask = (
+        (act_elig_epoch == far_future)
+        & (eff_bal >= queue_lo)
+        & (eff_bal <= queue_hi)
+    )
+    activation_mask = (act_elig_epoch <= finalized_epoch) & (
+        activation_epoch == far_future)
+    return new_eff, ejection_mask, queue_mask, activation_mask
 
 
 #: device_mesh.ShardedEntry for the epoch kernel (lazy).  The kernel's

@@ -166,6 +166,7 @@ class ScenarioRunner:
         self._saved_host_impl = None
         self._state_hashing_on = False
         self._breakers_touched = False
+        self._epoch_device_touched = False
         self._pipeline_enabled = False
         self._mesh_touched = False
         self._autotune_touched = False
@@ -176,6 +177,31 @@ class ScenarioRunner:
 
     def _node(self, index: int) -> SimNode:
         return self.sim.nodes[index]
+
+    def _current_slot(self) -> Optional[int]:
+        """The fleet's logical slot — the fault registry's slot provider.
+        Fault plans key their fire decisions on this instead of arrival
+        order, so thread interleaving across slots cannot move which
+        dispatch faults (the ``device_breaker_mid_sync`` flake)."""
+        sim = self.sim
+        if sim is None:
+            return None
+        for n in sim.live_nodes:
+            try:
+                return int(n.chain.current_slot())
+            except Exception:
+                continue
+        return None
+
+    def _settle(self) -> None:
+        """Quiesce the fabric or fail LOUDLY.  A silent settle timeout
+        let the slot proceed un-quiesced — the nondeterminism it exists
+        to prevent, reported only as a downstream head mismatch."""
+        if not self.sim.settle(timeout=self.SETTLE_TIMEOUT_S):
+            raise ScenarioFailure(
+                f"fabric failed to quiesce within {self.SETTLE_TIMEOUT_S}s "
+                f"at slot {self._current_slot()} — un-quiesced slots race "
+                "thread scheduling into block content")
 
     def _pump_until(self, cond: Callable[[], bool], timeout: float,
                     rekick: Optional[Callable[[], None]] = None) -> bool:
@@ -239,7 +265,7 @@ class ScenarioRunner:
         (equivocations ride on top of the honest message); its per-slot
         evidence probe runs at the end of every step, recovery included."""
         sim = self.sim
-        settle = lambda: sim.settle(timeout=self.SETTLE_TIMEOUT_S)  # noqa: E731
+        settle = self._settle
         slot = None
         for n in sim.live_nodes:
             slot = n.advance_slot()
@@ -341,6 +367,24 @@ class ScenarioRunner:
         autotune.set_mode(mode)
         if pin is not None:
             autotune.CONTROLLER.install_pin(pin)
+
+    def _ev_epoch_device(self, enable: bool, fused: bool = True) -> None:
+        """Route every node's epoch-boundary processing through the device
+        backend — with ``fused`` the whole boundary (deltas + balances +
+        shuffling + proposer selection) runs as ONE supervised dispatch
+        (``op=epoch_boundary``), so a fault plan on it exercises the
+        breaker/host-golden fallback on the fused program.  Host and device
+        produce identical bytes, so enabling it never changes chain
+        content — the determinism gate covers exactly that."""
+        from .consensus import per_epoch
+
+        if enable:
+            self._epoch_device_touched = True
+            per_epoch.set_epoch_backend("device")
+            per_epoch.set_fused_boundary(fused)
+        else:
+            per_epoch.set_epoch_backend("numpy")
+            per_epoch.set_fused_boundary(False)
 
     def _ev_device_pipeline(self, enable: bool, linger_s: float = 0.002) -> None:
         """Route every node's ``verify_signature_sets`` through the async
@@ -633,6 +677,9 @@ class ScenarioRunner:
             enable_slasher=scenario.slasher,
         )
         self.sim.hub.record_schedule()
+        # Fault plans key on the fleet's logical slot for the whole run —
+        # see fault_injection's slot-keying section; cleared in _cleanup.
+        fault_injection.set_slot_provider(self._current_slot)
         artifact: dict = {"scenario": scenario.to_dict(), "passed": False}
         try:
             for _ in range(scenario.warmup_slots):
@@ -783,7 +830,13 @@ class ScenarioRunner:
             return None
 
     def _cleanup(self) -> None:
+        fault_injection.set_slot_provider(None)
         fault_injection.clear()
+        if self._epoch_device_touched:
+            from .consensus import per_epoch
+
+            per_epoch.set_epoch_backend("numpy")
+            per_epoch.set_fused_boundary(False)
         if self._mesh_touched:
             from . import device_mesh
 
@@ -1114,6 +1167,37 @@ def autotune_pinned(seed: int = 0) -> Scenario:
     )
 
 
+def fused_epoch_boundary(seed: int = 0) -> Scenario:
+    """The fused epoch-boundary dispatch (ISSUE 16) under chaos: every
+    node's epoch transition runs as ONE supervised device program
+    (deltas + balance updates + next-epoch shuffling + proposer selection),
+    a fault plan errors the ``epoch_boundary`` dispatch at the first
+    boundary inside the window — the breaker trips, transitions resolve
+    through the host golden model verdict-identically — and after the
+    plan clears the breaker probes shut and later boundaries run on the
+    device again.  Warmup of 15 puts the epoch 1 -> 2 transition (the
+    first boundary PAST genesis — the genesis transition skips the delta
+    pass entirely) at window offset 0, so the faulted dispatch lands
+    there deterministically (slot-keyed fault firing makes WHICH dispatch
+    faults independent of thread arrival order)."""
+    return Scenario(
+        name="fused_epoch_boundary",
+        description="fused epoch dispatch faults, host fallback, recovery",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=15, fault_slots=8, recovery_slots=24,
+        events=(
+            Event(0, "breaker_config",
+                  {"failure_threshold": 2, "open_cooldown_s": 0.5,
+                   "probe_successes": 1}),
+            Event(0, "epoch_device", {"enable": True, "fused": True}),
+            Event(0, "install_faults",
+                  {"spec": "device.dispatch[op=epoch_boundary]=error"}),
+            Event(4, "clear_faults"),
+        ),
+        extra_checks=_check_fused_boundary,
+    )
+
+
 def spam_slow_peer(seed: int = 0) -> Scenario:
     """A spammer floods undecodable blocks at one node while another pair's
     RPC link turns slow: scoring graylists the spammer, the mesh converges
@@ -1437,6 +1521,32 @@ def _check_autotune_pinned(runner: ScenarioRunner) -> dict:
     }
 
 
+def _check_fused_boundary(runner: ScenarioRunner) -> dict:
+    """The fault really bit the fused dispatch (breaker tripped), the
+    breaker really recovered once the plan cleared (closed at run end),
+    boundary dispatches really reached the device, and the duty caches
+    really got primed from the fused result — convergence + finality
+    gates having passed is the verdict-identity evidence."""
+    from . import device_supervisor, device_telemetry
+
+    br = device_supervisor.SUPERVISOR.breaker("epoch_boundary").snapshot()
+    assert br["trips_total"] >= 1, (
+        "epoch_boundary breaker never tripped: the fault plan did not bite")
+    assert br["state"] == "closed", (
+        f"epoch_boundary breaker did not recover after the plan cleared: "
+        f"{br}")
+    recs = device_telemetry.FLIGHT_RECORDER.recent(
+        limit=device_telemetry.FLIGHT_RECORDER.capacity, op="epoch_boundary")
+    assert recs, "no fused boundary dispatch ever completed on the device"
+    primes = device_telemetry.boundary_prime_counts()
+    seeded = sum(v for k, v in primes.items() if k.startswith("seeded:"))
+    assert seeded >= 1, (
+        f"the fused boundary never seeded a duty cache ({primes})")
+    return {"breaker": br,
+            "device_boundary_dispatches": len(recs),
+            "boundary_primes": primes}
+
+
 def _check_spammer_penalized(runner: ScenarioRunner) -> dict:
     spammer_id, victim = runner.ctx["spammer"]
     score = victim.node.service.peer_manager._peer(spammer_id).score
@@ -1543,6 +1653,7 @@ SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     "pipeline_mid_sync": pipeline_mid_sync,
     "state_hash_pipeline": state_hash_pipeline,
     "autotune_pinned": autotune_pinned,
+    "fused_epoch_boundary": fused_epoch_boundary,
     "spam_slow_peer": spam_slow_peer,
     "byz_double_vote_smoke": byz_double_vote_smoke,
     "byz_minority_equivocation": byz_minority_equivocation,

@@ -64,6 +64,17 @@ def autotune_controller_reads_device(batch):
     return float(observed.sum())  # SEEDED: hot-path-sync (controller syncs device)
 
 
+def boundary_prime_reads_proposer(batch):
+    """ISSUE 16 coverage seed: a duty-cache priming leg that materializes
+    the fused boundary's proposer table OUTSIDE the sanctioned dispatch
+    context.  Production priming (per_epoch._prime_duty_caches) only ever
+    sees host arrays the supervised dispatch already fetched — this
+    fixture proves the pass would catch a cache layer reaching back onto
+    the device."""
+    table = sync_fixture_kernel(batch)
+    return np.asarray(table)  # SEEDED: hot-path-sync (priming syncs device)
+
+
 def host_marshalling_is_fine(rows):
     packed = np.asarray(rows)  # host data: no device taint, must not flag
     staged = jnp.asarray(sync_fixture_kernel(packed))  # jnp: no-op, not a sync

@@ -64,6 +64,19 @@ class MultiHopInversion:
                 return 5
 
 
+class BoundaryEntryCacheBlocks:
+    """ISSUE 16 coverage seed: the fused boundary's sharded-entry cache
+    lock (shuffle_device._ENTRY_LOCK) must never be held across a blocking
+    build — a compile inside it would stall every concurrent dispatch."""
+
+    def __init__(self):
+        self._entry_lock = threading.Lock()
+
+    def build_entry(self):
+        with self._entry_lock:
+            time.sleep(0.5)  # SEEDED: blocking-call
+
+
 class BlocksUnderLock:
     def __init__(self):
         self._lock = threading.Lock()

@@ -60,6 +60,15 @@ def rogue_entry(x):  # SEEDED: unregistered-entry (no BATCH_AXES declaration)
     return x * 2
 
 
+@jax.jit
+def rogue_fused_entry(x, table):  # SEEDED: unregistered-entry (fused shape)
+    """ISSUE 16 coverage seed: a fused multi-output kernel (per-validator
+    array + replicated table) with NO batch_axes declaration — exactly the
+    drift mode a new boundary-style op would introduce if its registry
+    entry (with its per-output ``out_batched`` list) were forgotten."""
+    return x + 1, table.sum()
+
+
 def pinning_transfer(x):
     return jax.device_put(x)  # SEEDED: unsharded-device-put
 

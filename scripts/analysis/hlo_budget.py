@@ -72,6 +72,14 @@ BASELINE_PATH = os.path.join(
 
 METRICS = ("dot_general", "s8_dot", "convert", "transpose", "collective")
 
+#: ops whose abstract args carry 64-bit dtypes — their traces must run
+#: under ``enable_x64`` exactly as the production dispatches do
+X64_OPS = frozenset({
+    "epoch_deltas", "epoch_deltas_leak",
+    "epoch_boundary", "epoch_boundary_leak",
+    "proposer_select",
+})
+
 _COLLECTIVE_RE = re.compile(
     r"\b(all_reduce|all_gather|all_to_all|reduce_scatter|collective_permute"
     r"|collective_broadcast)\b"
@@ -144,6 +152,7 @@ def _targets() -> List[Target]:
         kzg_device,
         pairing,
         sha256_device,
+        shuffle_device,
         tower,
         tree_hash,
         verify,
@@ -225,6 +234,64 @@ def _targets() -> List[Target]:
             )
         return build
 
+    def shuffle_build(n: int):
+        def build():
+            r = 90
+            chunks = max(1, (n + 255) // 256)
+            return (
+                (lambda *a: unwrap(shuffle_device._shuffle_kernel)(*a)),
+                (S((n,), i32), S((r,), i32),
+                 S((r, chunks * 32), jnp.uint8), S((), i32)),
+            )
+        return build
+
+    def proposer_build(n: int):
+        def build():
+            s, r = 32, 90
+            k = shuffle_device.PROPOSER_CANDIDATES
+            return (
+                (lambda *a: unwrap(shuffle_device._proposer_kernel)(*a)),
+                (S((s, 8), jnp.uint32), S((s, r), i32), S((s, k), i32),
+                 S((n,), jnp.int64), S((), i32), S((), jnp.int64)),
+            )
+        return build
+
+    def boundary_args(n: int):
+        s, r = 32, 90
+        k = shuffle_device.PROPOSER_CANDIDATES
+        chunks = max(1, (n + 255) // 256)
+        i64 = jnp.int64
+        return tuple(
+            [S((n,), i64)] * 4 + [S((n,), jnp.bool_)] + [S((n,), i64)] * 5
+            + [S((n,), i32)]
+            + [S((r,), i32), S((r, chunks * 32), jnp.uint8),
+               S((s, 8), jnp.uint32), S((s, r), i32), S((s, k), i32)]
+            + [S((), i64)] * 16 + [S((), i32)]
+        )
+
+    def boundary_build(n: int, in_leak: bool):
+        def build():
+            return (
+                (lambda *a: unwrap(shuffle_device._boundary_kernel)(
+                    *a, in_leak=in_leak)),
+                boundary_args(n),
+            )
+        return build
+
+    def boundary_mesh_build(n: int):
+        def build():
+            import functools
+
+            # signature-preserving partial: ShardedEntry derives the
+            # per-parameter shardings from the positional params (the
+            # keyword-only static ``in_leak`` is bound, not scanned)
+            return (
+                functools.partial(unwrap(shuffle_device._boundary_kernel),
+                                  in_leak=False),
+                boundary_args(n),
+            )
+        return build
+
     out: List[Target] = []
     for backend in ("int32", "int8"):
         for name, build in primitives:
@@ -262,6 +329,22 @@ def _targets() -> List[Target]:
         # every unsharded key, but big — slow tier
         out.append(Target(op, "-", "1048576", "slow",
                           epoch_build(1048576, in_leak)))
+    # The fused epoch-boundary family (ISSUE 16): shuffle + proposer as
+    # standalone entries, and the fused kernel in both leak modes — small
+    # buckets in tier-1, the mainnet registry bucket behind slow.
+    out.append(Target("shuffle", "-", "64", "small", shuffle_build(64)))
+    out.append(Target("shuffle", "-", "1048576", "slow",
+                      shuffle_build(1048576)))
+    out.append(Target("proposer_select", "-", "64", "small",
+                      proposer_build(64)))
+    out.append(Target("proposer_select", "-", "1048576", "slow",
+                      proposer_build(1048576)))
+    for in_leak in (False, True):
+        op = "epoch_boundary_leak" if in_leak else "epoch_boundary"
+        out.append(Target(op, "-", "64", "small",
+                          boundary_build(64, in_leak)))
+        out.append(Target(op, "-", "1048576", "slow",
+                          boundary_build(1048576, in_leak)))
     # Mesh-sharded lowerings (device_mesh.py): the batch axis of the full
     # entry points over the 8-way dp mesh.  These are the keys whose
     # ``collective`` budget is NON-zero — the bls batch-wide MSM and the
@@ -305,6 +388,14 @@ def _targets() -> List[Target]:
             "kzg_batch", backend, "8", "slow", kzg_mesh_build(8),
             mesh_size=8,
             entry_key="lighthouse_tpu/ops/kzg_device.py:_device_kzg_batch"))
+    # The fused boundary's 8-way lowering: its deltas sums complete
+    # through psums and its mixed out_batched list replicates the
+    # proposer table — the collective budget locks both.  Cold compile
+    # is heavy, so slow tier (the 8-device test mesh audits it).
+    out.append(Target(
+        "epoch_boundary", "-", "64", "slow", boundary_mesh_build(64),
+        mesh_size=8,
+        entry_key="lighthouse_tpu/ops/shuffle_device.py:_boundary_kernel"))
     return out
 
 
@@ -362,7 +453,7 @@ def measure_target(target: Target) -> Dict[str, int]:
                     lowered.compile().as_text()))
             return counts
 
-        if target.op.startswith("epoch_deltas"):
+        if target.op in X64_OPS:
             from jax.experimental import enable_x64
 
             with enable_x64():

@@ -97,6 +97,13 @@ SANCTIONED_CONTEXTS: Dict[str, Tuple[str, ...]] = {
     "lighthouse_tpu/ops/tree_hash.py": ("_dispatch_subtrees",),
     # the epoch kernel entry IS the supervisor's device_fn (per_epoch.py)
     "lighthouse_tpu/ops/epoch_device.py": ("epoch_deltas_device",),
+    # the fused boundary family (ISSUE 16): each dispatch entry is the
+    # supervised device_fn — dispatch+wait+device_get is its contract
+    "lighthouse_tpu/ops/shuffle_device.py": (
+        "shuffle_device",
+        "proposer_select_device",
+        "epoch_boundary_device",
+    ),
     # kzg device_fn — supervised since this PR
     "lighthouse_tpu/ops/kzg_device.py": (
         "verify_kzg_proof_batch_device.device_fn",

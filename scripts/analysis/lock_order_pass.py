@@ -72,6 +72,9 @@ SCAN_DIRS = (
     # state under locks, touched from dispatch hot paths
     # (bucket_vocabulary) and the HTTP surface — same discipline.
     "lighthouse_tpu/autotune.py",
+    # Fused epoch boundary (ISSUE 16): the sharded-entry cache lock is
+    # taken on the dispatch path — same discipline as the other ops locks.
+    "lighthouse_tpu/ops/shuffle_device.py",
     # Mesh-sharding subsystem (ISSUE 12): topology + per-device breaker
     # state behind a TimeoutLock, mutated from supervisor failure paths
     # and read per pipeline coalescing decision — same discipline.

@@ -80,7 +80,7 @@ SELF_TEST = {
     "lock-order": {
         # 2 cycle pairs (AB/BA lexical + the multi-hop c/d inversion), each
         # reported once per direction
-        "must_fire": {"lock-cycle": 4, "lock-self-cycle": 1, "blocking-call": 1},
+        "must_fire": {"lock-cycle": 4, "lock-self-cycle": 1, "blocking-call": 2},
         "must_not_flag_context": {"BlocksUnderLock.allowed"},
     },
     "device-purity": {
@@ -109,7 +109,7 @@ SELF_TEST = {
         # 7th seed: the autotune-shaped controller leg (ISSUE 15) — the
         # real lighthouse_tpu/autotune.py is in SCAN_DIRS with a zero-sync
         # contract, and this proves the pass would see it drift
-        "must_fire": {"hot-path-sync": 7},
+        "must_fire": {"hot-path-sync": 8},
         "must_not_flag_context": {
             "host_marshalling_is_fine",
             "suppressed_sync",
@@ -117,7 +117,7 @@ SELF_TEST = {
     },
     "sharding-ready": {
         "must_fire": {
-            "unregistered-entry": 1,
+            "unregistered-entry": 2,
             "registry-stale": 1,
             "batch-axis-fold": 2,
             "batch-axis-transpose": 1,
