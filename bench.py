@@ -1128,6 +1128,22 @@ def _campaign_budget(phase: str) -> float:
                                 str(CAMPAIGN_BUDGETS_S.get(phase, 900.0))))
 
 
+def _campaign_blackbox():
+    """The incident black box, if importable.  ``blackbox`` is jax-free by
+    contract (test_repo_lints gates it), so the campaign parent — which must
+    never import jax — can journal phase lifecycle and freeze a postmortem
+    bundle when a phase dies.  Never raises: an observability import failure
+    must not take the campaign with it."""
+    try:
+        if HERE not in sys.path:
+            sys.path.insert(0, HERE)
+        from lighthouse_tpu import blackbox
+        return blackbox
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"campaign: blackbox unavailable ({e})", file=sys.stderr)
+        return None
+
+
 def _campaign_subprocess(phase: str, argv_extra: list, timeout_s: float,
                          cpu: bool, scratch: str,
                          use_result_file: bool = False,
@@ -1295,6 +1311,10 @@ def _campaign_mode_main(out_path, force_cpu: bool) -> int:
             "epoch", ["--epoch-child"], _campaign_budget("epoch"),
             cpu=cpu, scratch=scratch, use_result_file=True),
     }
+    bb = _campaign_blackbox()
+    if bb is not None:
+        bb.emit("campaign", "start", phases=",".join(phases),
+                leg=artifact["leg"])
     for phase in phases:
         if phase == "probe":
             continue
@@ -1311,10 +1331,35 @@ def _campaign_mode_main(out_path, force_cpu: bool) -> int:
             continue
         print(f"campaign: phase {phase} (budget "
               f"{_campaign_budget(phase):.0f}s)", file=sys.stderr)
+        if bb is not None:
+            bb.emit("campaign", "phase_start", phase=phase,
+                    budget_s=_campaign_budget(phase))
         res = runners[phase]()
         artifact["phases"][phase] = res
+        if bb is not None:
+            bb.emit("campaign", "phase_end", phase=phase,
+                    ok=bool(res.get("ok")), rc=res.get("rc"),
+                    seconds=res.get("seconds"),
+                    timed_out=bool(res.get("timed_out_after_s")) or None)
         if not res.get("ok"):
             artifact["ok"] = False
+            if bb is not None:
+                # Freeze the black box at the failure: the campaign journal
+                # (which phases ran, how long, how this one died) plus the
+                # child's exit evidence, retained on disk for the postmortem.
+                try:
+                    cap = bb.capture(f"campaign_phase:{phase}", extra={
+                        "phase_result": {
+                            k: res.get(k)
+                            for k in ("phase", "rc", "seconds", "error",
+                                      "timed_out_after_s", "log_tail")
+                            if res.get(k) is not None
+                        },
+                    })
+                    res["postmortem_bundle"] = cap["path"]
+                except Exception as e:  # pragma: no cover - defensive
+                    print(f"campaign: postmortem capture failed ({e})",
+                          file=sys.stderr)
         flush()
         print(f"campaign: phase {phase} {'ok' if res.get('ok') else 'FAILED'}"
               f" ({res.get('seconds')}s)", file=sys.stderr)
@@ -1337,6 +1382,38 @@ def _campaign_mode_main(out_path, force_cpu: bool) -> int:
     epoch = (artifact["phases"].get("epoch") or {}).get("data") or {}
     artifact["epoch_summary"] = epoch.get("summary")
     flush()
+
+    # --- the perf-trajectory sentinel: compare every committed BENCH_* /
+    # MULTICHIP_* / SOAK_* artifact (plus this campaign's, once committed)
+    # against the baseline ribbons.  Advisory at campaign level — a red
+    # verdict names the regressed series without masking which PHASE died.
+    traj = os.path.join(HERE, "scripts", "analysis", "trajectory.py")
+    if os.path.exists(traj):
+        try:
+            proc = subprocess.run(
+                [sys.executable, traj, "--check"], cwd=HERE,
+                capture_output=True, text=True, timeout=120)
+            verdict = None
+            for line in reversed((proc.stdout or "").splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        verdict = json.loads(line)
+                        break
+                    except json.JSONDecodeError:
+                        continue
+            artifact["trajectory"] = {
+                "ok": proc.returncode == 0,
+                "rc": proc.returncode,
+                "verdict": verdict,
+            }
+        except (OSError, subprocess.TimeoutExpired) as e:
+            artifact["trajectory"] = {"ok": False, "error": str(e)}
+        if bb is not None:
+            bb.emit("campaign", "trajectory",
+                    ok=bool(artifact["trajectory"].get("ok")),
+                    rc=artifact["trajectory"].get("rc"))
+        flush()
     print(f"{MARKER} " + json.dumps(
         {"mode": "campaign", "ok": artifact["ok"], "leg": artifact.get("leg"),
          "out": out_path, "autotune_summary": artifact["autotune_summary"]},

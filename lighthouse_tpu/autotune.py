@@ -65,7 +65,7 @@ import os
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-from . import metrics
+from . import blackbox, metrics
 from .logs import get_logger
 
 log = get_logger("autotune")
@@ -339,6 +339,10 @@ class Controller:
             return entry
         log.info("autotune decision", **{
             k: v for k, v in entry.items() if k != "measurements_s"})
+        blackbox.emit("autotune", "decision", knob=entry.get("knob"),
+                      action=entry.get("action"), outcome=entry.get("outcome"),
+                      vocab=entry.get("vocab"), bucket=entry.get("bucket"),
+                      decision_seq=entry.get("seq"))
         return entry
 
     def decision_log(self) -> List[dict]:

@@ -50,7 +50,7 @@ import re
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from . import metrics
+from . import blackbox, metrics
 from .logs import get_logger
 from .timeout_lock import TimeoutLock
 
@@ -276,6 +276,8 @@ class MeshState:
             log.warning("mesh device breaker tripped; re-sharded",
                         device=dev, reason=reason, survivors=size,
                         generation=gen)
+            blackbox.emit("mesh", "reshard", device=dev, reason=reason,
+                          survivors=size, generation=gen)
             self._invalidate_topology()
         return bool(transitions)
 
@@ -296,6 +298,8 @@ class MeshState:
         metrics.DEVICE_MESH_SIZE.set(size)
         log.warning("mesh device force-tripped; re-sharded",
                     device=int(device_id), reason=reason, survivors=size)
+        blackbox.emit("mesh", "reshard", device=int(device_id), reason=reason,
+                      survivors=size, forced=True)
         self._invalidate_topology()
         return True
 

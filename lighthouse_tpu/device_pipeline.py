@@ -83,7 +83,7 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
-from . import metrics, tracing
+from . import blackbox, metrics, tracing
 from .logs import get_logger
 from .scheduler.work import STANDARD_DEVICE_BATCH
 
@@ -692,6 +692,10 @@ class DevicePipeline:
             "verdict": bool(verdict),
             "group_rechecks": rechecked,
         })
+        blackbox.emit("pipeline", "batch", op=self.op, n_sets=n_sets,
+                      n_groups=len(batch.groups), verdict=bool(verdict),
+                      group_rechecks=rechecked or None,
+                      unbuilt=bool(batch.unbuilt) or None)
 
     # ------------------------------------------------------------- control
 
@@ -976,6 +980,9 @@ class HashPipeline:
             "work_mix": dict(work_mix),
             "group_rehashes": rehashed,
         })
+        blackbox.emit("pipeline", "batch", op=self.op, n_blocks=n_blocks,
+                      n_groups=len(groups),
+                      group_rehashes=rehashed or None)
 
     # ------------------------------------------------------------- control
 

@@ -55,7 +55,7 @@ import time
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from . import metrics, tracing
+from . import blackbox, metrics, tracing
 from .logs import get_logger
 from .scheduler.work import RequeueWork
 from .timeout_lock import TimeoutLock
@@ -394,6 +394,8 @@ class DeviceSupervisor:
             metrics.DEVICE_DISPATCH_TIMEOUTS.inc(op=op)
             log.error("device dispatch watchdog fired",
                       op=op, deadline_s=deadline_s)
+            blackbox.emit("watchdog", "timeout", op=op, deadline_s=deadline_s)
+            blackbox.capture(f"dispatch_timeout:{op}")
             raise DispatchTimeout(op, deadline_s)
         if job.error is not None:
             raise job.error
@@ -452,6 +454,8 @@ class DeviceSupervisor:
             metrics.DEVICE_BREAKER_TRANSITIONS.inc(op=op, to=new)
             log.warning("device breaker transition",
                         op=op, frm=old, to=new, reason=reason)
+            blackbox.emit("breaker", "transition",
+                          op=op, frm=old, to=new, reason=reason)
             payload = {
                 "op": op,
                 "from": old,
@@ -464,6 +468,12 @@ class DeviceSupervisor:
                     bus.device_breaker(**payload)
                 except Exception:
                     pass  # a dead bus must never break the hot path
+            if new == STATE_OPEN:
+                # The trigger the black box exists for: freeze the journal
+                # window (pre-trip context included) before the ring
+                # evicts it.
+                blackbox.capture(f"breaker_open:{op}",
+                                 extra={"transition": payload})
 
     def _host(self, op: str, host_fn: Callable[[], Any], reason: str,
               info: dict) -> Any:
@@ -475,6 +485,7 @@ class DeviceSupervisor:
         metrics.DEVICE_HOST_FALLBACK.inc(reason=reason)
         tracing.annotate(host_fallback=True, fallback_reason=reason)
         log.warning("device batch routed to host backend", op=op, reason=reason)
+        blackbox.emit("supervisor", "host_fallback", op=op, reason=reason)
         t0 = time.perf_counter()
         try:
             return host_fn()

@@ -47,10 +47,17 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import metrics, tracing
+from . import blackbox, metrics, tracing
 
+#: Flight-recorder ring size.  ``LIGHTHOUSE_TPU_FLIGHT_RING`` is the
+#: knob (long soaks size it up so pre-incident records survive to the
+#: postmortem bundle); the older ``_FLIGHT_RECORDER_CAPACITY`` name is
+#: honored as a fallback.
 FLIGHT_RECORDER_CAPACITY = int(
-    os.environ.get("LIGHTHOUSE_TPU_FLIGHT_RECORDER_CAPACITY", "256")
+    os.environ.get(
+        "LIGHTHOUSE_TPU_FLIGHT_RING",
+        os.environ.get("LIGHTHOUSE_TPU_FLIGHT_RECORDER_CAPACITY", "256"),
+    )
 )
 
 #: Hard cap on one profiler capture — the HTTP task spawner allows 30 s per
@@ -332,7 +339,17 @@ def record_batch(
         reason = fallback_reason or "unknown"
         with _FALLBACKS_LOCK:
             _FALLBACKS[reason] = _FALLBACKS.get(reason, 0) + 1
-    return FLIGHT_RECORDER.record(entry)
+    entry = FLIGHT_RECORDER.record(entry)
+    # Every dispatched batch joins the incident journal with its
+    # flight_seq, so a postmortem bundle's journal window cross-references
+    # the ring (and, via trace_id, the span tree) record-for-record.
+    blackbox.emit("device_batch", "dispatch", trace_id=entry["trace_id"],
+                  flight_seq=entry["seq"], op=op, shape=entry["shape"],
+                  n_live=int(n_live), verdict=verdict,
+                  host_fallback=bool(host_fallback) or None,
+                  fallback_reason=fallback_reason,
+                  breaker_state=breaker_state)
+    return entry
 
 
 def host_fallback_counts() -> Dict[str, int]:

@@ -272,6 +272,12 @@ class FaultRegistry:
                 "injected fault fired", point=point, mode=plan.mode,
                 op=op or "*", plan_id=plan.plan_id,
             )
+            # Lazy import: blackbox imports this module for slot keying,
+            # so the journal hook must not create an import-time cycle.
+            from . import blackbox
+
+            blackbox.emit("fault", "fired", point=point, mode=plan.mode,
+                          op=op or "*", plan_id=plan.plan_id)
             if plan.mode == "hang":
                 time.sleep(plan.sleep_s)
             elif plan.mode == "error":

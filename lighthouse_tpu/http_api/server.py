@@ -2350,6 +2350,61 @@ def lighthouse_serving(ctx):
     }}
 
 
+# ---------------------------------------------------------- blackbox routes
+# The incident black box (blackbox.py): the causally-ordered journal that
+# every seam feeds, and the frozen postmortem bundles it writes on breaker
+# trips / watchdog timeouts / scenario gate failures.
+
+
+@route("GET", "/lighthouse/postmortems", P1)
+def lighthouse_postmortems(ctx):
+    """The black-box summary: journal occupancy, capture index (reason,
+    slot, journal/flight/trace counts per bundle), and the bundle files on
+    disk, newest first.  ``?bundle=<filename>`` returns one full bundle."""
+    from .. import blackbox
+
+    name = ctx.q1("bundle")
+    if name is not None:
+        bundle = blackbox.load_bundle(name)
+        if bundle is None:
+            raise _not_found(f"bundle {name}")
+        return {"data": bundle}
+    return {"data": blackbox.summary()}
+
+
+@route("GET", "/lighthouse/postmortems/journal", P1)
+def lighthouse_postmortems_journal(ctx):
+    """The live incident journal, oldest first.  Query params: ``source``
+    (e.g. ``breaker``, ``device_batch``), ``limit``."""
+    from .. import blackbox
+
+    try:
+        limit = int(ctx.q1("limit", "256"))
+    except ValueError:
+        raise _bad("limit must be an integer")
+    return {"data": blackbox.JOURNAL.window(
+        limit=max(1, min(limit, blackbox.JOURNAL.capacity)),
+        source=ctx.q1("source"),
+    )}
+
+
+@route("POST", "/lighthouse/postmortem", P1)
+def lighthouse_postmortem_capture(ctx):
+    """Freeze a postmortem bundle right now (the operator's "something is
+    off, snapshot everything" button).  Body: ``{"reason": "..."}``
+    (optional; defaults to ``manual``)."""
+    from .. import blackbox
+
+    body = ctx.body or {}
+    if not isinstance(body, dict):
+        raise _bad("body must be a JSON object")
+    reason = body.get("reason") or "manual"
+    if not isinstance(reason, str):
+        raise _bad("reason must be a string")
+    return {"data": blackbox.capture(f"manual:{reason}"
+                                     if reason != "manual" else "manual")}
+
+
 # ------------------------------------------------------------------ server
 
 
@@ -2686,6 +2741,12 @@ class HttpApiServer:
         self._httpd.api_server = self  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
         self._shutdown = threading.Event()
+        # Postmortem bundles get the serving admission state alongside the
+        # built-in breaker/mesh/pipeline snapshots (last server wins when
+        # tests run several; stop() withdraws ours).
+        from .. import blackbox
+
+        blackbox.register_snapshot("admission", self.spawner.admission.snapshot)
 
     @property
     def port(self) -> int:
@@ -2705,6 +2766,9 @@ class HttpApiServer:
 
     def stop(self) -> None:
         self._shutdown.set()
+        from .. import blackbox
+
+        blackbox.unregister_snapshot("admission")
         if self.response_cache is not None:
             self.response_cache.detach()
         self._httpd.shutdown()

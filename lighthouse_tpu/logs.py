@@ -46,6 +46,17 @@ class LogRing(logging.Handler):
             "message": record.getMessage(),
             "fields": getattr(record, "structured_fields", {}),
         }
+        # Log lines join the black-box cross-reference scheme: a line
+        # emitted inside a traced request carries its trace id, so a
+        # postmortem bundle's log tail links to the implicated trace
+        # trees the same way journal and flight records do.  (Imported
+        # here, not at module top: ``tracing`` is stdlib-only, but every
+        # module in the package imports ``logs`` first.)
+        from . import tracing
+
+        sp = tracing.current_span()
+        if sp is not None:
+            entry["trace_id"] = sp.trace.trace_id
         with self._cv:
             self._seq += 1
             entry["seq"] = self._seq

@@ -44,7 +44,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from . import fault_injection, metrics
+from . import blackbox, fault_injection, metrics
 from .logs import get_logger
 from .network.transport import LinkPlan
 from .simulator import SimNode, Simulator
@@ -314,6 +314,8 @@ class ScenarioRunner:
         log.info("scenario event", scenario=self.scenario.name,
                  action=event.action, at_slot=event.at_slot)
         SCENARIO_EVENTS.inc(action=event.action)
+        blackbox.emit("scenario", event.action,
+                      scenario=self.scenario.name, at_slot=event.at_slot)
         handler(**event.args)
 
     def _ev_partition(self, groups: Sequence[Sequence[int]]) -> None:
@@ -680,6 +682,8 @@ class ScenarioRunner:
         # Fault plans key on the fleet's logical slot for the whole run —
         # see fault_injection's slot-keying section; cleared in _cleanup.
         fault_injection.set_slot_provider(self._current_slot)
+        blackbox.emit("scenario", "run_start", scenario=scenario.name,
+                      seed=scenario.seed)
         artifact: dict = {"scenario": scenario.to_dict(), "passed": False}
         try:
             for _ in range(scenario.warmup_slots):
@@ -745,10 +749,15 @@ class ScenarioRunner:
         except ScenarioFailure as e:
             artifact["failure"] = str(e)
             SCENARIO_RUNS.inc(scenario=scenario.name, outcome="failed")
+            self._capture_postmortem(
+                artifact, f"scenario_gate:{scenario.name}", str(e))
             raise
         except Exception as e:
             artifact["failure"] = f"{type(e).__name__}: {e}"
             SCENARIO_RUNS.inc(scenario=scenario.name, outcome="error")
+            self._capture_postmortem(
+                artifact, f"scenario_crash:{scenario.name}",
+                artifact["failure"])
             raise
         finally:
             try:
@@ -777,6 +786,20 @@ class ScenarioRunner:
                 self._cleanup()
 
     # ---------------------------------------------------------- reporting
+
+    def _capture_postmortem(self, artifact: dict, reason: str,
+                            failure: str) -> None:
+        """Freeze the black box at a gate failure and attach the bundle
+        path to the SOAK artifact — an unattended soak failure triages
+        from one file (see OBSERVABILITY.md's playbook)."""
+        try:
+            cap = blackbox.capture(reason, extra={
+                "scenario": self.scenario.name, "failure": failure})
+            artifact["postmortem_bundle"] = cap["path"]
+        except Exception as e:  # noqa: BLE001 — must not mask the gate
+            log.warning("postmortem capture failed",
+                        scenario=self.scenario.name,
+                        error=f"{type(e).__name__}: {e}")
 
     def _node_summary(self, n: SimNode) -> dict:
         f_epoch, _ = n.chain.finalized_checkpoint()
@@ -1542,9 +1565,65 @@ def _check_fused_boundary(runner: ScenarioRunner) -> dict:
     seeded = sum(v for k, v in primes.items() if k.startswith("seeded:"))
     assert seeded >= 1, (
         f"the fused boundary never seeded a duty cache ({primes})")
+
+    # The black box (ISSUE 17): the injected fault must have frozen a
+    # postmortem bundle at the breaker trip, and the bundle's journal
+    # window must show the incident causally — the fault firing BEFORE the
+    # breaker transition it caused, with the host-fallback verdict present
+    # (the pre-trip fallback is IN the trip-time bundle; the tripping
+    # batch's own fallback resolves after the freeze and must appear in
+    # the live journal after the transition).
+    caps = [c for c in blackbox.captures()
+            if c["reason"] == "breaker_open:epoch_boundary"]
+    assert caps, "no postmortem bundle captured at the injected fault"
+    bundle = None
+    for cap in reversed(caps):
+        try:
+            with open(cap["path"]) as f:
+                bundle = json.load(f)
+            break
+        except (OSError, ValueError):
+            continue  # pruned by retention — try the next-newest capture
+    assert bundle is not None, "no captured bundle readable from disk"
+    window = bundle["journal"]
+
+    def _seqs(pred):
+        return [r["seq"] for r in window if pred(r)]
+
+    fault_seqs = _seqs(lambda r: r["source"] == "fault"
+                       and r.get("op") == "epoch_boundary")
+    open_seqs = _seqs(lambda r: r["source"] == "breaker"
+                      and r.get("op") == "epoch_boundary"
+                      and r.get("to") == "open")
+    fb_seqs = _seqs(lambda r: r["source"] == "supervisor"
+                    and r["event"] == "host_fallback"
+                    and r.get("op") == "epoch_boundary")
+    assert fault_seqs and open_seqs, (
+        f"bundle journal missing the incident "
+        f"(faults={fault_seqs}, opens={open_seqs})")
+    assert min(fault_seqs) < min(open_seqs), (
+        "fault firing did not precede the breaker trip in the journal")
+    assert fb_seqs, "no host-fallback verdict in the bundle journal"
+    # ... and the live journal carries the tripping batch's fallback AFTER
+    # the transition: fault -> open -> host_fallback, in seq order.
+    live = blackbox.JOURNAL.window()
+    live_opens = [r["seq"] for r in live if r["source"] == "breaker"
+                  and r.get("op") == "epoch_boundary"
+                  and r.get("to") == "open"]
+    live_fbs = [r["seq"] for r in live if r["source"] == "supervisor"
+                and r["event"] == "host_fallback"
+                and r.get("op") == "epoch_boundary"]
+    assert live_opens and live_fbs and max(live_fbs) > min(live_opens), (
+        "no host-fallback verdict followed the breaker trip in the journal")
     return {"breaker": br,
             "device_boundary_dispatches": len(recs),
-            "boundary_primes": primes}
+            "boundary_primes": primes,
+            "postmortem": {
+                "captured": True,
+                "journal_records": len(window),
+                "fault_before_trip": True,
+                "host_fallback_records": len(fb_seqs),
+            }}
 
 
 def _check_spammer_penalized(runner: ScenarioRunner) -> dict:

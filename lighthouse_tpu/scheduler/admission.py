@@ -54,7 +54,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Deque, Dict, Optional, Tuple
 
-from .. import metrics
+from .. import blackbox, metrics
 
 # ------------------------------------------------------------ HTTP classes
 
@@ -184,6 +184,8 @@ class Ticket:
             HTTP_REQUESTS_SHED.inc(**{"class": self.policy.name,
                                       "reason": "deadline"})
             self.controller._count_shed()
+            blackbox.emit("admission", "shed", klass=self.policy.name,
+                          reason="deadline", wait_s=round(wait, 4))
             raise ShedError(self.policy.name, "deadline",
                             self.controller.retry_after(self.policy.name))
         self.started_pc = now
@@ -310,7 +312,10 @@ class AdmissionController:
             self.shed += 1
             HTTP_REQUESTS_SHED.inc(**{"class": policy.name,
                                       "reason": "admission_full"})
-        # Retry-After derivation re-acquires the lock — raise outside it.
+        # Retry-After derivation re-acquires the lock — raise outside it
+        # (and the journal emit stays off the lock for the same reason).
+        blackbox.emit("admission", "shed", klass=policy.name,
+                      reason="admission_full", bound=bound)
         raise ShedError(policy.name, "admission_full",
                         self.retry_after(policy.name))
 

@@ -1,0 +1,473 @@
+#!/usr/bin/env python
+"""Perf-trajectory regression sentinel over the committed round artifacts.
+
+Every round leaves benchmark evidence in the repo root — ``BENCH_rNN.json``
+(north-star throughput, state-scale, serve, campaign), ``MULTICHIP_rNN.json``
+(weak/strong mesh scaling) and ``SOAK_*.json`` (virtual-time scenario gates).
+Each file is an island: nothing notices when round N+1's number quietly drops
+20% below round N's on the same hardware.  This sentinel is the cross-round
+memory — the trajectory-level complement of ``hlo_budget.py``'s per-lowering
+locks:
+
+- **Ingest**: every artifact is normalized into ``(round, metric,
+  environment-fingerprint, value)`` series.  The fingerprint (platform /
+  leg / mesh width / ``sim`` for virtual-clock soaks) keys the series so a
+  CPU-leg number is never compared against a TPU ribbon.
+- **Baseline**: ``scripts/analysis/trajectory_baseline.json`` commits, per
+  series, the reference value, the direction that counts as better
+  (``up`` = throughput/speedup, ``down`` = latency/waste) and a tolerance
+  ribbon (default ±10%).  The latest observed value of each series must
+  stay inside its ribbon: for ``up`` series ``value >= base*(1-tol)``, for
+  ``down`` series ``value <= base*(1+tol)`` — a 20% regression always
+  trips a 10% ribbon.
+- **Workflow** (the ``hlo_budget`` churn discipline): a deliberate perf
+  change is re-baselined with ``--update-baseline`` and the diff reviewed;
+  an unexplained drift fails.  The rewrite is canonical (sorted keys,
+  2-space indent, trailing newline — byte-identical round trip), keeps
+  hand-tuned per-series ``tolerance``/``direction`` overrides, prunes
+  series no artifact produces anymore, and REFUSES to run while the
+  self-test fails (a blind comparator must never be committed as the new
+  reference).
+- **Self-test**: fires on every run — canonical-serialization round trip,
+  extraction against a synthetic artifact, ribbon arithmetic in both
+  directions, and a seeded 20% regression over the real observed series
+  (every series, perturbed against itself, must be flagged).
+
+Stdlib-only BY CONTRACT: ``bench.py --campaign`` invokes this at campaign
+end from the parent process that must never import jax, and
+``tests/test_repo_lints.py`` runs it under an import poison that bans
+``jax``/``lighthouse_tpu``/``numpy``.
+
+    python scripts/analysis/trajectory.py                 # self-test + check
+    python scripts/analysis/trajectory.py --check         # same (campaign)
+    python scripts/analysis/trajectory.py -v              # + every series
+    python scripts/analysis/trajectory.py --update-baseline
+
+The last stdout line is one JSON verdict
+(``{"trajectory": "ok"|"fail", ...}``) for machine consumers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+BASELINE_PATH = os.path.join(
+    REPO_ROOT, "scripts", "analysis", "trajectory_baseline.json"
+)
+
+DEFAULT_TOLERANCE = 0.10
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+# ------------------------------------------------------------------- series
+
+
+class Point:
+    """One normalized observation: series key = ``metric|fingerprint``."""
+
+    def __init__(self, metric: str, fingerprint: str, value: float,
+                 direction: str, round_no: Optional[int], source: str):
+        self.metric = metric
+        self.fingerprint = fingerprint
+        self.value = float(value)
+        self.direction = direction  # "up" = bigger is better, "down" = smaller
+        self.round_no = round_no
+        self.source = source
+
+    @property
+    def key(self) -> str:
+        return f"{self.metric}|{self.fingerprint}"
+
+
+def _num(v) -> Optional[float]:
+    """Numeric or None (bools count — gate flags chart as 0/1 series)."""
+    if isinstance(v, bool):
+        return float(v)
+    if isinstance(v, (int, float)):
+        return float(v)
+    return None
+
+
+def _round_of(name: str) -> Optional[int]:
+    m = _ROUND_RE.search(name)
+    return int(m.group(1)) if m else None
+
+
+def _extract_bench(name: str, doc: dict, rnd: Optional[int]) -> List[Point]:
+    out: List[Point] = []
+
+    def add(metric, fp, value, direction):
+        v = _num(value)
+        if v is not None and fp:
+            out.append(Point(metric, str(fp), v, direction, rnd, name))
+
+    # r01–r05 shape: the north-star line under "parsed" (None when the
+    # round died before emitting one — nothing to chart, not a failure)
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        add("bls_verify.sets_per_sec", parsed.get("platform"),
+            parsed.get("value"), "up")
+    # r06 shape: state-scale (largest registry bucket's epoch-deltas
+    # throughput + the incremental tree-hash speedup floor)
+    scale = doc.get("state_scale")
+    if isinstance(scale, dict):
+        fp = doc.get("platform")
+        epochs = scale.get("epoch") or []
+        if isinstance(epochs, list) and epochs:
+            last = epochs[-1]
+            if isinstance(last, dict):
+                add("epoch_deltas.validators_per_sec", fp,
+                    last.get("validators_per_sec"), "up")
+        add("tree_hash.incremental_speedup_min", fp,
+            scale.get("incremental_speedup_min"), "up")
+    # r07 shape: the beacon-API load harness
+    serve = doc.get("serve")
+    if isinstance(serve, dict):
+        fp = doc.get("platform")
+        add("serve.p99_speedup_min", fp, serve.get("p99_speedup_min"), "up")
+        add("serve.p99_speedup_hot_reads_min", fp,
+            serve.get("p99_speedup_hot_reads_min"), "up")
+        overload = serve.get("overload") or {}
+        if isinstance(overload, dict):
+            add("serve.critical_p99_under_overload_s", fp,
+                overload.get("critical_p99_under_overload_s"), "down")
+        sse = serve.get("sse") or {}
+        if isinstance(sse, dict):
+            add("serve.sse.subscribers_fully_served", fp,
+                sse.get("subscribers_fully_served"), "up")
+    # r08/r09 shape: the campaign's closed-loop summaries
+    if doc.get("mode") == "campaign":
+        fp = doc.get("leg")
+        auto = doc.get("autotune_summary") or {}
+        if isinstance(auto, dict):
+            add("autotune.padding_waste_p50", fp,
+                auto.get("padding_waste_p50_autotuned"), "down")
+        epoch = doc.get("epoch_summary") or {}
+        if isinstance(epoch, dict):
+            speedup = epoch.get("boundary_speedup_vs_python") or {}
+            if isinstance(speedup, dict):
+                add("epoch_boundary.speedup_vs_python", fp,
+                    speedup.get("normal"), "up")
+                add("epoch_boundary.speedup_vs_python_leak", fp,
+                    speedup.get("leak"), "up")
+    return out
+
+
+def _extract_multichip(name: str, doc: dict, rnd: Optional[int]) -> List[Point]:
+    out: List[Point] = []
+    fp = f"{doc.get('platform') or 'cpu'}x{doc.get('n_devices')}"
+    for leg in ("weak_scaling", "strong_scaling"):
+        entries = doc.get(leg)
+        if not isinstance(entries, list):
+            continue
+        for entry in entries:
+            if not isinstance(entry, dict):
+                continue
+            v = _num(entry.get("sets_per_sec"))
+            if v is None:
+                continue
+            mesh = entry.get("mesh", "?")
+            out.append(Point(f"multichip.{leg}.mesh{mesh}.sets_per_sec",
+                             fp, v, "up", rnd, name))
+    return out
+
+
+def _extract_soak(name: str, doc: dict, rnd: Optional[int]) -> List[Point]:
+    # Soaks run on the deterministic virtual clock — one fingerprint.
+    out: List[Point] = []
+    scenario = (doc.get("scenario") or {}).get("name")
+    if not scenario:
+        return out
+    result = doc.get("result") or {}
+    for metric, value, direction in (
+        (f"soak.{scenario}.passed", doc.get("passed"), "up"),
+        (f"soak.{scenario}.final_finalized_epoch",
+         result.get("final_finalized_epoch"), "up"),
+    ):
+        v = _num(value)
+        if v is not None:
+            out.append(Point(metric, "sim", v, direction, rnd, name))
+    return out
+
+
+def extract(name: str, doc: dict) -> List[Point]:
+    """Normalize ONE artifact file into observation points."""
+    rnd = _round_of(name)
+    if name.startswith("BENCH_"):
+        return _extract_bench(name, doc, rnd)
+    if name.startswith("MULTICHIP_"):
+        return _extract_multichip(name, doc, rnd)
+    if name.startswith("SOAK_"):
+        return _extract_soak(name, doc, rnd)
+    return []
+
+
+def collect(artifacts_dir: str) -> Dict[str, Point]:
+    """Latest observation per series over every artifact in the dir.
+    "Latest" = highest round number; round-less files (``SOAK_*``,
+    ``BENCH_campaign.json``) sort before any numbered round of the same
+    series so a committed round is never shadowed by scratch output."""
+    latest: Dict[str, Point] = {}
+    names = []
+    for pattern in ("BENCH_*.json", "MULTICHIP_*.json", "SOAK_*.json"):
+        names.extend(os.path.basename(p)
+                     for p in glob.glob(os.path.join(artifacts_dir, pattern)))
+    for name in sorted(set(names)):
+        path = os.path.join(artifacts_dir, name)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue  # a half-written scratch artifact is not evidence
+        if not isinstance(doc, dict):
+            continue
+        for pt in extract(name, doc):
+            cur = latest.get(pt.key)
+            if cur is None or (cur.round_no or -1) <= (pt.round_no or -1):
+                latest[pt.key] = pt
+    return latest
+
+
+# ----------------------------------------------------------------- baseline
+
+
+def serialize_baseline(baseline: Dict[str, dict]) -> str:
+    """Canonical byte form: sorted keys, 2-space indent, trailing newline —
+    ``--update-baseline`` must round-trip byte-identically."""
+    return json.dumps(baseline, indent=2, sort_keys=True) + "\n"
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def write_baseline(path: str, baseline: Dict[str, dict]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(serialize_baseline(baseline))
+
+
+def rebuild_baseline(observed: Dict[str, Point],
+                     old: Dict[str, dict]) -> Dict[str, dict]:
+    """The ``--update-baseline`` result: every observed series at its
+    current value, keeping hand-tuned ``tolerance``/``direction`` overrides
+    from the old file, pruning series nothing produces anymore."""
+    out: Dict[str, dict] = {}
+    for key, pt in observed.items():
+        prev = old.get(key) or {}
+        out[key] = {
+            "value": pt.value,
+            "direction": prev.get("direction", pt.direction),
+            "tolerance": prev.get("tolerance", DEFAULT_TOLERANCE),
+            "round": pt.round_no,
+            "source": pt.source,
+        }
+    return out
+
+
+# -------------------------------------------------------------------- check
+
+
+def compare(key: str, base: dict, value: float) -> Optional[str]:
+    """None when ``value`` sits inside the ribbon, else the mismatch."""
+    ref = base.get("value")
+    if not isinstance(ref, (int, float)):
+        return f"{key}: baseline entry has no numeric value"
+    tol = base.get("tolerance", DEFAULT_TOLERANCE)
+    direction = base.get("direction", "up")
+    if direction == "up":
+        floor = ref * (1.0 - tol)
+        if value < floor:
+            return (f"{key}: {value:g} fell below the ribbon floor "
+                    f"{floor:g} (baseline {ref:g}, -{tol:.0%})")
+    else:
+        ceil = ref * (1.0 + tol)
+        if value > ceil:
+            return (f"{key}: {value:g} rose above the ribbon ceiling "
+                    f"{ceil:g} (baseline {ref:g}, +{tol:.0%})")
+    return None
+
+
+def check(observed: Dict[str, Point], baseline: Dict[str, dict],
+          strict: bool = False) -> Tuple[List[str], List[str]]:
+    """(mismatches, notes).  A baseline series no artifact produces anymore
+    is a mismatch (the stale-key rule from hlo_budget: an orphan ribbon
+    must not read as guarded coverage); a new series with no committed
+    ribbon is a note unless --strict (a fresh environment fingerprint is
+    expected at a new site, and must not redden an otherwise-green run)."""
+    mismatches: List[str] = []
+    notes: List[str] = []
+    for key in sorted(set(baseline) - set(observed)):
+        mismatches.append(
+            f"{key}: stale baseline series — no artifact produces it; "
+            "run --update-baseline (it prunes)"
+        )
+    for key in sorted(observed):
+        base = baseline.get(key)
+        if base is None:
+            msg = (f"{key}: no committed ribbon "
+                   f"(value {observed[key].value:g} from "
+                   f"{observed[key].source}) — run --update-baseline")
+            (mismatches if strict else notes).append(msg)
+            continue
+        m = compare(key, base, observed[key].value)
+        if m:
+            mismatches.append(f"{m} [{observed[key].source}]")
+    return mismatches, notes
+
+
+# ---------------------------------------------------------------- self-test
+
+
+_SELF_TEST_BENCH = {
+    "parsed": {"value": 1000.0, "unit": "sets/sec", "platform": "tpu"},
+    "serve": {"p99_speedup_min": 6.0, "p99_speedup_hot_reads_min": 12.0,
+              "overload": {"critical_p99_under_overload_s": 0.25},
+              "sse": {"subscribers_fully_served": 256}},
+    "platform": "cpu",
+}
+
+
+def self_test(observed: Dict[str, Point]) -> List[str]:
+    """The sentinel must still be able to SEE — a blind comparator passes
+    every trajectory.  Pure checks plus a seeded 20% regression over the
+    real observed series."""
+    errors: List[str] = []
+    # 1. canonical serialization round-trips byte-identically
+    probe = {"b|x": {"value": 1.5, "direction": "up", "tolerance": 0.1,
+                     "round": 3, "source": "B_r03.json"},
+             "a|y": {"value": 2.0, "direction": "down", "tolerance": 0.2,
+                     "round": None, "source": "S.json"}}
+    text = serialize_baseline(probe)
+    if serialize_baseline(json.loads(text)) != text:
+        errors.append("self-test: canonical serialization does not "
+                      "round-trip byte-identically")
+    # 2. extraction sees a known artifact
+    pts = {p.key: p for p in extract("BENCH_r42.json", _SELF_TEST_BENCH)}
+    if ("bls_verify.sets_per_sec|tpu" not in pts
+            or pts["bls_verify.sets_per_sec|tpu"].value != 1000.0
+            or pts["bls_verify.sets_per_sec|tpu"].round_no != 42):
+        errors.append("self-test: bench extraction went blind on the "
+                      "north-star series")
+    if "serve.critical_p99_under_overload_s|cpu" not in pts:
+        errors.append("self-test: bench extraction went blind on the "
+                      "serve latency series")
+    # 3. ribbon arithmetic, both directions: ±5% sits inside a 10% ribbon,
+    #    a 20% regression always trips it
+    up = {"value": 100.0, "direction": "up", "tolerance": 0.1}
+    down = {"value": 0.5, "direction": "down", "tolerance": 0.1}
+    if compare("k", up, 95.0) is not None:
+        errors.append("self-test: a 5% dip tripped the 10% up-ribbon")
+    if compare("k", up, 80.0) is None:
+        errors.append("self-test: a 20% throughput regression was not "
+                      "detected — the comparator has gone blind")
+    if compare("k", down, 0.52) is not None:
+        errors.append("self-test: a 4% rise tripped the 10% down-ribbon")
+    if compare("k", down, 0.6) is None:
+        errors.append("self-test: a 20% latency regression was not "
+                      "detected — the comparator has gone blind")
+    # 4. seeded regression over the REAL series: every observed series,
+    #    perturbed 20% the wrong way against itself, must be flagged
+    if observed:
+        as_baseline = rebuild_baseline(observed, {})
+        seeded = 0
+        for key, pt in observed.items():
+            direction = as_baseline[key]["direction"]
+            if pt.value == 0.0:
+                continue  # a zero has no 20%-worse twin on an up-series
+            worse = pt.value * (0.8 if direction == "up" else 1.2)
+            if compare(key, as_baseline[key], worse) is None:
+                errors.append(f"self-test: seeded 20% regression on {key} "
+                              "was not detected")
+            seeded += 1
+        if not seeded:
+            errors.append("self-test: no observed series could carry a "
+                          "seeded regression — extraction collapsed")
+    return errors
+
+
+# --------------------------------------------------------------------- main
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="self-test + ribbon check (the default action)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the committed ribbons from the artifacts")
+    ap.add_argument("--artifacts-dir", default=REPO_ROOT,
+                    help="where the BENCH_*/MULTICHIP_*/SOAK_* files live")
+    ap.add_argument("--baseline", default=BASELINE_PATH)
+    ap.add_argument("--strict", action="store_true",
+                    help="a series with no committed ribbon is a failure")
+    ap.add_argument("--no-self-test", action="store_true")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    observed = collect(args.artifacts_dir)
+    if args.verbose:
+        for key in sorted(observed):
+            pt = observed[key]
+            print(f"trajectory: {key} = {pt.value:g} "
+                  f"({pt.direction}, {pt.source})")
+
+    errors = [] if args.no_self_test else self_test(observed)
+
+    if args.update_baseline:
+        if errors:
+            for e in errors:
+                print(f"trajectory: FAIL: {e}", file=sys.stderr)
+            print("trajectory: refusing to rewrite the baseline with a "
+                  "failing self-test", file=sys.stderr)
+            return 1
+        old = load_baseline(args.baseline)
+        new = rebuild_baseline(observed, old)
+        pruned = sorted(set(old) - set(new))
+        write_baseline(args.baseline, new)
+        print(f"trajectory: baseline rewritten for {len(new)} series"
+              + (f", pruned {len(pruned)} stale" if pruned else ""))
+        print(json.dumps({"trajectory": "ok", "series": len(new),
+                          "pruned": len(pruned)}, sort_keys=True))
+        return 0
+
+    baseline = load_baseline(args.baseline)
+    mismatches, notes = check(observed, baseline, strict=args.strict)
+    for n in notes:
+        print(f"trajectory: note: {n}", file=sys.stderr)
+    for m in mismatches:
+        print(f"trajectory: FAIL: {m}", file=sys.stderr)
+    for e in errors:
+        print(f"trajectory: FAIL: {e}", file=sys.stderr)
+    ok = not mismatches and not errors
+    if not ok:
+        print(
+            f"trajectory: {len(mismatches)} ribbon mismatch(es), "
+            f"{len(errors)} self-test failure(s). Deliberate perf changes: "
+            "--update-baseline and review the diff (ANALYSIS.md).",
+            file=sys.stderr,
+        )
+    print(json.dumps({
+        "trajectory": "ok" if ok else "fail",
+        "series": len(observed),
+        "ribboned": sum(1 for k in observed if k in baseline),
+        "uncommitted": len(notes),
+        "mismatches": mismatches[:8],
+    }, sort_keys=True))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

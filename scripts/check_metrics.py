@@ -72,6 +72,18 @@ REGISTERING_MODULES = (
     # proves the module stays importable without jax (it is host-side
     # telemetry-plumbing only — the host-sync pass enforces the same)
     "lighthouse_tpu.autotune",
+    # blackbox_* live with the incident journal; importing also proves the
+    # black box stays importable without jax (the campaign parent journals
+    # through it — test_repo_lints gates the same under an import poison)
+    "lighthouse_tpu.blackbox",
+)
+
+# The incident black box's metric contract (ISSUE 17): every journal
+# append and every frozen postmortem bundle must stay countable.  A
+# refactor that silently drops one of these fails CI.
+REQUIRED_BLACKBOX_METRICS = (
+    "blackbox_events_total",
+    "blackbox_captures_total",
 )
 
 # The serving layer's metric contract (ISSUE 14): per-route latency,
@@ -148,6 +160,11 @@ def main() -> int:
     for name in REQUIRED_SERVING_METRICS:
         if name not in metrics._REGISTRY:
             errors.append(f"{name}: required serving metric is not "
+                          "registered")
+
+    for name in REQUIRED_BLACKBOX_METRICS:
+        if name not in metrics._REGISTRY:
+            errors.append(f"{name}: required black-box metric is not "
                           "registered")
 
     check_cached_routes(errors)

@@ -1,0 +1,375 @@
+"""The incident black box (ISSUE 17): journal causal ordering under
+concurrent emitters, slot keying through the fault-injection provider,
+trace-id auto-resolution, the capture triggers (breaker trip, watchdog
+timeout, manual POST), newest-K bundle retention, the
+``/lighthouse/postmortems*`` endpoint shapes, and the two acceptance
+paths — a breaker trip whose bundle cross-references flight-recorder
+records and trace trees by id with pre-incident events intact, and a
+killed ``bench.py --campaign`` phase that leaves a bundle behind."""
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu import blackbox
+from lighthouse_tpu import device_supervisor as ds
+from lighthouse_tpu import device_telemetry
+from lighthouse_tpu import fault_injection as fi
+from lighthouse_tpu import metrics, tracing
+from lighthouse_tpu.crypto.bls import api
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(tmp_path):
+    fi.reset_for_tests()
+    ds.reset_for_tests()
+    blackbox.reset_for_tests()
+    blackbox.configure(directory=str(tmp_path / "bundles"))
+    yield
+    fi.reset_for_tests()
+    ds.reset_for_tests()
+    blackbox.reset_for_tests()
+
+
+def make_set(msg: bytes, n_keys: int = 1):
+    sks = [api.SecretKey.random() for _ in range(n_keys)]
+    pks = [sk.public_key() for sk in sks]
+    agg = api.AggregateSignature.infinity()
+    for sk in sks:
+        agg.add_assign(sk.sign(msg))
+    return api.SignatureSet.multiple_pubkeys(agg, pks, msg)
+
+
+# ---------------------------------------------------------------- journal
+
+
+class TestJournal:
+    def test_concurrent_emitters_serialize_into_one_causal_order(self):
+        """N threads race emits; the journal must assign a gapless,
+        strictly-increasing seq AND preserve each thread's own program
+        order (the seq IS the causal order — nothing may reorder one
+        emitter's records against themselves)."""
+        n_threads, per_thread = 8, 50
+        barrier = threading.Barrier(n_threads)
+
+        def emitter(tid):
+            barrier.wait()
+            for i in range(per_thread):
+                blackbox.emit("test_race", "tick", tid=tid, i=i)
+
+        threads = [threading.Thread(target=emitter, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        window = blackbox.JOURNAL.window(source="test_race")
+        assert len(window) == n_threads * per_thread
+        seqs = [r["seq"] for r in window]
+        assert seqs == sorted(seqs)
+        assert len(set(seqs)) == len(seqs), "duplicate seq assigned"
+        per_tid = {}
+        for r in window:
+            per_tid.setdefault(r["tid"], []).append(r["i"])
+        for tid, order in per_tid.items():
+            assert order == list(range(per_thread)), (
+                f"emitter {tid}'s records were reordered: {order[:10]}...")
+
+    def test_ring_is_bounded_but_seq_keeps_counting(self):
+        j = blackbox.Journal(capacity=16)
+        for i in range(40):
+            j.append({"i": i})
+        assert len(j) == 16
+        assert j.emitted_total == 40
+        window = j.window()
+        assert [r["i"] for r in window] == list(range(24, 40))
+        assert window[0]["seq"] == 25  # eviction never renumbers
+
+    def test_slot_comes_from_the_fault_injection_provider(self):
+        """Virtual-time soaks journal deterministically: the scenario
+        runner installs its sim clock as the slot provider and every
+        journal record keys on it."""
+        assert blackbox.emit("test_slot", "bare")["slot"] is None
+        fi.set_slot_provider(lambda: 42)
+        try:
+            assert blackbox.emit("test_slot", "keyed")["slot"] == 42
+        finally:
+            fi.set_slot_provider(None)
+
+    def test_trace_id_auto_resolves_from_the_active_span(self):
+        with tracing.span("unit_blackbox_root") as sp:
+            rec = blackbox.emit("test_trace", "inside")
+            assert rec["trace_id"] == sp.trace.trace_id
+        rec = blackbox.emit("test_trace", "outside")
+        assert "trace_id" not in rec
+
+    def test_emit_counts_by_source(self):
+        n0 = blackbox.BLACKBOX_EVENTS.get(source="test_count")
+        blackbox.emit("test_count", "a")
+        blackbox.emit("test_count", "b")
+        assert blackbox.BLACKBOX_EVENTS.get(source="test_count") == n0 + 2
+
+
+# ----------------------------------------------------------- capture paths
+
+
+class TestCaptureTriggers:
+    def _configure_trip_fast(self):
+        ds.SUPERVISOR.configure(config=ds.BreakerConfig(
+            failure_threshold=1, open_cooldown_s=30.0, probe_successes=1))
+
+    def test_breaker_trip_freezes_a_cross_referenced_bundle(self):
+        """The acceptance path: healthy traced batches, then an injected
+        device error trips the breaker — the frozen bundle's journal must
+        cross-reference at least one flight-recorder record (by
+        ``flight_seq``) and one completed trace tree (by ``trace_id``),
+        with the PRE-incident batches present."""
+        from lighthouse_tpu.ops.verify import verify_signature_sets_device
+
+        s = make_set(b"blackbox-pre")
+        for i in range(3):
+            with tracing.span("unit_bb_batch", batch=i):
+                assert verify_signature_sets_device([s], seed=b"t") is True
+        self._configure_trip_fast()
+        fi.install("device.dispatch", "error", op="bls_verify", first_n=1)
+        assert verify_signature_sets_device([s], seed=b"t") is True  # host
+
+        caps = [c for c in blackbox.captures()
+                if c["reason"] == "breaker_open:bls_verify"]
+        assert len(caps) == 1
+        cap = caps[0]
+        assert os.path.exists(cap["path"])
+        with open(cap["path"]) as f:
+            bundle = json.load(f)
+        assert bundle["reason"] == "breaker_open:bls_verify"
+        window = bundle["journal"]
+        # the incident is in there, in causal order: pre-incident healthy
+        # batches, then the fault firing, then the transition
+        batches = [r for r in window if r["source"] == "device_batch"
+                   and r.get("op") == "bls_verify"]
+        assert len(batches) >= 3, "pre-incident batches were lost"
+        faults = [r["seq"] for r in window if r["source"] == "fault"]
+        opens = [r["seq"] for r in window if r["source"] == "breaker"
+                 and r.get("to") == "open"]
+        assert faults and opens and min(faults) < min(opens)
+        # cross-reference 1: journal flight_seq -> a record in the frozen ring
+        ring_seqs = {r["seq"] for r in bundle["flight_recorder"]}
+        linked = [r for r in batches if r.get("flight_seq") in ring_seqs]
+        assert linked, "no journal record resolves into the flight ring"
+        # cross-reference 2: journal trace_id -> a serialized trace tree
+        tree_ids = {t["trace_id"] for t in bundle["traces"]}
+        assert tree_ids, "no implicated trace trees were frozen"
+        assert any(r.get("trace_id") in tree_ids for r in batches), (
+            "no journal record resolves into a frozen trace tree")
+        # snapshots rode along, error-free
+        for section in ("supervisor", "mesh", "pipeline", "autotune",
+                        "telemetry"):
+            assert "error" not in (bundle["snapshots"][section] or {})
+        # the supervisor's breaker state is IN the frozen snapshot
+        assert any(b["op"] == "bls_verify" and b["state"] == "open"
+                   for b in bundle["snapshots"]["supervisor"]["breakers"])
+
+    def test_pre_incident_events_outlive_flight_ring_eviction(self):
+        """The regression PR 11 worked around: the flight ring evicts
+        pre-trip records, the journal must not.  With a tiny ring, batches
+        recorded long before the trip still appear in the bundle journal
+        even though the ring has dropped them."""
+        small = device_telemetry.FlightRecorder(capacity=4)
+        old_ring = device_telemetry.FLIGHT_RECORDER
+        device_telemetry.FLIGHT_RECORDER = small
+        try:
+            for i in range(12):
+                device_telemetry.record_batch(
+                    op="test_evict", shape=(8,), n_live=5)
+            cap = blackbox.capture("unit_eviction_probe")
+        finally:
+            device_telemetry.FLIGHT_RECORDER = old_ring
+        with open(cap["path"]) as f:
+            bundle = json.load(f)
+        journal_flight_seqs = [
+            r["flight_seq"] for r in bundle["journal"]
+            if r["source"] == "device_batch" and r.get("op") == "test_evict"]
+        assert len(journal_flight_seqs) == 12
+        ring_seqs = {r["seq"] for r in bundle["flight_recorder"]
+                     if r.get("op") == "test_evict"}
+        assert len(ring_seqs) == 4
+        evicted = [s for s in journal_flight_seqs if s not in ring_seqs]
+        assert len(evicted) == 8, "ring eviction still loses the journal"
+
+    def test_watchdog_timeout_captures(self):
+        from lighthouse_tpu.ops.verify import verify_signature_sets_device
+
+        ds.SUPERVISOR.configure(deadlines={"bls_verify": 0.3})
+        fi.install("device.dispatch", "hang", op="bls_verify",
+                   sleep_s=1.5, first_n=1)
+        s = make_set(b"blackbox-hang")
+        assert verify_signature_sets_device([s], seed=b"t") is True
+        reasons = [c["reason"] for c in blackbox.captures()]
+        assert "dispatch_timeout:bls_verify" in reasons
+        window = blackbox.JOURNAL.window(source="watchdog")
+        assert any(r["event"] == "timeout" and r.get("op") == "bls_verify"
+                   for r in window)
+
+    def test_newest_k_retention_prunes_oldest(self, tmp_path):
+        blackbox.configure(directory=str(tmp_path / "ret"), retain_bundles=3)
+        paths = [blackbox.capture(f"unit_retention:{i}")["path"]
+                 for i in range(5)]
+        on_disk = blackbox.bundle_files()
+        assert len(on_disk) == 3
+        kept = {e["path"] for e in on_disk}
+        assert kept == set(paths[-3:]), "retention did not keep the newest K"
+        assert blackbox.retain() == 3
+
+    def test_capture_counts_by_reason_label(self):
+        n0 = blackbox.BLACKBOX_CAPTURES.get(reason="unit_label")
+        blackbox.capture("unit_label:with_detail")
+        assert blackbox.BLACKBOX_CAPTURES.get(reason="unit_label") == n0 + 1
+
+    def test_capture_event_joins_the_journal_after_the_freeze(self):
+        cap = blackbox.capture("unit_selfref")
+        with open(cap["path"]) as f:
+            bundle = json.load(f)
+        # the bundle must not contain its own capture event ...
+        assert not any(r["source"] == "blackbox"
+                       and r.get("capture_seq") == cap["capture_seq"]
+                       for r in bundle["journal"])
+        # ... but the live journal does, for the NEXT bundle's context
+        assert any(r["source"] == "blackbox"
+                   and r.get("capture_seq") == cap["capture_seq"]
+                   for r in blackbox.JOURNAL.window(source="blackbox"))
+
+
+# ---------------------------------------------------------------- endpoints
+
+
+@pytest.fixture(scope="module")
+def api_server():
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.crypto.bls.backends import set_backend
+    from lighthouse_tpu.http_api import HttpApiServer
+
+    set_backend("fake")
+    harness = BeaconChainHarness(validator_count=8, fake_crypto=True)
+    server = HttpApiServer(harness.chain).start()
+    yield server
+    server.stop()
+    set_backend("host")
+
+
+def _request(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        payload = json.dumps(body) if body is not None else None
+        conn.request(method, path, body=payload,
+                     headers={"Content-Type": "application/json"}
+                     if payload else {})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+class TestEndpoints:
+    def test_postmortems_summary_shape(self, api_server):
+        blackbox.emit("test_http", "warm")
+        status, out = _request(api_server.port, "GET",
+                               "/lighthouse/postmortems")
+        assert status == 200
+        data = out["data"]
+        assert {"dir", "retain", "journal", "captures", "bundles"} <= set(data)
+        assert {"capacity", "stored", "emitted_total"} <= set(data["journal"])
+        assert data["journal"]["stored"] >= 1
+
+    def test_journal_endpoint_filters_and_limits(self, api_server):
+        for i in range(5):
+            blackbox.emit("test_http_j", "tick", i=i)
+        status, out = _request(
+            api_server.port, "GET",
+            "/lighthouse/postmortems/journal?source=test_http_j&limit=3")
+        assert status == 200
+        records = out["data"]
+        assert [r["i"] for r in records] == [2, 3, 4]  # newest 3, oldest first
+        assert all(r["source"] == "test_http_j" for r in records)
+        status, _ = _request(
+            api_server.port, "GET",
+            "/lighthouse/postmortems/journal?limit=bogus")
+        assert status == 400
+
+    def test_manual_post_captures_and_bundle_fetch_roundtrips(self, api_server):
+        status, out = _request(api_server.port, "POST",
+                               "/lighthouse/postmortem",
+                               body={"reason": "ops_probe"})
+        assert status == 200
+        entry = out["data"]
+        assert entry["reason"] == "manual:ops_probe"
+        assert os.path.exists(entry["path"])
+        name = os.path.basename(entry["path"])
+        status, out = _request(api_server.port, "GET",
+                               f"/lighthouse/postmortems?bundle={name}")
+        assert status == 200
+        bundle = out["data"]
+        assert bundle["reason"] == "manual:ops_probe"
+        # the admission controller's snapshot rode along (server-registered)
+        assert "admission" in bundle["snapshots"]
+        assert "error" not in (bundle["snapshots"]["admission"] or {})
+        status, _ = _request(api_server.port, "GET",
+                             "/lighthouse/postmortems?bundle=../etc/passwd")
+        assert status == 404
+
+    def test_manual_post_default_reason(self, api_server):
+        status, out = _request(api_server.port, "POST",
+                               "/lighthouse/postmortem", body={})
+        assert status == 200
+        assert out["data"]["reason"] == "manual"
+
+
+# ------------------------------------------------- killed campaign phase
+
+
+class TestCampaignPhaseDeath:
+    def test_killed_phase_leaves_a_postmortem_bundle(self, tmp_path):
+        """Acceptance path 2: a campaign phase that dies (here: budget so
+        tight the child is killed) makes the campaign parent freeze a
+        bundle and attach its path to the BENCH artifact."""
+        out = tmp_path / "BENCH_campaign.json"
+        bundles = tmp_path / "bundles"
+        env = {
+            **os.environ,
+            "BENCH_CAMPAIGN_PHASES": "scale",
+            "BENCH_CAMPAIGN_SCALE_S": "2",
+            "LIGHTHOUSE_TPU_BLACKBOX_DIR": str(bundles),
+        }
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+             "--campaign", "--cpu", "--out", str(out)],
+            capture_output=True, text=True, cwd=REPO_ROOT, timeout=300,
+            env=env)
+        assert out.exists(), (
+            f"campaign left no artifact (rc={res.returncode}):\n"
+            f"{res.stdout}\n{res.stderr}")
+        artifact = json.loads(out.read_text())
+        assert artifact["ok"] is False
+        phase = artifact["phases"]["scale"]
+        assert not phase["ok"]
+        bundle_path = phase.get("postmortem_bundle")
+        assert bundle_path, "no postmortem bundle attached to the artifact"
+        with open(bundle_path) as f:
+            bundle = json.load(f)
+        assert bundle["reason"] == "campaign_phase:scale"
+        assert "phase_result" in bundle["extra"]
+        # the campaign journaled its lifecycle up to the death
+        events = [(r["source"], r["event"], r.get("phase"))
+                  for r in bundle["journal"]]
+        assert ("campaign", "start", None) in events
+        assert ("campaign", "phase_start", "scale") in events
+        assert ("campaign", "phase_end", "scale") in events
+        # ... and the campaign still ran the trajectory sentinel afterwards
+        assert artifact["trajectory"]["ok"] is True

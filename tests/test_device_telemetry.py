@@ -94,6 +94,44 @@ class TestFlightRecorder:
         assert [r["i"] for r in recent] == [5, 4, 3, 2]
         assert [r["seq"] for r in recent] == [6, 5, 4, 3]
 
+    def test_ring_size_env_configurable(self):
+        """ISSUE 17 satellite: ``LIGHTHOUSE_TPU_FLIGHT_RING`` sizes the
+        ring (long soaks grow it so pre-incident records survive to the
+        postmortem bundle), with the legacy capacity name as fallback.
+        The constant is read at import, so the probe runs in a child."""
+        import os
+        import subprocess
+        import sys
+
+        probe = (
+            "from lighthouse_tpu import device_telemetry as dt\n"
+            "assert dt.FLIGHT_RECORDER_CAPACITY == 32\n"
+            "assert dt.FLIGHT_RECORDER.capacity == 32\n"
+            "for i in range(100):\n"
+            "    dt.record_batch(op='ring_probe', shape=(4,), n_live=2)\n"
+            "assert len(dt.FLIGHT_RECORDER) == 32\n"
+            "assert dt.FLIGHT_RECORDER.recorded_total == 100\n"
+            "print('RING_OK')\n"
+        )
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        res = subprocess.run(
+            [sys.executable, "-c", probe], capture_output=True, text=True,
+            cwd=repo_root, timeout=120,
+            env={**os.environ, "LIGHTHOUSE_TPU_FLIGHT_RING": "32"})
+        assert res.returncode == 0, res.stderr
+        assert "RING_OK" in res.stdout
+        # the legacy env name still works when the new one is absent
+        env = {k: v for k, v in os.environ.items()
+               if k != "LIGHTHOUSE_TPU_FLIGHT_RING"}
+        env["LIGHTHOUSE_TPU_FLIGHT_RECORDER_CAPACITY"] = "16"
+        res = subprocess.run(
+            [sys.executable, "-c",
+             "from lighthouse_tpu import device_telemetry as dt\n"
+             "assert dt.FLIGHT_RECORDER.capacity == 16\n"],
+            capture_output=True, text=True, cwd=repo_root, timeout=120,
+            env=env)
+        assert res.returncode == 0, res.stderr
+
     def test_filters(self):
         ring = device_telemetry.FlightRecorder(capacity=8)
         ring.record({"op": "a", "trace_id": "t1"})

@@ -221,3 +221,130 @@ class TestCheckMetrics:
             f"check_metrics.py failed:\n{res.stdout}\n{res.stderr}"
         )
         assert "OK" in res.stdout
+
+
+class TestTrajectorySentinel:
+    """ISSUE 17: the perf-trajectory sentinel (scripts/analysis/
+    trajectory.py) gates the committed round artifacts against the
+    committed ribbons, stays import-free of runtime packages (the campaign
+    parent invokes it and must never import jax), round-trips its baseline
+    byte-identically, and still SEES a seeded regression."""
+
+    BASELINE = os.path.join(
+        REPO_ROOT, "scripts", "analysis", "trajectory_baseline.json")
+
+    def test_committed_artifacts_pass_the_committed_ribbons(self):
+        res = _run(os.path.join("analysis", "trajectory.py"),
+                   "--check", "--strict")
+        assert res.returncode == 0, (
+            f"trajectory.py failed on the committed artifacts:\n"
+            f"{res.stdout}\n{res.stderr}"
+        )
+        assert '"trajectory": "ok"' in res.stdout
+
+    def test_import_free_of_runtime_packages(self):
+        """The sentinel runs from the campaign parent — the process that
+        must never import jax — and from bare CI boxes.  An import poison
+        proves it stays stdlib-only."""
+        poison = (
+            "import builtins, runpy, sys\n"
+            "real_import = builtins.__import__\n"
+            "def guarded(name, *a, **k):\n"
+            "    root = name.split('.')[0]\n"
+            "    if root in ('lighthouse_tpu', 'jax', 'jaxlib', 'numpy'):\n"
+            "        raise ImportError('trajectory must stay import-free "
+            "of ' + root)\n"
+            "    return real_import(name, *a, **k)\n"
+            "builtins.__import__ = guarded\n"
+            "sys.argv = ['trajectory.py', '--check']\n"
+            "runpy.run_path(%r, run_name='__main__')\n"
+            % os.path.join(REPO_ROOT, "scripts", "analysis", "trajectory.py")
+        )
+        res = subprocess.run(
+            [sys.executable, "-c", poison],
+            capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+        )
+        assert res.returncode == 0, (
+            f"trajectory.py imported a runtime package:\n{res.stderr}"
+        )
+        assert "ImportError" not in res.stderr
+
+    def test_update_baseline_roundtrips_byte_identically(self):
+        with open(self.BASELINE, "rb") as f:
+            committed = f.read()
+        try:
+            res1 = _run(os.path.join("analysis", "trajectory.py"),
+                        "--update-baseline")
+            assert res1.returncode == 0, res1.stderr
+            with open(self.BASELINE, "rb") as f:
+                first = f.read()
+            assert first == committed, (
+                "--update-baseline changed the committed trajectory "
+                "baseline — an artifact's series drifted without review"
+            )
+            res2 = _run(os.path.join("analysis", "trajectory.py"),
+                        "--update-baseline")
+            assert res2.returncode == 0, res2.stderr
+            with open(self.BASELINE, "rb") as f:
+                second = f.read()
+            assert second == first
+        finally:
+            with open(self.BASELINE, "wb") as f:
+                f.write(committed)
+
+    def test_seeded_regression_fails_the_check(self, tmp_path):
+        """A 20% drop in a committed series must redden the sentinel (the
+        ribbon is ±10%) — proven against the REAL baseline, not a synthetic
+        one, so a decoupled extractor cannot pass silently."""
+        import json as _json
+        import shutil
+
+        src = os.path.join(REPO_ROOT, "BENCH_r07.json")
+        dst = tmp_path / "BENCH_r07.json"
+        shutil.copy(src, dst)
+        doc = _json.loads(dst.read_text())
+        doc["serve"]["p99_speedup_min"] *= 0.8
+        dst.write_text(_json.dumps(doc))
+        res = _run(os.path.join("analysis", "trajectory.py"),
+                   "--check", "--artifacts-dir", str(tmp_path))
+        assert res.returncode == 1, res.stdout + res.stderr
+        assert "serve.p99_speedup_min|cpu" in res.stderr
+        assert "fell below the ribbon floor" in res.stderr
+
+
+class TestBlackboxImportFree:
+    def test_blackbox_runs_without_jax(self, tmp_path):
+        """The incident journal must stay importable AND functional with
+        jax banned: the campaign parent (which must never import jax)
+        journals phase lifecycle through it and freezes bundles on phase
+        death.  Emit, capture, and the snapshot gather all run under the
+        poison — a bundle with error-stubbed sections would mean a seam
+        module grew a top-level jax import."""
+        probe = (
+            "import builtins, json, sys\n"
+            "real_import = builtins.__import__\n"
+            "def guarded(name, *a, **k):\n"
+            "    if name.split('.')[0] in ('jax', 'jaxlib'):\n"
+            "        raise ImportError('blackbox must stay jax-free')\n"
+            "    return real_import(name, *a, **k)\n"
+            "builtins.__import__ = guarded\n"
+            "from lighthouse_tpu import blackbox\n"
+            "blackbox.configure(directory=%r, retain_bundles=4)\n"
+            "blackbox.emit('test', 'poison_probe', op='bls_verify')\n"
+            "cap = blackbox.capture('lint_probe')\n"
+            "bundle = blackbox.load_bundle("
+            "    cap['path'].rsplit('/', 1)[-1])\n"
+            "assert bundle['journal'], 'journal window empty'\n"
+            "for section in ('supervisor', 'mesh', 'pipeline',\n"
+            "                'autotune', 'telemetry'):\n"
+            "    snap = bundle['snapshots'][section]\n"
+            "    assert 'error' not in (snap or {}), (section, snap)\n"
+            "print('BLACKBOX_POISON_OK')\n"
+        ) % str(tmp_path / "bundles")
+        res = subprocess.run(
+            [sys.executable, "-c", probe],
+            capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert res.returncode == 0, res.stderr
+        assert "BLACKBOX_POISON_OK" in res.stdout

@@ -7,7 +7,7 @@ import os
 
 import pytest
 
-from lighthouse_tpu import fault_injection
+from lighthouse_tpu import blackbox, fault_injection
 from lighthouse_tpu.crypto.bls.backends import set_backend
 from lighthouse_tpu.scenarios import (
     SCENARIOS,
@@ -18,11 +18,14 @@ from lighthouse_tpu.scenarios import (
 
 
 @pytest.fixture(autouse=True)
-def _fake():
+def _fake(tmp_path):
     set_backend("fake")
     fault_injection.reset_for_tests()
+    blackbox.reset_for_tests()
+    blackbox.configure(directory=str(tmp_path / "postmortems"))
     yield
     fault_injection.reset_for_tests()
+    blackbox.reset_for_tests()
     set_backend("host")
 
 
@@ -52,6 +55,18 @@ def test_smoke_partition_scenario(tmp_path):
     assert on_disk["passed"]
     assert "schedule_digest" in on_disk["net"]
     assert "timeline" in on_disk
+    # the black box journaled the run: every timeline event landed in the
+    # incident journal keyed on the fleet's VIRTUAL slot (the runner
+    # installs its sim clock as the fault-injection slot provider)
+    window = blackbox.JOURNAL.window(source="scenario")
+    assert any(r["event"] == "run_start"
+               and r.get("scenario") == "smoke_partition" for r in window)
+    timeline_events = [r for r in window
+                       if r.get("scenario") == "smoke_partition"
+                       and r["event"] != "run_start"]
+    assert timeline_events, "scenario timeline events never hit the journal"
+    assert all(isinstance(r["slot"], int) for r in timeline_events), (
+        "journal records in a virtual-time soak must key on the sim slot")
 
 
 def test_failed_gate_still_writes_artifact(tmp_path):
@@ -69,6 +84,17 @@ def test_failed_gate_still_writes_artifact(tmp_path):
         artifact = json.load(f)
     assert not artifact["passed"]
     assert "failure" in artifact
+    # ISSUE 17: the gate failure froze a postmortem bundle and the SOAK
+    # artifact names it — an unattended soak failure triages from one file
+    bundle_path = artifact.get("postmortem_bundle")
+    assert bundle_path and os.path.exists(bundle_path)
+    with open(bundle_path) as f:
+        bundle = json.load(f)
+    assert bundle["reason"] == "scenario_gate:doomed"
+    assert bundle["extra"]["failure"] == artifact["failure"]
+    assert any(r["source"] == "scenario" and r["event"] == "run_start"
+               and r.get("scenario") == "doomed"
+               for r in bundle["journal"])
 
 
 @pytest.mark.slow
