@@ -65,7 +65,7 @@ import os
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-from . import blackbox, metrics
+from . import blackbox, locksmith, metrics
 from .logs import get_logger
 
 log = get_logger("autotune")
@@ -121,7 +121,7 @@ AUTOTUNE_FQ_MEASURE_SECONDS = metrics.histogram(
 # ------------------------------------------------------------------- mode
 
 _MODE: Optional[str] = None
-_MODE_LOCK = threading.Lock()
+_MODE_LOCK = locksmith.lock("autotune._MODE_LOCK")
 
 
 def mode() -> str:
@@ -195,7 +195,7 @@ _VOCABS: Dict[str, VocabSpec] = {}
 #: hot bucket_vocabulary() path reads it without the lock.
 _MERGED: Dict[str, Tuple[int, ...]] = {}
 _OVERLAY: Dict[str, Tuple[int, ...]] = {}
-_OVERLAY_LOCK = threading.Lock()
+_OVERLAY_LOCK = locksmith.lock("autotune._OVERLAY_LOCK")
 
 #: Fast-path flag: True iff the overlay is non-empty AND the mode allows
 #: it — bucket_vocabulary() is on every device dispatch, so the off case
@@ -251,7 +251,7 @@ def _set_overlay(name: str, buckets: Tuple[int, ...]) -> None:
 # -------------------------------------------------------------- budget gate
 
 _BUDGET_CACHE: Tuple[Optional[float], frozenset] = (None, frozenset())
-_BUDGET_LOCK = threading.Lock()
+_BUDGET_LOCK = locksmith.lock("autotune._BUDGET_LOCK")
 
 
 def budget_keys() -> frozenset:
@@ -286,7 +286,7 @@ class Controller:
     replay is wall-clock-free by construction."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = locksmith.lock("Controller._lock")
         self.evaluations = 0
         self._decisions: List[dict] = []
         self._decision_seq = 0
@@ -843,7 +843,7 @@ def _write_fq_cache(key: str, decision: dict) -> None:
 
 _THREAD: Optional[threading.Thread] = None
 _THREAD_STOP: Optional[threading.Event] = None
-_THREAD_LOCK = threading.Lock()
+_THREAD_LOCK = locksmith.lock("autotune._THREAD_LOCK")
 
 
 def maybe_start_from_env() -> Optional[threading.Thread]:

@@ -48,7 +48,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
-from . import metrics
+from . import locksmith, metrics
 from .logs import get_logger
 
 log = get_logger("blackbox")
@@ -103,7 +103,7 @@ class Journal:
     def __init__(self, capacity: int = JOURNAL_CAPACITY):
         self.capacity = capacity
         self._buf: deque = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = locksmith.lock("Journal._lock")
         self._seq = 0
 
     def append(self, record: dict) -> dict:
@@ -183,7 +183,7 @@ def emit(source: str, event: str, *, trace_id: Optional[str] = None,
 #: HTTP server registers its admission controller here; anything process-
 #: local that a 3am triage would want can join.
 _SNAPSHOTTERS: Dict[str, Callable[[], Any]] = {}
-_SNAPSHOTTERS_LOCK = threading.Lock()
+_SNAPSHOTTERS_LOCK = locksmith.lock("blackbox._SNAPSHOTTERS_LOCK")
 
 
 def register_snapshot(name: str, fn: Callable[[], Any]) -> None:
@@ -210,7 +210,7 @@ def _safe(fn: Callable[[], Any]) -> Any:
 #: Serializes captures AND guards the index/dir state.  Module-level (not
 #: per-object): captures are rare, seconds-scale events — serializing the
 #: whole freeze keeps bundle contents internally consistent.
-_CAPTURE_LOCK = threading.Lock()
+_CAPTURE_LOCK = locksmith.lock("blackbox._CAPTURE_LOCK")
 _CAPTURE_SEQ = 0
 _INDEX: deque = deque(maxlen=64)
 _DIR_OVERRIDE: Optional[str] = None

@@ -50,7 +50,7 @@ import re
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from . import blackbox, metrics
+from . import blackbox, locksmith, metrics
 from .logs import get_logger
 from .timeout_lock import TimeoutLock
 
@@ -121,7 +121,7 @@ class MeshState:
     """The process-wide mesh: device roster, breakers, topology generation."""
 
     def __init__(self) -> None:
-        self._lock = TimeoutLock("device_mesh")
+        self._lock = TimeoutLock("device_mesh", label="MeshState._lock")
         self._configured = False
         self._devices: List[Any] = []          # live mesh members, id order
         self._mesh = None                      # jax.sharding.Mesh | None
@@ -479,7 +479,7 @@ class ShardedEntry:
         self.arg_batched: Tuple[bool, ...] = tuple(
             name in batched for name in params
         )
-        self._cache_lock = threading.Lock()
+        self._cache_lock = locksmith.lock("ShardedEntry._cache_lock")
         self._jitted: Dict[int, Any] = {}  # generation -> jitted wrapper
 
     # ------------------------------------------------------------- specs

@@ -43,7 +43,7 @@ import threading
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
-from .. import metrics, tracing
+from .. import locksmith, metrics, tracing
 
 #: Topics a cached route may declare.  ``head`` and ``finalized_checkpoint``
 #: prune dead-fingerprint entries; ``block``/``chain_reorg`` additionally
@@ -96,7 +96,7 @@ class ResponseCache:
         self.chain = chain
         self.capacity = capacity if capacity is not None else default_capacity()
         self._entries: "OrderedDict[Tuple, CacheEntry]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = locksmith.lock("ResponseCache._lock")
         self._attached_bus = None
         self.hits = 0
         self.misses = 0
@@ -168,8 +168,9 @@ class ResponseCache:
             if entry is not None:
                 self._entries.move_to_end(key)
                 self.hits += 1
+            else:
+                self.misses += 1
         if entry is None:
-            self.misses += 1
             metrics.HTTP_CACHE_MISSES.inc(route=route)
             return None
         metrics.HTTP_CACHE_HITS.inc(route=route)

@@ -1,0 +1,15 @@
+"""Static lock-acquisition graph — GENERATED, do not edit by hand.
+
+Produced by ``scripts/check_static.py --update-baseline`` from
+``scripts/analysis/lock_order_pass.acquisition_edges``: every ``(held,
+then_acquired)`` lock-label pair the static pass observed across the
+scanned tree.  ``lighthouse_tpu/locksmith.py`` cross-checks dynamic
+acquisition sequences against this committed graph at test time;
+``scripts/check_static.py`` fails when the committed tuple drifts from
+the computed one, so the runtime sanitizer can never silently prove a
+stale graph.
+"""
+
+EDGES = (
+    ("DeviceArbiter._lock", "DeviceArbiter._stats"),
+)

@@ -1,0 +1,242 @@
+"""Lock-ownership registry: which lock guards which shared mutable state.
+
+ROADMAP items 2 (multi-process mesh / decoupled device service) and 4
+(virtual-time scenario engine) both multiply the number of thread roots
+touching the runtime's shared state.  Today the mapping from "this lock"
+to "these attributes" is folklore living in docstrings — the exact failure
+mode the ``ops/batch_axes.py`` registry was built to kill for sharding.
+This registry is the concurrency counterpart: one entry per lock in the
+concurrent subsystems, naming the attributes (instance attributes for
+class locks, module globals for module-level locks) that must only be
+written while that lock is held.
+
+Consumed two ways:
+
+- the **race static pass** (``scripts/analysis/race_pass.py``) reads this
+  file via ``ast.literal_eval`` (check_static stays import-free of
+  ``lighthouse_tpu``) and flags (a) writes to a registered attribute
+  reachable from two or more thread roots without the owning lock held,
+  and (b) registry rot — a lock in a scanned module missing here, or an
+  entry naming a lock/attribute that no longer exists;
+- the **runtime lock sanitizer** (``lighthouse_tpu/locksmith.py``)
+  imports it when ``LIGHTHOUSE_TPU_LOCK_SANITIZE=1`` to install write
+  guards: a write to a registered attribute while the owning sanitized
+  lock is NOT held by the writing thread becomes a test failure.
+
+Keys are repo-relative paths; per file, ``classes`` maps
+``ClassName -> {lock_attr: [guarded instance attrs]}`` and ``module``
+maps ``LOCK_GLOBAL -> [guarded module globals]``.  This module must stay
+a plain dict literal with no imports: the static pass parses it, never
+imports it.
+
+Registration discipline: register the attributes a lock's docstring/
+comments claim it guards AND that every write site actually honors.
+Attributes that are deliberately written lock-free (benign races,
+single-writer fast-path flags like ``fault_injection.ACTIVE``) stay out
+of the registry — the race pass's job is enforcing the contract, not
+inventing one.
+"""
+
+#: lock -> guarded-state contract per concurrent module (see module
+#: docstring; race_pass.py enforces completeness of this mapping).
+LOCK_OWNERSHIP = {
+    "lighthouse_tpu/device_supervisor.py": {
+        "classes": {
+            "CircuitBreaker": {
+                "_lock": [
+                    "_state",
+                    "_consecutive_failures",
+                    "_opened_at",
+                    "_probe_successes",
+                    "trips_total",
+                    "probes_total",
+                    "last_failure",
+                ],
+            },
+            "DeviceSupervisor": {
+                "_lock": ["_breakers", "_workers", "_deadlines", "_config"],
+            },
+        },
+        "module": {},
+    },
+    "lighthouse_tpu/device_pipeline.py": {
+        "classes": {
+            "DeviceArbiter": {
+                # _lock is the dispatch slot itself (a gate, not a guard):
+                # registered with no guarded attributes so the race pass
+                # knows it is accounted for, not forgotten.
+                "_lock": [],
+                "_stats": ["_grants", "_wait_s", "_holder"],
+            },
+            # batches_total is NOT registered: it is single-writer state,
+            # incremented only by the one exec/worker thread and read
+            # lock-free by summary() (benign monitoring read) — the
+            # runtime sanitizer proved the over-claim when it was listed.
+            "DevicePipeline": {
+                "_cond": [
+                    "_pending",
+                    "_pending_sets",
+                    "_in_flight_groups",
+                    "_shutdown",
+                    "groups_total",
+                    "sets_total",
+                ],
+            },
+            "HashPipeline": {
+                "_cond": [
+                    "_pending",
+                    "_pending_blocks",
+                    "_in_flight_groups",
+                    "_shutdown",
+                    "groups_total",
+                    "blocks_total",
+                ],
+            },
+            "JobPipeline": {
+                "_lock": ["_pending", "_shutdown", "jobs_total"],
+            },
+        },
+        "module": {
+            "_LOCK": ["_PIPELINE", "_HASH_PIPELINE", "_JOB_PIPELINES"],
+        },
+    },
+    "lighthouse_tpu/device_mesh.py": {
+        "classes": {
+            "MeshState": {
+                "_lock": [
+                    "_configured",
+                    "_devices",
+                    "_mesh",
+                    "_full_size",
+                    "_generation",
+                    "_reshards_total",
+                    "_breakers",
+                    "_threshold",
+                ],
+            },
+            "ShardedEntry": {
+                "_cache_lock": ["_jitted"],
+            },
+        },
+        "module": {},
+    },
+    "lighthouse_tpu/blackbox.py": {
+        "classes": {
+            "Journal": {
+                "_lock": ["_buf", "_seq"],
+            },
+        },
+        "module": {
+            "_SNAPSHOTTERS_LOCK": ["_SNAPSHOTTERS"],
+            "_CAPTURE_LOCK": ["_CAPTURE_SEQ", "_INDEX"],
+        },
+    },
+    "lighthouse_tpu/autotune.py": {
+        "classes": {
+            "Controller": {
+                "_lock": [
+                    "evaluations",
+                    "_decisions",
+                    "_decision_seq",
+                    "_pin",
+                    "_pin_applied",
+                    "_pin_loaded_env",
+                    "_warmups",
+                ],
+            },
+        },
+        "module": {
+            "_MODE_LOCK": ["_MODE"],
+            "_OVERLAY_LOCK": ["_OVERLAY", "_MERGED"],
+            "_BUDGET_LOCK": ["_BUDGET_CACHE"],
+            "_THREAD_LOCK": ["_THREAD", "_THREAD_STOP"],
+        },
+    },
+    "lighthouse_tpu/fault_injection.py": {
+        "classes": {
+            "FaultRegistry": {
+                "_lock": ["_plans", "_next_id"],
+            },
+        },
+        "module": {},
+    },
+    "lighthouse_tpu/scheduler/processor.py": {
+        "classes": {
+            "BeaconProcessor": {
+                "_lock": ["_queues", "_active_workers", "_shutdown"],
+            },
+            "ReprocessQueue": {
+                "_lock": [
+                    "_by_time",
+                    "_awaiting_root",
+                    "_seq",
+                    "_n_awaiting",
+                    "_shutdown",
+                ],
+            },
+        },
+        "module": {},
+    },
+    "lighthouse_tpu/scheduler/admission.py": {
+        "classes": {
+            "AdmissionController": {
+                "_lock": ["_inflight", "_ewma", "_done", "shed"],
+            },
+        },
+        "module": {},
+    },
+    "lighthouse_tpu/http_api/response_cache.py": {
+        "classes": {
+            "ResponseCache": {
+                "_lock": [
+                    "_entries",
+                    "hits",
+                    "misses",
+                    "invalidated",
+                    "generation",
+                ],
+            },
+        },
+        "module": {},
+    },
+    # Scenario soak: the runner itself owns no locks (it drives the Hub's
+    # fabric and the nodes' own locked subsystems) — an empty entry keeps
+    # the file under registry-rot audit so a lock added here later must be
+    # registered.
+    "lighthouse_tpu/scenarios.py": {
+        "classes": {},
+        "module": {},
+    },
+    "lighthouse_tpu/network/transport.py": {
+        "classes": {
+            "Hub": {
+                "_lock": [
+                    "_endpoints",
+                    "_links",
+                    "_partitions",
+                    "_link_plans",
+                    "_default_plan",
+                    "_link_seq",
+                    "_delayed",
+                    "_delayed_seq",
+                    "_tick",
+                    "_counters",
+                    "_schedule",
+                ],
+            },
+        },
+        "module": {},
+    },
+}
+
+#: Lock-order edges the runtime sanitizer accepts even though the static
+#: graph does not contain them, as ``(first_acquired, then_acquired)``
+#: label pairs with a reason.  Cross-object edges are outside the static
+#: pass's per-class scope (ANALYSIS.md); list here ONLY pairs that are
+#: provably acyclic in the wider graph.
+SANCTIONED_ORDER_PAIRS = {
+    # The arbiter's stats lock nests strictly inside the slot lock and is
+    # never held across any other acquisition.
+    ("DeviceArbiter._lock", "DeviceArbiter._stats"):
+        "leaf stats lock, nests one way inside the slot",
+}

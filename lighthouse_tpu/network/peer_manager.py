@@ -60,15 +60,22 @@ class PeerInfo:
 
 
 class PeerManager:
-    def __init__(self, target_peers: int = DEFAULT_TARGET_PEERS):
+    def __init__(self, target_peers: int = DEFAULT_TARGET_PEERS,
+                 clock=time.monotonic):
+        # Injectable clock (same seam as RateLimiter): score decay and ban
+        # lifts are control-path time — a scenario/virtual-time harness
+        # supplies its own clock so decay cannot race thresholds against
+        # host load (ROADMAP item 4; wallclock_pass holds this line).
         self.peers: Dict[str, PeerInfo] = {}
         self.target_peers = target_peers
+        self._clock = clock
         self._disconnect_requests: List[str] = []
 
     def _peer(self, peer_id: str) -> PeerInfo:
         info = self.peers.get(peer_id)
         if info is None:
-            info = self.peers[peer_id] = PeerInfo(peer_id)
+            info = self.peers[peer_id] = PeerInfo(
+                peer_id, last_update=self._clock())
         return info
 
     # --------------------------------------------------------- lifecycle
@@ -95,7 +102,7 @@ class PeerManager:
 
     def report(self, peer_id: str, action: str, _reason: str = "") -> None:
         """Apply a penalty (reference ``report_peer``)."""
-        now = time.monotonic()
+        now = self._clock()
         info = self._peer(peer_id)
         info.score = info.decayed_score(now) + PeerAction.PENALTIES[action]
         info.last_update = now
@@ -114,7 +121,7 @@ class PeerManager:
 
     def score(self, peer_id: str) -> float:
         info = self.peers.get(peer_id)
-        return info.decayed_score(time.monotonic()) if info else 0.0
+        return info.decayed_score(self._clock()) if info else 0.0
 
     def is_banned(self, peer_id: str) -> bool:
         info = self.peers.get(peer_id)
@@ -123,7 +130,7 @@ class PeerManager:
         if info.state != ConnectionState.BANNED:
             return False
         # bans lift once the decayed score recovers past the ban threshold
-        if info.decayed_score(time.monotonic()) > MIN_SCORE_BEFORE_BAN:
+        if info.decayed_score(self._clock()) > MIN_SCORE_BEFORE_BAN:
             info.state = ConnectionState.DISCONNECTED
             info.banned_at = None
             return False

@@ -38,6 +38,7 @@ import threading
 from dataclasses import dataclass, replace
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from .. import locksmith
 from ..metrics import (
     NET_ENVELOPES_DELAYED,
     NET_ENVELOPES_DROPPED,
@@ -145,7 +146,7 @@ class Hub:
     def __init__(self, seed: int = 0):
         self._endpoints: Dict[str, Endpoint] = {}
         self._links: Set[Tuple[str, str]] = set()
-        self._lock = threading.Lock()
+        self._lock = locksmith.lock("Hub._lock")
         self._rng = random.Random(seed)
         self.seed = seed
         self.drop_probability: float = 0.0
@@ -208,10 +209,12 @@ class Hub:
             return out
 
     def set_partition(self, peer_id: str, partition: int) -> None:
-        self._partitions[peer_id] = partition
+        with self._lock:
+            self._partitions[peer_id] = partition
 
     def clear_partitions(self) -> None:
-        self._partitions.clear()
+        with self._lock:
+            self._partitions.clear()
 
     # ------------------------------------------------------- fault fabric
 

@@ -54,7 +54,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Deque, Dict, Optional, Tuple
 
-from .. import blackbox, metrics
+from .. import blackbox, locksmith, metrics
 
 # ------------------------------------------------------------ HTTP classes
 
@@ -214,7 +214,7 @@ class AdmissionController:
                  adaptive: Optional[bool] = None):
         self._policies: Dict[str, ClassPolicy] = {p.name: p for p in policies}
         self._inflight: Dict[str, int] = {p.name: 0 for p in policies}
-        self._lock = threading.Lock()
+        self._lock = locksmith.lock("AdmissionController._lock")
         self._adaptive = adaptive
         self._ewma: Dict[str, float] = {}
         self._done: Dict[str, Deque[float]] = {

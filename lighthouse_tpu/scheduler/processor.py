@@ -39,6 +39,7 @@ from .work import (
 )
 
 
+from .. import locksmith
 from .. import metrics as _gm
 from .. import tracing
 
@@ -119,7 +120,7 @@ class BeaconProcessor:
         self._limits = dict(DEFAULT_QUEUE_LENGTHS)
         if queue_lengths:
             self._limits.update(queue_lengths)
-        self._lock = threading.Condition()
+        self._lock = locksmith.condition("BeaconProcessor._lock")
         self._active_workers = 0
         self._last_depth_sample = 0.0
         self._shutdown = False
@@ -328,7 +329,7 @@ class ReprocessQueue:
 
     def __init__(self, processor: BeaconProcessor):
         self.processor = processor
-        self._lock = threading.Condition()
+        self._lock = locksmith.condition("ReprocessQueue._lock")
         self._by_time: List = []  # heap of (due, seq, event)
         # root -> [(expires_at, event)]
         self._awaiting_root: Dict[bytes, List[tuple]] = {}

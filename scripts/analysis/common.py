@@ -13,8 +13,14 @@ from typing import Dict, Iterator, List, Optional, Set, Tuple
 #: the offending expression spans), or standalone on the line just above it.
 PRAGMA_RE = re.compile(
     r"#\s*(safe-arith|lock-order|device-purity|recompile-hazard|host-sync"
-    r"|sharding-ready):\s*ok\(([^)]*)\)"
+    r"|sharding-ready|race|wallclock|process-boundary):\s*ok\(([^)]*)\)"
 )
+
+#: The race pass's dedicated escape hatch (ISSUE 18): ``# race:
+#: sanctioned(<reason>)`` — same placement rules as ``ok(...)`` pragmas.
+#: Kept distinct from ``ok`` so a reviewed data-race waiver reads as what
+#: it is: a sanctioned racy write, not a false positive.
+RACE_SANCTIONED_RE = re.compile(r"#\s*race:\s*sanctioned\(([^)]*)\)")
 
 
 @dataclass(frozen=True)
@@ -220,9 +226,66 @@ def function_bound_names(fn: ast.AST) -> Set[str]:
     return names
 
 
+#: Lock constructors, both the raw threading/TimeoutLock forms and the
+#: ``locksmith`` factory seam (the runtime lock sanitizer, ISSUE 18).
+#: Maps ctor spelling -> kind ("lock" | "rlock" | "condition").
+_RAW_LOCK_CTORS = {
+    "Lock": "lock",
+    "TimeoutLock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+}
+_LOCKSMITH_CTORS = {"lock": "lock", "rlock": "rlock", "condition": "condition"}
+
+
+def lock_ctor_kind(call: ast.AST) -> Optional[str]:
+    """The kind of lock this call constructs, or None.  Recognizes
+    ``threading.Lock()``/``RLock()``/``Condition()``/``TimeoutLock(...)``
+    and the sanitizer factory forms ``locksmith.lock(...)``/
+    ``locksmith.rlock(...)``/``locksmith.condition(...)``."""
+    if not isinstance(call, ast.Call):
+        return None
+    name = terminal_name(call.func)
+    dotted = dotted_path(call.func) or ""
+    root = dotted.split(".")[0]
+    if root == "locksmith" and name in _LOCKSMITH_CTORS:
+        return _LOCKSMITH_CTORS[name]
+    if name in _RAW_LOCK_CTORS and root != "locksmith":
+        return _RAW_LOCK_CTORS[name]
+    return None
+
+
 #: Repo-relative path of the batch-axis registry (parsed, never imported —
 #: check_static stays import-free of lighthouse_tpu).
 BATCH_AXES_PATH = "lighthouse_tpu/ops/batch_axes.py"
+
+#: Repo-relative path of the lock-ownership registry (same discipline:
+#: parsed via ``ast.literal_eval``, never imported).
+LOCK_OWNERSHIP_PATH = "lighthouse_tpu/lock_ownership.py"
+
+
+def extract_literal(tree: ast.Module, name: str) -> Optional[dict]:
+    """A module-level ``NAME = {...}`` dict literal, or None."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    try:
+                        return ast.literal_eval(node.value)
+                    except (ValueError, SyntaxError):
+                        return None
+    return None
+
+
+def load_lock_ownership(root: str) -> Optional[dict]:
+    """Parse the committed lock-ownership registry.  None when missing or
+    malformed — the race pass turns that into a finding rather than going
+    silently blind."""
+    path = os.path.join(root, LOCK_OWNERSHIP_PATH)
+    if not os.path.exists(path):
+        return None
+    tree, _, _ = parse_file(path)
+    return extract_literal(tree, "LOCK_OWNERSHIP")
 
 
 def extract_batch_axes(tree: ast.Module) -> Optional[dict]:

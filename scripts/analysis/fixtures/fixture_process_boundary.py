@@ -1,0 +1,50 @@
+"""Seeded violations for the process-boundary pass self-test (never
+imported)."""
+
+import threading
+
+_FORK_HOSTILE = threading.Lock()  # SEEDED: fork-hostile-lock
+_REGISTRY = {}
+_CACHE = []
+_HANDLE = None
+_FROZEN = ("immutable", "tuple")  # clean: immutable module constant
+
+
+def register(key, value):
+    # SEEDED singleton-mutation: container store on a module singleton.
+    _REGISTRY[key] = value
+
+
+def enqueue(item):
+    # SEEDED singleton-mutation: mutating method call.
+    _CACHE.append(item)
+
+
+def install(handle):
+    # SEEDED singleton-mutation: global rebind of a singleton slot.
+    global _HANDLE
+    _HANDLE = handle
+
+
+def local_state_is_fine():
+    # clean: function-local mutables are per-call, not per-process
+    scratch = {}
+    scratch["k"] = 1
+    return scratch
+
+
+def read_only_is_fine():
+    # clean: reads do not diverge
+    return len(_CACHE) + len(_FROZEN)
+
+
+def pragma_site_is_fine():
+    _REGISTRY.clear()  # process-boundary: ok(fixture: demonstrates the pragma)
+
+
+class InstanceStateIsFine:
+    def __init__(self):
+        self._own = {}  # clean: instance state, no module singleton
+
+    def mutate(self):
+        self._own["k"] = 1

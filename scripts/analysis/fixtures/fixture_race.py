@@ -1,0 +1,111 @@
+"""Seeded violations for the race pass self-test (never imported).
+
+Carries a file-local ``RACE_OWNERSHIP`` table (the fixture seam — real
+modules register in ``lighthouse_tpu/lock_ownership.py``) and seeds every
+code the pass must fire, next to clean sites that prove the exemptions
+(lexical hold, always-held helper, thread confinement, ``__init__``,
+pragma/sanctioned waivers) do not over-fire.
+"""
+
+import threading
+
+RACE_OWNERSHIP = {
+    "classes": {
+        "SeededRacer": {
+            "_lock": ["_state", "_count", "_items"],
+        },
+        # SEEDED ownership-stale: this class does not exist in the file.
+        "GhostClass": {
+            "_lock": ["_x"],
+        },
+        # SEEDED ownership-stale x2: the lock is never constructed and the
+        # attribute is never written.
+        "StaleAttrs": {
+            "_missing_lock": ["_val"],
+        },
+    },
+    "module": {
+        "_MOD_LOCK": ["_SHARED"],
+        # SEEDED ownership-stale x2: neither the lock nor the global exists.
+        "_GHOST_LOCK": ["_NOPE"],
+    },
+}
+
+_MOD_LOCK = threading.Lock()
+_UNREGISTERED_LOCK = threading.Lock()  # SEEDED: unregistered-lock (module)
+_SHARED = {}
+
+
+class SeededRacer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = 0  # clean: __init__ happens-before publication
+        self._count = 0
+        self._items = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def run_inline(self):
+        self._loop()
+
+    def _loop(self):
+        # SEEDED unguarded-write: reachable from the spawn root in start()
+        # AND externally via run_inline() — two roots, no lock.
+        self._state += 1
+
+    def bump(self):
+        # SEEDED unguarded-write: public entry (external root), no lock.
+        self._count += 1
+
+    def bump_locked_is_fine(self):
+        with self._lock:
+            self._count += 1  # clean: lexical hold
+
+    def _helper(self):
+        self._state = 5  # clean: always-held — every call site holds _lock
+
+    def locked_entry_a(self):
+        with self._lock:
+            self._helper()
+
+    def locked_entry_b(self):
+        with self._lock:
+            self._helper()
+
+    def drain(self):
+        # SEEDED unguarded-write: mutating method call on a guarded attr.
+        self._items.clear()
+
+    def sanctioned_reset_is_fine(self):
+        self._count = 0  # race: sanctioned(fixture: demonstrates the waiver)
+
+    def spawn_confined(self):
+        threading.Thread(target=self._confined_writer, daemon=True).start()
+
+    def _confined_writer(self):
+        self._items.append(1)  # clean: reachable from one spawn root only
+
+
+class StaleAttrs:
+    def __init__(self):
+        self._lock = threading.Lock()  # SEEDED: unregistered-lock (class)
+
+
+def poke():
+    # SEEDED unguarded-write: public module function mutating a guarded
+    # global without its lock.
+    _SHARED["k"] = 1
+
+
+def poke_locked_is_fine():
+    with _MOD_LOCK:
+        _SHARED["k"] = 2  # clean: lexical hold on the module lock
+
+
+def rebind_locked_is_fine():
+    global _SHARED
+    with _MOD_LOCK:
+        _SHARED = {}  # clean

@@ -1,0 +1,52 @@
+"""Seeded violations for the wallclock pass self-test (never imported)."""
+
+import time
+from datetime import datetime, timezone
+from time import monotonic as mono
+
+
+def fault_window_deadline():
+    # SEEDED wallclock-read: control-path deadline from the wall clock.
+    return time.time() + 5.0
+
+
+def decay_loop():
+    # SEEDED wallclock-read x2: decay driven by the host clock.
+    start = time.monotonic()
+    while time.monotonic() - start < 1.0:
+        pass
+
+
+def stamp_with_naive_now():
+    # SEEDED wallclock-read: argless datetime.now().
+    return datetime.now()
+
+
+def bare_import_read():
+    # SEEDED wallclock-read: `from time import monotonic` spelling.
+    return mono()
+
+
+def stamp_telemetry_is_fine():
+    # clean: sanctioned context (telemetry timestamping seam)
+    return time.monotonic()
+
+
+class SanctionedSeam:
+    # clean: whole-class sanctioned context
+    def slot_anchor(self):
+        return time.time()
+
+
+def injectable_clock_is_fine(clock=time.monotonic):
+    # clean: referencing the clock function is the seam, not a read
+    return clock()
+
+
+def tz_aware_now_is_fine():
+    # clean: the ISSUE contract bans the argless naive read
+    return datetime.now(timezone.utc)
+
+
+def pragma_site_is_fine():
+    return time.monotonic()  # wallclock: ok(fixture: demonstrates the pragma)

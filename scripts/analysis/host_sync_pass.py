@@ -66,6 +66,10 @@ SCAN_DIRS = (
     # device-touching legs live in ops/compile_cache.py and ops/fq.py
     # under their own sanctioned contexts).
     "lighthouse_tpu/autotune.py",
+    # Incident black box (ISSUE 17): capture/snapshot runs on FAILURE
+    # paths, often while a device op is wedged — it must never
+    # materialize a device value (SCAN_DIRS rot fix, ISSUE 18 satellite).
+    "lighthouse_tpu/blackbox.py",
     "bench.py",
 )
 

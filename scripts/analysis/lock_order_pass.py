@@ -36,6 +36,7 @@ from .common import (
     PragmaIndex,
     Violation,
     iter_py_files,
+    lock_ctor_kind,
     parse_file,
     terminal_name,
 )
@@ -79,10 +80,11 @@ SCAN_DIRS = (
     # state behind a TimeoutLock, mutated from supervisor failure paths
     # and read per pipeline coalescing decision — same discipline.
     "lighthouse_tpu/device_mesh.py",
+    # Incident black box (ISSUE 17): journal ring + snapshotter/capture
+    # registries under locks, written from every subsystem's failure path
+    # — same discipline (SCAN_DIRS rot fix, ISSUE 18 satellite).
+    "lighthouse_tpu/blackbox.py",
 )
-
-LOCK_CTORS = frozenset({"TimeoutLock", "Lock", "RLock", "Condition"})
-REENTRANT_CTORS = frozenset({"RLock"})
 
 #: Call names that block the calling thread (receiver-based heuristics;
 #: ``.wait()`` is excluded — Condition.wait releases the held lock).
@@ -119,8 +121,8 @@ def _find_lock_defs(cls_node: ast.ClassDef) -> Dict[str, _LockDef]:
     for node in ast.walk(cls_node):
         if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
             continue
-        ctor = terminal_name(node.value.func)
-        if ctor not in LOCK_CTORS:
+        kind = lock_ctor_kind(node.value)
+        if kind is None:
             continue
         for target in node.targets:
             if (
@@ -129,7 +131,7 @@ def _find_lock_defs(cls_node: ast.ClassDef) -> Dict[str, _LockDef]:
                 and target.value.id == "self"
             ):
                 locks[target.attr] = _LockDef(
-                    cls_node.name, target.attr, ctor in REENTRANT_CTORS, node.lineno
+                    cls_node.name, target.attr, kind == "rlock", node.lineno
                 )
     return locks
 
@@ -228,7 +230,12 @@ def _method_nodes(cls_node: ast.ClassDef):
             yield item
 
 
-def run(root: str, scan_dirs: Tuple[str, ...] = SCAN_DIRS) -> List[Violation]:
+def _collect(
+    root: str, scan_dirs: Tuple[str, ...]
+) -> Tuple[List[Violation], Dict[Tuple[str, str], List[Tuple[str, str, int]]]]:
+    """Per-method walk over every scanned class: direct violations plus the
+    global acquisition-edge graph (pragma-suppressed edges excluded — a
+    sanctioned edge is not part of the enforced order)."""
     violations: List[Violation] = []
     # Global acquisition graph: (from_label, to_label) -> witness list
     edge_witness: Dict[Tuple[str, str], List[Tuple[str, str, int]]] = defaultdict(list)
@@ -309,6 +316,22 @@ def run(root: str, scan_dirs: Tuple[str, ...] = SCAN_DIRS) -> List[Violation]:
                             "`# lock-order: ok(<reason>)`",
                         )
                     )
+    return violations, edge_witness
+
+
+def acquisition_edges(
+    root: str, scan_dirs: Tuple[str, ...] = SCAN_DIRS
+) -> List[Tuple[str, str]]:
+    """The static lock-order graph as sorted ``(held, then_acquired)``
+    label pairs.  check_static generates ``lighthouse_tpu/lock_graph.py``
+    from this so the runtime sanitizer (``locksmith.py``) can cross-check
+    dynamic acquisition sequences against the committed static graph."""
+    _, edge_witness = _collect(root, scan_dirs)
+    return sorted(set(edge_witness))
+
+
+def run(root: str, scan_dirs: Tuple[str, ...] = SCAN_DIRS) -> List[Violation]:
+    violations, edge_witness = _collect(root, scan_dirs)
 
     # AB/BA inversions: for each unordered pair with edges in both
     # directions, emit one violation per direction's first witness.

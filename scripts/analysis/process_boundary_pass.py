@@ -1,0 +1,252 @@
+"""Process-boundary hazard auditor (ISSUE 18 — ahead of ROADMAP item 2).
+
+The pod-scale refactor splits the device worker into its own process and
+runs multi-process fleets.  Two idioms that are fine in one interpreter
+break at that boundary, and this pass maps every instance of them so the
+device-service split starts from a committed work list (the baseline):
+
+- ``singleton-mutation``  — a module-level mutable singleton (registry,
+  cache, pipeline handle, overlay...) written from a function body: after
+  a process split each process silently gets its own divergent copy; each
+  site must become per-process state behind an explicit init, or move to
+  the shared service.  Writes = ``global X`` rebinds, ``X[k] = v`` /
+  ``del X[k]`` subscript stores, and mutating method calls
+  (``append``/``update``/``clear``/...).
+- ``fork-hostile-lock``   — a lock constructed at module import time: an
+  ``os.fork`` while any thread holds it leaves the child's copy locked
+  forever (CPython locks do not fork cleanly), and a lock created before
+  the process split guards nothing across it.  Module locks must be
+  re-created in a post-fork/post-spawn init hook when item 2 lands.
+
+Reads of module state are not flagged — the hazard is divergent writes.
+``__init__``-time instance state, function locals, and class attributes
+are out of scope.  Suppress intentional sites with
+``# process-boundary: ok(<reason>)`` or baseline them: the baseline IS
+the item-2 work list, the same way the wallclock baseline is item 4's.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .common import (
+    Violation,
+    iter_py_files,
+    lock_ctor_kind,
+    parse_file,
+)
+
+PASS = "process-boundary"
+
+SCAN_DIRS = (
+    # the device-service cut points (ROADMAP item 2): everything that
+    # today lives in one interpreter and tomorrow straddles the split
+    "lighthouse_tpu/device_pipeline.py",
+    "lighthouse_tpu/device_supervisor.py",
+    "lighthouse_tpu/device_mesh.py",
+    "lighthouse_tpu/device_telemetry.py",
+    "lighthouse_tpu/autotune.py",
+    "lighthouse_tpu/blackbox.py",
+    "lighthouse_tpu/fault_injection.py",
+    "lighthouse_tpu/ops/shuffle_device.py",
+    # request/worker surfaces that would talk to the device service
+    "lighthouse_tpu/http_api",
+    "lighthouse_tpu/scheduler",
+)
+
+#: Mutating receiver methods (shared with the race pass's model).
+_MUTATORS = frozenset(
+    {
+        "append", "appendleft", "extend", "insert", "add", "update",
+        "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+        "clear", "sort", "reverse", "rotate", "move_to_end",
+    }
+)
+
+
+def _module_singletons(tree: ast.Module) -> Dict[str, int]:
+    """Module-level names bound to mutable values: container literals/
+    comprehensions, or constructor calls (excluding locks — those are the
+    ``fork-hostile-lock`` code's job)."""
+    out: Dict[str, int] = {}
+    for stmt in tree.body:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if value is None:
+            continue
+        mutable = isinstance(
+            value,
+            (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp,
+             ast.SetComp),
+        ) or (isinstance(value, ast.Call) and lock_ctor_kind(value) is None)
+        if not mutable:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = stmt.lineno
+    return out
+
+
+def _rebind_targets(tree: ast.Module) -> Set[str]:
+    """Names rebound via ``global X`` anywhere in the module — a
+    ``X = None`` singleton slot at top level is still process-divergent
+    state when workers rebind it."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Global):
+            names.update(node.names)
+    return names
+
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self, singletons: Set[str]):
+        self.singletons = singletons
+        self.scope: List[str] = []
+        self.globals_declared: List[Set[str]] = []
+        # (ctx, name, how, line, node)
+        self.hits: List[Tuple[str, str, str, int, ast.AST]] = []
+
+    @property
+    def context(self) -> str:
+        return ".".join(self.scope) if self.scope else "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.scope.append(node.name)
+        self.globals_declared.append(set())
+        self.generic_visit(node)
+        self.globals_declared.pop()
+        self.scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Global(self, node: ast.Global) -> None:
+        if self.globals_declared:
+            self.globals_declared[-1].update(node.names)
+
+    def _declared(self, name: str) -> bool:
+        return any(name in g for g in self.globals_declared)
+
+    def _hit(self, name: str, how: str, node: ast.AST) -> None:
+        self.hits.append((self.context, name, how, node.lineno, node))
+
+    def _root(self, expr: ast.AST) -> Tuple[Optional[str], int]:
+        depth = 0
+        while isinstance(expr, (ast.Subscript, ast.Attribute)):
+            expr = expr.value
+            depth += 1
+        if isinstance(expr, ast.Name):
+            return expr.id, depth
+        return None, depth
+
+    def _handle_store(self, target: ast.AST, node: ast.AST) -> None:
+        if not self.scope:  # module-level init assignments are the seed
+            return
+        name, depth = self._root(target)
+        if name is None or name not in self.singletons:
+            return
+        if depth == 0:
+            if self._declared(name):
+                self._hit(name, "global rebind", node)
+        else:
+            self._hit(name, "container store", node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for elt in t.elts:
+                    self._handle_store(elt, node)
+            else:
+                self._handle_store(t, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._handle_store(node.target, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._handle_store(node.target, node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            if isinstance(t, (ast.Subscript, ast.Attribute)):
+                self._handle_store(t, node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if (
+            self.scope
+            and isinstance(func, ast.Attribute)
+            and func.attr in _MUTATORS
+        ):
+            name, _depth = self._root(func.value)
+            if name is not None and name in self.singletons:
+                self._hit(name, f".{func.attr}()", node)
+        self.generic_visit(node)
+
+
+def run(root: str, scan_dirs: Tuple[str, ...] = SCAN_DIRS) -> List[Violation]:
+    violations: List[Violation] = []
+    for abs_path, rel_path in iter_py_files(root, scan_dirs):
+        tree, _, pragmas = parse_file(abs_path)
+
+        # fork-hostile module-level locks
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and lock_ctor_kind(stmt.value):
+                for t in stmt.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if pragmas.suppresses(PASS, stmt):
+                        continue
+                    violations.append(
+                        Violation(
+                            PASS, rel_path, stmt.lineno, "fork-hostile-lock",
+                            "<module>",
+                            f"module-level lock `{t.id}` is created at "
+                            "import time — it forks in unknown state and "
+                            "guards nothing across the item-2 process "
+                            "split; plan a post-fork init or annotate "
+                            "`# process-boundary: ok(<reason>)`",
+                        )
+                    )
+
+        singletons = set(_module_singletons(tree)) | (
+            _rebind_targets(tree) & {
+                t.id
+                for stmt in tree.body
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign))
+                for t in (
+                    stmt.targets if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                if isinstance(t, ast.Name)
+            }
+        )
+        w = _Walker(singletons)
+        w.visit(tree)
+        for ctx, name, how, line, node in w.hits:
+            if pragmas.suppresses(PASS, node):
+                continue
+            violations.append(
+                Violation(
+                    PASS, rel_path, line, "singleton-mutation", ctx,
+                    f"module singleton `{name}` mutated ({how}) from a "
+                    "function body — divergent per-process copies after "
+                    "the item-2 split; move behind a per-process init/"
+                    "service seam or annotate "
+                    "`# process-boundary: ok(<reason>)`",
+                )
+            )
+    return violations

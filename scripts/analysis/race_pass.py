@@ -1,0 +1,646 @@
+"""Shared-state race auditor (ISSUE 18).
+
+Enforces the lock-ownership registry (``lighthouse_tpu/lock_ownership.py``,
+parsed via ``ast.literal_eval`` — never imported): every lock in the
+concurrent subsystems declares the attributes it guards, and this pass
+flags writes to registered attributes that can race.
+
+- ``unguarded-write``   — a write (rebind, ``x[k] = v``/``del x[k]``, or a
+  mutating method call: ``append``/``update``/...) to a registered
+  attribute without the owning lock held, in code reachable from two or
+  more thread roots;
+- ``unregistered-lock`` — a lock constructed in a scanned module that the
+  registry does not know about (register it, with an empty guard list if
+  it is a pure gate like ``DeviceArbiter._lock``);
+- ``ownership-stale``   — registry rot: an entry naming a file, class,
+  lock, or attribute that no longer exists (or an attribute claimed by
+  two locks at once);
+- ``registry-missing``  — the registry file itself is absent or is no
+  longer a plain dict literal.
+
+Thread-root model: every ``threading.Thread``/``threading.Timer`` target
+and executor ``submit`` callee found in the file is a spawn root, and
+every public function/method is entered under the synthetic ``external``
+root — public entries on a registered class admit arbitrary caller
+threads, which is exactly why the state carries a lock.  Roots propagate
+through the same-file call graph (``self.m()`` within a class, bare-name
+calls between module functions, nested defs from their enclosing
+function).  A write is exempt as *thread-confined* only when its unit is
+reachable from at most one spawn root and from no public entry.
+
+Held-lock tracking is lexical through ``with`` nesting (``with
+self._lock:`` / ``with _LOCK:``), plus an "always-held" fixpoint: a
+private helper whose every same-file call site holds lock L is analyzed
+as holding L (the ``CircuitBreaker._transition`` idiom).  ``__init__``
+bodies are exempt — construction happens-before publication.  Manual
+``acquire()``/``release()`` pairs and cross-object calls are out of
+scope (documented in ANALYSIS.md).
+
+Scanned files may carry a file-local ``RACE_OWNERSHIP`` dict literal
+(same shape as one registry value) instead of a central entry — that is
+how the self-test fixture stays self-contained.
+
+Escape hatch: ``# race: sanctioned(<reason>)`` on (or adjacent to) the
+write — the reviewed-data-race waiver.  ``# race: ok(<reason>)`` also
+works for pass false positives; both are baselined like every pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .common import (
+    LOCK_OWNERSHIP_PATH,
+    PragmaIndex,
+    RACE_SANCTIONED_RE,
+    Violation,
+    extract_literal,
+    iter_py_files,
+    load_lock_ownership,
+    lock_ctor_kind,
+    parse_file,
+    terminal_name,
+)
+
+PASS = "race"
+
+SCAN_DIRS = (
+    "lighthouse_tpu/device_supervisor.py",
+    "lighthouse_tpu/device_pipeline.py",
+    "lighthouse_tpu/device_mesh.py",
+    "lighthouse_tpu/blackbox.py",
+    "lighthouse_tpu/autotune.py",
+    "lighthouse_tpu/fault_injection.py",
+    "lighthouse_tpu/scheduler",
+    "lighthouse_tpu/http_api/response_cache.py",
+    "lighthouse_tpu/scenarios.py",
+    "lighthouse_tpu/network/transport.py",
+)
+
+EXTERNAL_ROOT = "external"
+
+#: Receiver methods that mutate their receiver in place.
+MUTATORS = frozenset(
+    {
+        "append", "appendleft", "extend", "insert", "add", "update",
+        "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+        "clear", "sort", "reverse", "rotate", "move_to_end",
+    }
+)
+
+_SPAWN_CTORS = frozenset({"Thread", "Timer"})
+
+_MODULE = "<module>"
+
+
+def _sanctioned_lines(source: str) -> Set[int]:
+    lines: Set[int] = set()
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        if RACE_SANCTIONED_RE.search(text):
+            lines.add(lineno)
+    return lines
+
+
+def _span_hits(lines: Set[int], node: ast.AST) -> bool:
+    start = getattr(node, "lineno", None)
+    if start is None or not lines:
+        return False
+    end = getattr(node, "end_lineno", start) or start
+    return bool(lines.intersection(range(start - 1, end + 2)))
+
+
+def _self_attr_root(expr: ast.AST) -> Optional[str]:
+    """``self.a``/``self.a.b``/``self.a[k]...`` → ``a``; else None."""
+    while isinstance(expr, (ast.Subscript, ast.Attribute)):
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            return expr.attr
+        expr = expr.value
+    return None
+
+
+def _name_root(expr: ast.AST) -> Tuple[Optional[str], int]:
+    """``X``/``X[k]``/``X.attr`` → (``X``, chain depth)."""
+    depth = 0
+    while isinstance(expr, (ast.Subscript, ast.Attribute)):
+        expr = expr.value
+        depth += 1
+    if isinstance(expr, ast.Name):
+        return expr.id, depth
+    return None, depth
+
+
+#: Lock labels: ``(owning_class, attr)`` for instance locks,
+#: ``(_MODULE, name)`` for module-level locks.
+Label = Tuple[str, str]
+
+
+def _render_label(label: Label) -> str:
+    cls, name = label
+    return name if cls == _MODULE else f"{cls}.{name}"
+
+
+class _ScopeWalker(ast.NodeVisitor):
+    """Walks one function/method body: lexical held-lock tracking, guarded
+    writes, same-file calls, thread spawns, nested defs."""
+
+    def __init__(
+        self,
+        key: str,
+        cls: str,  # _MODULE for module functions
+        class_locks: Set[str],  # lock attrs of `cls` (held via self.X)
+        module_locks: Set[str],  # module lock globals (held via bare X)
+        class_guarded: Dict[str, str],  # attr -> owning lock attr (for cls)
+        module_guarded: Dict[str, str],  # global -> owning lock global
+        record_writes: bool,  # False inside __init__ (happens-before)
+    ):
+        self.key = key
+        self.cls = cls
+        self.class_locks = class_locks
+        self.module_locks = module_locks
+        self.class_guarded = class_guarded
+        self.module_guarded = module_guarded
+        self.record_writes = record_writes
+        self.held: List[Label] = []
+        # (owner_label, written_name, held_snapshot, line, node)
+        self.writes: List[Tuple[Label, str, Tuple[Label, ...], int, ast.AST]] = []
+        # (kind "self"|"mod", name, held_snapshot)
+        self.calls: List[Tuple[str, str, Tuple[Label, ...]]] = []
+        self.spawns: List[Tuple[str, str]] = []  # (kind "self"|"name", name)
+        self.nested: Dict[str, ast.AST] = {}
+        self.globals_declared: Set[str] = set()
+        self.attr_stores: Set[str] = set()  # every self.X written (rot audit)
+        self.global_stores: Set[str] = set()  # every guarded global written
+
+    # -- held tracking ---------------------------------------------------
+    def _lock_of(self, expr: ast.AST) -> Optional[Label]:
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and expr.attr in self.class_locks
+        ):
+            return (self.cls, expr.attr)
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return (_MODULE, expr.id)
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        entered = 0
+        for item in node.items:
+            label = self._lock_of(item.context_expr)
+            if label is not None:
+                self.held.append(label)
+                entered += 1
+        self.generic_visit(node)
+        for _ in range(entered):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self.globals_declared.update(node.names)
+
+    # -- writes ----------------------------------------------------------
+    def _record_write(self, owner: Label, name: str, node: ast.AST) -> None:
+        if self.record_writes:
+            self.writes.append(
+                (owner, name, tuple(self.held), node.lineno, node)
+            )
+
+    def _handle_store(self, target: ast.AST, node: ast.AST) -> None:
+        attr = _self_attr_root(target)
+        if attr is not None:
+            self.attr_stores.add(attr)
+            lock = self.class_guarded.get(attr)
+            if lock is not None:
+                self._record_write((self.cls, lock), attr, node)
+            return
+        name, depth = _name_root(target)
+        if name is None or name not in self.module_guarded:
+            return
+        # depth 0 rebinding only writes the global when declared `global`;
+        # depth > 0 (X[k] = v, X.attr = v) mutates whatever X names — for a
+        # registry-listed global that is the shared object (a same-named
+        # local shadowing it would be its own smell).
+        if depth == 0 and name not in self.globals_declared:
+            return
+        self.global_stores.add(name)
+        self._record_write((_MODULE, self.module_guarded[name]), name, node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            if isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    self._handle_store(elt, node)
+            else:
+                self._handle_store(target, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._handle_store(node.target, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._handle_store(node.target, node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            if isinstance(target, (ast.Subscript, ast.Attribute)):
+                self._handle_store(target, node)
+        self.generic_visit(node)
+
+    # -- calls / spawns --------------------------------------------------
+    def _spawn_target(self, expr: ast.AST) -> None:
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            self.spawns.append(("self", expr.attr))
+        elif isinstance(expr, ast.Name):
+            self.spawns.append(("name", expr.id))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+        ):
+            self.calls.append(("self", func.attr, tuple(self.held)))
+        elif isinstance(func, ast.Name):
+            self.calls.append(("mod", func.id, tuple(self.held)))
+        # mutating method call on a guarded receiver
+        if isinstance(func, ast.Attribute) and func.attr in MUTATORS:
+            self._handle_store(func.value, node)
+        # thread spawns
+        ctor = terminal_name(func)
+        if ctor in _SPAWN_CTORS:
+            for kw in node.keywords:
+                if kw.arg in ("target", "function"):
+                    self._spawn_target(kw.value)
+            if ctor == "Timer" and len(node.args) >= 2:
+                self._spawn_target(node.args[1])
+        elif isinstance(func, ast.Attribute) and func.attr == "submit" and node.args:
+            self._spawn_target(node.args[0])
+        self.generic_visit(node)
+
+    # -- nested functions ------------------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # Analyzed as its own unit (runs when called — possibly on another
+        # thread — not where defined); do not descend here.
+        self.nested[node.name] = node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # Lambda bodies run outside the lexical lock scope.
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+
+
+def _walk_unit(
+    key: str,
+    cls: str,
+    fn_node: ast.AST,
+    class_locks: Set[str],
+    module_locks: Set[str],
+    class_guarded: Dict[str, str],
+    module_guarded: Dict[str, str],
+    record_writes: bool,
+    units: Dict[str, "_ScopeWalker"],
+    nested_of: Dict[str, Dict[str, str]],
+) -> None:
+    w = _ScopeWalker(
+        key, cls, class_locks, module_locks, class_guarded, module_guarded,
+        record_writes,
+    )
+    for stmt in fn_node.body:
+        w.visit(stmt)
+    units[key] = w
+    nested_of[key] = {}
+    for name, sub in w.nested.items():
+        sub_key = f"{key}.{name}"
+        nested_of[key][name] = sub_key
+        _walk_unit(
+            sub_key, cls, sub, class_locks, module_locks, class_guarded,
+            module_guarded, record_writes, units, nested_of,
+        )
+
+
+def _entry_for(
+    rel_path: str, tree: ast.Module, registry: Optional[dict]
+) -> Tuple[Optional[dict], bool]:
+    """(ownership entry, is_file_local).  File-local ``RACE_OWNERSHIP``
+    wins — that is the fixture seam."""
+    local = extract_literal(tree, "RACE_OWNERSHIP")
+    if local is not None:
+        return local, True
+    if registry is not None and rel_path in registry:
+        return registry[rel_path], False
+    return None, False
+
+
+def _invert_guards(
+    guards: Dict[str, List[str]],
+    stale: List[Tuple[str, str]],
+    scope: str,
+) -> Dict[str, str]:
+    owner: Dict[str, str] = {}
+    for lock, attrs in guards.items():
+        for attr in attrs:
+            if attr in owner:
+                stale.append(
+                    (scope, f"attribute `{attr}` registered under both "
+                            f"`{owner[attr]}` and `{lock}`")
+                )
+            owner[attr] = lock
+    return owner
+
+
+def run(root: str, scan_dirs: Tuple[str, ...] = SCAN_DIRS) -> List[Violation]:
+    violations: List[Violation] = []
+    registry = load_lock_ownership(root)
+    if registry is None:
+        violations.append(
+            Violation(
+                PASS, LOCK_OWNERSHIP_PATH, 1, "registry-missing",
+                "<registry>",
+                "lock-ownership registry missing or not a plain dict "
+                "literal — the race pass is blind without it",
+            )
+        )
+        registry = {}
+    # Registry keys must point at files that still exist.
+    for key in sorted(registry):
+        if not os.path.exists(os.path.join(root, key)):
+            violations.append(
+                Violation(
+                    PASS, LOCK_OWNERSHIP_PATH, 1, "ownership-stale",
+                    "<registry>",
+                    f"registry entry `{key}` names a file that no longer "
+                    "exists",
+                )
+            )
+
+    for abs_path, rel_path in iter_py_files(root, scan_dirs):
+        tree, source, pragmas = parse_file(abs_path)
+        sanctioned = _sanctioned_lines(source)
+        entry, _ = _entry_for(rel_path, tree, registry)
+        classes_reg: Dict[str, Dict[str, List[str]]] = (
+            dict(entry.get("classes", {})) if entry else {}
+        )
+        module_reg: Dict[str, List[str]] = (
+            dict(entry.get("module", {})) if entry else {}
+        )
+        stale: List[Tuple[str, str]] = []  # (context, message)
+
+        module_guarded = _invert_guards(module_reg, stale, _MODULE)
+        module_locks = set(module_reg)
+
+        # -- module-level lock definitions (+ unregistered audit) --------
+        found_module_locks: Dict[str, int] = {}
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and lock_ctor_kind(stmt.value):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        found_module_locks[t.id] = stmt.lineno
+        for name, line in sorted(found_module_locks.items()):
+            if name not in module_reg:
+                violations.append(
+                    Violation(
+                        PASS, rel_path, line, "unregistered-lock",
+                        _MODULE,
+                        f"module lock `{name}` is not in the lock-ownership "
+                        "registry — register it (empty guard list if it "
+                        "guards nothing)",
+                    )
+                )
+        for name in sorted(module_reg):
+            if name not in found_module_locks:
+                stale.append(
+                    (_MODULE, f"registered module lock `{name}` is not "
+                              "constructed in this module")
+                )
+
+        units: Dict[str, _ScopeWalker] = {}
+        nested_of: Dict[str, Dict[str, str]] = {}
+        all_labels: Set[Label] = {(_MODULE, n) for n in found_module_locks}
+        all_labels.update((_MODULE, n) for n in module_reg)
+        found_classes: Set[str] = set()
+        class_lock_defs: Dict[str, Dict[str, int]] = {}
+        class_attr_stores: Dict[str, Set[str]] = {}
+
+        # -- class units --------------------------------------------------
+        for cls_node in [n for n in tree.body if isinstance(n, ast.ClassDef)]:
+            cls = cls_node.name
+            found_classes.add(cls)
+            lock_defs: Dict[str, int] = {}
+            for node in ast.walk(cls_node):
+                if isinstance(node, ast.Assign) and lock_ctor_kind(node.value):
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            lock_defs[t.attr] = node.lineno
+            class_lock_defs[cls] = lock_defs
+            guards = classes_reg.get(cls, {})
+            for attr, line in sorted(lock_defs.items()):
+                if attr not in guards:
+                    violations.append(
+                        Violation(
+                            PASS, rel_path, line, "unregistered-lock",
+                            cls,
+                            f"lock `{cls}.{attr}` is not in the "
+                            "lock-ownership registry — register it (empty "
+                            "guard list if it guards nothing)",
+                        )
+                    )
+            class_guarded = _invert_guards(guards, stale, cls)
+            class_locks = set(lock_defs) | set(guards)
+            all_labels.update((cls, a) for a in class_locks)
+            for item in cls_node.body:
+                if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                key = f"{cls}.{item.name}"
+                _walk_unit(
+                    key, cls, item, class_locks, module_locks, class_guarded,
+                    module_guarded, item.name != "__init__", units, nested_of,
+                )
+
+        # -- module-function units ---------------------------------------
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _walk_unit(
+                    stmt.name, _MODULE, stmt, set(), module_locks, {},
+                    module_guarded, True, units, nested_of,
+                )
+
+        for key, w in units.items():
+            if w.cls != _MODULE:
+                class_attr_stores.setdefault(w.cls, set()).update(w.attr_stores)
+
+        # -- registry rot: classes / locks / attrs ------------------------
+        for cls in sorted(classes_reg):
+            if cls not in found_classes:
+                stale.append(
+                    ("<registry>", f"registered class `{cls}` not found in "
+                                   f"{rel_path}")
+                )
+                continue
+            for lock in sorted(classes_reg[cls]):
+                if lock not in class_lock_defs.get(cls, {}):
+                    stale.append(
+                        (cls, f"registered lock `{cls}.{lock}` is not "
+                              "constructed in this class")
+                    )
+            for lock, attrs in classes_reg[cls].items():
+                for attr in attrs:
+                    if attr not in class_attr_stores.get(cls, set()):
+                        stale.append(
+                            (cls, f"registered attribute `{cls}.{attr}` is "
+                                  "never written in this class")
+                        )
+        written_globals: Set[str] = set()
+        for w in units.values():
+            written_globals.update(w.global_stores)
+        for stmt in tree.body:  # top-level init assignments
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Store
+                    ):
+                        written_globals.add(node.id)
+        for gname in sorted(module_guarded):
+            if gname not in written_globals:
+                stale.append(
+                    (_MODULE, f"registered module global `{gname}` is never "
+                              "written in this module")
+                )
+        for ctx, msg in stale:
+            violations.append(
+                Violation(PASS, rel_path, 1, "ownership-stale", ctx, msg)
+            )
+
+        # -- call graph + spawn roots -------------------------------------
+        def resolve(caller: str, kind: str, name: str) -> Optional[str]:
+            if kind == "self":
+                cls = units[caller].cls
+                if cls == _MODULE:
+                    return None
+                key = f"{cls}.{name}"
+                return key if key in units else None
+            # bare name: nested def of an ancestor, else module function
+            parts = caller.split(".")
+            for i in range(len(parts), 0, -1):
+                anc = ".".join(parts[:i])
+                sub = nested_of.get(anc, {}).get(name)
+                if sub is not None:
+                    return sub
+            return name if name in units else None
+
+        edges: Dict[str, Set[str]] = {k: set() for k in units}
+        roots: Dict[str, Set[str]] = {k: set() for k in units}
+        for key, w in units.items():
+            for kind, name, _held in w.calls:
+                callee = resolve(key, kind, name)
+                if callee is not None:
+                    edges[key].add(callee)
+            # a nested def is conservatively callable from its parent
+            for sub_key in nested_of.get(key, {}).values():
+                edges[key].add(sub_key)
+            for kind, name in w.spawns:
+                target = resolve(key, "self" if kind == "self" else "mod", name)
+                if target is not None:
+                    roots[target].add(f"thread:{target}")
+            leaf = key.rsplit(".", 1)[-1]
+            # Top-level units only (a module function, or a direct method of
+            # a class — not nested defs): public names are external entries.
+            is_top = (
+                "." not in key if w.cls == _MODULE else key.count(".") == 1
+            )
+            if is_top and not leaf.startswith("_"):
+                roots[key].add(EXTERNAL_ROOT)
+
+        # Direct roots (pre-propagation) decide always-held eligibility: a
+        # private helper reached only through same-file call sites may
+        # inherit a lock its callers always hold; a public entry or spawn
+        # target is entered with nothing held.
+        direct_roots: Dict[str, Set[str]] = {k: set(v) for k, v in roots.items()}
+
+        changed = True
+        while changed:
+            changed = False
+            for key in units:
+                for callee in edges[key]:
+                    missing = roots[key] - roots[callee]
+                    if missing:
+                        roots[callee].update(missing)
+                        changed = True
+
+        # -- always-held fixpoint -----------------------------------------
+        call_sites: Dict[str, List[Tuple[str, Tuple[Label, ...]]]] = {
+            k: [] for k in units
+        }
+        for key, w in units.items():
+            for kind, name, held in w.calls:
+                callee = resolve(key, kind, name)
+                if callee is not None:
+                    call_sites[callee].append((key, held))
+
+        top = set(all_labels)
+        always: Dict[str, Set[Label]] = {k: set() for k in units}
+        eligible = {k for k in units if not direct_roots[k]}
+        for k in eligible:
+            always[k] = set(top)
+        changed = True
+        while changed:
+            changed = False
+            for k in eligible:
+                sites = call_sites.get(k, [])
+                if not sites:
+                    new: Set[Label] = set()
+                else:
+                    new = set(top)
+                    for caller, held in sites:
+                        new &= set(held) | always.get(caller, set())
+                if new != always[k]:
+                    always[k] = new
+                    changed = True
+
+        # -- unguarded writes ---------------------------------------------
+        for key, w in units.items():
+            r = roots[key]
+            confined = EXTERNAL_ROOT not in r and len(r) <= 1
+            if confined:
+                continue
+            for owner, name, held, line, node in w.writes:
+                if owner in held or owner in always.get(key, set()):
+                    continue
+                if pragmas.suppresses(PASS, node) or _span_hits(
+                    sanctioned, node
+                ):
+                    continue
+                owner_s = _render_label(owner)
+                reach = ", ".join(sorted(r)) or EXTERNAL_ROOT
+                violations.append(
+                    Violation(
+                        PASS, rel_path, line, "unguarded-write", key,
+                        f"write to `{name}` (guarded by `{owner_s}`) without "
+                        f"the lock held; reachable from: {reach} — hold the "
+                        "lock or annotate `# race: sanctioned(<reason>)`",
+                    )
+                )
+
+    return violations

@@ -1,0 +1,174 @@
+"""Wall-clock purity auditor (ISSUE 18 — the static half of ROADMAP item 4).
+
+The scenario engine is deterministic-by-seed but its clock is wall-time
+where it matters: slasher CPU load shifts fault-plan indices and
+peer-score decay races thresholds (the ``device_breaker_mid_sync`` flake).
+PR 16 moved the fault-plan index onto the slot-provider seam
+(``fault_injection.set_slot_provider``); this pass holds that line and
+fences the rest ahead of the virtual-clock refactor.
+
+Bans wall-clock *reads* in scenario/fault/peer-score/decay control paths:
+
+- ``time.time()`` / ``time.monotonic()`` (and their ``_ns`` /
+  ``perf_counter`` variants), including ``from time import monotonic``
+  spellings;
+- argless ``datetime.now()`` / ``datetime.utcnow()``.
+
+Code: ``wallclock-read``.  Referencing a clock *function* (``clock=
+time.monotonic`` default parameters, ``field(default_factory=...)``) is
+not a read — injectable-clock seams are exactly the refactor this pass
+drives toward, so they stay clean by construction.
+
+Whitelist (``SANCTIONED_CONTEXTS``): telemetry timestamping (stamping a
+result artifact with how long the run took is reporting, not control
+flow) and the sanctioned slot-provider seam from PR 16.  Everything else
+is a violation — fix it, pragma it (``# wallclock: ok(<reason>)``), or
+baseline it: the baseline doubles as the ROADMAP item 4 work list.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .common import (
+    Violation,
+    dotted_path,
+    iter_py_files,
+    parse_file,
+)
+
+PASS = "wallclock"
+
+SCAN_DIRS = (
+    # the scenario soak engine: deadlines, pump loops, linger windows
+    "lighthouse_tpu/scenarios.py",
+    # slot-keyed fault plans (PR 16) — must stay wall-clock-free
+    "lighthouse_tpu/fault_injection.py",
+    # byzantine actors ride the scenario pump loops
+    "lighthouse_tpu/adversary.py",
+    # the in-process fleet harness the scenarios drive
+    "lighthouse_tpu/simulator.py",
+    # peer-score decay: the other half of the mid-sync flake
+    "lighthouse_tpu/network/peer_manager.py",
+    # perf-trajectory sentinel (PR 17): artifact analysis must key on the
+    # artifacts' own recorded stamps, never on analysis-time wall clock
+    "scripts/analysis/trajectory.py",
+)
+
+#: Wall-clock reads by dotted call path.
+_BANNED_DOTTED = frozenset(
+    {
+        "time.time",
+        "time.time_ns",
+        "time.monotonic",
+        "time.monotonic_ns",
+        "time.perf_counter",
+        "time.perf_counter_ns",
+        "datetime.datetime.now",
+        "datetime.datetime.utcnow",
+    }
+)
+#: ``from time import ...`` names that read the clock when called bare.
+_TIME_FUNCS = frozenset(
+    {"time", "time_ns", "monotonic", "monotonic_ns", "perf_counter",
+     "perf_counter_ns"}
+)
+
+#: Contexts (function qualname prefixes per file) where wall-clock reads
+#: are sanctioned; ``"*"`` sanctions the whole file.
+SANCTIONED_CONTEXTS: Dict[str, Tuple[str, ...]] = {
+    # Run-duration stamping on the soak artifact (`started`/`duration_s`
+    # in ScenarioRunner.run) is reporting, not a control input.  The
+    # deadline pump loops (_pump_until, _pump_node_to_head, backfill
+    # worker) are NOT sanctioned — they are the item-4 work list and live
+    # in the baseline until the virtual-clock refactor.
+    "lighthouse_tpu/scenarios.py": ("ScenarioRunner.run",),
+    # fixture (self-test): proves sanctioned contexts stay clean
+    "scripts/analysis/fixtures/fixture_wallclock.py": (
+        "stamp_telemetry_is_fine",
+        "SanctionedSeam",
+    ),
+}
+
+
+def _from_time_imports(tree: ast.Module) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name in _TIME_FUNCS:
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self, bare_time_names: Set[str]):
+        self.bare = bare_time_names
+        self.scope: List[str] = []
+        self.hits: List[Tuple[str, str, int, ast.AST]] = []  # (ctx, what, line, node)
+
+    @property
+    def context(self) -> str:
+        return ".".join(self.scope) if self.scope else "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = dotted_path(node.func)
+        argless = not node.args and not node.keywords
+        what = None
+        if dotted in _BANNED_DOTTED:
+            # the datetime forms are only banned argless (an explicit tz
+            # is still wall clock, but the ISSUE contract bans the naive
+            # argless read)
+            if not dotted.startswith("datetime.") or argless:
+                what = dotted
+        elif dotted in ("datetime.now", "datetime.utcnow") and argless:
+            what = dotted
+        elif isinstance(node.func, ast.Name) and node.func.id in self.bare:
+            what = f"time.{node.func.id}"
+        if what is not None:
+            self.hits.append((self.context, what, node.lineno, node))
+        self.generic_visit(node)
+
+
+def _sanctioned(rel_path: str, ctx: str) -> bool:
+    prefixes = SANCTIONED_CONTEXTS.get(rel_path)
+    if not prefixes:
+        return False
+    if "*" in prefixes:
+        return True
+    return any(ctx == p or ctx.startswith(p + ".") for p in prefixes)
+
+
+def run(root: str, scan_dirs: Tuple[str, ...] = SCAN_DIRS) -> List[Violation]:
+    violations: List[Violation] = []
+    for abs_path, rel_path in iter_py_files(root, scan_dirs):
+        tree, _, pragmas = parse_file(abs_path)
+        w = _Walker(_from_time_imports(tree))
+        w.visit(tree)
+        for ctx, what, line, node in w.hits:
+            if _sanctioned(rel_path, ctx):
+                continue
+            if pragmas.suppresses(PASS, node):
+                continue
+            violations.append(
+                Violation(
+                    PASS, rel_path, line, "wallclock-read", ctx,
+                    f"wall-clock read `{what}()` in a control path — drive "
+                    "it from the slot provider / an injectable clock, or "
+                    "annotate `# wallclock: ok(<reason>)`",
+                )
+            )
+    return violations

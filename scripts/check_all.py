@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""One-invocation repo gate (ISSUE 18 satellite).
+
+Runs the three repo checkers **in-process, in one interpreter**, with a
+single import-poison hook installed before any of them loads:
+
+- ``check_static``         — the nine AST passes, fixture self-tests,
+  baseline discipline, and the generated lock-graph verification;
+- ``check_metrics``        — the metrics-registry lint (imports the
+  registering ``lighthouse_tpu`` modules, which must stay jax-lazy);
+- ``analysis/trajectory``  — the perf-trajectory sentinel in ``--check``
+  mode against the committed round artifacts.
+
+The poison bans ``jax``/``jaxlib`` for the whole invocation: the repo
+gate must run on a bare CI box (and inside the unattended campaign
+parent, which must never import jax).  Any checker — or any module a
+checker imports — pulling jax eagerly aborts the run, which is the
+point: one process means one poison proves the property for all three
+at once, instead of three subprocesses each proving it separately.
+
+Exit code: 0 iff every checker exits 0.  Each checker's own output is
+passed through; a consolidated summary line goes last.
+
+Usage:
+    python scripts/check_all.py
+"""
+
+from __future__ import annotations
+
+import builtins
+import importlib
+import os
+import sys
+import traceback
+from typing import List, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+
+_real_import = builtins.__import__
+
+
+def _poisoned_import(name, *args, **kwargs):
+    if name.split(".")[0] in ("jax", "jaxlib"):
+        raise ImportError(
+            f"check_all: the repo gate must run without jax, but a checker "
+            f"(or a module it imports) tried to import {name!r}"
+        )
+    return _real_import(name, *args, **kwargs)
+
+
+#: (label, importable module, argv tail passed to its main()).
+CHECKERS: Tuple[Tuple[str, str, Tuple[str, ...]], ...] = (
+    ("check_static", "check_static", ()),
+    ("check_metrics", "check_metrics", ()),
+    ("trajectory", "analysis.trajectory", ("--check",)),
+)
+
+
+def _run_checker(label: str, module_name: str, argv: Tuple[str, ...]) -> int:
+    try:
+        mod = importlib.import_module(module_name)
+    except Exception:
+        traceback.print_exc()
+        return 2
+    saved_argv = sys.argv
+    sys.argv = [f"{label}.py", *argv]
+    try:
+        return int(mod.main() or 0)
+    except SystemExit as e:
+        return int(e.code or 0)
+    except Exception:
+        traceback.print_exc()
+        return 2
+    finally:
+        sys.argv = saved_argv
+
+
+def main() -> int:
+    builtins.__import__ = _poisoned_import
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    results: List[Tuple[str, int]] = []
+    for label, module_name, argv in CHECKERS:
+        results.append((label, _run_checker(label, module_name, argv)))
+
+    failed = [label for label, rc in results if rc != 0]
+    if failed:
+        print(
+            f"check_all: FAIL ({', '.join(failed)} of "
+            f"{len(results)} checkers failed)",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"check_all: OK ({len(results)} checkers, one import-poisoned "
+          "invocation)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

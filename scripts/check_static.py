@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static analysis runner: consensus safety + device performance.
 
-Aggregates the six AST passes in ``scripts/analysis/``:
+Aggregates the nine AST passes in ``scripts/analysis/``:
 
 - safe-arith        — raw arithmetic on spec-typed quantities in consensus/
 - lock-order        — lock-acquisition-order cycles + blocking calls under locks
@@ -13,6 +13,22 @@ Aggregates the six AST passes in ``scripts/analysis/``:
 - sharding-ready    — the ops/batch_axes.py batch-axis contract mesh
   sharding consumes (registry completeness, batch-axis-preserving entries,
   placed device_puts)
+- race              — the lighthouse_tpu/lock_ownership.py registry: writes
+  to registered shared state reachable from two or more thread roots
+  without the owning lock held, plus registry rot in both directions
+- wallclock         — wall-clock reads (time.time/monotonic, argless
+  datetime.now) in scenario/fault/peer-score/decay control paths (the
+  static half of ROADMAP item 4)
+- process-boundary  — module-level mutable singletons mutated from
+  request/worker paths and fork-hostile module-level locks (ahead of the
+  ROADMAP item 2 process split)
+
+This runner also owns the **generated lock graph**:
+``lighthouse_tpu/lock_graph.py`` is rendered from
+``lock_order_pass.acquisition_edges`` by ``--update-baseline`` and
+verified byte-identical against the computed graph on every normal run,
+so the runtime lock sanitizer (``lighthouse_tpu/locksmith.py``) always
+cross-checks dynamic acquisition order against a fresh static graph.
 
 (The StableHLO budget auditor ``scripts/analysis/hlo_budget.py`` is the
 sibling runner for lowering-level locks — it needs jax, so it runs from the
@@ -52,13 +68,17 @@ from analysis import (  # noqa: E402
     device_purity_pass,
     host_sync_pass,
     lock_order_pass,
+    process_boundary_pass,
+    race_pass,
     recompile_hazard_pass,
     safe_arith_pass,
     sharding_pass,
+    wallclock_pass,
 )
 from analysis.common import Violation, iter_py_files  # noqa: E402
 
 BASELINE_PATH = os.path.join(REPO_ROOT, "scripts", "analysis", "baseline.txt")
+LOCK_GRAPH_PATH = os.path.join(REPO_ROOT, "lighthouse_tpu", "lock_graph.py")
 FIXTURES = ("scripts/analysis/fixtures",)
 
 PASSES = (
@@ -68,6 +88,9 @@ PASSES = (
     recompile_hazard_pass,
     host_sync_pass,
     sharding_pass,
+    race_pass,
+    wallclock_pass,
+    process_boundary_pass,
 )
 
 #: codes each pass MUST produce on its fixture (proves the lint fires) and
@@ -130,7 +153,92 @@ SELF_TEST = {
             "pragmad_bypass_transfer",
         },
     },
+    "race": {
+        # 4 unguarded writes (public bump, 2-root _loop, mutator drain,
+        # module poke); 5 stale-registry seeds (ghost class, ghost lock,
+        # never-written attr/global, duplicate claim); unregistered locks
+        # (the fixture's seeded pair — other fixtures' locks add more,
+        # hence >= semantics)
+        "must_fire": {
+            "unguarded-write": 4,
+            "ownership-stale": 5,
+            "unregistered-lock": 2,
+        },
+        "must_not_flag_context": {
+            "bump_locked_is_fine",
+            "locked_entry",
+            "_confined_writer",
+            "sanctioned_reset_is_fine",
+            "poke_locked_is_fine",
+            "rebind_locked_is_fine",
+        },
+    },
+    "wallclock": {
+        # 5 seeded reads in fixture_wallclock (time.time deadline, 2x
+        # monotonic decay loop, argless datetime.now, from-import spelling)
+        "must_fire": {"wallclock-read": 5},
+        "must_not_flag_context": {
+            "stamp_telemetry_is_fine",
+            "SanctionedSeam",
+            "injectable_clock_is_fine",
+            "tz_aware_now_is_fine",
+            "pragma_site_is_fine",
+        },
+    },
+    "process-boundary": {
+        # container store + mutator call + global rebind, plus the
+        # module-level seeded lock (other fixtures' module locks add more)
+        "must_fire": {"singleton-mutation": 3, "fork-hostile-lock": 1},
+        "must_not_flag_context": {
+            "local_state_is_fine",
+            "read_only_is_fine",
+            "pragma_site_is_fine",
+            "InstanceStateIsFine",
+        },
+    },
 }
+
+
+def render_lock_graph(edges) -> str:
+    """The generated ``lighthouse_tpu/lock_graph.py`` — deterministic, so
+    ``--update-baseline`` round-trips byte-identically."""
+    lines = [
+        '"""Static lock-acquisition graph — GENERATED, do not edit by hand.',
+        "",
+        "Produced by ``scripts/check_static.py --update-baseline`` from",
+        "``scripts/analysis/lock_order_pass.acquisition_edges``: every ``(held,",
+        "then_acquired)`` lock-label pair the static pass observed across the",
+        "scanned tree.  ``lighthouse_tpu/locksmith.py`` cross-checks dynamic",
+        "acquisition sequences against this committed graph at test time;",
+        "``scripts/check_static.py`` fails when the committed tuple drifts from",
+        "the computed one, so the runtime sanitizer can never silently prove a",
+        "stale graph.",
+        '"""',
+        "",
+    ]
+    if not edges:
+        lines.append("EDGES = ()")
+    else:
+        lines.append("EDGES = (")
+        for held, acquired in edges:
+            lines.append(f'    ("{held}", "{acquired}"),')
+        lines.append(")")
+    return "\n".join(lines) + "\n"
+
+
+def check_lock_graph(errors: List[str]) -> None:
+    computed = render_lock_graph(lock_order_pass.acquisition_edges(REPO_ROOT))
+    try:
+        with open(LOCK_GRAPH_PATH, "r", encoding="utf-8") as f:
+            committed = f.read()
+    except FileNotFoundError:
+        committed = None
+    if committed != computed:
+        errors.append(
+            "lighthouse_tpu/lock_graph.py drifted from the computed static "
+            "lock graph — the runtime sanitizer would prove a stale graph; "
+            "regenerate with --update-baseline"
+        )
 
 
 def run_self_test() -> List[str]:
@@ -212,12 +320,17 @@ def main() -> int:
     violations = scan_tree(errors)
     if args.update_baseline:
         write_baseline(violations)
-        print(f"check_static: baseline rewritten with {len(violations)} findings")
+        with open(LOCK_GRAPH_PATH, "w", encoding="utf-8") as f:
+            f.write(render_lock_graph(
+                lock_order_pass.acquisition_edges(REPO_ROOT)))
+        print(f"check_static: baseline rewritten with {len(violations)} "
+              "findings; lock graph regenerated")
         # still report self-test failures: a blind lint must not be baselined
         for e in errors:
             print(f"check_static: FAIL: {e}", file=sys.stderr)
         return 1 if errors else 0
 
+    check_lock_graph(errors)
     baseline = load_baseline()
     budget = Counter(baseline)
     fresh: List[Violation] = []
@@ -251,7 +364,7 @@ def main() -> int:
         return 1
     print(
         f"check_static: OK ({len(PASSES)} passes, {len(violations)} finding(s) "
-        f"all baselined/pragma'd, self-test "
+        f"all baselined/pragma'd, lock graph verified, self-test "
         f"{'skipped' if args.no_self_test else 'fired'})"
     )
     return 0

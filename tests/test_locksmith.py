@@ -1,0 +1,248 @@
+"""Runtime lock sanitizer (ISSUE 18): zero overhead off, seeded
+two-thread order inversion and seeded unguarded write both redden under
+``LIGHTHOUSE_TPU_LOCK_SANITIZE=1``, and the sanitizer runs green over the
+real supervisor / pipeline / scenario stacks — the dynamic proof of the
+static lock graph and ownership registry."""
+
+import threading
+
+import pytest
+
+from lighthouse_tpu import locksmith
+from lighthouse_tpu.lock_graph import EDGES
+from lighthouse_tpu.timeout_lock import TimeoutLock
+
+
+@pytest.fixture(autouse=True)
+def _clean_sanitizer():
+    locksmith.reset()
+    yield
+    locksmith.reset()
+
+
+@pytest.fixture
+def sanitize(monkeypatch):
+    monkeypatch.setenv(locksmith.ENV_VAR, "1")
+
+
+# --------------------------------------------------- zero overhead when off
+
+
+class TestOffByDefault:
+    def test_factories_return_plain_primitives(self, monkeypatch):
+        monkeypatch.delenv(locksmith.ENV_VAR, raising=False)
+        assert not locksmith.enabled()
+        # the exact stdlib types — no wrapper, no indirection
+        assert isinstance(locksmith.lock("X._lock"), type(threading.Lock()))
+        assert isinstance(locksmith.rlock("X._rlock"),
+                          type(threading.RLock()))
+        cond = locksmith.condition("X._cond")
+        assert type(cond) is threading.Condition
+        assert isinstance(cond._lock, type(threading.RLock()))
+
+    def test_timeout_lock_inner_is_plain(self, monkeypatch):
+        monkeypatch.delenv(locksmith.ENV_VAR, raising=False)
+        tl = TimeoutLock("demo", label="Demo._lock")
+        assert isinstance(tl._lock, type(threading.Lock()))
+
+    def test_guard_is_a_no_op(self, monkeypatch):
+        monkeypatch.delenv(locksmith.ENV_VAR, raising=False)
+
+        class Box:
+            pass
+
+        b = Box()
+        assert locksmith.guard(b, {"x": "_lock"}) is b
+        assert type(b) is Box
+
+
+# ------------------------------------------------- seeded failures (redden)
+
+
+class TestSeededViolations:
+    def test_two_thread_order_inversion_reddens(self, sanitize):
+        """The static graph proves DeviceArbiter._lock -> ._stats; a second
+        thread acquiring them inverted must fail the check."""
+        assert ("DeviceArbiter._lock", "DeviceArbiter._stats") in EDGES
+        a = locksmith.lock("DeviceArbiter._lock")
+        s = locksmith.lock("DeviceArbiter._stats")
+
+        def proven_order():
+            with a:
+                with s:
+                    pass
+
+        def inverted_order():
+            with s:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=proven_order, name="proven")
+        t2 = threading.Thread(target=inverted_order, name="inverted")
+        t1.start(); t1.join()
+        t2.start(); t2.join()
+        vs = locksmith.violations()
+        assert len(vs) == 1 and "order-inversion" in vs[0]
+        assert "inverted" in vs[0]  # names the offending thread
+        with pytest.raises(locksmith.SanitizerViolation):
+            locksmith.check()
+
+    def test_seeded_unguarded_write_reddens(self, sanitize):
+        class Demo:
+            def __init__(self):
+                self._lock = locksmith.lock("Demo._lock")
+                self._state = 0  # __init__ writes are pre-guard: exempt
+
+        d = Demo()
+        locksmith.guard(d, {"_state": "_lock"})
+        with d._lock:
+            d._state = 1  # guarded: fine
+        locksmith.check()
+        d._state = 2  # unguarded: reddens
+        with pytest.raises(locksmith.SanitizerViolation) as exc:
+            locksmith.check()
+        assert "unguarded-write" in str(exc.value)
+
+    def test_unguarded_write_from_spawned_thread_reddens(self, sanitize):
+        class Demo:
+            def __init__(self):
+                self._lock = locksmith.lock("Demo._lock")
+                self._state = 0
+
+        d = Demo()
+        locksmith.guard(d, {"_state": "_lock"})
+        t = threading.Thread(target=lambda: setattr(d, "_state", 3))
+        t.start(); t.join()
+        with pytest.raises(locksmith.SanitizerViolation):
+            locksmith.check()
+
+
+# --------------------------------------------------------- sanctioned/clean
+
+
+class TestCleanPatterns:
+    def test_proven_order_and_sanctioned_pair_stay_green(self, sanitize):
+        a = locksmith.lock("DeviceArbiter._lock")
+        s = locksmith.lock("DeviceArbiter._stats")
+        with a:
+            with s:  # the statically proven direction
+                pass
+        locksmith.check()
+        assert ("DeviceArbiter._lock", "DeviceArbiter._stats") \
+            in locksmith.observed_edges()
+
+    def test_condition_wait_is_not_an_inversion(self, sanitize):
+        cv = locksmith.condition("P._cond")
+        other = locksmith.lock("Q._lock")
+        done = []
+
+        def waiter():
+            with cv:
+                while not done:
+                    cv.wait(timeout=2.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        with other:
+            with cv:
+                done.append(1)
+                cv.notify_all()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        locksmith.check()
+
+    def test_rlock_reentry_is_clean(self, sanitize):
+        r = locksmith.rlock("R._rlock")
+        with r:
+            with r:
+                pass
+        locksmith.check()
+
+    def test_timeout_lock_routes_label(self, sanitize):
+        tl = TimeoutLock("demo", label="Demo._lock")
+        assert isinstance(tl._lock, locksmith._SanitizedLock)
+        other = locksmith.lock("Other._lock")
+        with tl:
+            with other:
+                pass
+        locksmith.check()
+        assert ("Demo._lock", "Other._lock") in locksmith.observed_edges()
+
+
+# --------------------------------------- green over the real subsystems
+
+
+class TestRealSubsystemsGreen:
+    """The sanitizer riding tier-1: fresh supervisor / pipeline / scenario
+    objects get instrumented locks (env read at construction), their
+    registered state gets write-guarded, and exercising them records zero
+    violations — the runtime proof of the static claims."""
+
+    def test_supervisor_breaker_green(self, sanitize):
+        from lighthouse_tpu import device_supervisor as ds
+
+        cfg = ds.BreakerConfig(failure_threshold=2, open_cooldown_s=0.01,
+                               probe_successes=1)
+        br = locksmith.guard(ds.CircuitBreaker("t", cfg))
+        sup = locksmith.guard(ds.DeviceSupervisor(config=cfg))
+
+        def hammer():
+            for _ in range(5):
+                br.record_failure("device_error")
+                br.record_success()
+                sup.breaker("opx").record_success()
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        locksmith.check()
+
+    def test_device_pipeline_green(self, sanitize):
+        from lighthouse_tpu import device_pipeline
+        from lighthouse_tpu.crypto.bls.backends import set_backend
+
+        class _StubSet:
+            signing_keys = [1]
+
+        set_backend("fake")
+        try:
+            p = locksmith.guard(device_pipeline.DevicePipeline(
+                target_sets=2, linger_s=0.01,
+                verify_flat_fn=lambda flat: True))
+            futs = [p.submit([_StubSet()]) for _ in range(4)]
+            assert all(f.result(timeout=10.0) for f in futs)
+            p.shutdown()
+        finally:
+            set_backend("host")
+        locksmith.check()
+
+    def test_job_pipeline_green(self, sanitize):
+        from lighthouse_tpu.device_pipeline import JobPipeline
+
+        jp = locksmith.guard(JobPipeline("opy"))
+        futs = [jp.submit(lambda i=i: i * i) for i in range(8)]
+        assert [f.result(timeout=10.0) for f in futs] == \
+            [i * i for i in range(8)]
+        jp.shutdown()
+        locksmith.check()
+
+    def test_smoke_scenario_green(self, sanitize, tmp_path):
+        from lighthouse_tpu import blackbox, fault_injection
+        from lighthouse_tpu.crypto.bls.backends import set_backend
+        from lighthouse_tpu.scenarios import run_scenario, smoke_partition
+
+        set_backend("fake")
+        fault_injection.reset_for_tests()
+        blackbox.reset_for_tests()
+        blackbox.configure(directory=str(tmp_path / "postmortems"))
+        try:
+            artifact = run_scenario(smoke_partition(seed=0),
+                                    out_dir=str(tmp_path))
+        finally:
+            fault_injection.reset_for_tests()
+            blackbox.reset_for_tests()
+            set_backend("host")
+        assert artifact["passed"]
+        locksmith.check()

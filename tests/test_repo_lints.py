@@ -1,8 +1,9 @@
 """Tier-1 gate for the repo's own static checks (ISSUE 3, extended by
-ISSUE 10): ``scripts/check_static.py`` (six AST passes + fixture
-self-tests) and ``scripts/check_metrics.py`` run inside the test suite, so
-a regression in either gates the whole suite — same pattern the reference
-uses by running clippy deny-lists in CI next to the unit tests.
+ISSUE 10 and ISSUE 18): ``scripts/check_static.py`` (nine AST passes +
+fixture self-tests + the generated lock graph) and
+``scripts/check_metrics.py`` run inside the test suite, so a regression
+in either gates the whole suite — same pattern the reference uses by
+running clippy deny-lists in CI next to the unit tests.
 
 ISSUE 10 adds the tooling contracts: the AST runner must stay IMPORT-FREE
 of ``lighthouse_tpu``/``jax`` (so it runs in milliseconds with no device
@@ -48,7 +49,8 @@ class TestCheckStatic:
             f"check_static.py failed:\n{res.stdout}\n{res.stderr}"
         )
         assert "OK" in res.stdout
-        assert "6 passes" in res.stdout
+        assert "9 passes" in res.stdout
+        assert "lock graph verified" in res.stdout
 
     def test_fixtures_detected_without_baseline(self):
         """The self-test alone (fixtures only) must detect every seeded
@@ -164,11 +166,62 @@ class TestPassCoverage:
                     "lighthouse_tpu/scenarios.py",
                     "lighthouse_tpu/fork_choice"):
             assert mod in lock_order_pass.SCAN_DIRS
+        # ISSUE 18 satellite (SCAN_DIRS rot): the PR 15-17 modules joined
+        # the existing passes' scan lists
+        assert "lighthouse_tpu/autotune.py" in lock_order_pass.SCAN_DIRS
+        assert "lighthouse_tpu/blackbox.py" in lock_order_pass.SCAN_DIRS
+        assert "lighthouse_tpu/autotune.py" in host_sync_pass.SCAN_DIRS
+        assert "lighthouse_tpu/blackbox.py" in host_sync_pass.SCAN_DIRS
+
+    def test_concurrency_passes_cover_the_concurrent_tree(self):
+        """ISSUE 18: the new race / wallclock / process-boundary passes
+        scan the modules their contracts name."""
+        from analysis import process_boundary_pass, race_pass, wallclock_pass
+
+        for mod in ("lighthouse_tpu/device_supervisor.py",
+                    "lighthouse_tpu/device_pipeline.py",
+                    "lighthouse_tpu/device_mesh.py",
+                    "lighthouse_tpu/blackbox.py",
+                    "lighthouse_tpu/autotune.py",
+                    "lighthouse_tpu/scheduler",
+                    "lighthouse_tpu/scenarios.py",
+                    "lighthouse_tpu/network/transport.py"):
+            assert mod in race_pass.SCAN_DIRS, mod
+        for mod in ("lighthouse_tpu/scenarios.py",
+                    "lighthouse_tpu/fault_injection.py",
+                    "lighthouse_tpu/network/peer_manager.py",
+                    "scripts/analysis/trajectory.py"):
+            assert mod in wallclock_pass.SCAN_DIRS, mod
+        for mod in ("lighthouse_tpu/device_pipeline.py",
+                    "lighthouse_tpu/autotune.py",
+                    "lighthouse_tpu/http_api",
+                    "lighthouse_tpu/scheduler"):
+            assert mod in process_boundary_pass.SCAN_DIRS, mod
 
     def test_lock_order_has_zero_findings(self):
         from analysis import lock_order_pass
 
         assert lock_order_pass.run(REPO_ROOT) == []
+
+    def test_race_pass_has_zero_findings(self):
+        """The real tree is race-clean: the three findings the pass made on
+        landing (ResponseCache.misses outside the lock, Hub partition maps)
+        were fixed in source, not baselined."""
+        from analysis import race_pass
+
+        assert race_pass.run(REPO_ROOT) == []
+
+    def test_committed_lock_graph_matches_computed(self):
+        """lighthouse_tpu/lock_graph.py is generated; drift means the
+        runtime sanitizer proves a stale graph."""
+        from analysis import lock_order_pass
+
+        ns = {}
+        path = os.path.join(REPO_ROOT, "lighthouse_tpu", "lock_graph.py")
+        with open(path, "r", encoding="utf-8") as f:
+            exec(compile(f.read(), path, "exec"), ns)
+        assert list(ns["EDGES"]) == lock_order_pass.acquisition_edges(
+            REPO_ROOT)
 
 
 class TestHostSyncClassification:
@@ -221,6 +274,35 @@ class TestCheckMetrics:
             f"check_metrics.py failed:\n{res.stdout}\n{res.stderr}"
         )
         assert "OK" in res.stdout
+
+
+class TestCheckAll:
+    """ISSUE 18 satellite: the consolidated gate — check_static,
+    check_metrics and the trajectory sentinel in ONE interpreter with a
+    single jax-import poison installed before any checker loads."""
+
+    def test_consolidated_gate_passes(self):
+        res = _run("check_all.py")
+        assert res.returncode == 0, (
+            f"check_all.py failed:\n{res.stdout}\n{res.stderr}"
+        )
+        # every constituent checker reported, through one process
+        assert "check_static: OK" in res.stdout
+        assert "9 passes" in res.stdout
+        assert "check_metrics: OK" in res.stdout
+        assert '"trajectory": "ok"' in res.stdout
+        assert "check_all: OK (3 checkers" in res.stdout
+
+    def test_constituent_failure_propagates(self, tmp_path):
+        """A failing constituent must fail the whole gate: run one checker
+        through check_all's own dispatch against an empty artifacts dir
+        (the sentinel has nothing to check -> nonzero) and confirm the
+        nonzero code surfaces instead of being swallowed."""
+        import check_all as ca
+
+        rc = ca._run_checker("trajectory", "analysis.trajectory",
+                             ("--check", "--artifacts-dir", str(tmp_path)))
+        assert rc != 0
 
 
 class TestTrajectorySentinel:
