@@ -48,7 +48,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
-from . import locksmith, metrics
+from . import locksmith, metrics, telemetry_scope
 from .logs import get_logger
 
 log = get_logger("blackbox")
@@ -142,13 +142,22 @@ JOURNAL = Journal()
 
 
 def emit(source: str, event: str, *, trace_id: Optional[str] = None,
-         flight_seq: Optional[int] = None, **fields) -> dict:
+         flight_seq=None, link=None, **fields) -> dict:
     """Append one record to the incident journal (the seam entry point).
 
     ``trace_id`` is auto-resolved from the active span when not given;
     ``slot`` comes from the ``fault_injection`` slot provider (None in
     production, the virtual clock under the scenario runner).  Returns
     the record with its assigned ``seq``.
+
+    When a :mod:`telemetry_scope` is active the record is ALSO mirrored
+    into that node's scoped journal, stamped with ``node`` and a Lamport
+    ``lamport`` tick — ``merge_journals`` orders the fleet timeline on
+    those.  ``link=(origin_node, origin_lamport)`` declares a cross-node
+    causal edge (a gossip import linking back to the proposal): the local
+    clock ticks past the origin's, so the linked record merges strictly
+    after its cause.  ``flight_seq`` accepts the legacy int or the fleet
+    ``(node_id, seq)`` pair.
     """
     if trace_id is None:
         from . import tracing
@@ -168,13 +177,88 @@ def emit(source: str, event: str, *, trace_id: Optional[str] = None,
     if trace_id is not None:
         record["trace_id"] = trace_id
     if flight_seq is not None:
-        record["flight_seq"] = int(flight_seq)
+        if isinstance(flight_seq, (tuple, list)):
+            record["flight_seq"] = [str(flight_seq[0]), int(flight_seq[1])]
+        else:
+            record["flight_seq"] = int(flight_seq)
     for k, v in fields.items():
         if v is not None:
             record[k] = v
+    scope = telemetry_scope.current()
+    if scope is not None:
+        record["node"] = scope.node_id
+        if link is not None:
+            record["link"] = [str(link[0]), int(link[1])]
+            record["lamport"] = scope.tick(at_least=int(link[1]))
+            telemetry_scope.FLEET_TRACE_LINKS.inc(kind="journal-link")
+        else:
+            record["lamport"] = scope.tick()
+        telemetry_scope.FLEET_JOURNAL_EVENTS.inc(node=scope.node_id)
+        scope.tally.inc("fleet_journal_events_total", source=source)
+    # process-boundary: ok(scope seam: per-node journals via telemetry_scope)
     JOURNAL.append(record)
+    if scope is not None:
+        # per-node mirror: the copy gets the SCOPED journal's own seq
+        scope.journal.append(dict(record))
     BLACKBOX_EVENTS.inc(source=source)
     return record
+
+
+# ----------------------------------------------------- fleet timeline merge
+
+#: Fields dropped from merged fleet-timeline entries: wall-clock stamps and
+#: trace ids contain run-local entropy (``os.urandom`` suffixes, real time)
+#: — the merged timeline must be byte-identical across two runs at one
+#: seed, so only seed-deterministic fields survive the fold.  Canonical
+#: fleet time is the virtual ``slot`` (the fault-injection slot provider),
+#: not ``t_ms``.
+VOLATILE_FIELDS = frozenset({"t_ms", "trace_id", "remote_trace_id",
+                             "flight_seq"})
+
+
+def merge_key(record: dict):
+    """(virtual slot, Lamport clock, node id, per-node seq) — slot-major,
+    so cross-slot causality holds by construction and same-slot cross-node
+    edges hold via the Lamport tick (see :func:`emit`'s ``link``)."""
+    slot = record.get("slot")
+    return (
+        -1 if slot is None else int(slot),
+        int(record.get("lamport", 0)),
+        str(record.get("node", "")),
+        int(record.get("seq", 0)),
+    )
+
+
+def merge_journals(journals: Dict[str, List[dict]]) -> List[dict]:
+    """Fold N per-node journal windows (``node_id -> records``) into ONE
+    causally ordered fleet timeline, keyed by :func:`merge_key` with
+    :data:`VOLATILE_FIELDS` dropped.  Tolerates empty/partial journals,
+    clock skew (per-node Lamport rates differ freely), and a node restart
+    resetting its Lamport state (restarted records re-order only within
+    their own slot, never across slots)."""
+    merged: List[dict] = []
+    for node_id, records in journals.items():
+        for r in records or ():
+            entry = {k: v for k, v in r.items() if k not in VOLATILE_FIELDS}
+            entry.setdefault("node", str(node_id))
+            merged.append(entry)
+    merged.sort(key=merge_key)
+    return merged
+
+
+def fleet_summary(limit: Optional[int] = None) -> dict:
+    """The ``GET /lighthouse/fleet`` payload, also frozen into every
+    postmortem bundle and SOAK artifact: per-node scope snapshots plus the
+    merged fleet timeline over all registered scopes."""
+    scopes = telemetry_scope.all_scopes()
+    timeline = merge_journals(
+        {s.node_id: s.journal.window() for s in scopes})
+    if limit is not None:
+        timeline = timeline[-max(1, int(limit)):]
+    return {
+        "nodes": [s.snapshot() for s in scopes],
+        "timeline": timeline,
+    }
 
 
 # ------------------------------------------------------- snapshot registry
@@ -183,16 +267,19 @@ def emit(source: str, event: str, *, trace_id: Optional[str] = None,
 #: HTTP server registers its admission controller here; anything process-
 #: local that a 3am triage would want can join.
 _SNAPSHOTTERS: Dict[str, Callable[[], Any]] = {}
+# process-boundary: ok(scope seam: snapshot providers re-register per process)
 _SNAPSHOTTERS_LOCK = locksmith.lock("blackbox._SNAPSHOTTERS_LOCK")
 
 
 def register_snapshot(name: str, fn: Callable[[], Any]) -> None:
     with _SNAPSHOTTERS_LOCK:
+        # process-boundary: ok(scope seam: per-process registry, see telemetry_scope)
         _SNAPSHOTTERS[name] = fn
 
 
 def unregister_snapshot(name: str) -> None:
     with _SNAPSHOTTERS_LOCK:
+        # process-boundary: ok(scope seam: per-process registry, see telemetry_scope)
         _SNAPSHOTTERS.pop(name, None)
 
 
@@ -210,6 +297,7 @@ def _safe(fn: Callable[[], Any]) -> Any:
 #: Serializes captures AND guards the index/dir state.  Module-level (not
 #: per-object): captures are rare, seconds-scale events — serializing the
 #: whole freeze keeps bundle contents internally consistent.
+# process-boundary: ok(scope seam: capture state is per process by design)
 _CAPTURE_LOCK = locksmith.lock("blackbox._CAPTURE_LOCK")
 _CAPTURE_SEQ = 0
 _INDEX: deque = deque(maxlen=64)
@@ -232,8 +320,10 @@ def configure(directory: Optional[str] = None,
     the env defaults."""
     global _DIR_OVERRIDE, _RETAIN_OVERRIDE
     if directory is not None:
+        # process-boundary: ok(scope seam: per-process bundle dir override)
         _DIR_OVERRIDE = directory
     if retain_bundles is not None:
+        # process-boundary: ok(scope seam: per-process retention override)
         _RETAIN_OVERRIDE = max(1, int(retain_bundles))
 
 
@@ -327,6 +417,7 @@ def capture(reason: str, extra: Optional[dict] = None) -> dict:
     global _CAPTURE_SEQ
     reason_label = reason.split(":", 1)[0]
     with _CAPTURE_LOCK:
+        # process-boundary: ok(scope seam: capture seq is per process by design)
         _CAPTURE_SEQ += 1
         seq = _CAPTURE_SEQ
         journal = JOURNAL.window()
@@ -367,6 +458,7 @@ def capture(reason: str, extra: Optional[dict] = None) -> dict:
             "faults": _safe(_faults),
             "logs_tail": _safe(_logs),
             "metrics": _safe(metrics.render_prometheus),
+            "fleet": _safe(fleet_summary),
         }
         if extra is not None:
             bundle["extra"] = extra
@@ -388,6 +480,7 @@ def capture(reason: str, extra: Optional[dict] = None) -> dict:
             "trace_trees": len(bundle["traces"])
             if isinstance(bundle["traces"], list) else 0,
         }
+        # process-boundary: ok(scope seam: capture index is per process by design)
         _INDEX.append(index_entry)
     BLACKBOX_CAPTURES.inc(reason=reason_label)
     log.warning("postmortem bundle captured", reason=reason, path=path,
@@ -459,8 +552,13 @@ def reset_for_tests() -> None:
     """Clear journal + capture index and restore env-default dir/retention
     (disk bundles are left alone — tests own their tmp dirs)."""
     global _DIR_OVERRIDE, _RETAIN_OVERRIDE
+    # process-boundary: ok(scope seam: test-only reset of per-process state)
     JOURNAL.clear()
     with _CAPTURE_LOCK:
+        # process-boundary: ok(scope seam: test-only reset of per-process state)
         _INDEX.clear()
+    # process-boundary: ok(scope seam: test-only reset of per-process state)
     _DIR_OVERRIDE = None
+    # process-boundary: ok(scope seam: test-only reset of per-process state)
     _RETAIN_OVERRIDE = None
+    telemetry_scope.reset_for_tests()

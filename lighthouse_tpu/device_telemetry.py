@@ -219,8 +219,10 @@ class FlightRecorder:
         return entry
 
     def recent(self, limit: int = 64, op: Optional[str] = None,
-               trace_id: Optional[str] = None) -> List[dict]:
-        """Newest-first records, optionally filtered by op / trace id."""
+               trace_id: Optional[str] = None,
+               node: Optional[str] = None) -> List[dict]:
+        """Newest-first records, optionally filtered by op / trace id /
+        originating node (fleet runs stamp ``node`` via telemetry_scope)."""
         with self._lock:
             records = list(self._ring)
         records.reverse()
@@ -228,6 +230,8 @@ class FlightRecorder:
             records = [r for r in records if r.get("op") == op]
         if trace_id is not None:
             records = [r for r in records if r.get("trace_id") == trace_id]
+        if node is not None:
+            records = [r for r in records if r.get("node") == node]
         return [dict(r) for r in records[:max(1, limit)]]
 
     @property
@@ -249,6 +253,7 @@ FLIGHT_RECORDER = FlightRecorder()
 # Host-fallback tally by reason (also on the Prometheus counter; kept here
 # so the /lighthouse/device summary needs no registry introspection).
 _FALLBACKS: Dict[str, int] = {}
+# process-boundary: ok(scope seam: per-node views live in telemetry_scope)
 _FALLBACKS_LOCK = threading.Lock()
 
 
@@ -338,13 +343,28 @@ def record_batch(
     if host_fallback:
         reason = fallback_reason or "unknown"
         with _FALLBACKS_LOCK:
+            # process-boundary: ok(scope seam: per-node views in telemetry_scope)
             _FALLBACKS[reason] = _FALLBACKS.get(reason, 0) + 1
+    # Node attribution (fleet runs): a batch dispatched under an active
+    # telemetry scope is stamped with its node, mirrored into the scope's
+    # flight tail, and cross-referenced as a (node, seq) flight_seq pair —
+    # a plain int seq is ambiguous once N nodes share the process ring.
+    from . import telemetry_scope
+
+    scope = telemetry_scope.current()
+    if scope is not None:
+        entry["node"] = scope.node_id
     entry = FLIGHT_RECORDER.record(entry)
+    if scope is not None:
+        scope.note_flight(entry)
+        fseq = (scope.node_id, entry["seq"])
+    else:
+        fseq = entry["seq"]
     # Every dispatched batch joins the incident journal with its
     # flight_seq, so a postmortem bundle's journal window cross-references
     # the ring (and, via trace_id, the span tree) record-for-record.
     blackbox.emit("device_batch", "dispatch", trace_id=entry["trace_id"],
-                  flight_seq=entry["seq"], op=op, shape=entry["shape"],
+                  flight_seq=fseq, op=op, shape=entry["shape"],
                   n_live=int(n_live), verdict=verdict,
                   host_fallback=bool(host_fallback) or None,
                   fallback_reason=fallback_reason,
@@ -364,12 +384,14 @@ def host_fallback_counts() -> Dict[str, int]:
 # away — the first triage stop when epoch-boundary latency regresses with
 # the fused path on (see OBSERVABILITY.md).
 _BOUNDARY_PRIMES: Dict[str, int] = {}
+# process-boundary: ok(scope seam: per-node views live in telemetry_scope)
 _BOUNDARY_PRIMES_LOCK = threading.Lock()
 
 
 def note_boundary_prime(seeded: bool, reason: str) -> None:
     key = f"{'seeded' if seeded else 'discarded'}:{reason}"
     with _BOUNDARY_PRIMES_LOCK:
+        # process-boundary: ok(scope seam: per-node views in telemetry_scope)
         _BOUNDARY_PRIMES[key] = _BOUNDARY_PRIMES.get(key, 0) + 1
 
 
@@ -528,11 +550,15 @@ def summary() -> dict:
 
 def reset_for_tests() -> None:
     """Clear all module state (compile mirror, ring, fallback tallies)."""
+    # process-boundary: ok(scope seam: test-only reset of per-process state)
     COMPILE_CACHE.clear()
+    # process-boundary: ok(scope seam: test-only reset of per-process state)
     FLIGHT_RECORDER.clear()
     with _FALLBACKS_LOCK:
+        # process-boundary: ok(scope seam: test-only reset of per-process state)
         _FALLBACKS.clear()
     with _BOUNDARY_PRIMES_LOCK:
+        # process-boundary: ok(scope seam: test-only reset of per-process state)
         _BOUNDARY_PRIMES.clear()
 
 
@@ -547,6 +573,7 @@ class ProfilerBusy(RuntimeError):
     """A capture is already in flight — one at a time."""
 
 
+# process-boundary: ok(scope seam: profiler capture is per process by design)
 _PROFILE_LOCK = threading.Lock()
 
 #: Dump directories retained under the profile root — older captures are

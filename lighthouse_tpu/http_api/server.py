@@ -2210,7 +2210,8 @@ def lighthouse_device(ctx):
 def lighthouse_device_batches(ctx):
     """Recent device-batch flight-recorder records, newest first.  Query
     params: ``op`` (e.g. ``bls_verify``), ``trace_id`` (cross-reference
-    from ``/lighthouse/traces/{id}``), ``limit``."""
+    from ``/lighthouse/traces/{id}``), ``node`` (records stamped by one
+    node's telemetry scope), ``limit``."""
     from .. import device_telemetry
 
     try:
@@ -2221,6 +2222,7 @@ def lighthouse_device_batches(ctx):
         limit=max(1, min(limit, device_telemetry.FLIGHT_RECORDER.capacity)),
         op=ctx.q1("op"),
         trace_id=ctx.q1("trace_id"),
+        node=ctx.q1("node"),
     )}
 
 
@@ -2386,6 +2388,25 @@ def lighthouse_postmortems_journal(ctx):
         limit=max(1, min(limit, blackbox.JOURNAL.capacity)),
         source=ctx.q1("source"),
     )}
+
+
+@route("GET", "/lighthouse/fleet", P1)
+def lighthouse_fleet(ctx):
+    """Fleet observability (telemetry_scope.py): per-node scope snapshots
+    (Lamport clock, journal/tail occupancy, per-scope tallies) and the
+    merged causally-ordered timeline over every registered node's journal
+    — ordered on (virtual slot, Lamport clock, node id, per-node seq), so
+    "which node broke the fleet" reads top-to-bottom.  Query params:
+    ``limit`` (tail of the merged timeline)."""
+    from .. import blackbox
+
+    limit = ctx.q1("limit")
+    if limit is not None:
+        try:
+            limit = max(1, int(limit))
+        except ValueError:
+            raise _bad("limit must be an integer")
+    return {"data": blackbox.fleet_summary(limit=limit)}
 
 
 @route("POST", "/lighthouse/postmortem", P1)

@@ -131,6 +131,21 @@ LOCK_OWNERSHIP = {
             "_CAPTURE_LOCK": ["_CAPTURE_SEQ", "_INDEX"],
         },
     },
+    # Node-scoped telemetry (ISSUE 19): the scope's Lamport clock and
+    # worker-deferred event buffer are written from processor worker
+    # threads and drained on the runner; the registry lock guards the
+    # node-id -> scope map.  The flight/log tail deques are deliberately
+    # unregistered: single-writer monitoring mirrors, atomic appends.
+    "lighthouse_tpu/telemetry_scope.py": {
+        "classes": {
+            "TelemetryScope": {
+                "_lock": ["_lamport", "_pending"],
+            },
+        },
+        "module": {
+            "_SCOPES_LOCK": ["_SCOPES"],
+        },
+    },
     "lighthouse_tpu/autotune.py": {
         "classes": {
             "Controller": {

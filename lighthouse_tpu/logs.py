@@ -62,6 +62,16 @@ class LogRing(logging.Handler):
             entry["seq"] = self._seq
             self._buf.append(entry)
             self._cv.notify_all()
+        # Node-scoped mirror: a line emitted while a telemetry scope is
+        # active also lands in that node's log tail, so a fleet triage
+        # reads one node's lines without grepping the merged ring.
+        from . import telemetry_scope
+
+        scope = telemetry_scope.current()
+        if scope is not None:
+            entry = dict(entry)
+            entry["node"] = scope.node_id
+            scope.note_log(entry)
 
     def tail(self, n: int = 100) -> List[dict]:
         with self._cv:

@@ -204,6 +204,37 @@ class _HistTimer:
         return False
 
 
+class LocalTally:
+    """A scope-local metrics view: name → labeled counter totals, next to
+    (not instead of) the process-global registry.
+
+    ``telemetry_scope.TelemetryScope`` holds one per node so a fleet run
+    can answer "how many journal events did node B emit" without parsing
+    process-cumulative series — the per-node precursor of the per-process
+    registry the ROADMAP item 2 device-service split needs.  Never
+    rendered on ``/metrics``; surfaced through scope snapshots and the
+    fleet artifact."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple, float] = {}
+
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        key = (name,) + _labels_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+    def get(self, name: str, **labels) -> float:
+        with self._lock:
+            return self._series.get((name,) + _labels_key(labels), 0.0)
+
+    def snapshot(self) -> Dict[str, float]:
+        """``name{label="v",...} -> total`` in stable sorted order."""
+        with self._lock:
+            items = sorted(self._series.items())
+        return {key[0] + _fmt_labels(key[1:]): v for key, v in items}
+
+
 def _register(metric: _Metric) -> _Metric:
     with _REGISTRY_LOCK:
         existing = _REGISTRY.get(metric.name)

@@ -33,6 +33,7 @@ class LocalNode:
         slasher_config=None,
         endpoint=None,
         subscribe_all_subnets: bool = True,
+        scope=None,
     ):
         if harness is not None:
             chain = harness.chain
@@ -54,6 +55,10 @@ class LocalNode:
         else:
             assert hub is not None, "pass hub= or endpoint="
             self.endpoint = hub.register(peer_id)
+        # Node telemetry scope: stamps outbound envelopes with this node's
+        # trace context and receives deferred fleet-journal events.
+        self.scope = scope
+        self.endpoint.scope = scope
         self.service = NetworkService(self.endpoint)
         self.processor = BeaconProcessor(max_workers=max_workers)
         self.slasher = None
@@ -67,7 +72,7 @@ class LocalNode:
             )
         self.router = Router(
             chain=chain, service=self.service, processor=self.processor,
-            slasher=self.slasher,
+            slasher=self.slasher, scope=scope,
         )
         self.sync = SyncManager(chain=chain, service=self.service, router=self.router)
         digest = self.router.fork_digest

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from .. import metrics
+from .. import metrics, telemetry_scope, tracing
 from ..chain.beacon_chain import AttestationError, BlockError, ChainError
 from ..consensus import helpers as h
 from ..scheduler import BeaconProcessor, ReprocessQueue, W, WorkEvent
@@ -31,9 +31,14 @@ class Router:
         processor: Optional[BeaconProcessor] = None,
         sync_manager=None,
         slasher=None,
+        scope=None,
     ):
         self.chain = chain
         self.service = service
+        # Node telemetry scope (telemetry_scope.TelemetryScope) — held as a
+        # plain attribute because gossip handlers run on processor worker
+        # threads, where the runner's contextvar activation is invisible.
+        self.scope = scope
         self.processor = processor if processor is not None else BeaconProcessor(max_workers=2)
         self.sync = sync_manager
         self.slasher = slasher
@@ -56,6 +61,9 @@ class Router:
                 lambda: self.sync is not None and self.sync.state == SyncState.SYNCING
             )
         service.on_gossip = self.on_gossip
+        # Same handler, ctx-aware arity: the service prefers this hook and
+        # hands us the envelope's propagated trace context as the 5th arg.
+        service.on_gossip_ctx = self.on_gossip
         service.on_rpc_request = self.on_rpc_request
         service.on_peer_connected = self.on_peer_connected
         service.on_peer_disconnected = self.on_peer_disconnected
@@ -105,7 +113,8 @@ class Router:
 
     # ------------------------------------------------------------ gossip
 
-    def on_gossip(self, topic: str, uncompressed: bytes, compressed: bytes, sender: str) -> None:
+    def on_gossip(self, topic: str, uncompressed: bytes, compressed: bytes,
+                  sender: str, trace_ctx: Optional[dict] = None) -> None:
         try:
             kind = topics_mod.GossipTopic.parse(topic).kind
         except ValueError:
@@ -116,7 +125,8 @@ class Router:
                 WorkEvent(
                     work_type=W.GOSSIP_BLOCK,
                     process=lambda _: self._process_gossip_block(
-                        topic, uncompressed, compressed, sender
+                        topic, uncompressed, compressed, sender,
+                        trace_ctx=trace_ctx,
                     ),
                 )
             )
@@ -238,7 +248,8 @@ class Router:
                                  uncompressed=uncompressed)
 
     def _process_gossip_block(
-        self, topic: str, uncompressed: bytes, compressed: bytes, sender: str
+        self, topic: str, uncompressed: bytes, compressed: bytes, sender: str,
+        trace_ctx: Optional[dict] = None,
     ) -> None:
         from .sync import decode_signed_block
 
@@ -265,32 +276,62 @@ class Router:
                 self._drain_slasher()
             self.service.reject_gossip(sender, topic, "proposer_equivocation")
             return
-        try:
-            chain.process_block(signed)
-        except BlockError as e:
-            if "pending availability" in str(e):
-                # Blobs haven't arrived yet — the chain stashed the block in
-                # the DA checker; the blob handler completes the import.
+        # Resume the publisher's trace context (if the envelope carried one)
+        # as a fresh local root: the import tree joins the remote proposal
+        # tree on remote_trace_id in the fleet artifact.
+        with tracing.resume_remote(
+                trace_ctx, "gossip_block_import",
+                slot=int(signed.message.slot), root=block_root.hex(),
+                sender=sender,
+                node=self.scope.node_id if self.scope is not None else None):
+            try:
+                chain.process_block(signed)
+            except BlockError as e:
+                if "pending availability" in str(e):
+                    # Blobs haven't arrived yet — the chain stashed the block
+                    # in the DA checker; the blob handler completes the
+                    # import.
+                    return
+                if "unknown parent" in str(e) and self.sync is not None:
+                    # Don't penalize: we may simply be behind. But do NOT
+                    # forward either — an unknown-parent block has passed no
+                    # validation, so propagating it would relay junk (the
+                    # reference queues it for reprocessing and only
+                    # propagates validated blocks).
+                    self.sync.on_unknown_parent(signed, sender)
+                    return
+                self.service.reject_gossip(
+                    sender, topic, "invalid_block", detail=str(e))
                 return
-            if "unknown parent" in str(e) and self.sync is not None:
-                # Don't penalize: we may simply be behind. But do NOT forward
-                # either — an unknown-parent block has passed no validation,
-                # so propagating it would relay junk (the reference queues it
-                # for reprocessing and only propagates validated blocks).
-                self.sync.on_unknown_parent(signed, sender)
-                return
-            self.service.reject_gossip(
-                sender, topic, "invalid_block", detail=str(e))
-            return
-        chain.observed.block_producers.observe(
-            int(signed.message.slot), int(signed.message.proposer_index), block_root
-        )
-        if self.slasher is not None:
-            self.slasher.on_block(signed)
-            self._drain_slasher()
-        self.service.forward(topic, compressed, exclude=sender,
-                             uncompressed=uncompressed)
-        self._publish_light_client_updates()
+            chain.observed.block_producers.observe(
+                int(signed.message.slot), int(signed.message.proposer_index),
+                block_root
+            )
+            if self.slasher is not None:
+                self.slasher.on_block(signed)
+                self._drain_slasher()
+            # Forward with the ORIGIN's trace context, not a fresh local
+            # stamp — downstream nodes see the publisher's causal frame.
+            self.service.forward(topic, compressed, exclude=sender,
+                                 uncompressed=uncompressed,
+                                 trace_ctx=trace_ctx)
+            self._publish_light_client_updates()
+        # Imported: journal the cross-node causal link.  Worker threads must
+        # not append to the scope journal directly (ordering would depend on
+        # thread interleaving) — defer, drained on the runner thread at the
+        # next settle boundary.
+        if self.scope is not None:
+            link = None
+            origin = trace_ctx.get("node") if trace_ctx else None
+            if trace_ctx and trace_ctx.get("trace_id"):
+                link = (trace_ctx.get("node"), int(trace_ctx.get("lamport") or 0))
+                telemetry_scope.FLEET_TRACE_LINKS.inc(kind="remote-import")
+            self.scope.defer(
+                "fleet", "block_imported",
+                {"slot": int(signed.message.slot), "root": block_root.hex(),
+                 "origin": origin},
+                link=link,
+            )
 
     def _publish_light_client_updates(self) -> None:
         """Gossip newly-produced LC finality/optimistic updates (reference:

@@ -98,7 +98,10 @@ class NetworkService:
         self._mesh_lock = threading.Lock()
         self._last_heartbeat = 0.0
         self._seen: "OrderedDict[bytes, None]" = OrderedDict()
-        self._mcache: "OrderedDict[bytes, Tuple[str, bytes]]" = OrderedDict()
+        # mid -> (topic, compressed, origin trace_ctx): the cached ctx rides
+        # IWANT re-serves, so a pulled message still carries its ORIGINAL
+        # publisher's trace context, not the re-server's.
+        self._mcache: "OrderedDict[bytes, Tuple[str, bytes, Optional[dict]]]" = OrderedDict()
         # mid -> (sent_at, advertiser, topic): a peer whose IHAVE we
         # pulled owes us the message (gossip_promises.rs); broken promises
         # take the mild behaviour penalty, NEVER a violation-grade strike
@@ -111,6 +114,10 @@ class NetworkService:
         self._pending: Dict[int, dict] = {}
         # router hooks, set by Router.attach
         self.on_gossip: Optional[Callable] = None  # (topic, data, sender) -> bool accept
+        # trace-aware variant: (topic, uncompressed, compressed, sender,
+        # trace_ctx).  Preferred over on_gossip when set; the 4-arg hook
+        # stays for callers (tests, harnesses) that don't care about ctx.
+        self.on_gossip_ctx: Optional[Callable] = None
         self.on_rpc_request: Optional[Callable] = None  # (protocol, req, sender) -> chunks
         self.on_peer_connected: Optional[Callable] = None
         self.on_peer_disconnected: Optional[Callable] = None
@@ -194,9 +201,10 @@ class NetworkService:
                 self._seen.popitem(last=False)
             return True
 
-    def _cache_message(self, mid: bytes, topic: str, compressed: bytes) -> None:
+    def _cache_message(self, mid: bytes, topic: str, compressed: bytes,
+                       trace_ctx: Optional[dict] = None) -> None:
         with self._seen_lock:
-            self._mcache[mid] = (topic, compressed)
+            self._mcache[mid] = (topic, compressed, trace_ctx)
             while len(self._mcache) > MCACHE_SIZE:
                 self._mcache.popitem(last=False)
 
@@ -243,8 +251,9 @@ class NetworkService:
         return out
 
     def _disseminate(self, topic: str, mid: bytes, compressed: bytes,
-                     exclude: Optional[str], publishing: bool = False) -> int:
-        self._cache_message(mid, topic, compressed)
+                     exclude: Optional[str], publishing: bool = False,
+                     trace_ctx: Optional[dict] = None) -> int:
+        self._cache_message(mid, topic, compressed, trace_ctx=trace_ctx)
         # v1.1 score gates: low-scored peers fall out of gossip entirely,
         # and our OWN publications demand the stricter publish threshold.
         floor = PUBLISH_THRESHOLD if publishing else GOSSIP_THRESHOLD
@@ -255,7 +264,8 @@ class NetworkService:
         # the target degree — a just-subscribed node has full delivery
         # before its first heartbeat forms the mesh.
         eager, lazy = self.eager_lazy_split(topic, candidates, grafted)
-        env = Envelope(kind="gossip", sender=self.peer_id, topic=topic, data=compressed)
+        env = Envelope(kind="gossip", sender=self.peer_id, topic=topic,
+                       data=compressed, trace_ctx=trace_ctx)
         n = 0
         for peer in eager:
             if self.endpoint.send(peer, env):
@@ -267,21 +277,34 @@ class NetworkService:
         return n
 
     def publish(self, topic: str, uncompressed: bytes) -> int:
-        """Publish locally-originated data; returns #peers eagerly reached."""
+        """Publish locally-originated data; returns #peers eagerly reached.
+
+        The publisher's trace context is resolved HERE (not lazily at
+        ``Endpoint.send``) so the mcache entry carries it too — an IWANT
+        re-serve must present the origin's context, deterministically,
+        whichever node serves the pull."""
         from . import snappy_codec
 
+        ctx = None
+        if self.endpoint.scope is not None:
+            from .. import telemetry_scope
+
+            ctx = telemetry_scope.envelope_trace_ctx(self.endpoint.scope)
         mid = message_id(uncompressed)
         self._mark_seen(mid)
         return self._disseminate(
             str(topic), mid, snappy_codec.compress(uncompressed), exclude=None,
-            publishing=True,
+            publishing=True, trace_ctx=ctx,
         )
 
     def forward(self, topic: str, compressed: bytes, exclude: str,
-                uncompressed: Optional[bytes] = None) -> int:
+                uncompressed: Optional[bytes] = None,
+                trace_ctx: Optional[dict] = None) -> int:
         """Forward validated gossip.  Callers that hold the uncompressed
         bytes (the router always does) pass them to avoid re-decompressing
-        multi-MB payloads on the propagation hot path."""
+        multi-MB payloads on the propagation hot path.  ``trace_ctx``
+        preserves the ORIGIN's envelope trace context across hops (the
+        router passes through what it received)."""
         from . import snappy_codec
 
         if uncompressed is None:
@@ -290,7 +313,8 @@ class NetworkService:
             except snappy_codec.SnappyError:
                 return 0
         return self._disseminate(
-            str(topic), message_id(uncompressed), compressed, exclude=exclude
+            str(topic), message_id(uncompressed), compressed, exclude=exclude,
+            trace_ctx=trace_ctx,
         )
 
     # ---------------------------------------------------------------- rpc
@@ -636,12 +660,15 @@ class NetworkService:
             self._iwant_pending.pop(mid, None)  # pull satisfied (if any)
         if not self._mark_seen(mid):
             return
-        if self.on_gossip is None:
-            return
         # Router validates (possibly via the beacon processor) and calls
         # ``forward`` itself on acceptance — mirrors the reference's
-        # propagate-after-validation flow.
-        self.on_gossip(env.topic, uncompressed, env.data, env.sender)
+        # propagate-after-validation flow.  The ctx-aware hook wins when
+        # set; the 4-arg hook keeps its signature for existing callers.
+        if self.on_gossip_ctx is not None:
+            self.on_gossip_ctx(env.topic, uncompressed, env.data, env.sender,
+                               env.trace_ctx)
+        elif self.on_gossip is not None:
+            self.on_gossip(env.topic, uncompressed, env.data, env.sender)
 
     def _on_ihave(self, env: Envelope) -> None:
         """Lazy-gossip advert: pull the message if we haven't seen it
@@ -718,10 +745,11 @@ class NetworkService:
             entry = self._mcache.get(env.data)
         if entry is None:
             return
-        topic, compressed = entry
+        topic, compressed, trace_ctx = entry
         self.endpoint.send(
             env.sender,
-            Envelope(kind="gossip", sender=self.peer_id, topic=topic, data=compressed),
+            Envelope(kind="gossip", sender=self.peer_id, topic=topic,
+                     data=compressed, trace_ctx=trace_ctx),
         )
 
     def _on_rpc_request(self, env: Envelope) -> None:

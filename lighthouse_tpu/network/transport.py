@@ -58,6 +58,12 @@ class Envelope:
     protocol: Optional[str] = None  # rpc
     request_id: int = 0
     data: bytes = b""
+    #: Cross-node trace propagation (telemetry_scope.envelope_trace_ctx):
+    #: the sender's active trace id, node id, and a read-only Lamport stamp.
+    #: Observability sidecar only — never serialized into ``data``, never
+    #: part of ``Hub.record_schedule``'s determinism digest (the hub logs
+    #: link names + delivery decisions, not envelope contents).
+    trace_ctx: Optional[dict] = None
 
 
 # ---------------------------------------------------------- prune payload
@@ -96,11 +102,18 @@ class Endpoint:
         self.inbound: "queue.Queue[Envelope]" = queue.Queue()
         self.on_connect: Optional[Callable[[str], None]] = None
         self.on_disconnect: Optional[Callable[[str], None]] = None
+        #: The owning node's telemetry scope (set by LocalNode) — endpoints
+        #: outlive any contextvar activation, so the scope rides here.
+        self.scope = None
 
     def connected_peers(self) -> Set[str]:
         return self.hub.peers_of(self.peer_id)
 
     def send(self, to: str, env: Envelope) -> bool:
+        if env.trace_ctx is None and self.scope is not None:
+            from .. import telemetry_scope
+
+            env.trace_ctx = telemetry_scope.envelope_trace_ctx(self.scope)
         return self.hub.deliver(self.peer_id, to, env)
 
     def disconnect(self, peer: str) -> None:

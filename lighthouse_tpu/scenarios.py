@@ -44,7 +44,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from . import blackbox, fault_injection, metrics
+from . import blackbox, fault_injection, metrics, telemetry_scope, tracing
 from .logs import get_logger
 from .network.transport import LinkPlan
 from .simulator import SimNode, Simulator
@@ -172,6 +172,7 @@ class ScenarioRunner:
         self._autotune_touched = False
         self._spam_endpoints: List[str] = []
         self._api_servers: List[Any] = []  # (cached, uncached) HTTP pairs
+        self._offense_seen = 0  # byz.offenses already journaled (fleet)
 
     # ------------------------------------------------------------ helpers
 
@@ -292,6 +293,10 @@ class ScenarioRunner:
             autotune.CONTROLLER.evaluate()
         if self.byz is not None:
             self.byz.observe_slot(slot)
+            self._journal_offenses()
+        # quiescent: fold worker-deferred fleet events into the scoped
+        # journals on this (runner) thread — see Simulator.drain_fleet_events
+        sim.drain_fleet_events()
         heads = {n.chain.head_root for n in sim.live_nodes}
         max_final = max(
             n.chain.finalized_checkpoint()[0] for n in sim.live_nodes)
@@ -304,6 +309,23 @@ class ScenarioRunner:
     def _finalized(self, agg) -> int:
         return agg(n.chain.finalized_checkpoint()[0]
                    for n in self.sim.live_nodes)
+
+    def _journal_offenses(self) -> None:
+        """Journal freshly-recorded byzantine offenses under the OFFENDING
+        node's telemetry scope (the node whose validator misbehaved) — the
+        head of the cross-node causal chain the fleet-timeline gate asserts
+        (offense on A precedes slashing inclusion on B in merge order)."""
+        offenses = self.byz.offenses
+        fresh, self._offense_seen = (
+            offenses[self._offense_seen:], len(offenses))
+        for off in fresh:
+            node = next((n for n in self.sim.live_nodes
+                         if off.validator in n.keys), None)
+            scope = getattr(node, "scope", None) if node is not None else None
+            with telemetry_scope.activate(scope):
+                blackbox.emit("adversary", "offense", slot=int(off.slot),
+                              validator=int(off.validator),
+                              strategy=off.strategy)
 
     # ------------------------------------------------------- event actions
 
@@ -715,8 +737,13 @@ class ScenarioRunner:
                 self._step_slot()
 
             converged = self.sim.wait_converged(self.CONVERGE_DEADLINE_S)
+            # late imports during the convergence pump defer fleet events
+            # too — fold them in before the gates read the merged timeline
+            self.sim.drain_fleet_events()
             final_finalized_min = self._finalized(min)
             per_node = [self._node_summary(n) for n in self.sim.nodes]
+            if self.byz is not None:
+                self._check_fleet_causality()
             extra = {}
             if scenario.extra_checks is not None:
                 extra = scenario.extra_checks(self) or {}
@@ -779,6 +806,8 @@ class ScenarioRunner:
                     "breakers": breakers,
                     "delay_metrics": self._delay_deltas(delay_before),
                     "timeline": self.timeline,
+                    # frozen BEFORE _cleanup unregisters the node scopes
+                    "fleet": self._fleet_section(),
                     "duration_s": round(time.monotonic() - started, 3),
                 })
                 self._write_artifact(artifact)
@@ -800,6 +829,67 @@ class ScenarioRunner:
             log.warning("postmortem capture failed",
                         scenario=self.scenario.name,
                         error=f"{type(e).__name__}: {e}")
+
+    def _check_fleet_causality(self) -> None:
+        """Gate: the merged fleet timeline must order every cross-node
+        slashing pipeline causally — the first journaled offense (on the
+        offending node's scope) precedes the first ``slashing_included``
+        (journaled under the including proposer's scope) in merge order."""
+        included = [o for o in self.byz.offenses if o.included_slot is not None]
+        if not included:
+            return  # nothing reached inclusion: nothing to order
+        timeline = blackbox.fleet_summary()["timeline"]
+        first_off = next((i for i, r in enumerate(timeline)
+                          if r.get("event") == "offense"), None)
+        first_inc = next((i for i, r in enumerate(timeline)
+                          if r.get("event") == "slashing_included"), None)
+        if first_off is None or first_inc is None:
+            raise ScenarioFailure(
+                f"fleet timeline is missing the slashing causal chain "
+                f"(offense at {first_off}, inclusion at {first_inc})")
+        if first_off >= first_inc:
+            raise ScenarioFailure(
+                f"fleet timeline orders slashing inclusion (index "
+                f"{first_inc}) before the offense (index {first_off}) — "
+                "cross-node causality broken in the merge")
+
+    def _fleet_section(self) -> dict:
+        """The SOAK artifact's fleet-observability evidence: per-node scope
+        snapshots, the merged causally-ordered timeline, and cross-node
+        trace trees — each joins a ``propose_block`` span on the origin
+        node to a ``gossip_block_import`` span on a receiving node via the
+        envelope-propagated trace context (``remote_trace_id``)."""
+        try:
+            summary = blackbox.fleet_summary()
+        except Exception as e:  # noqa: BLE001 — evidence must not mask gates
+            return {"error": f"{type(e).__name__}: {e}"}
+        proposals = {t.trace_id: t for t in tracing.TRACES.recent(
+            root="propose_block", limit=512)}
+        trees = []
+        for t in tracing.TRACES.recent(root="gossip_block_import", limit=1024):
+            origin = proposals.get(t.root.fields.get("remote_trace_id"))
+            if origin is None:
+                continue  # import of a non-traced (pre-scope) publish
+            trees.append({
+                "proposal": {
+                    "trace_id": origin.trace_id,
+                    "node": origin.root.fields.get("node"),
+                    "slot": origin.root.fields.get("slot"),
+                    "root": origin.root.fields.get("root"),
+                },
+                "import": {
+                    "trace_id": t.trace_id,
+                    "node": t.root.fields.get("node"),
+                    "remote_trace_id": t.root.fields.get("remote_trace_id"),
+                    "slot": t.root.fields.get("slot"),
+                    "root": t.root.fields.get("root"),
+                },
+            })
+        trees.sort(key=lambda e: (
+            e["import"].get("slot") or -1, str(e["import"].get("root")),
+            str(e["import"].get("node"))))
+        summary["trace_trees"] = trees
+        return summary
 
     def _node_summary(self, n: SimNode) -> dict:
         f_epoch, _ = n.chain.finalized_checkpoint()

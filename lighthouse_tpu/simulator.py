@@ -10,10 +10,12 @@ publishing blocks and attestations to the others.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from . import blackbox, telemetry_scope, tracing
 from .chain import BeaconChainHarness
 from .consensus import helpers as h
 from .network.node import LocalNode
@@ -58,9 +60,12 @@ class SimNode:
         self.keys = set(keys)  # validator indices this node runs
         self._keys_mask: Optional[np.ndarray] = None  # bool over validators
         self.alive = True
+        pid = peer_id or f"sim{index}"
+        self.scope = telemetry_scope.register(telemetry_scope.TelemetryScope(pid))
         self.node = LocalNode(
-            hub=hub, peer_id=peer_id or f"sim{index}",
+            hub=hub, peer_id=pid,
             chain=self._chain, harness=self.harness, endpoint=endpoint,
+            scope=self.scope,
             **(_sim_slasher_kwargs(self._chain.spec) if enable_slasher else {}),
         )
 
@@ -76,8 +81,14 @@ class SimNode:
         fresh.keys = old.keys
         fresh._keys_mask = None
         fresh.alive = True
+        # Fresh scope: a restarted process starts a NEW Lamport clock (and
+        # an empty scoped journal) — merge_journals handles the reset via
+        # the slot-major merge key.
+        fresh.scope = telemetry_scope.register(
+            telemetry_scope.TelemetryScope(old.peer_id))
         fresh.node = LocalNode(
             hub=hub, peer_id=old.peer_id, chain=old.chain, harness=old.harness,
+            scope=fresh.scope,
             **(_sim_slasher_kwargs(old.chain.spec)
                if old.node.slasher is not None else {}),
         )
@@ -115,7 +126,15 @@ class SimNode:
         out = {"proposed": 0, "attested": 0}
         if self.harness is None or not self.keys:
             return out
-        skip = skip_validators or set()
+        # Duties run under this node's telemetry scope: journal records,
+        # flight entries, and log lines emitted below land in the per-node
+        # views as well as the process-global rings.
+        with telemetry_scope.activate(self.scope):
+            self._run_duties_scoped(slot, skip_validators or set(), out)
+        return out
+
+    def _run_duties_scoped(self, slot: int, skip: set,
+                           out: Dict[str, int]) -> None:
         harness, chain = self.harness, self.chain
         spec = harness.spec
         state, parent_root = chain.state_at_slot(slot)
@@ -126,8 +145,27 @@ class SimNode:
         if (proposer in self.keys and proposer not in skip
                 and not state.validators[proposer].slashed):
             signed = harness.produce_signed_block(slot=slot)
-            chain.process_block(signed)
-            self.node.publish_block(signed)
+            root = signed.message.hash_tree_root().hex()
+            # publish_block runs INSIDE the proposal span: the outbound
+            # envelope's trace context snapshots the active trace id, which
+            # is what lets a remote import's resume_remote tree join back
+            # to this proposal in the merged fleet artifact.
+            with tracing.span("propose_block", slot=int(slot), root=root,
+                              node=self.peer_id, proposer=int(proposer)):
+                chain.process_block(signed)
+                blackbox.emit("fleet", "block_proposed", slot=int(slot),
+                              root=root, proposer=int(proposer))
+                body = signed.message.body
+                n_slashings = (len(body.attester_slashings)
+                               + len(body.proposer_slashings))
+                if n_slashings:
+                    # the causal tail of the slashing pipeline: an offense
+                    # on node A precedes this inclusion on node B in the
+                    # merged fleet timeline (slot-major merge key)
+                    blackbox.emit("fleet", "slashing_included",
+                                  slot=int(slot), root=root,
+                                  slashings=int(n_slashings))
+                self.node.publish_block(signed)
             out["proposed"] = 1
         # committees are epoch-deterministic on the advanced state.  The
         # membership scan is vectorized: one boolean ownership mask over the
@@ -163,7 +201,6 @@ class SimNode:
                     continue
                 self.node.publish_attestation(att)
                 out["attested"] += 1
-        return out
 
     def _ownership_mask(self, n_validators: int,
                         skip: set) -> np.ndarray:
@@ -195,6 +232,7 @@ class SimNode:
             for peer in list(endpoint.connected_peers()):
                 endpoint.hub.disconnect(self.node.peer_id, peer)
         self.node.shutdown()
+        telemetry_scope.unregister(self.node.peer_id)
 
 
 class Simulator:
@@ -210,9 +248,13 @@ class Simulator:
     def __init__(self, *, node_count: int = 3, validator_count: int = 16,
                  genesis_time: int = 1_600_000_000, spec=None,
                  transport: str = "hub", discovery: Optional[str] = None,
-                 seed: int = 0, enable_slasher: bool = False):
+                 seed: int = 0, enable_slasher: bool = False,
+                 clock=time.monotonic):
         if transport not in ("hub", "tcp_secured"):
             raise ValueError(f"unknown transport {transport!r}")
+        # Injectable deadline clock (virtual-time soaks swap it); real
+        # waiting (sleep) still uses the wallclock.
+        self._clock = clock
         tcp = transport == "tcp_secured"
         self.genesis_time = genesis_time
         self.validator_count = validator_count
@@ -288,6 +330,9 @@ class Simulator:
             # one fabric tick per slot: link-plan latency is slot-granular
             self.hub.advance_tick()
             self.settle()
+        # the fabric is quiescent: worker-deferred fleet events are final
+        # for this slot — drain them on this (runner) thread
+        self.drain_fleet_events()
         if require_converged and not self.wait_converged():
             raise AssertionError(f"heads failed to converge at slot {slot}")
         return slot
@@ -349,8 +394,8 @@ class Simulator:
                  if n.alive]
         if not group:
             return True
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = self._clock() + timeout
+        while self._clock() < deadline:
             heads = {n.chain.head_root for n in group}
             if len(heads) == 1:
                 return True
@@ -361,6 +406,24 @@ class Simulator:
             # all idle yet diverged: don't busy-spin until the deadline
             time.sleep(0.05)
         return len({n.chain.head_root for n in group}) == 1
+
+    def drain_fleet_events(self) -> None:
+        """Drain worker-deferred fleet journal events into each node's
+        scoped journal — on THIS (runner) thread, in stable node order, with
+        each scope's stable-sorted batch — so per-node ``seq`` and Lamport
+        assignment never depends on worker-thread interleaving (the 2-run
+        fleet-timeline determinism gate hangs on this)."""
+        for n in sorted(self.live_nodes, key=lambda n: n.peer_id):
+            scope = getattr(n, "scope", None)
+            if scope is None:
+                continue
+            events = scope.drain_pending()
+            if not events:
+                continue
+            with telemetry_scope.activate(scope):
+                for ev in events:
+                    blackbox.emit(ev["source"], ev["event"],
+                                  link=ev.get("link"), **ev["fields"])
 
     # ----------------------------------------------------------- churn
 

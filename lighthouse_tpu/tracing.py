@@ -216,6 +216,32 @@ def span(name: str, hist=None, hist_labels: Optional[dict] = None, **fields):
             TRACES.push(trace)
 
 
+@contextmanager
+def resume_remote(ctx: Optional[dict], name: str, **fields):
+    """Resume an envelope-propagated trace context from ANOTHER node as a
+    new local root trace (the receiving half of cross-node propagation).
+
+    The remote linkage rides in fields — ``remote_trace_id`` /
+    ``remote_node`` / ``remote_lamport`` — rather than by reusing the
+    origin's trace id: :data:`TRACES` is process-global across simulated
+    nodes, so id reuse would splice two nodes' spans into one tree.  The
+    fleet artifact joins proposal and import trees on
+    ``remote_trace_id == <proposal trace_id>``.  Always roots a fresh
+    trace: any span active on this worker thread belongs to LOCAL work,
+    not to the remote cause."""
+    ctx = ctx or {}
+    token = _current.set(None)
+    try:
+        with span(name,
+                  remote_trace_id=ctx.get("trace_id"),
+                  remote_node=ctx.get("node"),
+                  remote_lamport=ctx.get("lamport"),
+                  **fields) as sp:
+            yield sp
+    finally:
+        _current.reset(token)
+
+
 def span_event(name: str, **fields) -> Optional[Span]:
     """A zero-duration marker child on the active span — for point events
     that explain a trace without timing anything (a response-cache

@@ -1,0 +1,79 @@
+"""Seeded violations proving pass coverage of ``telemetry_scope.py``
+(parsed, never imported — ISSUE 19).
+
+The real module is in the race / lock-order / host-sync SCAN_DIRS with a
+clean contract: the scope lock guards only the Lamport clock and the
+deferred-event buffer, never nests another lock, never blocks while held,
+and the whole plane is host-side plumbing (no device syncs).  Each seed
+below is that contract violated in the scope's own shape, so a future
+regression in the real module is provably within the passes' reach.
+
+Expected findings: one race ``unregistered-lock`` (a scope-shaped module
+lock missing from the ownership table), one lock-order ``blocking-call``
+(a journal append sleeping under the scope lock), and one host-sync
+``hot-path-sync`` (a scope snapshot materializing a device value).
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+
+N_BUCKETS = (1, 2)  # keep fixture_recompile_hazard's no-bucket-decl quiet
+
+RACE_OWNERSHIP = {
+    "classes": {
+        "SeededScope": {
+            "_lock": ["_lamport", "_pending"],
+        },
+    },
+    "module": {},
+}
+
+# SEEDED: unregistered-lock — a scope-registry lock that never made it
+# into the ownership table (the drift the registry discipline exists to
+# catch; the real _SCOPES_LOCK is registered in lock_ownership.py).
+_ROGUE_SCOPE_LOCK = threading.Lock()
+
+
+@jax.jit
+def scope_fixture_kernel(x):
+    return x + 1
+
+
+class SeededScope:
+    """A telemetry-scope-shaped class: Lamport clock + pending buffer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._lamport = 0
+        self._pending = []
+
+    def tick_is_fine(self, at_least=0):
+        with self._lock:
+            self._lamport = max(self._lamport, at_least) + 1  # clean: held
+            return self._lamport
+
+    def defer_is_fine(self, item):
+        with self._lock:
+            self._pending.append(item)  # clean: lexical hold
+
+    def slow_append(self, item):
+        # SEEDED: blocking-call — a journal append must never block under
+        # the scope lock (it is taken on every gossip worker's emit path).
+        with self._lock:
+            time.sleep(0.5)
+            self._pending.append(item)
+
+    def snapshot_syncs_device(self, batch):
+        # SEEDED: hot-path-sync — a scope snapshot materializing a device
+        # value.  The real snapshot() reads host dicts and deque lengths
+        # only; a tally that reached onto the device would stall the
+        # failure paths that read it.
+        tally = scope_fixture_kernel(batch)
+        return np.asarray(tally)
+
+    def snapshot_host_only_is_fine(self):
+        with self._lock:
+            return {"lamport": self._lamport, "pending": len(self._pending)}

@@ -70,6 +70,10 @@ SCAN_DIRS = (
     # paths, often while a device op is wedged — it must never
     # materialize a device value (SCAN_DIRS rot fix, ISSUE 18 satellite).
     "lighthouse_tpu/blackbox.py",
+    # Node-scoped telemetry (ISSUE 19): journal/flight/log mirrors ride
+    # failure and gossip hot paths — host-side plumbing only, like
+    # blackbox.
+    "lighthouse_tpu/telemetry_scope.py",
     "bench.py",
 )
 

@@ -84,6 +84,10 @@ SCAN_DIRS = (
     # registries under locks, written from every subsystem's failure path
     # — same discipline (SCAN_DIRS rot fix, ISSUE 18 satellite).
     "lighthouse_tpu/blackbox.py",
+    # Node-scoped telemetry (ISSUE 19): the scope lock is taken on every
+    # journal append (including gossip worker paths) — it must never
+    # nest another lock or block while held.
+    "lighthouse_tpu/telemetry_scope.py",
 )
 
 #: Call names that block the calling thread (receiver-based heuristics;

@@ -77,6 +77,10 @@ SCAN_DIRS = (
     "lighthouse_tpu/http_api/response_cache.py",
     "lighthouse_tpu/scenarios.py",
     "lighthouse_tpu/network/transport.py",
+    # Node-scoped telemetry (ISSUE 19): Lamport clock + deferred-event
+    # buffer under the scope lock, written from processor worker threads
+    # and drained on the runner — exactly the registry's audience.
+    "lighthouse_tpu/telemetry_scope.py",
 )
 
 EXTERNAL_ROOT = "external"

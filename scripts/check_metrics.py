@@ -76,6 +76,9 @@ REGISTERING_MODULES = (
     # black box stays importable without jax (the campaign parent journals
     # through it — test_repo_lints gates the same under an import poison)
     "lighthouse_tpu.blackbox",
+    # fleet_* live with the node-scoped telemetry plane (ISSUE 19); same
+    # jax-free import discipline as blackbox, which imports it at top
+    "lighthouse_tpu.telemetry_scope",
 )
 
 # The incident black box's metric contract (ISSUE 17): every journal
@@ -84,6 +87,16 @@ REGISTERING_MODULES = (
 REQUIRED_BLACKBOX_METRICS = (
     "blackbox_events_total",
     "blackbox_captures_total",
+)
+
+# The fleet observability contract (ISSUE 19): scoped journal routing and
+# cross-node trace links must stay countable — `fleet_journal_events_total
+# {node}` is how an operator sees a node's telemetry go dark, and
+# `fleet_trace_links_total{kind}` is the canary for envelope trace
+# propagation silently breaking.
+REQUIRED_FLEET_METRICS = (
+    "fleet_journal_events_total",
+    "fleet_trace_links_total",
 )
 
 # The serving layer's metric contract (ISSUE 14): per-route latency,
@@ -166,6 +179,11 @@ def main() -> int:
         if name not in metrics._REGISTRY:
             errors.append(f"{name}: required black-box metric is not "
                           "registered")
+
+    for name in REQUIRED_FLEET_METRICS:
+        if name not in metrics._REGISTRY:
+            errors.append(f"{name}: required fleet-observability metric "
+                          "is not registered")
 
     check_cached_routes(errors)
 

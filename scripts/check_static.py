@@ -102,8 +102,9 @@ SELF_TEST = {
     },
     "lock-order": {
         # 2 cycle pairs (AB/BA lexical + the multi-hop c/d inversion), each
-        # reported once per direction
-        "must_fire": {"lock-cycle": 4, "lock-self-cycle": 1, "blocking-call": 2},
+        # reported once per direction; 3rd blocking-call is the telemetry
+        # scope seed (ISSUE 19: sleep under the scope lock)
+        "must_fire": {"lock-cycle": 4, "lock-self-cycle": 1, "blocking-call": 3},
         "must_not_flag_context": {"BlocksUnderLock.allowed"},
     },
     "device-purity": {
@@ -131,11 +132,13 @@ SELF_TEST = {
     "host-sync": {
         # 7th seed: the autotune-shaped controller leg (ISSUE 15) — the
         # real lighthouse_tpu/autotune.py is in SCAN_DIRS with a zero-sync
-        # contract, and this proves the pass would see it drift
-        "must_fire": {"hot-path-sync": 8},
+        # contract, and this proves the pass would see it drift; 9th is
+        # the telemetry-scope snapshot seed (ISSUE 19, same contract)
+        "must_fire": {"hot-path-sync": 9},
         "must_not_flag_context": {
             "host_marshalling_is_fine",
             "suppressed_sync",
+            "snapshot_host_only_is_fine",
         },
     },
     "sharding-ready": {
@@ -157,12 +160,13 @@ SELF_TEST = {
         # 4 unguarded writes (public bump, 2-root _loop, mutator drain,
         # module poke); 5 stale-registry seeds (ghost class, ghost lock,
         # never-written attr/global, duplicate claim); unregistered locks
-        # (the fixture's seeded pair — other fixtures' locks add more,
+        # (fixture_race's seeded pair + fixture_telemetry_scope's rogue
+        # scope-registry lock, ISSUE 19 — other fixtures' locks add more,
         # hence >= semantics)
         "must_fire": {
             "unguarded-write": 4,
             "ownership-stale": 5,
-            "unregistered-lock": 2,
+            "unregistered-lock": 3,
         },
         "must_not_flag_context": {
             "bump_locked_is_fine",
@@ -171,6 +175,8 @@ SELF_TEST = {
             "sanctioned_reset_is_fine",
             "poke_locked_is_fine",
             "rebind_locked_is_fine",
+            "tick_is_fine",
+            "defer_is_fine",
         },
     },
     "wallclock": {

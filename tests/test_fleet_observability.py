@@ -1,0 +1,321 @@
+"""Fleet observability (ISSUE 19): the node-scoped telemetry seam, the
+Lamport-ordered journal merge, cross-node trace propagation, and the
+merged fleet timeline — unit matrix plus the two-run byte-identity gates
+on the tier-1 smoke scenarios."""
+
+import http.client
+import json
+
+import pytest
+
+from lighthouse_tpu import blackbox, fault_injection, telemetry_scope, tracing
+from lighthouse_tpu.crypto.bls.backends import set_backend
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path):
+    set_backend("fake")
+    fault_injection.reset_for_tests()
+    blackbox.reset_for_tests()  # also clears the telemetry_scope registry
+    blackbox.configure(directory=str(tmp_path / "postmortems"))
+    yield
+    fault_injection.reset_for_tests()
+    blackbox.reset_for_tests()
+    set_backend("host")
+
+
+# --------------------------------------------------------------- unit layer
+
+
+class TestTelemetryScope:
+    def test_lamport_tick_clock_and_at_least(self):
+        scope = telemetry_scope.TelemetryScope("n0")
+        assert scope.tick() == 1
+        assert scope.tick() == 2
+        # a linked event must land strictly after its remote cause
+        assert scope.tick(at_least=10) == 11
+        # clock() is a read-only stamp (outbound envelopes never tick)
+        assert scope.clock() == 11
+        assert scope.clock() == 11
+        assert scope.tick() == 12
+
+    def test_defer_drain_is_stable_under_arrival_order(self):
+        scope = telemetry_scope.TelemetryScope("n0")
+        # worker threads may interleave arbitrarily; the drain re-sorts on
+        # stable fields so two runs at one seed agree
+        scope.defer("fleet", "block_imported", {"slot": 7, "root": "bb"})
+        scope.defer("fleet", "block_imported", {"slot": 5, "root": "zz"})
+        scope.defer("fleet", "block_imported", {"slot": 7, "root": "aa"},
+                    link=("n1", 3))
+        drained = scope.drain_pending()
+        assert [(d["fields"]["slot"], d["fields"]["root"])
+                for d in drained] == [(5, "zz"), (7, "aa"), (7, "bb")]
+        assert drained[1]["link"] == ("n1", 3)
+        assert scope.drain_pending() == []
+
+    def test_registry_and_activation(self):
+        b = telemetry_scope.register(telemetry_scope.TelemetryScope("b"))
+        a = telemetry_scope.register(telemetry_scope.TelemetryScope("a"))
+        assert telemetry_scope.get("a") is a
+        assert [s.node_id for s in telemetry_scope.all_scopes()] == ["a", "b"]
+        assert telemetry_scope.current() is None
+        with telemetry_scope.activate(a):
+            assert telemetry_scope.current() is a
+            with telemetry_scope.activate(b):
+                assert telemetry_scope.current() is b
+            assert telemetry_scope.current() is a
+        assert telemetry_scope.current() is None
+        telemetry_scope.unregister("a")
+        assert telemetry_scope.get("a") is None
+
+    def test_envelope_trace_ctx(self):
+        assert telemetry_scope.envelope_trace_ctx(None) is None
+        scope = telemetry_scope.TelemetryScope("n0")
+        scope.tick()
+        ctx = telemetry_scope.envelope_trace_ctx(scope)
+        assert ctx == {"trace_id": None, "node": "n0", "lamport": 1}
+        with tracing.span("propose_block", slot=1) as sp:
+            ctx = telemetry_scope.envelope_trace_ctx(scope)
+            assert ctx["trace_id"] == sp.trace.trace_id
+        # stamping reads the clock, never advances it
+        assert scope.clock() == 1
+
+
+class TestScopedEmit:
+    def test_emit_mirrors_into_the_active_scope(self):
+        scope = telemetry_scope.register(telemetry_scope.TelemetryScope("n0"))
+        with telemetry_scope.activate(scope):
+            rec = blackbox.emit("fleet", "block_proposed", slot=3, root="ab")
+        assert rec["node"] == "n0"
+        assert rec["lamport"] == 1
+        (mirror,) = scope.journal.window()
+        assert mirror["event"] == "block_proposed"
+        assert mirror["node"] == "n0"
+        # the mirror carries the SCOPED journal's own seq
+        assert mirror["seq"] == 1
+        # and the process-global journal saw the record too
+        assert any(r["event"] == "block_proposed"
+                   for r in blackbox.JOURNAL.window(source="fleet"))
+
+    def test_unscoped_emit_stays_process_global(self):
+        rec = blackbox.emit("fleet", "block_proposed", slot=3, root="ab")
+        assert "node" not in rec and "lamport" not in rec
+
+    def test_linked_emit_ticks_past_the_origin_clock(self):
+        scope = telemetry_scope.register(telemetry_scope.TelemetryScope("n1"))
+        with telemetry_scope.activate(scope):
+            rec = blackbox.emit("fleet", "block_imported", slot=3,
+                                link=("n0", 41))
+        assert rec["link"] == ["n0", 41]
+        assert rec["lamport"] == 42  # max(local, 41) + 1
+
+
+class TestMergeJournals:
+    def test_slot_major_order_survives_clock_skew(self):
+        # node a's Lamport clock races far ahead of node b's — the virtual
+        # slot stays the canonical fleet time, so skew cannot reorder
+        # across slots
+        merged = blackbox.merge_journals({
+            "a": [{"seq": 1, "slot": 1, "lamport": 900, "event": "x"},
+                  {"seq": 2, "slot": 2, "lamport": 901, "event": "y"}],
+            "b": [{"seq": 1, "slot": 1, "lamport": 2, "event": "z"}],
+        })
+        assert [(r["slot"], r["node"]) for r in merged] == [
+            (1, "b"), (1, "a"), (2, "a")]
+
+    def test_same_slot_cross_node_link_orders_cause_first(self):
+        # within one slot the Lamport tick is the tiebreak: the import
+        # ticked past the proposal's stamp, so it merges strictly after
+        merged = blackbox.merge_journals({
+            "a": [{"seq": 9, "slot": 5, "lamport": 3,
+                   "event": "block_proposed"}],
+            "b": [{"seq": 1, "slot": 5, "lamport": 4,
+                   "event": "block_imported", "link": ["a", 3]}],
+        })
+        assert [r["event"] for r in merged] == ["block_proposed",
+                                                "block_imported"]
+
+    def test_node_restart_resets_lamport_within_slot_only(self):
+        # node a restarted (fresh clock at 1) in slot 3; node b is deep
+        # into lamport 50 but still in slot 2 — restart reordering is
+        # confined to a's own slot, never across slots
+        merged = blackbox.merge_journals({
+            "a": [{"seq": 40, "slot": 1, "lamport": 80, "event": "old"},
+                  {"seq": 1, "slot": 3, "lamport": 1, "event": "reborn"}],
+            "b": [{"seq": 7, "slot": 2, "lamport": 50, "event": "mid"}],
+        })
+        assert [r["event"] for r in merged] == ["old", "mid", "reborn"]
+
+    def test_empty_and_partial_journals(self):
+        assert blackbox.merge_journals({}) == []
+        merged = blackbox.merge_journals({
+            "a": [],
+            "b": None,
+            "c": [{"seq": 1, "slot": None, "lamport": 1, "event": "x"}],
+        })
+        assert [r["event"] for r in merged] == ["x"]
+        # slotless records (no virtual clock installed) sort first
+        merged = blackbox.merge_journals({
+            "c": [{"seq": 2, "slot": 0, "lamport": 2, "event": "slotted"},
+                  {"seq": 1, "lamport": 1, "event": "slotless"}],
+        })
+        assert [r["event"] for r in merged] == ["slotless", "slotted"]
+
+    def test_volatile_fields_dropped_and_node_defaulted(self):
+        (entry,) = blackbox.merge_journals({
+            "a": [{"seq": 1, "slot": 2, "lamport": 1, "event": "x",
+                   "t_ms": 123456, "trace_id": "deadbeef",
+                   "remote_trace_id": "cafe", "flight_seq": ["a", 9]}],
+        })
+        assert blackbox.VOLATILE_FIELDS.isdisjoint(entry)
+        assert entry["node"] == "a"  # defaulted from the journal key
+
+    def test_fleet_summary_merges_registered_scopes(self):
+        for node in ("n1", "n0"):
+            scope = telemetry_scope.register(
+                telemetry_scope.TelemetryScope(node))
+            with telemetry_scope.activate(scope):
+                blackbox.emit("fleet", "block_proposed", slot=1, root=node)
+        summary = blackbox.fleet_summary()
+        assert [n["node"] for n in summary["nodes"]] == ["n0", "n1"]
+        assert [r["node"] for r in summary["timeline"]] == ["n0", "n1"]
+        assert blackbox.fleet_summary(limit=1)["timeline"] == \
+            summary["timeline"][-1:]
+
+
+# ----------------------------------------------- two-run byte-identity gate
+
+
+def _run_twice(factory, tmp_path):
+    timelines, artifacts = [], []
+    for run_index in range(2):
+        fault_injection.reset_for_tests()
+        blackbox.reset_for_tests()
+        blackbox.configure(directory=str(tmp_path / f"pm{run_index}"))
+        from lighthouse_tpu.scenarios import run_scenario
+
+        artifact = run_scenario(factory(seed=7),
+                                out_dir=str(tmp_path / f"run{run_index}"))
+        assert artifact["passed"]
+        timelines.append(json.dumps(artifact["fleet"]["timeline"],
+                                    sort_keys=True))
+        artifacts.append(artifact)
+    return timelines, artifacts
+
+
+class TestFleetTimelineDeterminism:
+    def test_smoke_partition_two_runs_byte_identical(self, tmp_path):
+        """ISSUE 19 acceptance: two smoke_partition runs at one seed
+        produce byte-identical merged fleet timelines, and the SOAK
+        artifact carries a cross-node trace tree joining a proposal span
+        to a remote import span."""
+        from lighthouse_tpu.scenarios import smoke_partition
+
+        timelines, artifacts = _run_twice(smoke_partition, tmp_path)
+        assert timelines[0] == timelines[1]
+        fleet = artifacts[0]["fleet"]
+        assert fleet["timeline"], "fleet timeline is empty"
+        assert all(blackbox.VOLATILE_FIELDS.isdisjoint(r)
+                   for r in fleet["timeline"])
+        cross = [t for t in fleet["trace_trees"]
+                 if t["proposal"]["node"] != t["import"]["node"]]
+        assert cross, "no cross-node trace tree in the SOAK artifact"
+        for tree in cross:
+            assert tree["import"]["remote_trace_id"] == \
+                tree["proposal"]["trace_id"]
+        # the artifact on disk carries the fleet section too
+        path = tmp_path / "run0" / "SOAK_smoke_partition_seed7.json"
+        with open(path) as f:
+            on_disk = json.load(f)
+        assert on_disk["fleet"]["timeline"] == fleet["timeline"]
+
+    def test_byz_double_vote_two_runs_byte_identical(self, tmp_path):
+        """Same gate on the byzantine smoke — plus the causal ordering the
+        runner itself asserts: the offense on the byzantine node precedes
+        the slashing inclusion on the proposer node in merge order."""
+        from lighthouse_tpu.scenarios import byz_double_vote_smoke
+
+        timelines, artifacts = _run_twice(byz_double_vote_smoke, tmp_path)
+        assert timelines[0] == timelines[1]
+        timeline = artifacts[0]["fleet"]["timeline"]
+        offense = next(i for i, r in enumerate(timeline)
+                       if r["event"] == "offense")
+        included = next(i for i, r in enumerate(timeline)
+                        if r["event"] == "slashing_included")
+        assert offense < included
+        # the two events live on different nodes: cross-node causality is
+        # what the Lamport merge exists to witness
+        assert timeline[offense]["node"] != timeline[included]["node"]
+
+
+# ------------------------------------------------------------- HTTP surface
+
+
+@pytest.fixture()
+def fleet_api():
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.http_api import HttpApiServer
+
+    harness = BeaconChainHarness(validator_count=8, fake_crypto=True)
+    server = HttpApiServer(harness.chain).start()
+    yield server
+    server.stop()
+
+
+def _request(port, method, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request(method, path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+class TestFleetEndpoint:
+    def test_fleet_summary_shape_and_limit(self, fleet_api):
+        for node in ("n0", "n1"):
+            scope = telemetry_scope.register(
+                telemetry_scope.TelemetryScope(node))
+            with telemetry_scope.activate(scope):
+                blackbox.emit("fleet", "block_proposed", slot=1, root=node)
+                blackbox.emit("fleet", "block_imported", slot=2, root=node)
+        status, out = _request(fleet_api.port, "GET", "/lighthouse/fleet")
+        assert status == 200
+        data = out["data"]
+        assert [n["node"] for n in data["nodes"]] == ["n0", "n1"]
+        assert len(data["timeline"]) == 4
+        status, out = _request(fleet_api.port, "GET",
+                               "/lighthouse/fleet?limit=1")
+        assert status == 200
+        assert len(out["data"]["timeline"]) == 1
+        status, _ = _request(fleet_api.port, "GET",
+                             "/lighthouse/fleet?limit=junk")
+        assert status == 400
+
+    def test_device_batches_node_filter(self, fleet_api):
+        from lighthouse_tpu import device_telemetry
+
+        device_telemetry.reset_for_tests()
+        scope = telemetry_scope.register(telemetry_scope.TelemetryScope("n0"))
+        with telemetry_scope.activate(scope):
+            device_telemetry.record_batch(op="bls_verify", shape=(8, 4),
+                                          n_live=6)
+        device_telemetry.record_batch(op="bls_verify", shape=(8, 4), n_live=6)
+        status, out = _request(fleet_api.port, "GET",
+                               "/lighthouse/device/batches?node=n0")
+        assert status == 200
+        assert out["data"], "node filter should match the scoped batch"
+        assert all(r["node"] == "n0" for r in out["data"])
+        # the journal cross-reference for a scoped batch is the fleet
+        # (node, seq) pair — a plain int is ambiguous across N nodes
+        scoped_seqs = {r["seq"] for r in out["data"]}
+        journal = blackbox.JOURNAL.window(source="device_batch")
+        assert any(r.get("flight_seq") == ["n0", s]
+                   for r in journal for s in scoped_seqs)
+        assert any(isinstance(r.get("flight_seq"), int) for r in journal), (
+            "the unscoped batch should keep the legacy int flight_seq")
+        status, out = _request(fleet_api.port, "GET",
+                               "/lighthouse/device/batches?node=ghost")
+        assert status == 200
+        assert out["data"] == []
