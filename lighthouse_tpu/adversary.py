@@ -12,7 +12,13 @@ subset of a node's validators through misbehavior strategies —
   validator's previous honest vote (seeded one epoch, sprung the next);
 - ``invalid_block``: structurally valid SSZ carrying consensus-invalid
   content (bad state root, wrong proposer, future slot, unknown parent);
-- ``malformed_gossip``: truncated SSZ / corrupted snappy on real topics.
+- ``malformed_gossip``: truncated SSZ / corrupted snappy on real topics;
+- ``invalid_aggregate``: ``SignedAggregateAndProof`` wraps around an
+  HONEST inner attestation whose aggregator fails the gossip rules (not
+  in the committee, index out of the registry, undecodable SSZ);
+- ``malformed_sync_contribution``: ``SignedContributionAndProof`` at the
+  current slot whose contribution fails the sync gossip rules (bad or
+  mismatched subcommittee, zero participation bits, undecodable SSZ).
 
 Slashable messages are signed through the EXPLICIT unsafe seam on
 :class:`~.validator_client.validator_store.ValidatorStore`
@@ -727,6 +733,175 @@ class ByzantineController:
         spec["emitted"] += 1
         self._record("malformed_gossip", slot, None,
                      f"{count} malformed messages at {victim.peer_id}")
+
+    # -------------------------------------------------- invalid aggregate
+
+    AGGREGATE_MODES = ("not_in_committee", "aggregator_out_of_range",
+                       "undecodable")
+
+    def _act_invalid_aggregate(self, spec: dict, slot: int) -> None:
+        """``SignedAggregateAndProof`` wraps that fail the aggregate gossip
+        rules.  The INNER attestation is honest (real committee data, a real
+        member's signature) — the attack is the wrap, so the victim must
+        reach the aggregate-specific checks in ``preverify_aggregate``
+        rather than bounce off the inner preverify.  Each mode launders
+        through its OWN forger identity: reject penalties graylist a forger
+        after a couple of hits, and a shared forger would have later modes'
+        traffic silently dropped instead of rejected (the per-reason metric
+        gates need every mode to actually reach validation)."""
+        source = self._node(spec["node"])
+        if source is None or source.harness is None:
+            return
+        victim = self._node(spec["kwargs"].get("target", 0))
+        if victim is None:
+            return
+        chain, sp = source.chain, source.harness.spec
+        types = source.harness.types
+        state = chain.head_state
+        committee = h.get_beacon_committee(state, slot, 0, sp)
+        committee_set = {int(i) for i in committee}
+        data = chain.produce_attestation_data(slot, 0)
+        modes = spec["kwargs"].get("modes", list(self.AGGREGATE_MODES))
+        per_mode = spec["kwargs"].get("per_mode", 4)
+        signer = min(source.keys)
+        store, pk = self._store(source), self._pubkey(source, signer)
+        topic = str(topics_mod.GossipTopic(
+            source.node.router.fork_digest,
+            topics_mod.BEACON_AGGREGATE_AND_PROOF))
+        forgers = spec["state"].setdefault("forgers", {})
+        for mode in modes:
+            if mode not in forgers:
+                forgers[mode] = self._forger(victim.peer_id)
+            forger, endpoint = forgers[mode]
+            for i in range(per_mode):
+                digest = self._digest("invalid_aggregate", slot, mode, i)
+                pos = i % len(committee)
+                inner = self._build_attestation(
+                    source, data, 0, pos, committee,
+                    source.harness.sign_attestation_data(
+                        state, data, int(committee[pos])).to_bytes())
+                if mode == "not_in_committee":
+                    aggregator = min(set(range(len(state.validators)))
+                                     - committee_set)
+                elif mode == "aggregator_out_of_range":
+                    aggregator = len(state.validators) + 1 + digest[0] % 7
+                elif mode == "undecodable":
+                    aggregator = signer
+                else:
+                    raise ValueError(
+                        f"unknown invalid_aggregate mode {mode!r}")
+                message = types.AggregateAndProof(
+                    aggregator_index=aggregator, aggregate=inner,
+                    selection_proof=store.selection_proof(pk, slot))
+                signed = types.SignedAggregateAndProof(
+                    message=message,
+                    signature=store.sign_aggregate_and_proof_unsafe(
+                        pk, message))
+                raw = signed.as_ssz_bytes()
+                if mode == "undecodable":
+                    raw = raw[: 1 + digest[1] % max(1, len(raw) - 1)]
+                self._send_gossip(endpoint, forger, [victim.peer_id],
+                                  topic, compress(raw))
+        spec["emitted"] += 1
+        self._record(
+            "invalid_aggregate", slot, None,
+            f"{len(modes)}x{per_mode} forged aggregates at {victim.peer_id} "
+            f"({','.join(modes)})")
+
+    # -------------------------------------- malformed sync contribution
+
+    SYNC_CONTRIBUTION_MODES = ("bad_subcommittee", "not_in_subcommittee",
+                               "empty_contribution", "undecodable")
+
+    def _act_malformed_sync_contribution(self, spec: dict, slot: int) -> None:
+        """``SignedContributionAndProof`` messages that fail the sync gossip
+        rules.  Pinned to the CURRENT slot deliberately: the chain IGNOREs
+        (no reject, no penalty) contributions outside the ±1-slot window, so
+        a stale-slot forgery would prove nothing.  One forger per mode, as
+        in ``_act_invalid_aggregate``."""
+        source = self._node(spec["node"])
+        if source is None or source.harness is None:
+            return
+        victim = self._node(spec["kwargs"].get("target", 0))
+        if victim is None:
+            return
+        chain, sp = source.chain, source.harness.spec
+        types = source.harness.types
+        state = chain.head_state
+        sub_size = chain.sync_contribution_pool._sub_size()
+        # first owned validator with a seat in this period's sync committee
+        # (a 32-seat committee over 16 validators leaves ~13% of validators
+        # without a seat on any given seed — scan instead of betting on one)
+        aggregator, positions = None, []
+        for v in sorted(source.keys):
+            positions = chain._sync_committee_positions(state, v, slot=slot)
+            if positions:
+                aggregator = v
+                break
+        if aggregator is None:
+            return  # no owned seat this period; retry next slot
+        covered = sorted({p // sub_size for p in positions})
+        free = [s for s in range(sp.sync_committee_subnet_count)
+                if s not in covered]
+        modes = spec["kwargs"].get(
+            "modes", list(self.SYNC_CONTRIBUTION_MODES))
+        per_mode = spec["kwargs"].get("per_mode", 4)
+        store, pk = self._store(source), self._pubkey(source, aggregator)
+        head_root = chain.head_root
+        topic = str(topics_mod.GossipTopic(
+            source.node.router.fork_digest,
+            topics_mod.SYNC_COMMITTEE_CONTRIBUTION_AND_PROOF))
+        forgers = spec["state"].setdefault("forgers", {})
+        sent = []
+        for mode in modes:
+            if mode == "not_in_subcommittee" and not free:
+                continue  # aggregator covers every subnet (tiny committee)
+            if mode not in forgers:
+                forgers[mode] = self._forger(victim.peer_id)
+            forger, endpoint = forgers[mode]
+            for i in range(per_mode):
+                digest = self._digest(
+                    "malformed_sync_contribution", slot, mode, i)
+                bits = [False] * sub_size
+                if mode == "bad_subcommittee":
+                    sub = sp.sync_committee_subnet_count + digest[0] % 4
+                    bits[i % sub_size] = True
+                elif mode == "not_in_subcommittee":
+                    sub = free[0]
+                    bits[i % sub_size] = True
+                elif mode == "empty_contribution":
+                    sub = covered[0]  # member, so the zero-bits check fires
+                elif mode == "undecodable":
+                    sub = covered[0]
+                    bits[i % sub_size] = True
+                else:
+                    raise ValueError(
+                        f"unknown malformed_sync_contribution mode {mode!r}")
+                contribution = types.SyncCommitteeContribution(
+                    slot=slot, beacon_block_root=head_root,
+                    subcommittee_index=sub, aggregation_bits=bits,
+                    signature=store.sign_sync_committee_message(
+                        pk, slot, head_root))
+                message = types.ContributionAndProof(
+                    aggregator_index=aggregator, contribution=contribution,
+                    selection_proof=store.sync_selection_proof(
+                        pk, slot, sub, types))
+                signed = types.SignedContributionAndProof(
+                    message=message,
+                    signature=store.sign_contribution_and_proof_unsafe(
+                        pk, message))
+                raw = signed.as_ssz_bytes()
+                if mode == "undecodable":
+                    # fixed-size container: any truncation is a length error
+                    raw = raw[: 1 + digest[1] % max(1, len(raw) - 1)]
+                self._send_gossip(endpoint, forger, [victim.peer_id],
+                                  topic, compress(raw))
+            sent.append(mode)
+        spec["emitted"] += 1
+        self._record(
+            "malformed_sync_contribution", slot, None,
+            f"{len(sent)}x{per_mode} forged contributions at "
+            f"{victim.peer_id} ({','.join(sent)})")
 
     # ---------------------------------------------------------- evidence
 

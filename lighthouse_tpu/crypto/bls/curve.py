@@ -58,18 +58,93 @@ def neg(p1: Point) -> Point:
     return (x1, -y1)
 
 
+# Jacobian projective coordinates (x = X/Z^2, y = Y/Z^3) for the scalar-
+# multiplication hot path: affine double-and-add pays one ~381-bit modular
+# inversion PER STEP (~0.3 ms each on Python ints), so deriving a
+# production-scale registry's worth of interop keypairs took minutes of
+# setup.  Jacobian arithmetic is inversion-free until the single final
+# conversion — an order-of-magnitude speedup with identical results.
+# Formulas: EFD dbl-2009-l / add-2007-bl, valid for a = 0 over every field
+# in the tower (the same genericity contract as the affine ops above).
+# Infinity stays None; a Jacobian point is a tuple (X, Y, Z).
+
+
+def _jac_double(p):
+    if p is None:
+        return None
+    X1, Y1, Z1 = p
+    if Y1.is_zero():
+        return None
+    A = X1 * X1
+    B = Y1 * Y1
+    C = B * B
+    t = X1 + B
+    D = t * t - A - C
+    D = D + D
+    E = A + A + A
+    F = E * E
+    X3 = F - (D + D)
+    C8 = C + C
+    C8 = C8 + C8
+    C8 = C8 + C8
+    Y3 = E * (D - X3) - C8
+    Z3 = (Y1 + Y1) * Z1
+    return (X3, Y3, Z3)
+
+
+def _jac_add(p, q):
+    if p is None:
+        return q
+    if q is None:
+        return p
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    Z1Z1 = Z1 * Z1
+    Z2Z2 = Z2 * Z2
+    U1 = X1 * Z2Z2
+    U2 = X2 * Z1Z1
+    S1 = Y1 * Z2 * Z2Z2
+    S2 = Y2 * Z1 * Z1Z1
+    if U1 == U2:
+        if S1 == S2:
+            return _jac_double(p)
+        return None
+    H = U2 - U1
+    t = H + H
+    I = t * t
+    J = H * I
+    r = S2 - S1
+    r = r + r
+    V = U1 * I
+    X3 = r * r - J - (V + V)
+    S1J2 = S1 * J
+    Y3 = r * (V - X3) - (S1J2 + S1J2)
+    t2 = Z1 + Z2
+    Z3 = (t2 * t2 - Z1Z1 - Z2Z2) * H
+    return (X3, Y3, Z3)
+
+
 def mul(p1: Point, k: int) -> Point:
-    """Scalar multiplication [k]P (double-and-add; host reference only)."""
+    """Scalar multiplication [k]P (host reference only) — Jacobian
+    double-and-add internally, converted back to the affine form the rest
+    of the module speaks."""
     if k < 0:
         return mul(neg(p1), -k)
-    acc: Point = None
-    addend = p1
+    if p1 is None or k == 0:
+        return None
+    acc = None
+    addend = (p1[0], p1[1], p1[0].one())
     while k:
         if k & 1:
-            acc = add(acc, addend)
-        addend = double(addend)
+            acc = _jac_add(acc, addend)
+        addend = _jac_double(addend)
         k >>= 1
-    return acc
+    if acc is None:
+        return None
+    X, Y, Z = acc
+    zinv = Z.inv()
+    zinv2 = zinv * zinv
+    return (X * zinv2, Y * zinv2 * zinv)
 
 
 G1 = (Fq(G1_X), Fq(G1_Y))

@@ -81,7 +81,7 @@ import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from . import blackbox, locksmith, metrics, tracing
 from .logs import get_logger
@@ -133,6 +133,20 @@ ADAPTIVE_LINGER_FRACTION = 0.5
 #: An explicit env linger pins every pipeline (the operator override the
 #: adaptive default must never fight).
 _LINGER_ENV_PINNED = "LIGHTHOUSE_TPU_PIPELINE_LINGER_S" in os.environ
+
+# Injectable linger clock (ISSUE 20): how long a pending group has lingered
+# is a control-path decision — during a scenario it runs on the virtual
+# clock so batch cut points sit at virtual instants, not wall instants.
+# Telemetry spans (pipeline_wait, batch linger observations) deliberately
+# stay on ``submitted_pc``/``time.perf_counter``: an operator reading them
+# wants real latency.
+_linger_clock: Callable[[], float] = time.perf_counter
+
+
+def set_linger_clock(fn: Optional[Callable[[], float]] = None) -> None:
+    global _linger_clock
+    # process-boundary: ok(clock seam: harness-only install, restored in _cleanup)
+    _linger_clock = fn if fn is not None else time.perf_counter
 
 
 def effective_linger(op: str, base_s: float, pinned: bool) -> float:
@@ -290,7 +304,8 @@ class _FutureBase:
     Event/result/error pattern every pipeline shares (verify groups, hash
     groups, epoch jobs differ only in payload fields and result type)."""
 
-    __slots__ = ("_done", "_result", "_error", "submitted_pc", "work")
+    __slots__ = ("_done", "_result", "_error", "submitted_pc",
+                 "submitted_lc", "work")
 
     #: result(timeout) message on expiry; subclasses name their unit.
     _timeout_msg = "pipeline result not available in time"
@@ -299,7 +314,11 @@ class _FutureBase:
         self._done = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
+        # submitted_pc: real perf_counter, telemetry spans only.
+        # submitted_lc: the linger clock's reading, the coalescing
+        # decision's time base (virtual during scenarios).
         self.submitted_pc = time.perf_counter()
+        self.submitted_lc = _linger_clock()
         self.work = work
 
     def done(self) -> bool:
@@ -503,6 +522,7 @@ class DevicePipeline:
             # was already parked on an empty queue when a test/scenario
             # assigned linger_s
             linger = None
+            frozen = 0
             while True:
                 target = self._effective_target()
                 if self._pending:
@@ -510,12 +530,24 @@ class DevicePipeline:
                         break
                     if linger is None:
                         linger = self._effective_linger()
-                    oldest = self._pending[0].future.submitted_pc
-                    remaining = (linger
-                                 - (time.perf_counter() - oldest))
-                    if remaining <= 0:
+                    now_lc = _linger_clock()
+                    oldest = self._pending[0].future.submitted_lc
+                    remaining = linger - (now_lc - oldest)
+                    # a reading BEHIND the stamp means the group straddled
+                    # a clock install/restore: dispatch rather than trust
+                    # cross-clock arithmetic
+                    if remaining <= 0 or now_lc < oldest:
+                        break
+                    # Stall-breaker: a linger clock frozen across
+                    # consecutive waits means the thread that advances it
+                    # (a virtual clock's runner) is blocked on one of OUR
+                    # futures — dispatch now instead of deadlocking.  A
+                    # wall clock always advances, so production coalescing
+                    # is untouched.
+                    if frozen >= 2:
                         break
                     self._cond.wait(timeout=min(remaining, 0.05))
+                    frozen = frozen + 1 if _linger_clock() == now_lc else 0
                 elif self._shutdown:
                     return None
                 else:
@@ -864,6 +896,7 @@ class HashPipeline:
             # sampled once per take, at first-group observation — same
             # rationale as DevicePipeline._take_batch
             linger = None
+            frozen = 0
             while True:
                 if self._pending:
                     if (self._shutdown
@@ -871,12 +904,17 @@ class HashPipeline:
                         break
                     if linger is None:
                         linger = self._effective_linger()
-                    oldest = self._pending[0].future.submitted_pc
-                    remaining = (linger
-                                 - (time.perf_counter() - oldest))
-                    if remaining <= 0:
+                    now_lc = _linger_clock()
+                    oldest = self._pending[0].future.submitted_lc
+                    remaining = linger - (now_lc - oldest)
+                    # clock-straddle + stall-breaker — see
+                    # DevicePipeline._take_batch
+                    if remaining <= 0 or now_lc < oldest:
+                        break
+                    if frozen >= 2:
                         break
                     self._cond.wait(timeout=min(remaining, 0.05))
+                    frozen = frozen + 1 if _linger_clock() == now_lc else 0
                 elif self._shutdown:
                     return None
                 else:
@@ -1313,5 +1351,6 @@ def shutdown(timeout: float = 30.0) -> None:
 
 
 def reset_for_tests() -> None:
+    set_linger_clock(None)
     shutdown(timeout=5.0)
     ARBITER.reset_for_tests()

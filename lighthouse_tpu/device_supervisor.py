@@ -164,6 +164,20 @@ class BreakerConfig:
         )
 
 
+# Injectable cooldown clock (ISSUE 20): a breaker cooldown is control-path
+# time — whether a scenario's breaker recovers before the run ends must be
+# a property of the run's virtual timeline, not of host load.  The scenario
+# runner installs its VirtualClock.now here and restores the default in
+# _cleanup; production keeps wall time.
+_cooldown_clock: Callable[[], float] = time.monotonic
+
+
+def set_cooldown_clock(fn: Optional[Callable[[], float]] = None) -> None:
+    global _cooldown_clock
+    # process-boundary: ok(clock seam: harness-only install, restored in _cleanup)
+    _cooldown_clock = fn if fn is not None else time.monotonic
+
+
 class CircuitBreaker:
     """CLOSED → OPEN → HALF_OPEN → CLOSED, per device op.
 
@@ -197,7 +211,7 @@ class CircuitBreaker:
         self._state = to
         if to == STATE_OPEN:
             self.trips_total += 1
-            self._opened_at = time.monotonic()
+            self._opened_at = _cooldown_clock()
             self._probe_successes = 0
         elif to == STATE_CLOSED:
             self._consecutive_failures = 0
@@ -209,7 +223,7 @@ class CircuitBreaker:
         transitions: List[Tuple[str, str, str]] = []
         with self._lock:
             if self._state == STATE_OPEN:
-                if time.monotonic() - self._opened_at >= self.config.open_cooldown_s:
+                if _cooldown_clock() - self._opened_at >= self.config.open_cooldown_s:
                     self._transition(STATE_HALF_OPEN, "cooldown_elapsed", transitions)
                 else:
                     return "host", transitions
@@ -648,6 +662,7 @@ def breaker_state(op: str) -> str:
 
 
 def reset_for_tests() -> None:
+    set_cooldown_clock(None)
     SUPERVISOR.reset_for_tests()
 
 

@@ -280,7 +280,7 @@ class FaultRegistry:
             blackbox.emit("fault", "fired", point=point, mode=plan.mode,
                           op=op or "*", plan_id=plan.plan_id)
             if plan.mode == "hang":
-                time.sleep(plan.sleep_s)
+                _sleeper(plan.sleep_s)
             elif plan.mode == "error":
                 raise InjectedFault(
                     plan.message
@@ -292,6 +292,18 @@ class FaultRegistry:
 
 
 REGISTRY = FaultRegistry()
+
+# Injectable hang sleeper (ISSUE 20): a hang plan's stall is control-path
+# time.  The scenario runner installs its virtual clock's ``sleep`` so an
+# injected 2 s hang burns 2 VIRTUAL seconds (one real yield) — long-horizon
+# soaks stay cheap and breaker/deadline interactions stay deterministic.
+_sleeper: Callable[[float], None] = time.sleep
+
+
+def set_sleeper(fn: Optional[Callable[[float], None]] = None) -> None:
+    global _sleeper
+    # process-boundary: ok(clock seam: harness-only install, same as set_slot_provider)
+    _sleeper = fn if fn is not None else time.sleep
 
 
 # ------------------------------------------------------------- injection API
@@ -394,6 +406,7 @@ def summary() -> dict:
 
 
 def reset_for_tests() -> None:
+    set_sleeper(None)
     clear()
 
 

@@ -34,6 +34,7 @@ class LocalNode:
         endpoint=None,
         subscribe_all_subnets: bool = True,
         scope=None,
+        clock=None,
     ):
         if harness is not None:
             chain = harness.chain
@@ -59,7 +60,9 @@ class LocalNode:
         # trace context and receives deferred fleet-journal events.
         self.scope = scope
         self.endpoint.scope = scope
-        self.service = NetworkService(self.endpoint)
+        # clock: optional callable threaded into peer scoring so decay and
+        # ban lifts run on the simulator's virtual clock during scenarios
+        self.service = NetworkService(self.endpoint, clock=clock)
         self.processor = BeaconProcessor(max_workers=max_workers)
         self.slasher = None
         if enable_slasher:

@@ -460,11 +460,22 @@ class Router:
                         # root imports (park BEFORE the lookup spawns, or
                         # the import could land between the two and strand
                         # the attestation), then chase the block off-thread
+                        # The re-queued event must carry the SAME batch
+                        # shape as fresh gossip (item + process_batch): a
+                        # released park coalesces with live attestation
+                        # events in the processor's drain batch, and a
+                        # shapeless event there feeds item=None into the
+                        # batch handler — the unpack TypeError then kills
+                        # the WHOLE drained batch in the worker-panic
+                        # handler (silent attestation loss the 128-epoch
+                        # soak caught as nondeterministic block content).
                         item = (topic, uncompressed, compressed, sender)
                         self.reprocess.await_block(root, WorkEvent(
                             work_type=W.GOSSIP_ATTESTATION,
-                            process=lambda _=None, it=item:
+                            process=lambda it:
                                 self._process_gossip_attestations([it]),
+                            process_batch=self._process_gossip_attestations,
+                            item=item,
                         ))
                         if chain.fork_choice.contains_block(root):
                             # ANOTHER import path (range sync, a parent

@@ -76,12 +76,19 @@ def message_id(uncompressed: bytes) -> bytes:
 
 class NetworkService:
     def __init__(self, endpoint: Endpoint, peer_manager: Optional[PeerManager] = None,
-                 rate_limiter=None):
+                 rate_limiter=None, clock=None):
         from .rate_limiter import RPCRateLimiter
 
         self.endpoint = endpoint
         self.peer_id = endpoint.peer_id
-        self.peer_manager = peer_manager if peer_manager is not None else PeerManager()
+        if peer_manager is not None:
+            self.peer_manager = peer_manager
+        else:
+            # clock: optional callable for score decay / ban lifts — the
+            # simulator threads its virtual clock here so peer scoring is
+            # deterministic under host load (ISSUE 20)
+            self.peer_manager = (PeerManager(clock=clock) if clock is not None
+                                 else PeerManager())
         self.rate_limiter = rate_limiter if rate_limiter is not None else RPCRateLimiter()
         # outbound throttle (self_limiter.rs): same quotas as we enforce
         # on peers — never send what we ourselves would reject
@@ -387,12 +394,18 @@ class NetworkService:
         import queue as queue_mod
 
         while not self._shutdown:
+            got_item = False
             try:
                 env = self.endpoint.inbound.get(timeout=0.5)
-                # quiescence beacon for Simulator.settle(): raised the
-                # instant an envelope is in hand (BEFORE the heartbeat
-                # block below, or settle could observe empty-queue +
-                # not-processing while this envelope awaits dispatch)
+                got_item = True
+                # quiescence beacon for Simulator.settle().  NOTE: between
+                # the get() above and this assignment the envelope is in
+                # hand but invisible to both the queue and the flag — a
+                # settle that read only .empty() + _processing could slip
+                # into that gap.  Settle therefore keys on the queue's
+                # task accounting (unfinished_tasks, decremented only in
+                # the finally below), which has no such window; the flag
+                # stays as a redundant beacon.
                 self._processing = True
             except queue_mod.Empty:
                 env = None
@@ -406,6 +419,9 @@ class NetworkService:
                 self._mesh_heartbeat(now)
                 self._expire_gossip_promises(now)
             if env is None:
+                if got_item:  # the stop() wake sentinel
+                    self._processing = False
+                    self.endpoint.inbound.task_done()
                 continue
             # _processing stays True until the envelope's work is handed
             # off (router validation enqueues to the processor BEFORE the
@@ -438,6 +454,7 @@ class NetworkService:
                 self.peer_manager.report(env.sender, PeerAction.LOW_TOLERANCE, "codec error")
             finally:
                 self._processing = False
+                self.endpoint.inbound.task_done()
 
     # -------------------------------------------------- mesh maintenance
 

@@ -175,6 +175,10 @@ class Hub:
         self._tick = 0
         self._counters: Dict[str, int] = {}
         self._schedule: Optional[Dict[str, List[str]]] = None
+        # Optional per-tick hook, invoked outside the fabric lock after
+        # each advance_tick.  The scenario engine installs the virtual
+        # clock's advance here, making "ticks = hub ticks" structural.
+        self.on_tick: Optional[Callable[[], None]] = None
 
     def register(self, peer_id: str) -> Endpoint:
         with self._lock:
@@ -413,6 +417,11 @@ class Hub:
             self._tick = self._tick + 1 if tick is None else int(tick)
             while self._delayed and self._delayed[0][0] <= self._tick:
                 due_entries.append(heapq.heappop(self._delayed))
+            on_tick = self.on_tick
+        if on_tick is not None:
+            # outside the lock: the hook (a VirtualClock advance in
+            # scenario runs) must not nest under the fabric lock
+            on_tick()
         due_entries.sort(key=lambda e: (e[0], e[1], e[2]))
         delivered = 0
         for _due, _prio, _seq, to, env in due_entries:

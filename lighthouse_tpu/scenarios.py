@@ -40,7 +40,6 @@ from __future__ import annotations
 import json
 import os
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -48,6 +47,7 @@ from . import blackbox, fault_injection, metrics, telemetry_scope, tracing
 from .logs import get_logger
 from .network.transport import LinkPlan
 from .simulator import SimNode, Simulator
+from .virtual_clock import VirtualClock, telemetry_stamp
 
 log = get_logger("scenarios")
 
@@ -58,6 +58,11 @@ SCENARIO_RUNS = metrics.counter(
 SCENARIO_EVENTS = metrics.counter(
     "scenario_events_applied_total",
     "timeline events applied by the scenario runner, by action",
+)
+SOAK_LEAK_CHECKS = metrics.counter(
+    "soak_leak_checks_total",
+    "production-soak leak-gate evaluations, by gate and outcome "
+    "(passed|failed)",
 )
 
 #: Envelope kinds that carry gossipsub traffic (vs the rpc_* stream) — the
@@ -148,6 +153,10 @@ class ScenarioRunner:
     PUMP_SLEEP_S = 0.02
     SYNC_DEADLINE_S = 60.0
     CONVERGE_DEADLINE_S = 30.0
+    #: rekick cadence in VIRTUAL seconds.  The old wall-clock 1.0 s compare
+    #: meant a loaded box rekicked at a different virtual point than an
+    #: idle one — the cadence is now a property of the run, not the host.
+    REKICK_VIRTUAL_S = 1.0
     #: per-step quiescence budget.  Settle returns False on timeout and the
     #: slot proceeds un-quiesced — silent nondeterminism.  The busiest slots
     #: (a byzantine burst: votes + slashing gossip + packing) can exceed
@@ -162,6 +171,10 @@ class ScenarioRunner:
         self.byz = None  # ByzantineController, created by the first byz event
         self.ctx: Dict[str, Any] = {}  # cross-event state for extra checks
         self.timeline: List[dict] = []
+        # The run's virtual clock: owned here, injected into the Simulator
+        # and installed into every control-path seam (breaker cooldowns,
+        # pipeline linger, fault hang sleeps) for the run's duration.
+        self.clock = VirtualClock()
         self._saved_hash_impl = None
         self._saved_host_impl = None
         self._state_hashing_on = False
@@ -207,19 +220,25 @@ class ScenarioRunner:
     def _pump_until(self, cond: Callable[[], bool], timeout: float,
                     rekick: Optional[Callable[[], None]] = None) -> bool:
         """Advance fabric ticks (so delayed envelopes drain) until ``cond``
-        holds; ``rekick`` fires about once a second (re-triggering sync for
-        a node whose first attempt lost a race)."""
-        deadline = time.monotonic() + timeout
-        last_kick = 0.0
-        while time.monotonic() < deadline:
+        holds; ``rekick`` fires about once a virtual second (re-triggering
+        sync for a node whose first attempt lost a race).
+
+        Runs on the scenario's virtual clock: the deadline is a budget of
+        virtual seconds and the rekick cadence is keyed on virtual ticks,
+        so a loaded box pumps/rekicks at the same virtual points as an
+        idle one (the determinism gate's structural guarantee)."""
+        clock = self.clock
+        deadline = clock.now() + timeout
+        next_kick = clock.now()
+        while clock.now() < deadline:
             if cond():
                 return True
             if self.sim.hub.pending_delayed():
                 self.sim.hub.advance_tick()
-            if rekick is not None and time.monotonic() - last_kick > 1.0:
-                last_kick = time.monotonic()
+            if rekick is not None and clock.now() >= next_kick:
+                next_kick = clock.now() + self.REKICK_VIRTUAL_S
                 rekick()
-            time.sleep(self.PUMP_SLEEP_S)
+            clock.lull(self.PUMP_SLEEP_S)
         return cond()
 
     def _pump_node_to_head(self, node: SimNode, donor: SimNode,
@@ -304,6 +323,10 @@ class ScenarioRunner:
             {"slot": slot, "distinct_heads": len(heads),
              "head_root": sim.live_nodes[0].chain.head_root.hex(),
              "max_finalized_epoch": max_final})
+        # re-anchor virtual time to the slot boundary: slot-spanning
+        # durations (breaker cooldowns, score decay) become deterministic
+        # functions of the slot timeline, not of settle-round counts
+        self.clock.snap_to_next_slot()
         return slot
 
     def _finalized(self, agg) -> int:
@@ -322,10 +345,14 @@ class ScenarioRunner:
             node = next((n for n in self.sim.live_nodes
                          if off.validator in n.keys), None)
             scope = getattr(node, "scope", None) if node is not None else None
+            # forger strategies (invalid_block, invalid_aggregate, ...) have
+            # no offending validator — they journal without one, under the
+            # global scope (no node's validator misbehaved)
+            fields = {"slot": int(off.slot), "strategy": off.strategy}
+            if off.validator is not None:
+                fields["validator"] = int(off.validator)
             with telemetry_scope.activate(scope):
-                blackbox.emit("adversary", "offense", slot=int(off.slot),
-                              validator=int(off.validator),
-                              strategy=off.strategy)
+                blackbox.emit("adversary", "offense", **fields)
 
     # ------------------------------------------------------- event actions
 
@@ -681,11 +708,30 @@ class ScenarioRunner:
             "cache": cached.response_cache.snapshot(),
         })
 
+    def _ev_leak_baseline(self) -> None:
+        """Snapshot every bounded ring and every monotone counter at the
+        start of the fault window — the reference point the leak gates
+        (``_check_leak_gates``) diff the end-of-run state against.  Only
+        ``Counter`` series are snapshotted: gauges may legally fall, and
+        histograms ride on counters of their own."""
+        from . import device_telemetry
+
+        with metrics._REGISTRY_LOCK:
+            counters = {name: m.snapshot()
+                        for name, m in metrics._REGISTRY.items()
+                        if isinstance(m, metrics.Counter)}
+        self.ctx["leak_baseline"] = {
+            "counters": counters,
+            "journal_emitted": blackbox.JOURNAL.emitted_total,
+            "flight_recorded":
+                device_telemetry.FLIGHT_RECORDER.recorded_total,
+        }
+
     # ------------------------------------------------------------ the run
 
     def run(self) -> dict:
         scenario = self.scenario
-        started = time.monotonic()
+        started = telemetry_stamp()  # telemetry only: artifact duration_s
         delay_before = {k: h.stats() for k, h in DELAY_HISTOGRAMS.items()}
         # fault-window evidence, captured before recovery clears the plans
         breakers: Optional[dict] = None
@@ -699,8 +745,14 @@ class ScenarioRunner:
             validator_count=scenario.validator_count,
             seed=scenario.seed,
             enable_slasher=scenario.slasher,
+            clock=self.clock,
         )
         self.sim.hub.record_schedule()
+        # Install the virtual clock into every control-path wall-time seam
+        # for the run's duration (restored in _cleanup): breaker cooldowns,
+        # pipeline linger decisions, and fault-injection hang sleeps all
+        # burn virtual time while the scenario owns the process.
+        self._install_clock_seams()
         # Fault plans key on the fleet's logical slot for the whole run —
         # see fault_injection's slot-keying section; cleared in _cleanup.
         fault_injection.set_slot_provider(self._current_slot)
@@ -710,6 +762,7 @@ class ScenarioRunner:
         try:
             for _ in range(scenario.warmup_slots):
                 self.sim.run_slot()
+                self.clock.snap_to_next_slot()
             finalized_at_window_start = self._finalized(max)
 
             events = sorted(scenario.events, key=lambda e: e.at_slot)
@@ -808,7 +861,7 @@ class ScenarioRunner:
                     "timeline": self.timeline,
                     # frozen BEFORE _cleanup unregisters the node scopes
                     "fleet": self._fleet_section(),
-                    "duration_s": round(time.monotonic() - started, 3),
+                    "duration_s": round(telemetry_stamp() - started, 3),
                 })
                 self._write_artifact(artifact)
             finally:
@@ -942,7 +995,25 @@ class ScenarioRunner:
             log.warning("soak artifact not written", out_dir=self.out_dir)
             return None
 
+    def _install_clock_seams(self) -> None:
+        """Point every control-path wall-time seam at the run's virtual
+        clock.  _cleanup restores the wall defaults unconditionally, so a
+        crashed run cannot leak virtual time into the next test."""
+        from . import device_pipeline, device_supervisor
+
+        device_supervisor.set_cooldown_clock(self.clock.now)
+        device_pipeline.set_linger_clock(self.clock.now)
+        fault_injection.set_sleeper(self.clock.sleep)
+
+    def _restore_clock_seams(self) -> None:
+        from . import device_pipeline, device_supervisor
+
+        device_supervisor.set_cooldown_clock(None)
+        device_pipeline.set_linger_clock(None)
+        fault_injection.set_sleeper(None)
+
     def _cleanup(self) -> None:
+        self._restore_clock_seams()
         fault_injection.set_slot_provider(None)
         fault_injection.clear()
         if self._epoch_device_touched:
@@ -1457,6 +1528,100 @@ def byz_slashing_flood(seed: int = 0) -> Scenario:
     )
 
 
+def byz_invalid_aggregate(seed: int = 0) -> Scenario:
+    """Forged ``SignedAggregateAndProof`` wraps vs the aggregate gossip
+    rules: HONEST inner attestations (real committee data, a real member's
+    signature) wrapped by aggregators that are not in the committee, past
+    the registry's end, or simply undecodable SSZ.  Every mode must count
+    its REJECT reason on the aggregate topic, score its forger below the
+    graylist, and leave honest convergence/finality untouched — the
+    aggregate half of ROADMAP item 4's adversarial coverage gap."""
+    return Scenario(
+        name="byz_invalid_aggregate",
+        description="forged aggregate-and-proof wraps vs gossip validation",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=8, fault_slots=8, recovery_slots=16,
+        slasher=True,
+        events=(
+            Event(0, "byzantine",
+                  {"strategy": "invalid_aggregate", "node": 1, "target": 0,
+                   "max_offenses": 2}),
+        ),
+        extra_checks=_check_aggregate_rejected,
+    )
+
+
+def byz_malformed_sync_contribution(seed: int = 0) -> Scenario:
+    """Forged ``SignedContributionAndProof`` messages vs the sync gossip
+    rules: contributions at the CURRENT slot (the ±1-slot window IGNOREs
+    anything else, proving nothing) with an out-of-range subcommittee, a
+    subcommittee the aggregator holds no seat in, zero participation bits,
+    or undecodable SSZ.  Counts, graylisting, and untouched honest
+    finality gate it — the sync half of ROADMAP item 4's coverage gap."""
+    return Scenario(
+        name="byz_malformed_sync_contribution",
+        description="malformed sync contributions vs gossip validation",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=8, fault_slots=8, recovery_slots=16,
+        slasher=True,
+        events=(
+            Event(0, "byzantine",
+                  {"strategy": "malformed_sync_contribution", "node": 1,
+                   "target": 0, "max_offenses": 2}),
+        ),
+        extra_checks=_check_sync_contribution_rejected,
+    )
+
+
+# ------------------------------------------------------- production soaks
+
+
+def long_horizon_soak(seed: int = 0) -> Scenario:
+    """The long-horizon production soak: 128+ epochs of continuous fleet
+    operation in minutes of wall time (the virtual clock is what makes the
+    horizon affordable), with the whole epoch boundary fused on the device
+    backend and a brief partition/heal cycle early in the window.  The
+    leak gates then assert the run's residue: bounded rings honored their
+    bounds over the whole horizon, counters moved monotonically, and the
+    evidence is read back off the blackbox journal itself."""
+    return Scenario(
+        name="long_horizon_soak",
+        description="128-epoch virtual-time soak with leak-check gates",
+        seed=seed, node_count=3, validator_count=16,
+        warmup_slots=8, fault_slots=8, recovery_slots=1008,
+        events=(
+            Event(0, "leak_baseline"),
+            Event(0, "epoch_device", {"enable": True, "fused": True}),
+            Event(2, "partition", {"groups": [[0, 1], [2]]}),
+            Event(6, "heal"),
+        ),
+        extra_checks=_check_long_horizon,
+    )
+
+
+def production_fleet_soak(seed: int = 0) -> Scenario:
+    """The production-scale fleet soak: 16 SimNodes sharing thousands of
+    validators, every node's duty evaluation riding the device epoch ops
+    (shuffling + proposer selection at registry scale), a partition/heal
+    cycle mid-window, and the same leak gates as the long-horizon soak.
+    Short horizon by design — the axis under test is fleet width and
+    registry size, not epoch count."""
+    return Scenario(
+        name="production_fleet_soak",
+        description="16-node fleet at registry scale with leak-check gates",
+        seed=seed, node_count=16, validator_count=2048,
+        warmup_slots=8, fault_slots=4, recovery_slots=12,
+        events=(
+            Event(0, "leak_baseline"),
+            Event(0, "epoch_device", {"enable": True, "fused": True}),
+            Event(1, "partition",
+                  {"groups": [list(range(12)), [12, 13, 14, 15]]}),
+            Event(3, "heal"),
+        ),
+        extra_checks=_check_fleet_soak,
+    )
+
+
 # ------------------------------------------------------------ extra checks
 
 
@@ -1778,6 +1943,188 @@ def _check_forgers_penalized(runner: ScenarioRunner) -> dict:
     return {"forger_scores": forgers, "gossip_rejected": rejected}
 
 
+def _forger_scores_graylisted(runner: ScenarioRunner) -> dict:
+    """Every forger identity the controller laundered traffic through must
+    have been scored below the graylist on the victim (node 0)."""
+    from .network import service as service_mod
+
+    byz = runner.ctx["byz"]
+    pm = runner._node(0).node.service.peer_manager
+    forgers = {}
+    for forger in byz.forger_ids:
+        info = pm.peers.get(forger)
+        assert info is not None, f"forger {forger} was never scored"
+        forgers[forger] = round(info.score, 1)
+        assert info.score < service_mod.GRAYLIST_THRESHOLD, (
+            f"forger {forger} not graylisted (score {info.score})")
+    return forgers
+
+
+def _check_aggregate_rejected(runner: ScenarioRunner) -> dict:
+    """Every forged-aggregate mode REJECTed and counted on the aggregate
+    topic, every forger graylisted, honest convergence untouched (the
+    runner's standard gates)."""
+    from .network import service as service_mod
+
+    byz = runner.ctx.get("byz")
+    assert byz is not None and byz.forger_ids, "no forger ever attacked"
+    assert any(o.strategy == "invalid_aggregate" for o in byz.offenses), (
+        "no forged aggregates were emitted")
+    forgers = _forger_scores_graylisted(runner)
+    # deltas against the controller's creation-time snapshot — see
+    # _check_forgers_penalized.  Both committee-rule modes (outside the
+    # committee, index past the registry) land on invalid_attestation;
+    # the truncation mode lands on undecodable.
+    rejected = {
+        "invalid_attestation": service_mod.GOSSIP_REJECTED.delta(
+            byz.rejected_baseline, topic="beacon_aggregate_and_proof",
+            reason="invalid_attestation"),
+        "undecodable": service_mod.GOSSIP_REJECTED.delta(
+            byz.rejected_baseline, topic="beacon_aggregate_and_proof",
+            reason="undecodable"),
+    }
+    for reason, count in rejected.items():
+        assert count >= 1, f"gossip_rejected_total never counted {reason}"
+    return {"forger_scores": forgers, "gossip_rejected": rejected}
+
+
+def _check_sync_contribution_rejected(runner: ScenarioRunner) -> dict:
+    """Every malformed-contribution mode REJECTed and counted on the sync
+    contribution topic, every forger graylisted, honest convergence
+    untouched (the runner's standard gates)."""
+    from .network import service as service_mod
+
+    byz = runner.ctx.get("byz")
+    assert byz is not None and byz.forger_ids, "no forger ever attacked"
+    assert any(o.strategy == "malformed_sync_contribution"
+               for o in byz.offenses), "no forged contributions were emitted"
+    forgers = _forger_scores_graylisted(runner)
+    # the three contribution-rule modes (bad subcommittee, no seat in the
+    # subcommittee, zero participation) all land on invalid_op; the
+    # truncation mode lands on undecodable
+    rejected = {
+        "invalid_op": service_mod.GOSSIP_REJECTED.delta(
+            byz.rejected_baseline,
+            topic="sync_committee_contribution_and_proof",
+            reason="invalid_op"),
+        "undecodable": service_mod.GOSSIP_REJECTED.delta(
+            byz.rejected_baseline,
+            topic="sync_committee_contribution_and_proof",
+            reason="undecodable"),
+    }
+    for reason, count in rejected.items():
+        assert count >= 1, f"gossip_rejected_total never counted {reason}"
+    return {"forger_scores": forgers, "gossip_rejected": rejected}
+
+
+def _check_leak_gates(runner: ScenarioRunner) -> dict:
+    """The production-soak leak gates.  Each gate reads the same surface
+    an operator triages from (``blackbox.summary()``, the flight ring, the
+    scoped journals, the metrics registry), diffs it against the
+    ``leak_baseline`` snapshot taken at the fault window's start, and
+    counts its verdict on ``soak_leak_checks_total`` before the combined
+    assert fires — a failed soak still exports which gate leaked."""
+    from . import device_telemetry, telemetry_scope as ts
+
+    base = runner.ctx.get("leak_baseline")
+    assert base is not None, "no leak_baseline event armed the gates"
+    evidence: Dict[str, Any] = {}
+    failures: List[str] = []
+
+    def gate(name: str, ok: bool, detail) -> None:
+        SOAK_LEAK_CHECKS.inc(gate=name, outcome="passed" if ok else "failed")
+        evidence[name] = {"passed": bool(ok), "detail": detail}
+        if not ok:
+            failures.append(name)
+
+    js = blackbox.summary()["journal"]
+    gate("journal_bounded", js["stored"] <= js["capacity"], dict(js))
+    gate("journal_monotone",
+         js["emitted_total"] >= js["stored"]
+         and js["emitted_total"] > base["journal_emitted"],
+         {"emitted_total": js["emitted_total"],
+          "at_baseline": base["journal_emitted"]})
+    ring = device_telemetry.FLIGHT_RECORDER
+    flight = {"stored": len(ring), "capacity": ring.capacity,
+              "recorded_total": ring.recorded_total}
+    gate("flight_ring_bounded", flight["stored"] <= flight["capacity"],
+         flight)
+    gate("flight_ring_monotone",
+         flight["recorded_total"] >= flight["stored"]
+         and flight["recorded_total"] >= base["flight_recorded"], flight)
+    scoped, scoped_ok = {}, True
+    for s in ts.all_scopes():
+        j = s.journal
+        ok = len(j) <= j.capacity and j.emitted_total >= len(j)
+        scoped_ok = scoped_ok and ok
+        scoped[s.node_id] = {"stored": len(j), "capacity": j.capacity,
+                             "emitted_total": j.emitted_total}
+    gate("scoped_journals_bounded", scoped_ok and bool(scoped), scoped)
+    regressed: List[str] = []
+    with metrics._REGISTRY_LOCK:
+        current = {name: m.snapshot()
+                   for name, m in metrics._REGISTRY.items()
+                   if isinstance(m, metrics.Counter)}
+    for name, baseline in sorted(base["counters"].items()):
+        now = current.get(name)
+        if now is None:
+            regressed.append(f"{name}: vanished from the registry")
+            continue
+        for key, value in baseline.items():
+            if now.get(key, 0.0) < value:
+                regressed.append(
+                    f"{name}{dict(key)}: {now.get(key, 0.0)} < {value}")
+    gate("counters_monotone", not regressed,
+         regressed or {"counters_checked": len(base["counters"])})
+    assert not failures, (
+        f"leak gates failed: {failures} — "
+        + "; ".join(f"{n}={evidence[n]['detail']}" for n in failures))
+    return {"leak_gates": evidence}
+
+
+def _check_long_horizon(runner: ScenarioRunner) -> dict:
+    """Leak gates plus the horizon itself: the fleet really stepped 128+
+    epochs of virtual time, heads kept proposing across the whole span,
+    and the fused device boundary seeded duty caches throughout."""
+    from . import device_telemetry
+
+    out = _check_leak_gates(runner)
+    spec = runner.sim.live_nodes[0].harness.spec
+    last_slot = runner.timeline[-1]["slot"]
+    epochs = last_slot // spec.slots_per_epoch
+    assert epochs >= 128, f"soak only reached epoch {epochs}"
+    head_slot = runner.sim.live_nodes[0].chain.head_slot()
+    assert head_slot >= last_slot - spec.slots_per_epoch, (
+        f"head stalled at slot {head_slot} of {last_slot}")
+    primes = device_telemetry.boundary_prime_counts()
+    seeded = sum(v for k, v in primes.items() if k.startswith("seeded:"))
+    assert seeded >= epochs, (
+        f"fused boundary seeded {seeded} duty caches over {epochs} epochs")
+    out["horizon"] = {"epochs": epochs, "head_slot": head_slot,
+                      "boundary_seeded": seeded}
+    return out
+
+
+def _check_fleet_soak(runner: ScenarioRunner) -> dict:
+    """Leak gates plus the fleet-scale evidence: all 16 nodes converged
+    (standard gates), the registry really was thousands of validators,
+    and epoch processing really rode the device backend."""
+    from . import device_telemetry
+
+    out = _check_leak_gates(runner)
+    sim = runner.sim
+    assert len(sim.nodes) >= 16, f"only {len(sim.nodes)} nodes"
+    n_validators = len(sim.live_nodes[0].chain.head_state.validators)
+    assert n_validators >= 2048, f"only {n_validators} validators"
+    primes = device_telemetry.boundary_prime_counts()
+    seeded = sum(v for k, v in primes.items() if k.startswith("seeded:"))
+    assert seeded >= 1, f"no fused boundary seeded a duty cache ({primes})"
+    out["fleet_scale"] = {"nodes": len(sim.nodes),
+                          "validators": n_validators,
+                          "boundary_seeded": seeded}
+    return out
+
+
 def _check_slashing_flood(runner: ScenarioRunner) -> dict:
     """Pipeline gate for all three offenders + flood-specific evidence: no
     block exceeded max_attester_slashings, conviction took >1 block, and
@@ -1829,6 +2176,10 @@ SCENARIOS: Dict[str, Callable[[int], Scenario]] = {
     "byz_surround_nonfinality": byz_surround_nonfinality,
     "byz_invalid_block_spam": byz_invalid_block_spam,
     "byz_slashing_flood": byz_slashing_flood,
+    "byz_invalid_aggregate": byz_invalid_aggregate,
+    "byz_malformed_sync_contribution": byz_malformed_sync_contribution,
+    "long_horizon_soak": long_horizon_soak,
+    "production_fleet_soak": production_fleet_soak,
 }
 
 

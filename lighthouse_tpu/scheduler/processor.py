@@ -42,6 +42,9 @@ from .work import (
 from .. import locksmith
 from .. import metrics as _gm
 from .. import tracing
+from ..logs import get_logger
+
+log = get_logger("scheduler.processor")
 
 # Per-work-class series on /metrics (reference: the beacon_processor's
 # per-queue event counters, task_executor's per-task metrics).
@@ -271,28 +274,48 @@ class BeaconProcessor:
                 # Batch handler whenever one exists — including a batch of
                 # ONE (the handler is the device-pipeline seam; see
                 # _next_work).  Events without a batch handler run per-item.
-                if batch[0].process_batch is not None and wt in BATCH_RULES:
+                # A drained batch may MIX shapes: the same queue holds fresh
+                # gossip (process_batch + item) and re-queued events from
+                # the reprocess queue; feeding a shapeless event's
+                # item=None through the batch handler would throw and take
+                # every sibling down with it — so the batch call covers
+                # only the events that opted into it, the rest run
+                # per-item.
+                if wt in BATCH_RULES:
+                    grouped = [ev for ev in batch
+                               if ev.process_batch is not None]
+                    loose = [ev for ev in batch if ev.process_batch is None]
+                else:
+                    grouped, loose = [], batch
+                if grouped:
                     batch_wt = BATCH_RULES[wt][0]
                     self.metrics.bump(self.metrics.batches, batch_wt)
-                    self.metrics.bump(self.metrics.batch_items, batch_wt, len(batch))
+                    self.metrics.bump(self.metrics.batch_items, batch_wt,
+                                      len(grouped))
                     try:
-                        batch[0].process_batch([ev.item for ev in batch])
+                        grouped[0].process_batch([ev.item for ev in grouped])
                     except RequeueWork:
-                        self._requeue(batch, wt)
+                        self._requeue(grouped, wt)
                     else:
-                        self.metrics.bump(self.metrics.processed, wt, len(batch))
-                else:
+                        self.metrics.bump(self.metrics.processed, wt,
+                                          len(grouped))
+                if loose:
                     idx = 0
                     try:
-                        for idx, ev in enumerate(batch):
+                        for idx, ev in enumerate(loose):
                             ev.process(ev.item)
                             self.metrics.bump(self.metrics.processed, wt)
                     except RequeueWork:
                         # Only the raiser and the unprocessed tail retry;
                         # events before it already ran to completion.
-                        self._requeue(batch[idx:], wt)
+                        self._requeue(loose[idx:], wt)
         except Exception:
-            # A worker panic must not kill the node (reference logs + metric).
+            # A worker panic must not kill the node (reference logs + metric)
+            # — but it must not vanish either: the batch it took down is
+            # real work (the silent-drop variant of this cost a soak run
+            # its attestations), so leave a trace for triage.
+            log.warning("worker panic", work=wt, n_items=len(batch),
+                        exc_info=True)
             self.metrics.bump(self.metrics.dropped, wt, len(batch))
         finally:
             tracing.detach(token)

@@ -10,7 +10,6 @@ publishing blocks and attestations to the others.
 
 from __future__ import annotations
 
-import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -20,6 +19,7 @@ from .chain import BeaconChainHarness
 from .consensus import helpers as h
 from .network.node import LocalNode
 from .network.transport import Hub
+from .virtual_clock import WAIT_SLICE_S, ensure_clock
 
 
 #: Slasher history window for simulator nodes — scenarios span a handful of
@@ -44,8 +44,9 @@ class SimNode:
     def __init__(self, *, index: int, hub: Optional[Hub], validator_count: int,
                  keys: List[int], genesis_time: int, spec=None,
                  endpoint=None, chain=None, peer_id: Optional[str] = None,
-                 enable_slasher: bool = False):
+                 enable_slasher: bool = False, clock=None):
         self.index = index
+        self.clock = clock  # callable or None; threaded into peer scoring
         if chain is not None:
             # Chain-only node (checkpoint-sync join): no duty keys, no
             # harness — it follows the chain over gossip/sync.
@@ -65,7 +66,7 @@ class SimNode:
         self.node = LocalNode(
             hub=hub, peer_id=pid,
             chain=self._chain, harness=self.harness, endpoint=endpoint,
-            scope=self.scope,
+            scope=self.scope, clock=clock,
             **(_sim_slasher_kwargs(self._chain.spec) if enable_slasher else {}),
         )
 
@@ -81,6 +82,7 @@ class SimNode:
         fresh.keys = old.keys
         fresh._keys_mask = None
         fresh.alive = True
+        fresh.clock = old.clock
         # Fresh scope: a restarted process starts a NEW Lamport clock (and
         # an empty scoped journal) — merge_journals handles the reset via
         # the slot-major merge key.
@@ -88,7 +90,7 @@ class SimNode:
             telemetry_scope.TelemetryScope(old.peer_id))
         fresh.node = LocalNode(
             hub=hub, peer_id=old.peer_id, chain=old.chain, harness=old.harness,
-            scope=fresh.scope,
+            scope=fresh.scope, clock=old.clock,
             **(_sim_slasher_kwargs(old.chain.spec)
                if old.node.slasher is not None else {}),
         )
@@ -249,12 +251,14 @@ class Simulator:
                  genesis_time: int = 1_600_000_000, spec=None,
                  transport: str = "hub", discovery: Optional[str] = None,
                  seed: int = 0, enable_slasher: bool = False,
-                 clock=time.monotonic):
+                 clock=None):
         if transport not in ("hub", "tcp_secured"):
             raise ValueError(f"unknown transport {transport!r}")
-        # Injectable deadline clock (virtual-time soaks swap it); real
-        # waiting (sleep) still uses the wallclock.
-        self._clock = clock
+        # The control-path clock (virtual_clock.Clock).  None -> WallClock;
+        # scenario runs pass a VirtualClock so every deadline, decay, and
+        # quiescence window below runs on virtual ticks.  Legacy callables
+        # (clock=time.monotonic) are shimmed by ensure_clock.
+        self.clock = ensure_clock(clock)
         tcp = transport == "tcp_secured"
         self.genesis_time = genesis_time
         self.validator_count = validator_count
@@ -262,6 +266,10 @@ class Simulator:
         self.nodes: List[SimNode] = []
         self.boot_discv5 = None
         self.hub = None if tcp else Hub(seed=seed)
+        if self.hub is not None:
+            # ticks = hub ticks: every fabric tick advances the virtual
+            # clock (a WallClock advance is a no-op)
+            self.hub.on_tick = self.clock.advance
         shares: List[List[int]] = [[] for _ in range(node_count)]
         for v in range(validator_count):
             shares[v % node_count].append(v)
@@ -277,6 +285,7 @@ class Simulator:
                     index=i, hub=self.hub, validator_count=validator_count,
                     keys=shares[i], genesis_time=genesis_time, spec=spec,
                     endpoint=endpoint, enable_slasher=enable_slasher,
+                    clock=self.clock.now,
                 ))
             # topology wiring
             if not tcp:
@@ -357,30 +366,41 @@ class Simulator:
 
         This, not head equality, is what makes a slot deterministic: the
         next proposer's op pool must hold every attestation the wire
-        delivered, or block content races thread scheduling."""
-        import time
+        delivered, or block content races thread scheduling.
 
-        deadline = time.monotonic() + timeout
+        Runs entirely on the injected clock: deadlines are virtual-time
+        budgets.  A busy processor is granted a fixed REAL wait slice per
+        round (workers need wall time to finish), and the clock is charged
+        the equivalent virtual ticks so the budget tracks the waiting
+        actually performed — host load can stretch a round's wall time
+        without moving the virtual point at which the deadline fires."""
+        clock = self.clock
+        deadline = clock.now() + timeout
         consecutive = 0
         while consecutive < rounds:
             quiet = True
             for n in self.live_nodes:
                 node = n.node
-                if not node.endpoint.inbound.empty() or \
+                # unfinished_tasks, not .empty(): the count covers an
+                # envelope from the producer's put() until the service
+                # loop's task_done() — including the instant it is popped
+                # but not yet flagged _processing (the ~1/1000-slot
+                # long-horizon determinism race)
+                if node.endpoint.inbound.unfinished_tasks or \
                         getattr(node.service, "_processing", False):
                     quiet = False
                 if node.sync.busy():  # background lookups still importing
                     quiet = False
-                if not node.processor.wait_idle(
-                        max(0.0, deadline - time.monotonic())):
+                if not node.processor.wait_idle(WAIT_SLICE_S):
+                    clock.charge(WAIT_SLICE_S)
                     quiet = False
             if quiet:
                 consecutive += 1
             else:
                 consecutive = 0
-                if time.monotonic() > deadline:
+                if clock.now() > deadline:
                     return False
-            time.sleep(0.002)
+            clock.lull(0.002)
         return True
 
     def wait_converged(self, timeout: float = 10.0,
@@ -388,14 +408,13 @@ class Simulator:
         """Wait until every (live) node agrees on the head (gossip settled).
         Pumps the fabric's delayed queue while waiting so plan latency
         cannot deadlock convergence."""
-        import time
-
+        clock = self.clock
         group = [n for n in (nodes if nodes is not None else self.nodes)
                  if n.alive]
         if not group:
             return True
-        deadline = self._clock() + timeout
-        while self._clock() < deadline:
+        deadline = clock.now() + timeout
+        while clock.now() < deadline:
             heads = {n.chain.head_root for n in group}
             if len(heads) == 1:
                 return True
@@ -404,7 +423,7 @@ class Simulator:
             if self.hub is not None and self.hub.pending_delayed():
                 self.hub.advance_tick()
             # all idle yet diverged: don't busy-spin until the deadline
-            time.sleep(0.05)
+            clock.lull(0.05)
         return len({n.chain.head_root for n in group}) == 1
 
     def drain_fleet_events(self) -> None:
@@ -485,7 +504,7 @@ class Simulator:
             index=index, hub=self.hub, validator_count=self.validator_count,
             keys=[], genesis_time=self.genesis_time, chain=chain,
             peer_id=peer_id or f"sim{index}",
-            enable_slasher=self.enable_slasher,
+            enable_slasher=self.enable_slasher, clock=self.clock.now,
         )
         self.nodes.append(joined)
         for other in self.live_nodes:

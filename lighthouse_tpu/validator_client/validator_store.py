@@ -161,6 +161,14 @@ class ValidatorStore:
         signing_root = h.compute_signing_root(data.hash_tree_root(), domain)
         return self._raw_sign(pubkey, signing_root)
 
+    def sign_aggregate_and_proof_unsafe(self, pubkey: bytes,
+                                        aggregate_and_proof) -> bytes:
+        """UNSAFE alias of ``sign_aggregate_and_proof`` for the byzantine
+        seam.  Aggregate wraps are not EIP-3076-gated (there is no veto to
+        bypass), but adversarial signing must stay greppable as ``_unsafe``
+        — the audit invariant the byzantine layer is built on."""
+        return self.sign_aggregate_and_proof(pubkey, aggregate_and_proof)
+
     def randao_reveal(self, pubkey: bytes, epoch: int) -> bytes:
         domain = self._domain(DOMAIN_RANDAO, epoch)
         root = h.compute_signing_root(uint64.hash_tree_root(epoch), domain)
@@ -249,3 +257,9 @@ class ValidatorStore:
         domain = self._domain(DOMAIN_CONTRIBUTION_AND_PROOF, epoch)
         root = h.compute_signing_root(message.hash_tree_root(), domain)
         return self._raw_sign(pubkey, root)
+
+    def sign_contribution_and_proof_unsafe(self, pubkey: bytes,
+                                           message) -> bytes:
+        """UNSAFE alias of ``sign_contribution_and_proof`` for the byzantine
+        seam — see ``sign_aggregate_and_proof_unsafe``."""
+        return self.sign_contribution_and_proof(pubkey, message)

@@ -190,11 +190,27 @@ def _extract_soak(name: str, doc: dict, rnd: Optional[int]) -> List[Point]:
     if not scenario:
         return out
     result = doc.get("result") or {}
-    for metric, value, direction in (
+    series = [
         (f"soak.{scenario}.passed", doc.get("passed"), "up"),
         (f"soak.{scenario}.final_finalized_epoch",
          result.get("final_finalized_epoch"), "up"),
-    ):
+    ]
+    # ISSUE 20 leak gates: a production soak records its gate evidence in
+    # extra.leak_gates — the passed-gate count is a ratchet (a refactor
+    # that silently drops a gate, or a leak that fails one, both regress
+    # it), and the horizon epoch count keeps a soak from being quietly
+    # shortened below its advertised scale.
+    extra = doc.get("extra") or {}
+    gates = extra.get("leak_gates")
+    if isinstance(gates, dict):
+        passed = sum(1 for g in gates.values()
+                     if isinstance(g, dict) and g.get("passed"))
+        series.append((f"soak.{scenario}.leak_gates_passed", passed, "up"))
+    horizon = extra.get("horizon")
+    if isinstance(horizon, dict):
+        series.append((f"soak.{scenario}.epochs", horizon.get("epochs"),
+                       "up"))
+    for metric, value, direction in series:
         v = _num(value)
         if v is not None:
             out.append(Point(metric, "sim", v, direction, rnd, name))

@@ -99,6 +99,17 @@ REQUIRED_FLEET_METRICS = (
     "fleet_trace_links_total",
 )
 
+# The production-soak contract (ISSUE 20): leak-gate evaluations (by gate
+# and outcome) and byzantine offenses must stay countable — a soak whose
+# leak gates stop firing is indistinguishable from a soak that leaks.
+REQUIRED_SOAK_METRICS = (
+    "soak_leak_checks_total",
+    "scenario_runs_total",
+    "scenario_events_applied_total",
+    "byzantine_offenses_total",
+    "gossip_rejected_total",
+)
+
 # The serving layer's metric contract (ISSUE 14): per-route latency,
 # response-cache hit/miss/invalidation, admission shed/wait, and SSE
 # backpressure.  A refactor that silently drops one of these fails CI.
@@ -184,6 +195,11 @@ def main() -> int:
         if name not in metrics._REGISTRY:
             errors.append(f"{name}: required fleet-observability metric "
                           "is not registered")
+
+    for name in REQUIRED_SOAK_METRICS:
+        if name not in metrics._REGISTRY:
+            errors.append(f"{name}: required soak/leak-gate metric is not "
+                          "registered")
 
     check_cached_routes(errors)
 

@@ -190,8 +190,20 @@ class TestPassCoverage:
         for mod in ("lighthouse_tpu/scenarios.py",
                     "lighthouse_tpu/fault_injection.py",
                     "lighthouse_tpu/network/peer_manager.py",
-                    "scripts/analysis/trajectory.py"):
+                    "scripts/analysis/trajectory.py",
+                    # ISSUE 20: the virtual-clock module is scanned too —
+                    # its WallClock/telemetry_stamp seams are the only
+                    # sanctioned wall-clock reads in the control tree
+                    "lighthouse_tpu/virtual_clock.py"):
             assert mod in wallclock_pass.SCAN_DIRS, mod
+        # ISSUE 20: scenarios.py lost its sanctioned-context entry when the
+        # runner moved onto the virtual clock; only the clock module itself
+        # may read wall time now
+        assert ("lighthouse_tpu/scenarios.py"
+                not in wallclock_pass.SANCTIONED_CONTEXTS)
+        assert wallclock_pass.SANCTIONED_CONTEXTS[
+            "lighthouse_tpu/virtual_clock.py"] == (
+                "WallClock", "telemetry_stamp")
         for mod in ("lighthouse_tpu/device_pipeline.py",
                     "lighthouse_tpu/autotune.py",
                     "lighthouse_tpu/http_api",
@@ -209,20 +221,37 @@ class TestPassCoverage:
                     in pass_mod.SCAN_DIRS), pass_mod.PASS
 
     def test_baseline_only_shrinks(self):
-        """ISSUE 19 ratchet: the concurrency-debt baseline is a burn-down
-        list.  58 is the count after the telemetry-owned process-boundary
-        entries (blackbox + device_telemetry singletons, now routed
-        through the scope seam) and two wallclock reads (the injectable
-        deadline clock) burned down — PRs may shrink this bound, never
-        raise it.  New findings get fixed or pragma'd, not baselined."""
+        """ISSUE 19/20 ratchet: the concurrency-debt baseline is a
+        burn-down list.  51 is the count after the virtual-clock refactor
+        burned the entire wallclock section (the _pump_until and settle
+        deadline loops now read an injected clock) — PRs may shrink this
+        bound, never raise it.  New findings get fixed or pragma'd, not
+        baselined."""
         path = os.path.join(REPO_ROOT, "scripts", "analysis", "baseline.txt")
         with open(path, "r", encoding="utf-8") as f:
             entries = [ln for ln in f.read().splitlines()
                        if ln.strip() and not ln.startswith("#")]
-        assert len(entries) <= 58, (
-            f"baseline grew to {len(entries)} entries (ratchet is 58) — "
+        assert len(entries) <= 51, (
+            f"baseline grew to {len(entries)} entries (ratchet is 51) — "
             "fix or pragma the new finding instead of baselining it"
         )
+        # ISSUE 20: the wallclock section ratchets at ZERO — the scenario
+        # control path reads virtual time only, and no new wall-clock read
+        # may ever be baselined again
+        wallclock = [ln for ln in entries if ln.startswith("wallclock|")]
+        assert wallclock == [], (
+            "wallclock findings re-entered the baseline — the scenario "
+            f"control tree must stay on the virtual clock: {wallclock}"
+        )
+
+    def test_wallclock_pass_has_zero_findings(self):
+        """ISSUE 20 tentpole gate: scenarios.py and simulator.py carry no
+        wall-clock reads at all — not sanctioned, not pragma'd away by a
+        whole-file waiver, not baselined.  The pass itself returns clean on
+        the live tree."""
+        from analysis import wallclock_pass
+
+        assert wallclock_pass.run(REPO_ROOT) == []
 
     def test_lock_order_has_zero_findings(self):
         from analysis import lock_order_pass

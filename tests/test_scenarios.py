@@ -101,19 +101,30 @@ def test_failed_gate_still_writes_artifact(tmp_path):
 @pytest.mark.parametrize("name", sorted(SCENARIOS))
 def test_scenario_matrix_deterministic(name, tmp_path):
     """The full matrix, each scenario twice with one seed: both runs pass
-    their gates and produce identical final head roots (the acceptance
-    criterion: seeded faults => bit-for-bit reproducible chains)."""
-    results = []
+    their gates, produce identical final head roots, AND identical merged
+    fleet timelines (the ISSUE 20 acceptance criterion: seeded faults +
+    virtual time => bit-for-bit reproducible chains at any horizon —
+    ``long_horizon_soak`` makes this a 128-epoch byte-identity gate)."""
+    results, timelines = [], []
     for run_index in range(2):
         out = tmp_path / f"run{run_index}"
         artifact = run_scenario(name, seed=7, out_dir=str(out))
         assert artifact["passed"], f"{name} run {run_index} failed its gates"
         results.append(artifact["result"])
+        timelines.append(json.dumps(
+            artifact.get("fleet", {}).get("timeline", []), sort_keys=True))
     assert results[0]["head_root"] == results[1]["head_root"], (
         f"{name}: nondeterministic final head"
     )
     assert (results[0]["final_finalized_epoch"]
             == results[1]["final_finalized_epoch"])
+    # byte-identity on the cross-node event stream, not just the final
+    # head: any thread-scheduling leak into block content or delivery
+    # order shows up here first (volatile fields are already stripped by
+    # the fleet merge)
+    assert timelines[0] == timelines[1], (
+        f"{name}: fleet timelines diverged between identically-seeded runs"
+    )
 
 
 def test_byzantine_smoke_slashing_pipeline(tmp_path):

@@ -131,6 +131,47 @@ class TestBatching:
         assert singles == []
         assert processor.metrics.batch_items[W.GOSSIP_ATTESTATION_BATCH] == 1
 
+    def test_mixed_batch_shapeless_events_run_per_item(self, processor):
+        """A drained batch mixing full-shape events (process_batch + item)
+        with shapeless ones (process only, item=None — the shape the
+        reprocess queue's released parks used to carry) must run BOTH: the
+        shaped events through one batch call, the shapeless per-item.  The
+        old code fed every ``ev.item`` to the batch handler, so one
+        item=None poisoned the whole batch with an unpack TypeError that
+        the worker-panic handler swallowed — silently losing every
+        attestation in the batch (caught by the ISSUE 20 128-epoch soak as
+        nondeterministic block content)."""
+        gate = threading.Event()
+        started = threading.Event()
+        processor.send(gate_event(W.STATUS, gate, started))
+        assert started.wait(2.0)
+
+        batches = []
+        loose = []
+        for i in range(3):
+            processor.send(
+                WorkEvent(
+                    work_type=W.GOSSIP_ATTESTATION,
+                    process=lambda it: loose.append(("single", it)),
+                    process_batch=lambda items: batches.append(list(items)),
+                    item=i,
+                )
+            )
+        # the shapeless event, sandwiched into the same queue
+        processor.send(
+            WorkEvent(
+                work_type=W.GOSSIP_ATTESTATION,
+                process=lambda _=None: loose.append(("shapeless", None)),
+            )
+        )
+        gate.set()
+        assert processor.wait_idle(5.0)
+        assert batches == [[0, 1, 2]]
+        assert loose == [("shapeless", None)]
+        # nothing was dropped: every event completed through its own path
+        assert processor.metrics.processed[W.GOSSIP_ATTESTATION] == 4
+        assert W.GOSSIP_ATTESTATION not in processor.metrics.dropped
+
     def test_queue_depth_gauge_sampled(self, processor):
         """The manager mirrors queue lengths onto
         beacon_processor_queue_depth{work} (throttled sampling)."""
